@@ -1,0 +1,24 @@
+"""kube_scheduler_simulator_tpu — a TPU-native scheduling-simulation framework.
+
+A brand-new JAX/XLA implementation of the capabilities of
+`sigs.k8s.io/kube-scheduler-simulator` (reference surveyed in SURVEY.md): an
+in-memory simulated Kubernetes cluster whose per-pod Filter → Score →
+Normalize → Bind scheduling loop is re-expressed as a vectorized, batched
+constraint solve over the entire pending queue, with full per-plugin decision
+traces, a REST+SSE API compatible with the reference, and a KEP-140-style
+scenario / Monte-Carlo engine that shards thousands of cluster replicas and
+policy variants over a TPU mesh.
+
+Layout:
+  models/    typed object model, string vocabularies, columnar device state,
+             in-memory resource store (list/watch), snapshot import/export
+  sched/     scheduler configuration, plugin registry semantics, the pure
+             Python oracle scheduler, and the batched JAX engine
+  ops/       per-plugin filter/score kernels (jax.numpy / vmap / pallas)
+  parallel/  device mesh construction, shardings, Monte-Carlo sweeps
+  scenario/  KEP-140 scenario VM + deterministic controllers
+  server/    REST + SSE serving layer with the reference API surface
+  utils/     quantities, small helpers
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,15 @@
+from .vocab import Vocab
+from .objects import PodView, NodeView, pod_effective_requests
+from .store import ResourceStore, WatchEvent
+from .snapshot import export_snapshot, import_snapshot
+
+__all__ = [
+    "Vocab",
+    "PodView",
+    "NodeView",
+    "pod_effective_requests",
+    "ResourceStore",
+    "WatchEvent",
+    "export_snapshot",
+    "import_snapshot",
+]

@@ -1,0 +1,219 @@
+"""In-memory typed resource store — the simulator's "cluster state".
+
+Replaces the reference's etcd + embedded kube-apiserver pair (reference:
+simulator/k8sapiserver/k8sapiserver.go — a real apiserver over etcd) with a
+single-process typed store that preserves the semantics the rest of the
+framework needs: per-object resourceVersion, list/watch with replayable
+events (reference: simulator/resourcewatcher/resourcewatcher.go:61-120),
+server-side-apply-style upsert (reference CRUD services, e.g.
+simulator/pod/pod.go:45), cascading node deletion (reference:
+simulator/node/node.go:69-92), and a boot-time snapshot for reset
+(reference: simulator/reset/reset.go:32-55).
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import threading
+from dataclasses import dataclass
+from typing import Callable, Iterable
+
+# The seven watched kinds, in the reference's order
+# (resourcewatcher.go:22-30).
+KINDS = (
+    "pods",
+    "nodes",
+    "pvs",
+    "pvcs",
+    "storageclasses",
+    "priorityclasses",
+    "namespaces",
+)
+
+NAMESPACED = {"pods": True, "pvcs": True}
+
+
+class StaleResourceVersion(Exception):
+    """The requested resourceVersion predates the retained event log."""
+
+
+@dataclass(frozen=True)
+class WatchEvent:
+    event_type: str  # ADDED | MODIFIED | DELETED
+    kind: str
+    obj: dict
+    resource_version: int
+
+
+class ResourceStore:
+    """Typed collections with list/watch semantics."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._rv = itertools.count(1)
+        self._objs: dict[str, dict[str, dict]] = {k: {} for k in KINDS}
+        self._events: list[WatchEvent] = []
+        self._pruned_through = 0  # highest resourceVersion dropped from the log
+        self._subscribers: list[Callable[[WatchEvent], None]] = []
+        self._initial_snapshot: "dict | None" = None
+
+    # -- keys ---------------------------------------------------------------
+
+    @staticmethod
+    def key(kind: str, obj: dict) -> str:
+        meta = obj.get("metadata", {}) or {}
+        if NAMESPACED.get(kind):
+            return f"{meta.get('namespace', 'default')}/{meta.get('name', '')}"
+        return meta.get("name", "")
+
+    @staticmethod
+    def obj_key(kind: str, name: str, namespace: str = "default") -> str:
+        return f"{namespace}/{name}" if NAMESPACED.get(kind) else name
+
+    # -- CRUD ---------------------------------------------------------------
+
+    def apply(self, kind: str, obj: dict) -> dict:
+        """Upsert, bumping resourceVersion (server-side-apply semantics:
+        the provided manifest wins field-for-field, merged over existing)."""
+        if kind not in KINDS:
+            raise KeyError(f"unknown kind {kind}")
+        with self._lock:
+            obj = copy.deepcopy(obj)
+            if not (obj.get("metadata", {}) or {}).get("name"):
+                raise ValueError("object has no metadata.name")
+            k = self.key(kind, obj)
+            existing = self._objs[kind].get(k)
+            if existing is not None:
+                merged = _merge(copy.deepcopy(existing), obj)
+                event_type = "MODIFIED"
+            else:
+                merged = obj
+                event_type = "ADDED"
+            rv = next(self._rv)
+            meta = merged.setdefault("metadata", {})
+            meta["resourceVersion"] = str(rv)
+            meta.setdefault("uid", f"uid-{kind}-{k}-{rv}")
+            if NAMESPACED.get(kind):
+                meta.setdefault("namespace", "default")
+            self._objs[kind][k] = merged
+            self._emit(WatchEvent(event_type, kind, copy.deepcopy(merged), rv))
+            return copy.deepcopy(merged)
+
+    def get(self, kind: str, name: str, namespace: str = "default") -> "dict | None":
+        with self._lock:
+            obj = self._objs[kind].get(self.obj_key(kind, name, namespace))
+            return copy.deepcopy(obj) if obj is not None else None
+
+    def list(self, kind: str) -> list[dict]:
+        with self._lock:
+            return [copy.deepcopy(o) for o in self._objs[kind].values()]
+
+    def delete(self, kind: str, name: str, namespace: str = "default") -> bool:
+        with self._lock:
+            k = self.obj_key(kind, name, namespace)
+            obj = self._objs[kind].pop(k, None)
+            if obj is None:
+                return False
+            rv = next(self._rv)
+            self._emit(WatchEvent("DELETED", kind, copy.deepcopy(obj), rv))
+            if kind == "nodes":
+                # Cascade: deleting a node deletes the pods scheduled on it
+                # (reference: simulator/node/node.go:69-92).
+                doomed = [
+                    p
+                    for p in self._objs["pods"].values()
+                    if (p.get("spec", {}) or {}).get("nodeName") == name
+                ]
+                for p in doomed:
+                    meta = p.get("metadata", {})
+                    self.delete("pods", meta.get("name", ""), meta.get("namespace", "default"))
+            return True
+
+    # -- watch --------------------------------------------------------------
+
+    def subscribe(self, fn: Callable[[WatchEvent], None]):
+        with self._lock:
+            self._subscribers.append(fn)
+
+    def unsubscribe(self, fn: Callable[[WatchEvent], None]):
+        with self._lock:
+            if fn in self._subscribers:
+                self._subscribers.remove(fn)
+
+    def events_since(self, kind: str, last_rv: int) -> list[WatchEvent]:
+        """Events for `kind` after `last_rv`.
+
+        Raises StaleResourceVersion when `last_rv` predates the retained log
+        window — the analogue of a real apiserver's 410 Gone, telling the
+        watcher to relist instead of silently missing events.
+        """
+        with self._lock:
+            if last_rv < self._pruned_through:
+                raise StaleResourceVersion(
+                    f"resourceVersion {last_rv} is too old (oldest retained: "
+                    f"{self._pruned_through + 1}); relist required"
+                )
+            return [e for e in self._events if e.kind == kind and e.resource_version > last_rv]
+
+    def list_as_added(self, kind: str) -> list[WatchEvent]:
+        """Initial list replayed as ADDED events (resourcewatcher.go:94-105)."""
+        with self._lock:
+            return [
+                WatchEvent("ADDED", kind, copy.deepcopy(o), int(o["metadata"]["resourceVersion"]))
+                for o in self._objs[kind].values()
+            ]
+
+    def latest_rv(self) -> int:
+        with self._lock:
+            return self._events[-1].resource_version if self._events else 0
+
+    def _emit(self, ev: WatchEvent):
+        self._events.append(ev)
+        if len(self._events) > 100_000:
+            self._pruned_through = self._events[49_999].resource_version
+            del self._events[:50_000]
+        for fn in list(self._subscribers):
+            fn(ev)
+
+    # -- reset --------------------------------------------------------------
+
+    def snapshot_initial(self):
+        """Capture the current keyspace as the reset target
+        (reference: reset/reset.go:32-55 snapshots etcd at boot)."""
+        with self._lock:
+            self._initial_snapshot = {
+                kind: copy.deepcopy(objs) for kind, objs in self._objs.items()
+            }
+
+    def reset(self):
+        """Delete everything and restore the boot snapshot
+        (reference: reset/reset.go:57-84)."""
+        with self._lock:
+            for kind in KINDS:
+                for obj in list(self._objs[kind].values()):
+                    meta = obj.get("metadata", {})
+                    self.delete(kind, meta.get("name", ""), meta.get("namespace", "default"))
+            for kind, objs in (self._initial_snapshot or {}).items():
+                for obj in objs.values():
+                    self.apply(kind, copy.deepcopy(obj))
+
+    # -- convenience --------------------------------------------------------
+
+    def pods_on_node(self, node_name: str) -> list[dict]:
+        with self._lock:
+            return [
+                copy.deepcopy(p)
+                for p in self._objs["pods"].values()
+                if (p.get("spec", {}) or {}).get("nodeName") == node_name
+            ]
+
+
+def _merge(base: dict, patch: dict) -> dict:
+    """Structural merge: dicts merge recursively, everything else replaces."""
+    for k, v in patch.items():
+        if isinstance(v, dict) and isinstance(base.get(k), dict):
+            base[k] = _merge(base[k], v)
+        else:
+            base[k] = v
+    return base

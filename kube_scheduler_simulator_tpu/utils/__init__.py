@@ -1,0 +1,3 @@
+from .quantity import parse_quantity, format_quantity, Quantity
+
+__all__ = ["parse_quantity", "format_quantity", "Quantity"]

@@ -1,0 +1,13 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+Multi-chip hardware isn't available in CI; all sharding tests run over a
+virtual 8-device CPU mesh, which exercises the same pjit/shard_map
+partitioning XLA applies on a real TPU slice.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

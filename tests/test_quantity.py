@@ -1,0 +1,53 @@
+from fractions import Fraction
+
+import pytest
+
+from kube_scheduler_simulator_tpu.utils.quantity import parse_quantity, format_quantity
+
+
+@pytest.mark.parametrize(
+    "s,expected",
+    [
+        ("100m", Fraction(1, 10)),
+        ("1", Fraction(1)),
+        ("1.5", Fraction(3, 2)),
+        ("1Gi", Fraction(1024**3)),
+        ("512Mi", Fraction(512 * 1024**2)),
+        ("1Ki", Fraction(1024)),
+        ("2e3", Fraction(2000)),
+        ("1E2", Fraction(100)),
+        ("5k", Fraction(5000)),
+        ("3M", Fraction(3_000_000)),
+        ("250n", Fraction(250, 10**9)),
+        ("-2", Fraction(-2)),
+        ("+2", Fraction(2)),
+        (".5", Fraction(1, 2)),
+        ("0", Fraction(0)),
+    ],
+)
+def test_parse(s, expected):
+    assert parse_quantity(s).value == expected
+
+
+def test_milli_rounds_up():
+    assert parse_quantity("1n").milli == 1
+    assert parse_quantity("100m").milli == 100
+    assert parse_quantity("1").milli == 1000
+
+
+def test_units_round_up():
+    assert parse_quantity("100m").units == 1
+    assert parse_quantity("1Gi").units == 1024**3
+
+
+@pytest.mark.parametrize("bad", ["", "abc", "1Q", "--1", "1.2.3", "1 Gi"])
+def test_invalid(bad):
+    with pytest.raises(ValueError):
+        parse_quantity(bad)
+
+
+def test_format_roundtrip():
+    assert format_quantity(1024**3) == "1Gi"
+    assert format_quantity(2000) == "2k"
+    assert format_quantity(0) == "0"
+    assert format_quantity(1500) == "1500"

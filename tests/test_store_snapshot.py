@@ -1,0 +1,137 @@
+from kube_scheduler_simulator_tpu.models.store import ResourceStore
+from kube_scheduler_simulator_tpu.models.snapshot import export_snapshot, import_snapshot
+from kube_scheduler_simulator_tpu.models.objects import PodView, NodeView, pod_effective_requests
+from fractions import Fraction
+
+
+def make_pod(name, node=None, ns="default", cpu="100m", mem="128Mi"):
+    pod = {
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {
+            "containers": [
+                {"name": "c", "resources": {"requests": {"cpu": cpu, "memory": mem}}}
+            ]
+        },
+    }
+    if node:
+        pod["spec"]["nodeName"] = node
+    return pod
+
+
+def make_node(name, cpu="4", mem="8Gi", pods="110"):
+    return {
+        "metadata": {"name": name},
+        "status": {"allocatable": {"cpu": cpu, "memory": mem, "pods": pods}},
+    }
+
+
+def test_apply_get_list_delete():
+    s = ResourceStore()
+    s.apply("pods", make_pod("p1"))
+    s.apply("nodes", make_node("n1"))
+    assert s.get("pods", "p1") is not None
+    assert s.get("pods", "p1")["metadata"]["resourceVersion"] == "1"
+    s.apply("pods", make_pod("p1"))  # modify bumps rv
+    assert s.get("pods", "p1")["metadata"]["resourceVersion"] == "3"
+    assert len(s.list("pods")) == 1
+    assert s.delete("pods", "p1")
+    assert s.get("pods", "p1") is None
+
+
+def test_node_delete_cascades_pods():
+    s = ResourceStore()
+    s.apply("nodes", make_node("n1"))
+    s.apply("pods", make_pod("p1", node="n1"))
+    s.apply("pods", make_pod("p2", node="n2"))
+    s.delete("nodes", "n1")
+    assert s.get("pods", "p1") is None
+    assert s.get("pods", "p2") is not None
+
+
+def test_watch_events():
+    s = ResourceStore()
+    seen = []
+    s.subscribe(lambda e: seen.append((e.event_type, e.kind)))
+    s.apply("pods", make_pod("p1"))
+    s.apply("pods", make_pod("p1"))
+    s.delete("pods", "p1")
+    assert seen == [("ADDED", "pods"), ("MODIFIED", "pods"), ("DELETED", "pods")]
+    added = s.list_as_added("pods")
+    assert added == []
+
+
+def test_reset_restores_boot_snapshot():
+    s = ResourceStore()
+    s.apply("nodes", make_node("boot-node"))
+    s.snapshot_initial()
+    s.apply("pods", make_pod("later-pod"))
+    s.delete("nodes", "boot-node")
+    s.reset()
+    assert s.get("nodes", "boot-node") is not None
+    assert s.get("pods", "later-pod") is None
+
+
+def test_export_import_roundtrip():
+    s = ResourceStore()
+    s.apply("namespaces", {"metadata": {"name": "team-a"}})
+    s.apply("namespaces", {"metadata": {"name": "kube-system"}})
+    s.apply("priorityclasses", {"metadata": {"name": "high"}, "value": 1000})
+    s.apply("priorityclasses", {"metadata": {"name": "system-node-critical"}, "value": 2e9})
+    s.apply("nodes", make_node("n1"))
+    s.apply("pods", make_pod("p1", ns="team-a"))
+    s.apply("pvcs", {"metadata": {"name": "claim1", "namespace": "team-a"}, "spec": {}})
+    s.apply(
+        "pvs",
+        {
+            "metadata": {"name": "pv1"},
+            "spec": {"claimRef": {"name": "claim1", "namespace": "team-a", "uid": "stale"}},
+        },
+    )
+    snap = export_snapshot(s, {"kind": "KubeSchedulerConfiguration"})
+    # system objects filtered
+    assert [o["metadata"]["name"] for o in snap["namespaces"]] == ["team-a"]
+    assert [o["metadata"]["name"] for o in snap["priorityClasses"]] == ["high"]
+    assert snap["schedulerConfig"]["kind"] == "KubeSchedulerConfiguration"
+    # metadata stripped
+    assert "resourceVersion" not in snap["pods"][0]["metadata"]
+
+    s2 = ResourceStore()
+    cfg, errs = import_snapshot(s2, snap)
+    assert errs == []
+    assert cfg["kind"] == "KubeSchedulerConfiguration"
+    assert s2.get("pods", "p1", "team-a") is not None
+    pv = s2.get("pvs", "pv1")
+    pvc = s2.get("pvcs", "claim1", "team-a")
+    assert pv["spec"]["claimRef"]["uid"] == pvc["metadata"]["uid"]
+
+
+def test_pod_views_and_requests():
+    pod = {
+        "metadata": {"name": "p", "labels": {"app": "web"}},
+        "spec": {
+            "nodeName": "n1",
+            "containers": [
+                {"name": "a", "resources": {"requests": {"cpu": "200m", "memory": "1Gi"}}},
+                {"name": "b", "resources": {"requests": {"cpu": "300m"}}},
+            ],
+            "initContainers": [
+                {"name": "i", "resources": {"requests": {"cpu": "1", "memory": "64Mi"}}}
+            ],
+            "overhead": {"cpu": "10m"},
+        },
+    }
+    req = pod_effective_requests(pod)
+    # max(sum(containers)=500m, init=1) + overhead 10m = 1.01 cores
+    assert req["cpu"] == Fraction(101, 100)
+    assert req["memory"] == Fraction(1024**3)
+    v = PodView(pod)
+    assert v.node_name == "n1"
+    assert v.labels == {"app": "web"}
+    assert v.num_containers == 2
+
+
+def test_node_view():
+    n = NodeView(make_node("n1", cpu="4", mem="8Gi"))
+    assert n.allocatable["cpu"] == 4
+    assert n.allocatable["memory"] == Fraction(8 * 1024**3)
+    assert not n.unschedulable
