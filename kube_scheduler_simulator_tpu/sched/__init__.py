@@ -1,0 +1,17 @@
+from .config import (
+    SchedulerConfiguration,
+    default_plugins,
+    default_plugin_config,
+    merge_plugin_set,
+    convert_plugins_for_simulator,
+    new_plugin_config,
+)
+
+__all__ = [
+    "SchedulerConfiguration",
+    "default_plugins",
+    "default_plugin_config",
+    "merge_plugin_set",
+    "convert_plugins_for_simulator",
+    "new_plugin_config",
+]

@@ -1,0 +1,305 @@
+"""The oracle scheduler: a pure-Python, per-pod re-implementation of the
+upstream kube-scheduler v1.26 framework semantics.
+
+This is the parity target and test oracle for the batched TPU engine
+(SURVEY.md §7 M1): it schedules one pod at a time through
+PreFilter → Filter → PostFilter(preemption) → PreScore → Score →
+NormalizeScore → weight → select → Reserve/Bind, exactly as the reference
+drives the vendored upstream scheduler (reference call stack:
+SURVEY.md §3.3; simulator/scheduler/plugin/wrappedplugin.go records each
+phase, which is what `PodSchedulingResult` captures here).
+
+Determinism policy (documented divergence from upstream):
+  * upstream `selectHost` picks uniformly among max-score nodes; the oracle
+    (and the TPU engine) picks the lowest node index — parity is defined
+    modulo this tie-break;
+  * `percentageOfNodesToScore` sampling is not applied: all feasible nodes
+    are scored (equivalent to 100), which is the deterministic superset.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from fractions import Fraction
+from typing import Any, Callable
+
+from ..models.objects import (
+    NodeView,
+    PodView,
+    pod_effective_requests,
+    pod_scoring_requests,
+)
+from .config import MAX_NODE_SCORE, SchedulerConfiguration
+from .resources import to_int_resources
+from .results import PASSED_FILTER_MESSAGE, SUCCESS_MESSAGE, PodSchedulingResult
+from . import oracle_plugins as plugins_mod
+
+
+class NodeInfo:
+    """Mutable per-node accounting, mirroring upstream framework.NodeInfo:
+    `requested` (actual requests, used by Filter) and `nonzero_requested`
+    (with the 100m/200MB scoring defaults, used by Score)."""
+
+    def __init__(self, node: dict):
+        self.node = NodeView(node)
+        self.pods: list[PodView] = []
+        self.requested: dict[str, int] = {}
+        self.nonzero_requested: dict[str, int] = {}
+        self.allocatable: dict[str, int] = to_int_resources(self.node.allocatable)
+
+    def add_pod(self, pod: dict):
+        pv = PodView(pod)
+        self.pods.append(pv)
+        for name, v in to_int_resources(pod_effective_requests(pod)).items():
+            self.requested[name] = self.requested.get(name, 0) + v
+        for name, v in to_int_resources(pod_scoring_requests(pod)).items():
+            self.nonzero_requested[name] = self.nonzero_requested.get(name, 0) + v
+
+    def remove_pod(self, namespace: str, name: str) -> bool:
+        for i, pv in enumerate(self.pods):
+            if pv.name == name and pv.namespace == namespace:
+                pod = self.pods.pop(i).obj
+                for rname, v in to_int_resources(pod_effective_requests(pod)).items():
+                    self.requested[rname] = self.requested.get(rname, 0) - v
+                for rname, v in to_int_resources(pod_scoring_requests(pod)).items():
+                    self.nonzero_requested[rname] = self.nonzero_requested.get(rname, 0) - v
+                return True
+        return False
+
+    def used_host_ports(self) -> list[tuple[str, str, int]]:
+        out = []
+        for pv in self.pods:
+            out.extend(pv.host_ports)
+        return out
+
+
+@dataclass
+class ClusterSnapshot:
+    """Indexed view of every object the plugins consult."""
+
+    nodes: dict[str, NodeInfo] = field(default_factory=dict)
+    pvcs: dict[str, dict] = field(default_factory=dict)  # ns/name → obj
+    pvs: dict[str, dict] = field(default_factory=dict)
+    storageclasses: dict[str, dict] = field(default_factory=dict)
+    priorityclasses: dict[str, dict] = field(default_factory=dict)
+    namespaces: dict[str, dict] = field(default_factory=dict)
+
+    def node_list(self) -> list[NodeInfo]:
+        return list(self.nodes.values())
+
+    def all_pods(self) -> list[PodView]:
+        return [p for ni in self.nodes.values() for p in ni.pods]
+
+    def pod_priority(self, pod: PodView) -> int:
+        if pod.priority is not None:
+            return int(pod.priority)
+        pc_name = pod.priority_class_name
+        if pc_name and pc_name in self.priorityclasses:
+            return int(self.priorityclasses[pc_name].get("value", 0))
+        for pc in self.priorityclasses.values():
+            if pc.get("globalDefault"):
+                return int(pc.get("value", 0))
+        return 0
+
+
+class CycleContext:
+    """Per-scheduling-cycle state (upstream CycleState): plugin-keyed cache
+    plus the cluster snapshot and resolved plugin args."""
+
+    def __init__(self, snapshot: ClusterSnapshot, config: SchedulerConfiguration):
+        self.snapshot = snapshot
+        self.config = config
+        self.state: dict[str, Any] = {}
+
+    def args(self, plugin: str) -> dict:
+        return self.config.plugin_args(plugin)
+
+
+class Oracle:
+    """Sequential scheduler over a ClusterSnapshot."""
+
+    def __init__(
+        self,
+        nodes: list[dict],
+        pods: list[dict],
+        config: "SchedulerConfiguration | None" = None,
+        pvcs: "list[dict] | None" = None,
+        pvs: "list[dict] | None" = None,
+        storageclasses: "list[dict] | None" = None,
+        priorityclasses: "list[dict] | None" = None,
+        namespaces: "list[dict] | None" = None,
+    ):
+        self.config = config or SchedulerConfiguration.default()
+        self.snapshot = ClusterSnapshot()
+        for n in nodes:
+            self.snapshot.nodes[NodeView(n).name] = NodeInfo(n)
+        for obj, store_ in (
+            (pvcs, self.snapshot.pvcs),
+            (pvs, self.snapshot.pvs),
+            (storageclasses, self.snapshot.storageclasses),
+            (priorityclasses, self.snapshot.priorityclasses),
+            (namespaces, self.snapshot.namespaces),
+        ):
+            for o in obj or []:
+                meta = o.get("metadata", {})
+                if store_ is self.snapshot.pvcs:
+                    store_[f"{meta.get('namespace', 'default')}/{meta['name']}"] = o
+                else:
+                    store_[meta["name"]] = o
+        self.pending: list[dict] = []
+        for p in pods:
+            pv = PodView(p)
+            if pv.node_name and pv.node_name in self.snapshot.nodes:
+                self.snapshot.nodes[pv.node_name].add_pod(p)
+            else:
+                self.pending.append(p)
+        # plugin dispatch tables from the resolved configuration
+        self._filter_names = [
+            n for n in self.config.enabled("filter") if n in plugins_mod.FILTER_PLUGINS
+        ]
+        self._prefilter_names = [
+            n for n in self.config.enabled("preFilter") if n in plugins_mod.PREFILTER_PLUGINS
+        ]
+        self._prescore_names = [
+            n for n in self.config.enabled("preScore") if n in plugins_mod.PRESCORE_PLUGINS
+        ]
+        self._score_plugins = [
+            (n, w) for n, w in self.config.score_plugins() if n in plugins_mod.SCORE_PLUGINS
+        ]
+        self._postfilter_names = [
+            n for n in self.config.enabled("postFilter") if n in plugins_mod.POSTFILTER_PLUGINS
+        ]
+
+    # -- queue ordering (PrioritySort: priority desc, FIFO among equal) -----
+
+    def _sorted_queue(self) -> list[dict]:
+        indexed = list(enumerate(self.pending))
+        indexed.sort(
+            key=lambda t: (-self.snapshot.pod_priority(PodView(t[1])), t[0])
+        )
+        return [p for _, p in indexed]
+
+    # -- one cycle ----------------------------------------------------------
+
+    def schedule_one(self, pod: dict) -> PodSchedulingResult:
+        pv = PodView(pod)
+        res = PodSchedulingResult(pod_namespace=pv.namespace, pod_name=pv.name)
+        ctx = CycleContext(self.snapshot, self.config)
+        nodes = self.snapshot.node_list()
+
+        # PreFilter
+        failed_prefilter = None
+        for name in self._prefilter_names:
+            status = plugins_mod.PREFILTER_PLUGINS[name](ctx, pv)
+            res.pre_filter_status[name] = status or SUCCESS_MESSAGE
+            if status is not None and failed_prefilter is None:
+                failed_prefilter = (name, status)
+        if failed_prefilter is not None:
+            res.status = "Unschedulable"
+            return res
+
+        # Filter: every plugin in order per node, stop at first failure
+        feasible: list[NodeInfo] = []
+        for ni in nodes:
+            ok = True
+            for name in self._filter_names:
+                reason = plugins_mod.FILTER_PLUGINS[name](ctx, pv, ni)
+                res.add_filter(ni.node.name, name, reason or PASSED_FILTER_MESSAGE)
+                if reason is not None:
+                    ok = False
+                    break
+            if ok:
+                feasible.append(ni)
+
+        if not feasible:
+            res.status = "Unschedulable"
+            self._run_post_filter(ctx, pv, res)
+            return res
+
+        # PreScore
+        for name in self._prescore_names:
+            status = plugins_mod.PRESCORE_PLUGINS[name](ctx, pv, feasible)
+            res.pre_score[name] = status or SUCCESS_MESSAGE
+
+        # Score → Normalize → weight
+        weighted_total: dict[str, int] = {ni.node.name: 0 for ni in feasible}
+        for name, weight in self._score_plugins:
+            score_fn, normalize_fn = plugins_mod.SCORE_PLUGINS[name]
+            raw: dict[str, int] = {}
+            for ni in feasible:
+                raw[ni.node.name] = score_fn(ctx, pv, ni)
+                res.add_score(ni.node.name, name, raw[ni.node.name])
+            normalized = normalize_fn(ctx, pv, raw) if normalize_fn else raw
+            for node_name, s in normalized.items():
+                final = s * weight  # resultstore.applyWeightOnScore (store.go:499-502)
+                res.add_final_score(node_name, name, final)
+                weighted_total[node_name] += final
+
+        # select: max total, lowest node index tie-break (deterministic)
+        order = {ni.node.name: i for i, ni in enumerate(nodes)}
+        best = min(
+            weighted_total.items(), key=lambda kv: (-kv[1], order[kv[0]])
+        )[0]
+        res.selected_node = best
+        res.status = "Scheduled"
+        res.reserve["VolumeBinding"] = SUCCESS_MESSAGE
+        res.prebind["VolumeBinding"] = SUCCESS_MESSAGE
+        res.bind["DefaultBinder"] = SUCCESS_MESSAGE
+        return res
+
+    def _run_post_filter(self, ctx: CycleContext, pv: PodView, res: PodSchedulingResult):
+        for name in self._postfilter_names:
+            nominated, victims, msgs = plugins_mod.POSTFILTER_PLUGINS[name](
+                ctx, pv, res, self
+            )
+            for node_name, msg in msgs.items():
+                res.post_filter.setdefault(node_name, {})[name] = msg
+            if nominated:
+                res.status = "Nominated"
+                res.nominated_node = nominated
+                res.preemption_victims = victims
+                return
+
+    # -- full run -----------------------------------------------------------
+
+    def bind(self, pod: dict, node_name: str):
+        pod = dict(pod)
+        pod.setdefault("spec", {})
+        pod["spec"] = dict(pod["spec"], nodeName=node_name)
+        self.snapshot.nodes[node_name].add_pod(pod)
+
+    def evict(self, namespace: str, name: str):
+        for ni in self.snapshot.nodes.values():
+            if ni.remove_pod(namespace, name):
+                return
+
+    def schedule_all(self, max_rounds: "int | None" = None) -> list[PodSchedulingResult]:
+        """Drain the pending queue; preemption victims are evicted and the
+        preemptor retried. Returns one result per scheduling attempt that
+        concluded (Scheduled or terminally Unschedulable)."""
+        results: list[PodSchedulingResult] = []
+        rounds = 0
+        cap = max_rounds if max_rounds is not None else 2 * len(self.pending) + 10
+        queue = self._sorted_queue()
+        self.pending = []
+        retried: set[str] = set()
+        while queue and rounds < cap:
+            rounds += 1
+            pod = queue.pop(0)
+            pv = PodView(pod)
+            res = self.schedule_one(pod)
+            if res.status == "Scheduled":
+                self.bind(pod, res.selected_node)
+                results.append(res)
+            elif res.status == "Nominated" and f"{pv.namespace}/{pv.name}" not in retried:
+                for victim in res.preemption_victims:
+                    ns, vname = victim.split("/", 1)
+                    self.evict(ns, vname)
+                retried.add(f"{pv.namespace}/{pv.name}")
+                queue.insert(0, pod)
+                results.append(res)
+            else:
+                res.status = "Unschedulable"
+                results.append(res)
+        return results

@@ -1,0 +1,993 @@
+"""Upstream v1.26 scheduler plugin semantics, re-implemented per-pod.
+
+Each plugin is a set of pure functions over (CycleContext, PodView,
+NodeInfo). The enumerated plugin set is pinned by the reference's golden
+test (simulator/scheduler/plugin/plugins_test.go:852-884); the semantics are
+re-derived from the upstream kube-scheduler v1.26 behavior the reference
+vendors (SURVEY.md §2 #14). The TPU kernels in ops/ are property-tested
+against these functions.
+
+Filter functions return None on pass, or the failure reason string (the
+message the reference shows in its filter-result annotation). Score
+functions return raw scores; normalize functions apply each plugin's
+NormalizeScore pass. DefaultNormalizeScore here mirrors the upstream helper
+(max-scaling to [0,100], optionally reversed).
+"""
+
+from __future__ import annotations
+
+import math
+from fractions import Fraction
+from typing import TYPE_CHECKING, Callable
+
+from ..models.objects import (
+    NodeView,
+    PodView,
+    match_label_selector,
+    match_node_selector_terms,
+    pod_scoring_requests,
+    tolerations_tolerate_taint,
+)
+
+if TYPE_CHECKING:  # pragma: no cover
+    from .oracle import CycleContext, NodeInfo, Oracle
+    from .results import PodSchedulingResult
+
+from .config import MAX_NODE_SCORE
+from .resources import to_int_resources
+
+
+# ---------------------------------------------------------------------------
+# shared helpers
+# ---------------------------------------------------------------------------
+
+def default_normalize_score(
+    raw: dict[str, int], reverse: bool = False, max_priority: int = MAX_NODE_SCORE
+) -> dict[str, int]:
+    """Upstream helper.DefaultNormalizeScore: scale by the max to
+    [0, max_priority]; if reverse, flip (used by TaintToleration)."""
+    max_count = max(raw.values(), default=0)
+    if max_count == 0:
+        if reverse:
+            return {k: max_priority for k in raw}
+        return dict(raw)
+    out = {}
+    for k, score in raw.items():
+        s = max_priority * score // max_count
+        if reverse:
+            s = max_priority - s
+        out[k] = s
+    return out
+
+
+def _pod_fit_resources(pod: PodView) -> dict[str, int]:
+    return to_int_resources(pod.requests)
+
+
+def _namespaces_for_term(term: dict, owner_ns: str, snapshot) -> "set[str] | None":
+    """Resolve an affinity term's namespace set. None means "all namespaces"
+    (a present-but-empty namespaceSelector). Defaults to the owner pod's
+    namespace when neither namespaces nor namespaceSelector is given."""
+    namespaces = set(term.get("namespaces") or [])
+    ns_selector = term.get("namespaceSelector")
+    if ns_selector is not None:
+        if ns_selector == {} or (
+            not ns_selector.get("matchLabels") and not ns_selector.get("matchExpressions")
+        ):
+            return None  # empty selector matches every namespace
+        for ns_name, ns_obj in snapshot.namespaces.items():
+            labels = (ns_obj.get("metadata", {}) or {}).get("labels") or {}
+            if match_label_selector(ns_selector, labels):
+                namespaces.add(ns_name)
+    if not namespaces and ns_selector is None:
+        namespaces = {owner_ns}
+    return namespaces
+
+
+def _term_matches_pod(term: dict, owner_ns: str, other: PodView, snapshot) -> bool:
+    """Does an affinity term (owned by a pod in owner_ns) select `other`?"""
+    ns = _namespaces_for_term(term, owner_ns, snapshot)
+    if ns is not None and other.namespace not in ns:
+        return False
+    return match_label_selector(term.get("labelSelector"), other.labels)
+
+
+# ---------------------------------------------------------------------------
+# NodeResourcesFit
+# ---------------------------------------------------------------------------
+
+def fit_pre_filter(ctx: "CycleContext", pod: PodView) -> "str | None":
+    ctx.state["fit.requests"] = _pod_fit_resources(pod)
+    return None
+
+
+def fit_filter(ctx: "CycleContext", pod: PodView, ni: "NodeInfo") -> "str | None":
+    req = ctx.state.get("fit.requests")
+    if req is None:
+        req = _pod_fit_resources(pod)
+    allowed_pods = ni.allocatable.get("pods", 0)
+    if len(ni.pods) + 1 > allowed_pods:
+        return "Too many pods"
+    for name, v in req.items():
+        if v == 0:
+            continue
+        free = ni.allocatable.get(name, 0) - ni.requested.get(name, 0)
+        if v > free:
+            return f"Insufficient {name}"
+    return None
+
+
+def fit_score(ctx: "CycleContext", pod: PodView, ni: "NodeInfo") -> int:
+    """ScoringStrategy LeastAllocated (the default): per configured resource,
+    ((allocatable - requested) * 100) / allocatable, weight-averaged.
+    Requested includes existing pods' non-zero requests plus this pod's."""
+    args = ctx.args("NodeResourcesFit")
+    strategy = (args.get("scoringStrategy") or {})
+    resources = strategy.get("resources") or [
+        {"name": "cpu", "weight": 1},
+        {"name": "memory", "weight": 1},
+    ]
+    stype = strategy.get("type", "LeastAllocated")
+    pod_req = to_int_resources(pod_scoring_requests(pod.obj))
+    score_sum = 0
+    weight_sum = 0
+    for spec in resources:
+        rname, weight = spec["name"], int(spec.get("weight", 1))
+        requested = ni.nonzero_requested.get(rname, 0) + pod_req.get(rname, 0)
+        capacity = ni.allocatable.get(rname, 0)
+        if capacity == 0 or requested > capacity:
+            r_score = 0
+        elif stype == "MostAllocated":
+            r_score = requested * MAX_NODE_SCORE // capacity
+        else:  # LeastAllocated
+            r_score = (capacity - requested) * MAX_NODE_SCORE // capacity
+        score_sum += r_score * weight
+        weight_sum += weight
+    return score_sum // weight_sum if weight_sum else 0
+
+
+# ---------------------------------------------------------------------------
+# NodeResourcesBalancedAllocation
+# ---------------------------------------------------------------------------
+
+def balanced_allocation_score(ctx: "CycleContext", pod: PodView, ni: "NodeInfo") -> int:
+    """score = (1 - std(fractions)) * 100, fractions capped at 1; for two
+    resources std = |f0 - f1| / 2 (upstream balancedResourceScorer)."""
+    args = ctx.args("NodeResourcesBalancedAllocation")
+    resources = args.get("resources") or [
+        {"name": "cpu", "weight": 1},
+        {"name": "memory", "weight": 1},
+    ]
+    pod_req = to_int_resources(pod_scoring_requests(pod.obj))
+    fractions: list[float] = []
+    for spec in resources:
+        rname = spec["name"]
+        capacity = ni.allocatable.get(rname, 0)
+        if capacity == 0:
+            continue
+        requested = ni.nonzero_requested.get(rname, 0) + pod_req.get(rname, 0)
+        f = requested / capacity
+        fractions.append(min(f, 1.0))
+    if len(fractions) == 2:
+        std = abs(fractions[0] - fractions[1]) / 2
+    elif len(fractions) > 2:
+        mean = sum(fractions) / len(fractions)
+        std = math.sqrt(sum((f - mean) ** 2 for f in fractions) / len(fractions))
+    else:
+        std = 0.0
+    return int((1 - std) * MAX_NODE_SCORE)
+
+
+# ---------------------------------------------------------------------------
+# NodeName / NodeUnschedulable
+# ---------------------------------------------------------------------------
+
+def node_name_filter(ctx: "CycleContext", pod: PodView, ni: "NodeInfo") -> "str | None":
+    if pod.node_name and pod.node_name != ni.node.name:
+        return "node(s) didn't match the requested node name"
+    return None
+
+
+def node_unschedulable_filter(ctx, pod: PodView, ni: "NodeInfo") -> "str | None":
+    if not ni.node.unschedulable:
+        return None
+    tolerated = tolerations_tolerate_taint(
+        pod.tolerations,
+        {"key": "node.kubernetes.io/unschedulable", "effect": "NoSchedule"},
+    )
+    if tolerated:
+        return None
+    return "node(s) were unschedulable"
+
+
+# ---------------------------------------------------------------------------
+# TaintToleration
+# ---------------------------------------------------------------------------
+
+def taint_toleration_filter(ctx, pod: PodView, ni: "NodeInfo") -> "str | None":
+    for taint in ni.node.taints:
+        if taint.get("effect") not in ("NoSchedule", "NoExecute"):
+            continue
+        if not tolerations_tolerate_taint(pod.tolerations, taint):
+            return (
+                "node(s) had untolerated taint "
+                f"{{{taint.get('key', '')}: {taint.get('value', '')}}}"
+            )
+    return None
+
+
+def taint_toleration_score(ctx, pod: PodView, ni: "NodeInfo") -> int:
+    """Raw score = count of intolerable PreferNoSchedule taints."""
+    count = 0
+    for taint in ni.node.taints:
+        if taint.get("effect") != "PreferNoSchedule":
+            continue
+        if not tolerations_tolerate_taint(pod.tolerations, taint):
+            count += 1
+    return count
+
+
+def taint_toleration_normalize(ctx, pod: PodView, raw: dict[str, int]) -> dict[str, int]:
+    return default_normalize_score(raw, reverse=True)
+
+
+# ---------------------------------------------------------------------------
+# NodeAffinity
+# ---------------------------------------------------------------------------
+
+def node_affinity_filter(ctx, pod: PodView, ni: "NodeInfo") -> "str | None":
+    node = ni.node
+    selector = pod.node_selector
+    if selector:
+        if any(node.labels.get(k) != v for k, v in selector.items()):
+            return "node(s) didn't match Pod's node affinity/selector"
+    required = (
+        pod.node_affinity.get("requiredDuringSchedulingIgnoredDuringExecution") or {}
+    )
+    terms = required.get("nodeSelectorTerms") or []
+    if terms and not match_node_selector_terms(terms, node):
+        return "node(s) didn't match Pod's node affinity/selector"
+    return None
+
+
+def node_affinity_score(ctx, pod: PodView, ni: "NodeInfo") -> int:
+    """Sum of weights of matching preferred terms."""
+    total = 0
+    preferred = (
+        pod.node_affinity.get("preferredDuringSchedulingIgnoredDuringExecution") or []
+    )
+    for pref in preferred:
+        term = pref.get("preference") or {}
+        weight = int(pref.get("weight", 0))
+        if match_node_selector_terms([term], ni.node):
+            total += weight
+    return total
+
+
+def node_affinity_normalize(ctx, pod: PodView, raw: dict[str, int]) -> dict[str, int]:
+    return default_normalize_score(raw, reverse=False)
+
+
+# ---------------------------------------------------------------------------
+# NodePorts
+# ---------------------------------------------------------------------------
+
+def _ports_conflict(a: tuple[str, str, int], b: tuple[str, str, int]) -> bool:
+    proto_a, ip_a, port_a = a
+    proto_b, ip_b, port_b = b
+    if port_a != port_b or proto_a != proto_b:
+        return False
+    return ip_a == ip_b or ip_a == "0.0.0.0" or ip_b == "0.0.0.0"
+
+
+def node_ports_pre_filter(ctx: "CycleContext", pod: PodView) -> "str | None":
+    ctx.state["ports.want"] = pod.host_ports
+    return None
+
+
+def node_ports_filter(ctx, pod: PodView, ni: "NodeInfo") -> "str | None":
+    want = ctx.state.get("ports.want")
+    if want is None:
+        want = pod.host_ports
+    if not want:
+        return None
+    used = ni.used_host_ports()
+    for w in want:
+        if any(_ports_conflict(w, u) for u in used):
+            return "node(s) didn't have free ports for the requested pod ports"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# PodTopologySpread
+# ---------------------------------------------------------------------------
+
+_SYSTEM_DEFAULT_CONSTRAINTS = [
+    {"maxSkew": 3, "topologyKey": "topology.kubernetes.io/zone", "whenUnsatisfiable": "ScheduleAnyway"},
+    {"maxSkew": 5, "topologyKey": "kubernetes.io/hostname", "whenUnsatisfiable": "ScheduleAnyway"},
+]
+
+
+def _spread_constraints(ctx, pod: PodView, when: str) -> list[dict]:
+    explicit = [
+        c
+        for c in pod.topology_spread_constraints
+        if (c.get("whenUnsatisfiable") or "DoNotSchedule") == when
+    ]
+    if pod.topology_spread_constraints:
+        return explicit
+    # System defaulting (PodTopologySpreadArgs.defaultingType=System): two
+    # ScheduleAnyway constraints whose selector is derived from the pod's
+    # owning services/controllers. The simulator's store has no Service
+    # kind (same as the reference's 7 watched kinds), so the derived
+    # selector matches nothing — defaults contribute uniformly to scores.
+    args = ctx.args("PodTopologySpread")
+    if args.get("defaultingType", "System") == "System":
+        return [c for c in _SYSTEM_DEFAULT_CONSTRAINTS if c["whenUnsatisfiable"] == when]
+    return [c for c in (args.get("defaultConstraints") or []) if (c.get("whenUnsatisfiable") or "DoNotSchedule") == when]
+
+
+def _node_eligible_for_spread(pod: PodView, ni: "NodeInfo") -> bool:
+    """Nodes counted for min-match: must satisfy the pod's nodeSelector and
+    required node affinity (upstream requiredNodeAffinity in PreFilter)."""
+    return node_affinity_filter(None, pod, ni) is None
+
+
+def _count_matching_pods(ni: "NodeInfo", selector: "dict | None", namespace: str, self_labels_match=None) -> int:
+    if selector is None:
+        return 0
+    count = 0
+    for p in ni.pods:
+        if p.namespace != namespace:
+            continue
+        if p.obj.get("metadata", {}).get("deletionTimestamp"):
+            continue
+        if match_label_selector(selector, p.labels):
+            count += 1
+    return count
+
+
+def spread_pre_filter(ctx: "CycleContext", pod: PodView) -> "str | None":
+    constraints = _spread_constraints(ctx, pod, "DoNotSchedule")
+    state: dict = {"constraints": constraints, "counts": {}, "mins": {}}
+    ctx.state["spread.filter"] = state
+    if not constraints:
+        return None
+    nodes = ctx.snapshot.node_list()
+    for c in constraints:
+        key = c["topologyKey"]
+        sel = c.get("labelSelector")
+        counts: dict[str, int] = {}
+        for ni in nodes:
+            if not _node_eligible_for_spread(pod, ni):
+                continue
+            if key not in ni.node.labels:
+                continue
+            # all constraint keys must be present for min-candidate nodes
+            if any(c2["topologyKey"] not in ni.node.labels for c2 in constraints):
+                continue
+            val = ni.node.labels[key]
+            counts[val] = counts.get(val, 0) + _count_matching_pods(ni, sel, pod.namespace)
+        state["counts"][key] = counts
+        state["mins"][key] = min(counts.values()) if counts else 0
+    return None
+
+
+def spread_filter(ctx, pod: PodView, ni: "NodeInfo") -> "str | None":
+    state = ctx.state.get("spread.filter")
+    if state is None:
+        spread_pre_filter(ctx, pod)
+        state = ctx.state["spread.filter"]
+    constraints = state["constraints"]
+    if not constraints:
+        return None
+    for c in constraints:
+        key = c["topologyKey"]
+        if key not in ni.node.labels:
+            return "node(s) didn't match pod topology spread constraints (missing required label)"
+        val = ni.node.labels[key]
+        match_num = state["counts"][key].get(val, 0)
+        self_match = 1 if match_label_selector(c.get("labelSelector"), pod.labels) else 0
+        skew = match_num + self_match - state["mins"][key]
+        if skew > int(c.get("maxSkew", 1)):
+            return "node(s) didn't match pod topology spread constraints"
+    return None
+
+
+def spread_pre_score(ctx: "CycleContext", pod: PodView, feasible: list) -> "str | None":
+    constraints = _spread_constraints(ctx, pod, "ScheduleAnyway")
+    state: dict = {"constraints": constraints, "ignored": set(), "counts": {}, "weights": []}
+    ctx.state["spread.score"] = state
+    if not constraints:
+        return None
+    # requireAllTopologies: true when the pod carries explicit constraints
+    require_all = bool(pod.topology_spread_constraints)
+    topo_size = [0] * len(constraints)
+    eligible_pairs: list[dict[str, int]] = [dict() for _ in constraints]
+    for ni in feasible:
+        if require_all and any(
+            c["topologyKey"] not in ni.node.labels for c in constraints
+        ):
+            state["ignored"].add(ni.node.name)
+            continue
+        for i, c in enumerate(constraints):
+            key = c["topologyKey"]
+            if key == "kubernetes.io/hostname":
+                continue
+            val = ni.node.labels.get(key)
+            if val is None:
+                continue
+            if val not in eligible_pairs[i]:
+                eligible_pairs[i][val] = 0
+                topo_size[i] += 1
+    # count matching pods over ALL nodes that satisfy node affinity (+ keys)
+    for ni in ctx.snapshot.node_list():
+        if not _node_eligible_for_spread(pod, ni):
+            continue
+        if require_all and any(c["topologyKey"] not in ni.node.labels for c in constraints):
+            continue
+        for i, c in enumerate(constraints):
+            key = c["topologyKey"]
+            if key == "kubernetes.io/hostname":
+                continue
+            val = ni.node.labels.get(key)
+            if val is None or val not in eligible_pairs[i]:
+                continue
+            eligible_pairs[i][val] += _count_matching_pods(ni, c.get("labelSelector"), pod.namespace)
+    state["counts"] = eligible_pairs
+    n_scored = len(feasible) - len(state["ignored"])
+    state["weights"] = [
+        math.log((n_scored if c["topologyKey"] == "kubernetes.io/hostname" else topo_size[i]) + 2)
+        for i, c in enumerate(constraints)
+    ]
+    return None
+
+
+def spread_score(ctx, pod: PodView, ni: "NodeInfo") -> int:
+    state = ctx.state.get("spread.score")
+    if state is None or not state["constraints"]:
+        return 0
+    if ni.node.name in state["ignored"]:
+        return 0
+    total = 0.0
+    for i, c in enumerate(state["constraints"]):
+        key = c["topologyKey"]
+        val = ni.node.labels.get(key)
+        if val is None:
+            continue
+        if key == "kubernetes.io/hostname":
+            cnt = _count_matching_pods(ni, c.get("labelSelector"), pod.namespace)
+        else:
+            pair_counts = state["counts"][i]
+            if val not in pair_counts:
+                continue
+            cnt = pair_counts[val]
+        total += cnt * state["weights"][i] + (int(c.get("maxSkew", 1)) - 1)
+    return round(total)
+
+
+def spread_normalize(ctx, pod: PodView, raw: dict[str, int]) -> dict[str, int]:
+    state = ctx.state.get("spread.score") or {"constraints": [], "ignored": set()}
+    if not state["constraints"]:
+        return {k: 0 for k in raw}
+    ignored = state["ignored"]
+    live = [s for n, s in raw.items() if n not in ignored]
+    if not live:
+        return {k: 0 for k in raw}
+    min_score, max_score = min(live), max(live)
+    out = {}
+    for node, s in raw.items():
+        if node in ignored:
+            out[node] = 0
+        elif max_score == 0:
+            out[node] = MAX_NODE_SCORE
+        else:
+            out[node] = MAX_NODE_SCORE * (max_score + min_score - s) // max_score
+    return out
+
+
+# ---------------------------------------------------------------------------
+# InterPodAffinity
+# ---------------------------------------------------------------------------
+
+def _required_terms(affinity: dict) -> list[dict]:
+    return affinity.get("requiredDuringSchedulingIgnoredDuringExecution") or []
+
+
+def _preferred_terms(affinity: dict) -> list[dict]:
+    return affinity.get("preferredDuringSchedulingIgnoredDuringExecution") or []
+
+
+def interpod_pre_filter(ctx: "CycleContext", pod: PodView) -> "str | None":
+    snapshot = ctx.snapshot
+    affinity_terms = _required_terms(pod.pod_affinity)
+    anti_terms = _required_terms(pod.pod_anti_affinity)
+    # counts per (term index, topology value) for the incoming pod's terms,
+    # and per (topologyKey, value) for existing pods' required anti-affinity
+    affinity_counts: dict[tuple[int, str, str], int] = {}
+    anti_counts: dict[tuple[int, str, str], int] = {}
+    existing_anti: dict[tuple[str, str], int] = {}
+    for ni in snapshot.node_list():
+        node_labels = ni.node.labels
+        for other in ni.pods:
+            for i, term in enumerate(affinity_terms):
+                if _term_matches_pod(term, pod.namespace, other, snapshot):
+                    key = term.get("topologyKey", "")
+                    if key in node_labels:
+                        k = (i, key, node_labels[key])
+                        affinity_counts[k] = affinity_counts.get(k, 0) + 1
+            for i, term in enumerate(anti_terms):
+                if _term_matches_pod(term, pod.namespace, other, snapshot):
+                    key = term.get("topologyKey", "")
+                    if key in node_labels:
+                        k = (i, key, node_labels[key])
+                        anti_counts[k] = anti_counts.get(k, 0) + 1
+            # symmetry: existing pods' required anti-affinity vs incoming pod
+            for term in _required_terms(PodView(other.obj).pod_anti_affinity):
+                if _term_matches_pod(term, other.namespace, pod, snapshot):
+                    key = term.get("topologyKey", "")
+                    if key in node_labels:
+                        k2 = (key, node_labels[key])
+                        existing_anti[k2] = existing_anti.get(k2, 0) + 1
+    ctx.state["interpod"] = {
+        "affinity_terms": affinity_terms,
+        "anti_terms": anti_terms,
+        "affinity_counts": affinity_counts,
+        "anti_counts": anti_counts,
+        "existing_anti": existing_anti,
+    }
+    return None
+
+
+def interpod_filter(ctx, pod: PodView, ni: "NodeInfo") -> "str | None":
+    state = ctx.state.get("interpod")
+    if state is None:
+        interpod_pre_filter(ctx, pod)
+        state = ctx.state["interpod"]
+    node_labels = ni.node.labels
+    # 1. existing pods' required anti-affinity
+    for (key, val), cnt in state["existing_anti"].items():
+        if cnt > 0 and node_labels.get(key) == val:
+            return "node(s) didn't satisfy existing pods anti-affinity rules"
+    # 2. incoming pod's required anti-affinity
+    for i, term in enumerate(state["anti_terms"]):
+        key = term.get("topologyKey", "")
+        if key not in node_labels:
+            continue
+        if state["anti_counts"].get((i, key, node_labels[key]), 0) > 0:
+            return "node(s) didn't match pod anti-affinity rules"
+    # 3. incoming pod's required affinity
+    terms = state["affinity_terms"]
+    if terms:
+        satisfied = True
+        for i, term in enumerate(terms):
+            key = term.get("topologyKey", "")
+            if key not in node_labels or state["affinity_counts"].get(
+                (i, key, node_labels[key]), 0
+            ) <= 0:
+                satisfied = False
+                break
+        if not satisfied:
+            # first-pod-in-series rule: nothing matches anywhere AND the pod
+            # matches its own terms
+            if not state["affinity_counts"] and all(
+                _term_matches_pod(t, pod.namespace, pod, ctx.snapshot) for t in terms
+            ):
+                return None
+            return "node(s) didn't match pod affinity rules"
+    return None
+
+
+def interpod_pre_score(ctx: "CycleContext", pod: PodView, feasible: list) -> "str | None":
+    snapshot = ctx.snapshot
+    hard_weight = int(ctx.args("InterPodAffinity").get("hardPodAffinityWeight", 1))
+    topology_score: dict[tuple[str, str], int] = {}
+
+    def add(term: dict, owner_ns: str, target: PodView, node_labels: dict, weight: int):
+        if weight == 0:
+            return
+        if _term_matches_pod(term, owner_ns, target, snapshot):
+            key = term.get("topologyKey", "")
+            if key in node_labels:
+                k = (key, node_labels[key])
+                topology_score[k] = topology_score.get(k, 0) + weight
+
+    incoming_pref_aff = _preferred_terms(pod.pod_affinity)
+    incoming_pref_anti = _preferred_terms(pod.pod_anti_affinity)
+    has_any = bool(incoming_pref_aff or incoming_pref_anti)
+    for ni in snapshot.node_list():
+        node_labels = ni.node.labels
+        for other in ni.pods:
+            opv = PodView(other.obj)
+            # incoming pod's preferred terms vs existing pod
+            for pref in incoming_pref_aff:
+                add(pref.get("podAffinityTerm") or {}, pod.namespace, opv, node_labels, int(pref.get("weight", 0)))
+            for pref in incoming_pref_anti:
+                add(pref.get("podAffinityTerm") or {}, pod.namespace, opv, node_labels, -int(pref.get("weight", 0)))
+            # existing pod's preferred terms vs incoming pod
+            for pref in _preferred_terms(opv.pod_affinity):
+                add(pref.get("podAffinityTerm") or {}, opv.namespace, pod, node_labels, int(pref.get("weight", 0)))
+                has_any = True
+            for pref in _preferred_terms(opv.pod_anti_affinity):
+                add(pref.get("podAffinityTerm") or {}, opv.namespace, pod, node_labels, -int(pref.get("weight", 0)))
+                has_any = True
+            # existing pod's REQUIRED affinity, counted at hardPodAffinityWeight
+            if hard_weight > 0:
+                for term in _required_terms(opv.pod_affinity):
+                    add(term, opv.namespace, pod, node_labels, hard_weight)
+                    has_any = True
+    ctx.state["interpod.score"] = {"topology_score": topology_score, "active": has_any or bool(topology_score)}
+    return None
+
+
+def interpod_score(ctx, pod: PodView, ni: "NodeInfo") -> int:
+    state = ctx.state.get("interpod.score")
+    if not state or not state["topology_score"]:
+        return 0
+    node_labels = ni.node.labels
+    total = 0
+    for (key, val), w in state["topology_score"].items():
+        if node_labels.get(key) == val:
+            total += w
+    return total
+
+
+def interpod_normalize(ctx, pod: PodView, raw: dict[str, int]) -> dict[str, int]:
+    state = ctx.state.get("interpod.score")
+    if not state or not state["topology_score"]:
+        return {k: 0 for k in raw}
+    min_c, max_c = min(raw.values()), max(raw.values())
+    diff = max_c - min_c
+    return {
+        k: int(MAX_NODE_SCORE * (v - min_c) / diff) if diff > 0 else 0
+        for k, v in raw.items()
+    }
+
+
+# ---------------------------------------------------------------------------
+# ImageLocality
+# ---------------------------------------------------------------------------
+
+_IMG_MIN_THRESHOLD = 23 * 1024 * 1024
+_IMG_MAX_CONTAINER_THRESHOLD = 1000 * 1024 * 1024
+
+
+def _normalized_image_name(name: str) -> str:
+    if ":" not in name.rsplit("/", 1)[-1]:
+        name = name + ":latest"
+    return name
+
+
+def image_locality_score(ctx, pod: PodView, ni: "NodeInfo") -> int:
+    nodes = ctx.snapshot.node_list()
+    total_nodes = len(nodes)
+    if total_nodes == 0 or pod.num_containers == 0:
+        return 0
+    # image → (size, how many nodes have it)
+    sum_scores = 0
+    for image in pod.container_images:
+        want = _normalized_image_name(image)
+        size = 0
+        have = 0
+        for other in nodes:
+            found = False
+            for names, sz in other.node.images:
+                if any(_normalized_image_name(n) == want for n in names):
+                    found = True
+                    if other is ni:
+                        size = sz
+            if found:
+                have += 1
+        if size:
+            sum_scores += int(size * have / total_nodes)
+    max_threshold = _IMG_MAX_CONTAINER_THRESHOLD * pod.num_containers
+    sum_scores = min(max(sum_scores, _IMG_MIN_THRESHOLD), max_threshold)
+    return MAX_NODE_SCORE * (sum_scores - _IMG_MIN_THRESHOLD) // (max_threshold - _IMG_MIN_THRESHOLD)
+
+
+# ---------------------------------------------------------------------------
+# Volume plugins
+# ---------------------------------------------------------------------------
+
+def _pod_pvcs(ctx, pod: PodView) -> "list[tuple[str, dict | None]]":
+    out = []
+    for claim in pod.pvc_names:
+        out.append((claim, ctx.snapshot.pvcs.get(f"{pod.namespace}/{claim}")))
+    return out
+
+
+def volume_binding_pre_filter(ctx: "CycleContext", pod: PodView) -> "str | None":
+    for claim, pvc in _pod_pvcs(ctx, pod):
+        if pvc is None:
+            return f'persistentvolumeclaim "{claim}" not found'
+    return None
+
+
+def _pv_matches_node(pv: dict, ni: "NodeInfo") -> bool:
+    required = ((pv.get("spec", {}) or {}).get("nodeAffinity") or {}).get("required")
+    if not required:
+        return True
+    return match_node_selector_terms(required.get("nodeSelectorTerms") or [], ni.node)
+
+
+def volume_binding_filter(ctx, pod: PodView, ni: "NodeInfo") -> "str | None":
+    snapshot = ctx.snapshot
+    for claim, pvc in _pod_pvcs(ctx, pod):
+        if pvc is None:
+            return f'persistentvolumeclaim "{claim}" not found'
+        spec = pvc.get("spec", {}) or {}
+        bound_pv_name = spec.get("volumeName")
+        if bound_pv_name:
+            pv = snapshot.pvs.get(bound_pv_name)
+            if pv is not None and not _pv_matches_node(pv, ni):
+                return "node(s) had volume node affinity conflict"
+            continue
+        sc_name = spec.get("storageClassName")
+        sc = snapshot.storageclasses.get(sc_name) if sc_name else None
+        if sc is not None and sc.get("volumeBindingMode") == "WaitForFirstConsumer":
+            continue  # provisioning deferred to this node
+        # Immediate binding: a compatible unbound PV must exist for this node
+        if not any(
+            _static_pv_matches(pv, pvc) and _pv_matches_node(pv, ni)
+            for pv in snapshot.pvs.values()
+        ):
+            return "node(s) didn't find available persistent volumes to bind"
+    return None
+
+
+def _static_pv_matches(pv: dict, pvc: dict) -> bool:
+    pv_spec = pv.get("spec", {}) or {}
+    pvc_spec = pvc.get("spec", {}) or {}
+    if (pv_spec.get("claimRef") or {}).get("name") not in (None, (pvc.get("metadata", {}) or {}).get("name")):
+        return False
+    if (pv_spec.get("storageClassName") or "") != (pvc_spec.get("storageClassName") or ""):
+        return False
+    want_modes = set(pvc_spec.get("accessModes") or [])
+    if want_modes and not want_modes.issubset(set(pv_spec.get("accessModes") or [])):
+        return False
+    from ..utils.quantity import parse_quantity
+
+    want = (pvc_spec.get("resources") or {}).get("requests", {}).get("storage")
+    have = (pv_spec.get("capacity") or {}).get("storage")
+    if want and have and parse_quantity(have).value < parse_quantity(want).value:
+        return False
+    sel = pvc_spec.get("selector")
+    if sel is not None and not match_label_selector(sel, (pv.get("metadata", {}) or {}).get("labels") or {}):
+        return False
+    return True
+
+
+_ZONE_LABELS = (
+    "topology.kubernetes.io/zone",
+    "topology.kubernetes.io/region",
+    "failure-domain.beta.kubernetes.io/zone",
+    "failure-domain.beta.kubernetes.io/region",
+)
+
+
+def volume_zone_filter(ctx, pod: PodView, ni: "NodeInfo") -> "str | None":
+    snapshot = ctx.snapshot
+    for claim, pvc in _pod_pvcs(ctx, pod):
+        if pvc is None:
+            continue
+        pv_name = (pvc.get("spec", {}) or {}).get("volumeName")
+        if not pv_name:
+            continue
+        pv = snapshot.pvs.get(pv_name)
+        if pv is None:
+            continue
+        pv_labels = (pv.get("metadata", {}) or {}).get("labels") or {}
+        for zl in _ZONE_LABELS:
+            if zl not in pv_labels:
+                continue
+            allowed = set(pv_labels[zl].split("__"))
+            if ni.node.labels.get(zl) not in allowed:
+                return "node(s) had no available volume zone"
+    return None
+
+
+def volume_restrictions_filter(ctx, pod: PodView, ni: "NodeInfo") -> "str | None":
+    # ReadWriteOncePod: the claim must not be used by any other pod.
+    for claim, pvc in _pod_pvcs(ctx, pod):
+        if pvc is None:
+            continue
+        modes = (pvc.get("spec", {}) or {}).get("accessModes") or []
+        if "ReadWriteOncePod" in modes:
+            for other_ni in ctx.snapshot.node_list():
+                for other in other_ni.pods:
+                    if other.namespace == pod.namespace and claim in other.pvc_names:
+                        return "node has pod using PersistentVolumeClaim with the same name and ReadWriteOncePod access mode"
+    # GCEPD / AWS EBS: no two pods on a node may mount the same volume unless
+    # both read-only.
+    def disk_keys(p: PodView):
+        keys = []
+        for v in p.spec.get("volumes", []) or []:
+            gce = v.get("gcePersistentDisk")
+            if gce:
+                keys.append(("gce", gce.get("pdName"), bool(gce.get("readOnly"))))
+            ebs = v.get("awsElasticBlockStore")
+            if ebs:
+                keys.append(("ebs", ebs.get("volumeID"), bool(ebs.get("readOnly"))))
+            rbd = v.get("rbd")
+            if rbd:
+                keys.append(("rbd", f"{rbd.get('pool')}/{rbd.get('image')}", bool(rbd.get("readOnly"))))
+            iscsi = v.get("iscsi")
+            if iscsi:
+                keys.append(("iscsi", f"{iscsi.get('targetPortal')}/{iscsi.get('iqn')}", bool(iscsi.get("readOnly"))))
+        return keys
+
+    mine = disk_keys(pod)
+    if mine:
+        for other in ni.pods:
+            for kind, ident, ro in disk_keys(other):
+                for mkind, mident, mro in mine:
+                    if kind == mkind and ident == mident and not (ro and mro):
+                        return "node(s) conflicted with the pod's volumes"
+    return None
+
+
+_VOLUME_LIMITS = {"EBSLimits": ("awsElasticBlockStore", 39), "GCEPDLimits": ("gcePersistentDisk", 16), "AzureDiskLimits": ("azureDisk", 16)}
+
+
+def _make_volume_limits_filter(plugin: str):
+    vol_type, limit = _VOLUME_LIMITS[plugin]
+
+    def _filter(ctx, pod: PodView, ni: "NodeInfo") -> "str | None":
+        def count(p: PodView) -> int:
+            return sum(1 for v in p.spec.get("volumes", []) or [] if v.get(vol_type))
+
+        want = count(pod)
+        if want == 0:
+            return None
+        have = sum(count(p) for p in ni.pods)
+        if have + want > limit:
+            return "node(s) exceed max volume count"
+        return None
+
+    return _filter
+
+
+def node_volume_limits_filter(ctx, pod: PodView, ni: "NodeInfo") -> "str | None":
+    # CSI volume limits require CSINode objects, which the simulator's store
+    # (like the reference's 7 watched kinds) does not model; pass-through.
+    return None
+
+
+# ---------------------------------------------------------------------------
+# DefaultPreemption (PostFilter)
+# ---------------------------------------------------------------------------
+
+def default_preemption(
+    ctx: "CycleContext", pod: PodView, res: "PodSchedulingResult", oracle: "Oracle"
+) -> tuple[str, list[str], dict[str, str]]:
+    """Victim selection per upstream dry-run preemption: on each candidate
+    node, remove pods with lower priority, check feasibility, then reprieve
+    victims (highest priority first) that keep the pod feasible. Node choice:
+    min highest-victim-priority, then min priority sum, then fewest victims,
+    then lowest node index. (PDBs are not modeled — the store has no PDB
+    kind, matching the reference's 7 watched kinds.)"""
+    snapshot = ctx.snapshot
+    pod_priority = snapshot.pod_priority(pod)
+    messages: dict[str, str] = {}
+    candidates: list[tuple[str, list[PodView]]] = []
+    for ni in snapshot.node_list():
+        lower = [p for p in ni.pods if snapshot.pod_priority(p) < pod_priority]
+        if not lower:
+            messages[ni.node.name] = "no lower-priority pods to preempt"
+            continue
+        saved = list(ni.pods)
+        # remove all lower-priority pods
+        for victim in lower:
+            ni.remove_pod(victim.namespace, victim.name)
+        fits = _feasible_after_removal(ctx, pod, ni)
+        if not fits:
+            _restore(ni, saved)
+            messages[ni.node.name] = "preemption would not make pod schedulable"
+            continue
+        # reprieve: re-add victims (highest priority first) while still feasible
+        lower_sorted = sorted(lower, key=lambda p: -snapshot.pod_priority(p))
+        victims: list[PodView] = []
+        for v in lower_sorted:
+            ni.add_pod(v.obj)
+            if not _feasible_after_removal(ctx, pod, ni):
+                ni.remove_pod(v.namespace, v.name)
+                victims.append(v)
+        _restore(ni, saved)
+        if victims:
+            candidates.append((ni.node.name, victims))
+            messages[ni.node.name] = (
+                f"can preempt {len(victims)} victim(s): "
+                + ", ".join(f"{v.namespace}/{v.name}" for v in victims)
+            )
+    if not candidates:
+        return "", [], messages
+    order = {ni.node.name: i for i, ni in enumerate(snapshot.node_list())}
+
+    def rank(cand: tuple[str, list[PodView]]):
+        node, victims = cand
+        prios = [snapshot.pod_priority(v) for v in victims]
+        return (max(prios), sum(prios), len(victims), order[node])
+
+    best_node, best_victims = min(candidates, key=rank)
+    messages[best_node] = "preemption victim(s): " + ", ".join(
+        f"{v.namespace}/{v.name}" for v in best_victims
+    )
+    return best_node, [f"{v.namespace}/{v.name}" for v in best_victims], messages
+
+
+def _restore(ni: "NodeInfo", saved_pods: list):
+    current = {(p.namespace, p.name) for p in ni.pods}
+    for p in saved_pods:
+        if (p.namespace, p.name) not in current:
+            ni.add_pod(p.obj)
+
+
+def _feasible_after_removal(ctx: "CycleContext", pod: PodView, ni: "NodeInfo") -> bool:
+    """Re-run the filter plugins against the mutated NodeInfo. Cycle state
+    that depends on existing pods (inter-pod affinity, topology spread) is
+    recomputed so victim removal is visible."""
+    sub_ctx = type(ctx)(ctx.snapshot, ctx.config)
+    for name in ("NodeResourcesFit", "NodeUnschedulable", "NodeName", "TaintToleration",
+                 "NodeAffinity", "NodePorts", "PodTopologySpread", "InterPodAffinity",
+                 "VolumeRestrictions", "VolumeBinding", "VolumeZone"):
+        fn = FILTER_PLUGINS.get(name)
+        if fn is None:
+            continue
+        if fn(sub_ctx, pod, ni) is not None:
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# dispatch tables
+# ---------------------------------------------------------------------------
+
+PREFILTER_PLUGINS: dict[str, Callable] = {
+    "NodeResourcesFit": fit_pre_filter,
+    "NodePorts": node_ports_pre_filter,
+    "PodTopologySpread": spread_pre_filter,
+    "InterPodAffinity": interpod_pre_filter,
+    "VolumeBinding": volume_binding_pre_filter,
+}
+
+FILTER_PLUGINS: dict[str, Callable] = {
+    "NodeUnschedulable": node_unschedulable_filter,
+    "NodeName": node_name_filter,
+    "TaintToleration": taint_toleration_filter,
+    "NodeAffinity": node_affinity_filter,
+    "NodePorts": node_ports_filter,
+    "NodeResourcesFit": fit_filter,
+    "VolumeRestrictions": volume_restrictions_filter,
+    "EBSLimits": _make_volume_limits_filter("EBSLimits"),
+    "GCEPDLimits": _make_volume_limits_filter("GCEPDLimits"),
+    "NodeVolumeLimits": node_volume_limits_filter,
+    "AzureDiskLimits": _make_volume_limits_filter("AzureDiskLimits"),
+    "VolumeBinding": volume_binding_filter,
+    "VolumeZone": volume_zone_filter,
+    "PodTopologySpread": spread_filter,
+    "InterPodAffinity": interpod_filter,
+}
+
+PRESCORE_PLUGINS: dict[str, Callable] = {
+    "InterPodAffinity": interpod_pre_score,
+    "PodTopologySpread": spread_pre_score,
+    "TaintToleration": lambda ctx, pod, feasible: None,
+    "NodeAffinity": lambda ctx, pod, feasible: None,
+    "NodeResourcesFit": lambda ctx, pod, feasible: None,
+    "NodeResourcesBalancedAllocation": lambda ctx, pod, feasible: None,
+}
+
+# name → (score_fn, normalize_fn | None)
+SCORE_PLUGINS: dict[str, tuple[Callable, "Callable | None"]] = {
+    "NodeResourcesBalancedAllocation": (balanced_allocation_score, None),
+    "ImageLocality": (image_locality_score, None),
+    "InterPodAffinity": (interpod_score, interpod_normalize),
+    "NodeResourcesFit": (fit_score, None),
+    "NodeAffinity": (node_affinity_score, node_affinity_normalize),
+    "PodTopologySpread": (spread_score, spread_normalize),
+    "TaintToleration": (taint_toleration_score, taint_toleration_normalize),
+}
+
+POSTFILTER_PLUGINS: dict[str, Callable] = {
+    "DefaultPreemption": default_preemption,
+}

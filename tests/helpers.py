@@ -1,0 +1,54 @@
+"""Manifest builders shared by oracle and kernel tests."""
+
+
+def node(name, cpu="4", mem="8Gi", pods="110", labels=None, taints=None,
+         unschedulable=False, images=None):
+    n = {
+        "metadata": {"name": name, "labels": labels or {}},
+        "spec": {},
+        "status": {"allocatable": {"cpu": cpu, "memory": mem, "pods": pods}},
+    }
+    if taints:
+        n["spec"]["taints"] = taints
+    if unschedulable:
+        n["spec"]["unschedulable"] = True
+    if images:
+        n["status"]["images"] = images
+    return n
+
+
+def pod(name, cpu="100m", mem="128Mi", ns="default", labels=None, node_name=None,
+        node_selector=None, affinity=None, tolerations=None, priority=None,
+        priority_class=None, spread=None, ports=None, images=None, volumes=None):
+    containers = []
+    if images:
+        for i, img in enumerate(images):
+            containers.append({"name": f"c{i}", "image": img})
+        if cpu or mem:
+            containers[0]["resources"] = {"requests": {"cpu": cpu, "memory": mem}}
+    else:
+        c = {"name": "c", "resources": {"requests": {"cpu": cpu, "memory": mem}}}
+        if ports:
+            c["ports"] = ports
+        containers = [c]
+    p = {
+        "metadata": {"name": name, "namespace": ns, "labels": labels or {}},
+        "spec": {"containers": containers},
+    }
+    if node_name:
+        p["spec"]["nodeName"] = node_name
+    if node_selector:
+        p["spec"]["nodeSelector"] = node_selector
+    if affinity:
+        p["spec"]["affinity"] = affinity
+    if tolerations:
+        p["spec"]["tolerations"] = tolerations
+    if priority is not None:
+        p["spec"]["priority"] = priority
+    if priority_class:
+        p["spec"]["priorityClassName"] = priority_class
+    if spread:
+        p["spec"]["topologySpreadConstraints"] = spread
+    if volumes:
+        p["spec"]["volumes"] = volumes
+    return p

@@ -1,0 +1,148 @@
+from kube_scheduler_simulator_tpu.sched.config import (
+    SchedulerConfiguration,
+    convert_plugins_for_simulator,
+    default_plugins,
+    merge_plugin_set,
+    new_plugin_config,
+)
+
+# The full default plugin list pinned by the reference's golden test
+# (simulator/scheduler/plugin/plugins_test.go:852-884).
+GOLDEN_REGISTERED = [
+    ("NodeResourcesBalancedAllocation", 1),
+    ("ImageLocality", 1),
+    ("InterPodAffinity", 1),
+    ("NodeResourcesFit", 1),
+    ("NodeAffinity", 1),
+    ("PodTopologySpread", 2),
+    ("TaintToleration", 1),
+    ("DefaultBinder", None),
+    ("VolumeBinding", None),
+    ("NodePorts", None),
+    ("VolumeRestrictions", None),
+    ("NodeUnschedulable", None),
+    ("NodeName", None),
+    ("EBSLimits", None),
+    ("GCEPDLimits", None),
+    ("NodeVolumeLimits", None),
+    ("AzureDiskLimits", None),
+    ("VolumeZone", None),
+    ("DefaultPreemption", None),
+]
+
+
+def test_golden_registered_plugin_set():
+    """Union of score + other default plugins matches the reference golden list."""
+    d = default_plugins()
+    seen = []
+    for p in d["score"]:
+        seen.append((p["name"], p.get("weight")))
+    for ep in ("bind", "reserve", "preFilter", "filter", "postFilter"):
+        for p in d[ep]:
+            if all(p["name"] != n for n, _ in seen):
+                seen.append((p["name"], p.get("weight")))
+    assert set(seen) == set(GOLDEN_REGISTERED)
+
+
+def test_merge_disable_star():
+    merged = merge_plugin_set(default_plugins()["filter"], {"disabled": [{"name": "*"}]})
+    assert merged == []
+
+
+def test_merge_disable_one():
+    merged = merge_plugin_set(
+        default_plugins()["filter"], {"disabled": [{"name": "NodeResourcesFit"}]}
+    )
+    names = [p["name"] for p in merged]
+    assert "NodeResourcesFit" not in names
+    assert "NodeName" in names
+
+
+def test_merge_replace_in_place_preserves_order():
+    defaults = default_plugins()["score"]
+    merged = merge_plugin_set(defaults, {"enabled": [{"name": "NodeResourcesFit", "weight": 5}]})
+    names = [p["name"] for p in merged]
+    # order unchanged, weight replaced
+    assert names == [p["name"] for p in defaults]
+    fit = next(p for p in merged if p["name"] == "NodeResourcesFit")
+    assert fit["weight"] == 5
+
+
+def test_merge_appends_custom():
+    merged = merge_plugin_set(
+        default_plugins()["score"], {"enabled": [{"name": "MyPlugin", "weight": 3}]}
+    )
+    assert merged[-1] == {"name": "MyPlugin", "weight": 3}
+
+
+def test_convert_disables_star_everywhere():
+    out = convert_plugins_for_simulator(None)
+    for ep, ps in out.items():
+        assert ps["disabled"] == [{"name": "*"}]
+
+
+def test_plugin_config_defaults_and_override():
+    pc = new_plugin_config(None)
+    by_name = {p["name"]: p["args"] for p in pc}
+    assert by_name["DefaultPreemption"]["minCandidateNodesPercentage"] == 10
+    assert by_name["InterPodAffinity"]["hardPodAffinityWeight"] == 1
+    assert by_name["NodeResourcesFit"]["scoringStrategy"]["type"] == "LeastAllocated"
+    assert by_name["VolumeBinding"]["bindTimeoutSeconds"] == 600
+
+    pc2 = new_plugin_config(
+        [
+            {"name": "InterPodAffinity", "args": {"hardPodAffinityWeight": 7}},
+            {"name": "Custom", "args": {"x": 1}},
+        ]
+    )
+    by_name2 = {p["name"]: p["args"] for p in pc2}
+    assert by_name2["InterPodAffinity"]["hardPodAffinityWeight"] == 7
+    # untouched defaults survive the override
+    assert by_name2["InterPodAffinity"]["kind"] == "InterPodAffinityArgs"
+    assert by_name2["Custom"] == {"x": 1}
+
+
+def test_from_yaml_only_profiles_honored():
+    cfg = SchedulerConfiguration.from_yaml(
+        """
+apiVersion: kubescheduler.config.k8s.io/v1beta2
+kind: KubeSchedulerConfiguration
+parallelism: 999
+profiles:
+  - schedulerName: my-sched
+    plugins:
+      score:
+        disabled:
+          - name: "*"
+        enabled:
+          - name: NodeResourcesFit
+            weight: 10
+"""
+    )
+    # non-profile field forced back to default
+    assert cfg.raw["parallelism"] == 16
+    assert cfg.score_plugins("my-sched") == [("NodeResourcesFit", 10)]
+    # filter set untouched by score changes
+    assert "PodTopologySpread" in cfg.enabled("filter", "my-sched")
+
+
+def test_empty_config_gets_default_profile():
+    cfg = SchedulerConfiguration.default()
+    assert cfg.score_plugins() == [
+        ("NodeResourcesBalancedAllocation", 1),
+        ("ImageLocality", 1),
+        ("InterPodAffinity", 1),
+        ("NodeResourcesFit", 1),
+        ("NodeAffinity", 1),
+        ("PodTopologySpread", 2),
+        ("TaintToleration", 1),
+    ]
+    assert cfg.enabled("postFilter") == ["DefaultPreemption"]
+    assert cfg.enabled("queueSort") == ["PrioritySort"]
+
+
+def test_bad_kind_rejected():
+    import pytest
+
+    with pytest.raises(ValueError):
+        SchedulerConfiguration.from_yaml("kind: Deployment")

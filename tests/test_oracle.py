@@ -1,0 +1,371 @@
+import json
+
+from kube_scheduler_simulator_tpu.sched.oracle import Oracle
+from kube_scheduler_simulator_tpu.sched.config import SchedulerConfiguration
+
+from helpers import node, pod
+
+
+def schedule(nodes, pods, **kw):
+    o = Oracle(nodes, pods, **kw)
+    return o, o.schedule_all()
+
+
+def test_basic_fit_lands_on_free_node():
+    nodes = [node("n0", cpu="1"), node("n1", cpu="4")]
+    # n0 already can't fit a 2-cpu pod
+    o, results = schedule(nodes, [pod("p0", cpu="2")])
+    assert results[0].status == "Scheduled"
+    assert results[0].selected_node == "n1"
+    assert results[0].filter["n0"]["NodeResourcesFit"] == "Insufficient cpu"
+    assert results[0].filter["n1"]["NodeResourcesFit"] == "passed"
+
+
+def test_too_many_pods():
+    nodes = [node("n0", pods="0"), node("n1")]
+    o, results = schedule(nodes, [pod("p0")])
+    assert results[0].selected_node == "n1"
+    assert results[0].filter["n0"]["NodeResourcesFit"] == "Too many pods"
+
+
+def test_least_allocated_prefers_empty_node():
+    nodes = [node("n0", cpu="4", mem="8Gi"), node("n1", cpu="4", mem="8Gi")]
+    existing = pod("busy", cpu="3", mem="6Gi", node_name="n0")
+    o, results = schedule(nodes, [existing, pod("p0", cpu="100m")])
+    assert results[0].selected_node == "n1"
+
+
+def test_sequential_capacity_updates():
+    # two 2-cpu pods, two 3-cpu nodes: second pod must go to the other node
+    nodes = [node("n0", cpu="3", mem="8Gi"), node("n1", cpu="3", mem="8Gi")]
+    o, results = schedule(nodes, [pod("a", cpu="2", mem="1Gi"), pod("b", cpu="2", mem="1Gi")])
+    assert {results[0].selected_node, results[1].selected_node} == {"n0", "n1"}
+
+
+def test_node_name_filter():
+    nodes = [node("n0"), node("n1")]
+    p = pod("p0")
+    p["spec"]["nodeName"] = ""  # unset
+    o, results = schedule(nodes, [pod("p0", node_selector=None)])
+    assert results[0].status == "Scheduled"
+    # pinned pod: nodeName set but pod still pending (not counted as bound
+    # because node doesn't exist in snapshot? use existing node)
+
+
+def test_unschedulable_node():
+    nodes = [node("n0", unschedulable=True), node("n1")]
+    o, results = schedule(nodes, [pod("p0")])
+    assert results[0].selected_node == "n1"
+    assert results[0].filter["n0"]["NodeUnschedulable"] == "node(s) were unschedulable"
+    # with toleration it can land on n0 too (but scoring still picks a node)
+    tol = [{"key": "node.kubernetes.io/unschedulable", "operator": "Exists", "effect": "NoSchedule"}]
+    o2, results2 = schedule(nodes, [pod("p1", tolerations=tol)])
+    assert results2[0].filter["n0"]["NodeUnschedulable"] == "passed"
+
+
+def test_taint_toleration_filter_and_score():
+    taint = [{"key": "dedicated", "value": "gpu", "effect": "NoSchedule"}]
+    pref = [{"key": "noisy", "value": "true", "effect": "PreferNoSchedule"}]
+    nodes = [node("n0", taints=taint), node("n1", taints=pref), node("n2")]
+    o, results = schedule(nodes, [pod("p0")])
+    r = results[0]
+    assert "untolerated taint" in r.filter["n0"]["TaintToleration"]
+    # n1 passes filter but scores worse than n2 on TaintToleration
+    assert r.filter["n1"]["TaintToleration"] == "passed"
+    assert int(r.final_score["n1"]["TaintToleration"]) < int(r.final_score["n2"]["TaintToleration"])
+    assert r.selected_node == "n2"
+
+    tol = [{"key": "dedicated", "operator": "Equal", "value": "gpu", "effect": "NoSchedule"}]
+    o2, results2 = schedule(nodes, [pod("p1", tolerations=tol)])
+    assert results2[0].filter["n0"]["TaintToleration"] == "passed"
+
+
+def test_node_selector_and_affinity():
+    nodes = [node("n0", labels={"disk": "hdd"}), node("n1", labels={"disk": "ssd"})]
+    o, results = schedule(nodes, [pod("p0", node_selector={"disk": "ssd"})])
+    assert results[0].selected_node == "n1"
+    assert "affinity" in results[0].filter["n0"]["NodeAffinity"]
+
+    aff = {
+        "nodeAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": {
+                "nodeSelectorTerms": [
+                    {"matchExpressions": [{"key": "disk", "operator": "In", "values": ["ssd"]}]}
+                ]
+            }
+        }
+    }
+    o2, results2 = schedule(nodes, [pod("p1", affinity=aff)])
+    assert results2[0].selected_node == "n1"
+
+
+def test_node_affinity_preferred_scoring():
+    nodes = [node("n0", labels={"zone": "a"}), node("n1", labels={"zone": "b"})]
+    aff = {
+        "nodeAffinity": {
+            "preferredDuringSchedulingIgnoredDuringExecution": [
+                {
+                    "weight": 10,
+                    "preference": {
+                        "matchExpressions": [{"key": "zone", "operator": "In", "values": ["b"]}]
+                    },
+                }
+            ]
+        }
+    }
+    o, results = schedule(nodes, [pod("p0", affinity=aff)])
+    assert results[0].selected_node == "n1"
+    assert int(results[0].final_score["n1"]["NodeAffinity"]) == 100
+    assert int(results[0].final_score["n0"]["NodeAffinity"]) == 0
+
+
+def test_node_ports_conflict():
+    ports = [{"containerPort": 80, "hostPort": 8080}]
+    nodes = [node("n0"), node("n1")]
+    existing = pod("web", ports=ports, node_name="n0")
+    o, results = schedule(nodes, [existing, pod("p0", ports=ports)])
+    r = results[0]
+    assert "free ports" in r.filter["n0"]["NodePorts"]
+    assert r.selected_node == "n1"
+
+
+def test_topology_spread_filter():
+    # 2 zones; zone a already has 2 matching pods, zone b has 0; maxSkew 1
+    nodes = [
+        node("n0", labels={"topology.kubernetes.io/zone": "a", "kubernetes.io/hostname": "n0"}),
+        node("n1", labels={"topology.kubernetes.io/zone": "b", "kubernetes.io/hostname": "n1"}),
+    ]
+    spread = [
+        {
+            "maxSkew": 1,
+            "topologyKey": "topology.kubernetes.io/zone",
+            "whenUnsatisfiable": "DoNotSchedule",
+            "labelSelector": {"matchLabels": {"app": "web"}},
+        }
+    ]
+    existing = [
+        pod("w1", labels={"app": "web"}, node_name="n0"),
+        pod("w2", labels={"app": "web"}, node_name="n0"),
+    ]
+    new = pod("w3", labels={"app": "web"}, spread=spread)
+    o, results = schedule(nodes, existing + [new])
+    r = results[0]
+    assert "topology spread" in r.filter["n0"]["PodTopologySpread"]
+    assert r.selected_node == "n1"
+
+
+def test_interpod_anti_affinity():
+    nodes = [
+        node("n0", labels={"kubernetes.io/hostname": "n0"}),
+        node("n1", labels={"kubernetes.io/hostname": "n1"}),
+    ]
+    anti = {
+        "podAntiAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {
+                    "labelSelector": {"matchLabels": {"app": "db"}},
+                    "topologyKey": "kubernetes.io/hostname",
+                }
+            ]
+        }
+    }
+    existing = pod("db-0", labels={"app": "db"}, node_name="n0")
+    new = pod("db-1", labels={"app": "db"}, affinity=anti)
+    o, results = schedule(nodes, [existing, new])
+    r = results[0]
+    assert "anti-affinity" in r.filter["n0"]["InterPodAffinity"]
+    assert r.selected_node == "n1"
+
+
+def test_interpod_required_affinity_and_first_pod_rule():
+    nodes = [
+        node("n0", labels={"kubernetes.io/hostname": "n0"}),
+        node("n1", labels={"kubernetes.io/hostname": "n1"}),
+    ]
+    aff = {
+        "podAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {
+                    "labelSelector": {"matchLabels": {"app": "web"}},
+                    "topologyKey": "kubernetes.io/hostname",
+                }
+            ]
+        }
+    }
+    # first pod matching its own selector: allowed anywhere
+    first = pod("web-0", labels={"app": "web"}, affinity=aff)
+    o, results = schedule(nodes, [first])
+    assert results[0].status == "Scheduled"
+
+    # second pod must co-locate with web-0
+    existing = pod("web-0", labels={"app": "web"}, node_name="n1")
+    second = pod("web-1", labels={"app": "web"}, affinity=aff)
+    o2, results2 = schedule(nodes, [existing, second])
+    assert results2[0].selected_node == "n1"
+    assert "affinity rules" in results2[0].filter["n0"]["InterPodAffinity"]
+
+
+def test_existing_pod_anti_affinity_symmetry():
+    nodes = [
+        node("n0", labels={"kubernetes.io/hostname": "n0"}),
+        node("n1", labels={"kubernetes.io/hostname": "n1"}),
+    ]
+    anti = {
+        "podAntiAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {
+                    "labelSelector": {"matchLabels": {"app": "web"}},
+                    "topologyKey": "kubernetes.io/hostname",
+                }
+            ]
+        }
+    }
+    # existing pod repels app=web pods
+    existing = pod("lonely", labels={"app": "db"}, affinity=anti, node_name="n0")
+    new = pod("web-0", labels={"app": "web"})
+    o, results = schedule(nodes, [existing, new])
+    assert "existing pods anti-affinity" in results[0].filter["n0"]["InterPodAffinity"]
+    assert results[0].selected_node == "n1"
+
+
+def test_preferred_interpod_affinity_scoring():
+    nodes = [
+        node("n0", labels={"kubernetes.io/hostname": "n0"}),
+        node("n1", labels={"kubernetes.io/hostname": "n1"}),
+    ]
+    pref = {
+        "podAffinity": {
+            "preferredDuringSchedulingIgnoredDuringExecution": [
+                {
+                    "weight": 100,
+                    "podAffinityTerm": {
+                        "labelSelector": {"matchLabels": {"app": "cache"}},
+                        "topologyKey": "kubernetes.io/hostname",
+                    },
+                }
+            ]
+        }
+    }
+    existing = pod("cache-0", labels={"app": "cache"}, node_name="n1")
+    new = pod("web-0", affinity=pref)
+    o, results = schedule(nodes, [existing, new])
+    r = results[0]
+    assert r.selected_node == "n1"
+    assert int(r.final_score["n1"]["InterPodAffinity"]) == 100
+
+
+def test_image_locality():
+    img = [{"names": ["registry/app:v1"], "sizeBytes": 500 * 1024 * 1024}]
+    nodes = [node("n0", images=img), node("n1")]
+    o, results = schedule(nodes, [pod("p0", images=["registry/app:v1"])])
+    r = results[0]
+    assert int(r.score["n0"]["ImageLocality"]) > int(r.score["n1"]["ImageLocality"])
+
+
+def test_volume_binding_missing_pvc():
+    nodes = [node("n0")]
+    p = pod("p0", volumes=[{"name": "v", "persistentVolumeClaim": {"claimName": "nope"}}])
+    o, results = schedule(nodes, [p])
+    assert results[0].status == "Unschedulable"
+    assert 'persistentvolumeclaim "nope" not found' in results[0].pre_filter_status["VolumeBinding"]
+
+
+def test_volume_binding_node_affinity_conflict():
+    nodes = [
+        node("n0", labels={"topology.kubernetes.io/zone": "a"}),
+        node("n1", labels={"topology.kubernetes.io/zone": "b"}),
+    ]
+    pvc = {
+        "metadata": {"name": "claim", "namespace": "default"},
+        "spec": {"volumeName": "pv0"},
+    }
+    pv = {
+        "metadata": {"name": "pv0"},
+        "spec": {
+            "nodeAffinity": {
+                "required": {
+                    "nodeSelectorTerms": [
+                        {
+                            "matchExpressions": [
+                                {"key": "topology.kubernetes.io/zone", "operator": "In", "values": ["b"]}
+                            ]
+                        }
+                    ]
+                }
+            }
+        },
+    }
+    p = pod("p0", volumes=[{"name": "v", "persistentVolumeClaim": {"claimName": "claim"}}])
+    o, results = schedule(nodes, [p], pvcs=[pvc], pvs=[pv])
+    r = results[0]
+    assert r.filter["n0"]["VolumeBinding"] == "node(s) had volume node affinity conflict"
+    assert r.selected_node == "n1"
+
+
+def test_preemption():
+    pcs = [{"metadata": {"name": "high"}, "value": 1000}]
+    nodes = [node("n0", cpu="2", mem="4Gi")]
+    low = pod("low", cpu="1500m", priority=0, node_name="n0")
+    high = pod("high-pod", cpu="1500m", priority_class="high")
+    o, results = schedule(nodes, [low, high], priorityclasses=pcs)
+    nominated = [r for r in results if r.status == "Nominated"]
+    assert nominated and nominated[0].nominated_node == "n0"
+    assert nominated[0].preemption_victims == ["default/low"]
+    scheduled = [r for r in results if r.status == "Scheduled" and r.pod_name == "high-pod"]
+    assert scheduled and scheduled[0].selected_node == "n0"
+
+
+def test_priority_queue_order():
+    pcs = [{"metadata": {"name": "high"}, "value": 1000}]
+    nodes = [node("n0", cpu="1", mem="4Gi")]
+    # only room for one 1-cpu pod; high-priority pod should be scheduled first
+    a = pod("low-pod", cpu="800m", priority=0)
+    b = pod("high-pod", cpu="800m", priority_class="high")
+    o, results = schedule(nodes, [a, b], priorityclasses=pcs)
+    by_name = {r.pod_name: r for r in results}
+    assert by_name["high-pod"].status == "Scheduled"
+
+
+def test_annotations_shape():
+    nodes = [node("n0")]
+    o, results = schedule(nodes, [pod("p0")])
+    ann = results[0].to_annotations()
+    assert ann["scheduler-simulator/selected-node"] == "n0"
+    filt = json.loads(ann["scheduler-simulator/filter-result"])
+    assert filt["n0"]["NodeResourcesFit"] == "passed"
+    final = json.loads(ann["scheduler-simulator/finalscore-result"])
+    assert "NodeResourcesBalancedAllocation" in final["n0"]
+    assert set(ann.keys()) == {
+        f"scheduler-simulator/{k}"
+        for k in (
+            "prefilter-result-status", "prefilter-result", "filter-result",
+            "postfilter-result", "prescore-result", "score-result",
+            "finalscore-result", "reserve-result", "permit-result",
+            "permit-result-timeout", "prebind-result", "bind-result",
+            "selected-node",
+        )
+    }
+
+
+def test_custom_config_weights():
+    cfg = SchedulerConfiguration.from_dict(
+        {
+            "profiles": [
+                {
+                    "schedulerName": "default-scheduler",
+                    "plugins": {
+                        "score": {
+                            "disabled": [{"name": "*"}],
+                            "enabled": [{"name": "NodeResourcesFit", "weight": 2}],
+                        }
+                    },
+                }
+            ]
+        }
+    )
+    nodes = [node("n0", cpu="4"), node("n1", cpu="8")]
+    o, results = schedule(nodes, [pod("p0", cpu="1")], config=cfg)
+    r = results[0]
+    # only NodeResourcesFit contributes, doubled
+    assert set(r.final_score["n0"].keys()) == {"NodeResourcesFit"}
+    assert r.selected_node == "n1"
