@@ -1,0 +1,36 @@
+"""The batched TPU scheduling engine.
+
+This package is the TPU-native replacement for the reference's scheduling
+stack (reference: simulator/scheduler/ — the vendored upstream kube-scheduler
+driven one pod at a time, with every plugin wrapped for result recording,
+SURVEY.md §3.3). Here the whole Filter→Score→Normalize→Select→Bind cycle is
+a single jitted tensor program:
+
+  * `encode` turns cluster manifests into padded, vocab-encoded device
+    arrays (`ClusterArrays`) plus host-side metadata (`EncodedCluster`);
+  * `kernels` holds per-plugin filter/score kernels operating on the
+    `[nodes]` axis — one vectorized pass replaces the reference's
+    per-node goroutine loop (wrappedplugin.go:491, :388);
+  * `engine` runs a `lax.scan` over the pod queue: each step is fully
+    vectorized over nodes and plugins, state (per-node requested
+    resources, pod counts, assignments) is scatter-updated in place of
+    the reference's etcd write + informer round-trip.
+
+Results are emitted as dense result tensors `[pods, nodes, plugins]` and
+converted on the host to the reference's exact 13-annotation wire format
+(sched/results.py), so the decision trace is identical to what the
+reference's result stores produce.
+"""
+
+from .encode import EncodedCluster, ClusterArrays, SchedState, encode_cluster, EXACT, TPU32
+from .engine import BatchedScheduler
+
+__all__ = [
+    "EncodedCluster",
+    "ClusterArrays",
+    "SchedState",
+    "encode_cluster",
+    "BatchedScheduler",
+    "EXACT",
+    "TPU32",
+]

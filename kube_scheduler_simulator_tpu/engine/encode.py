@@ -1,0 +1,301 @@
+"""Cluster state → device arrays (the featurizer).
+
+The reference keeps cluster state as objects in etcd behind a real
+kube-apiserver (simulator/k8sapiserver/k8sapiserver.go) and the scheduler
+walks object graphs per node per pod. The TPU engine instead encodes the
+whole cluster once into padded, statically-shaped arrays:
+
+  * resources become a `[*, R]` axis over an interned resource vocabulary
+    (cpu in millicores, bytes-like resources optionally scaled to Mi so
+    they fit int32 on the TPU fast path);
+  * every string the scheduling semantics compare for equality is interned
+    through `models.vocab.Vocab` — device arrays only hold int32 ids;
+  * dynamic sets (pods arriving, nodes joining) are handled by capacity
+    padding + boolean masks, keeping XLA shapes static (SURVEY.md §7 hard
+    part #5).
+
+Two dtype policies:
+  * EXACT — int64/float64 (tests, CPU): bit-identical to the pure-Python
+    oracle's integer semantics for arbitrary quantities;
+  * TPU32 — int32/float32 with per-resource unit scaling (memory in Mi):
+    native TPU dtypes; exact whenever quantities are Mi-granular, which
+    real manifests are.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any
+
+import chex
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.objects import (
+    NodeView,
+    PodView,
+    pod_effective_requests,
+    pod_scoring_requests,
+    resolve_pod_priority,
+    tolerations_tolerate_taint,
+)
+from ..models.vocab import Vocab
+from ..sched.config import SchedulerConfiguration
+from ..sched.resources import to_int_resources
+
+# Node index sentinels in pod_node_name: -1 = no nodeName requested,
+# -2 = names a node that does not exist (fails NodeName everywhere,
+# matching the oracle which leaves such pods pending).
+NO_NODE = -1
+MISSING_NODE = -2
+
+# Fixed low ids in the resource vocabulary.
+BASE_RESOURCES = ("cpu", "memory", "ephemeral-storage", "pods")
+PODS_RES = 3  # index of "pods" in BASE_RESOURCES
+
+
+@dataclass(frozen=True)
+class DTypePolicy:
+    """Dtype + unit-scaling choices for the device arrays."""
+
+    name: str
+    res: Any
+    score: Any
+    flt: Any
+    scale_bytes: bool = False  # divide bytes-like resources by 2**20 (Mi)
+
+    def divisor(self, resource: str) -> int:
+        if self.scale_bytes and (
+            resource in ("memory", "ephemeral-storage")
+            or resource.startswith("hugepages-")
+        ):
+            return 1 << 20
+        return 1
+
+    def to_units(self, resource: str, v: int, *, up: bool) -> int:
+        """Scale an integer base-unit quantity into device units. Requests
+        round up (conservative: never under-reserve), capacities round
+        down (never overcommit vs the exact semantics). In the 32-bit
+        policy, quantities clamp to 2^23-1 device units (8 TiB of memory,
+        8388 cores) so int32 kernel intermediates cannot overflow."""
+        d = self.divisor(resource)
+        scaled = v if d == 1 else (-((-v) // d) if up else v // d)
+        if self.scale_bytes:  # 32-bit policy
+            return min(scaled, (1 << 23) - 1)
+        return scaled
+
+
+EXACT = DTypePolicy("exact", jnp.int64, jnp.int64, jnp.float64)
+TPU32 = DTypePolicy("i32", jnp.int32, jnp.int32, jnp.float32, scale_bytes=True)
+
+
+@chex.dataclass
+class ClusterArrays:
+    """Static per-problem device arrays. Axes: N = padded nodes (+1 junk
+    row in mutable state), P = padded pods, R = resource kinds."""
+
+    node_alloc: jnp.ndarray  # [N, R] allocatable, device units
+    node_unsched: jnp.ndarray  # [N] bool
+    node_mask: jnp.ndarray  # [N] bool — real node
+    pod_req: jnp.ndarray  # [P, R] effective requests (Filter path)
+    pod_sreq: jnp.ndarray  # [P, R] scoring requests w/ nonzero defaults
+    pod_req_rank: jnp.ndarray  # [P, R] rank of r in pod's request-dict order; R if absent
+    pod_node_name: jnp.ndarray  # [P] int32 node idx | NO_NODE | MISSING_NODE
+    pod_tol_unsched: jnp.ndarray  # [P] bool — tolerates the unschedulable taint
+    pod_priority: jnp.ndarray  # [P] int32 resolved priority
+    pod_mask: jnp.ndarray  # [P] bool — real pod
+
+
+@chex.dataclass
+class SchedState:
+    """Mutable per-step state. Node rows have one extra junk row at index N
+    so scatter-updates for unschedulable pods (target -1) land harmlessly."""
+
+    requested: jnp.ndarray  # [N+1, R] sum of effective requests of bound pods
+    s_requested: jnp.ndarray  # [N+1, R] sum of scoring requests
+    n_pods: jnp.ndarray  # [N+1] int32 bound-pod count
+    assignment: jnp.ndarray  # [P] int32 node idx | -1
+
+
+class EncodedCluster:
+    """Device arrays + the host-side metadata needed to decode results."""
+
+    def __init__(
+        self,
+        arrays: ClusterArrays,
+        state0: SchedState,
+        *,
+        node_names: list[str],
+        pod_keys: list[tuple[str, str]],
+        pods: list[dict],
+        resource_names: list[str],
+        queue: np.ndarray,
+        policy: DTypePolicy,
+        config: SchedulerConfiguration,
+        n_nodes: int,
+        n_pods: int,
+        aux: "dict | None" = None,
+    ):
+        self.arrays = arrays
+        self.state0 = state0
+        self.node_names = node_names
+        self.pod_keys = pod_keys
+        self.pods = pods  # raw manifests, pod-index order
+        self.resource_names = resource_names
+        self.queue = queue  # pending pod indices, scheduling order
+        self.policy = policy
+        self.config = config
+        self.n_nodes = n_nodes  # real (unpadded) counts
+        self.n_pods = n_pods
+        self.aux = aux or {}  # per-plugin extra encodings (filled by kernels)
+
+    @property
+    def N(self) -> int:
+        return int(self.arrays.node_mask.shape[0])
+
+    @property
+    def P(self) -> int:
+        return int(self.arrays.pod_mask.shape[0])
+
+    @property
+    def R(self) -> int:
+        return len(self.resource_names)
+
+
+def encode_cluster(
+    nodes: list[dict],
+    pods: list[dict],
+    config: "SchedulerConfiguration | None" = None,
+    *,
+    policy: DTypePolicy = TPU32,
+    priorityclasses: "list[dict] | None" = None,
+    namespaces: "list[dict] | None" = None,
+    pvcs: "list[dict] | None" = None,
+    pvs: "list[dict] | None" = None,
+    storageclasses: "list[dict] | None" = None,
+    node_capacity: "int | None" = None,
+    pod_capacity: "int | None" = None,
+) -> EncodedCluster:
+    """Build the padded device encoding of a cluster.
+
+    `node_capacity`/`pod_capacity` fix the static shapes (pad with masked
+    rows) so repeated problems of varying size reuse one XLA compilation.
+    """
+    config = config or SchedulerConfiguration.default()
+    N = node_capacity or max(len(nodes), 1)
+    if N < len(nodes):
+        raise ValueError(f"node_capacity {N} < {len(nodes)} nodes")
+    P = pod_capacity or max(len(pods), 1)
+    if P < len(pods):
+        raise ValueError(f"pod_capacity {P} < {len(pods)} pods")
+
+    res_vocab = Vocab(list(BASE_RESOURCES))
+    node_views = [NodeView(n) for n in nodes]
+    pod_views = [PodView(p) for p in pods]
+    node_idx = {nv.name: i for i, nv in enumerate(node_views)}
+    pcs = {
+        (pc.get("metadata", {}) or {}).get("name", ""): pc
+        for pc in priorityclasses or []
+    }
+
+    # First pass interns every resource name so R is final before filling.
+    node_alloc_ints = []
+    for nv in node_views:
+        ai = to_int_resources(nv.allocatable)
+        for r in ai:
+            res_vocab.intern(r)
+        node_alloc_ints.append(ai)
+    pod_req_ints, pod_sreq_ints = [], []
+    for p in pods:
+        ri = to_int_resources(pod_effective_requests(p))
+        si = to_int_resources(pod_scoring_requests(p))
+        for r in list(ri) + list(si):
+            res_vocab.intern(r)
+        pod_req_ints.append(ri)
+        pod_sreq_ints.append(si)
+    R = len(res_vocab)
+    resource_names = [s for s, _ in res_vocab.items()]
+
+    res_np = np.int64  # fill in numpy int64, cast at device-put time
+    node_alloc = np.zeros((N, R), res_np)
+    node_unsched = np.zeros(N, bool)
+    node_mask = np.zeros(N, bool)
+    for i, (nv, ai) in enumerate(zip(node_views, node_alloc_ints)):
+        node_mask[i] = True
+        node_unsched[i] = nv.unschedulable
+        for r, v in ai.items():
+            node_alloc[i, res_vocab.get(r)] = policy.to_units(r, v, up=False)
+
+    pod_req = np.zeros((P, R), res_np)
+    pod_sreq = np.zeros((P, R), res_np)
+    pod_req_rank = np.full((P, R), R, np.int32)
+    pod_node_name = np.full(P, NO_NODE, np.int32)
+    pod_tol_unsched = np.zeros(P, bool)
+    pod_priority = np.zeros(P, np.int32)
+    pod_mask = np.zeros(P, bool)
+    unsched_taint = {"key": "node.kubernetes.io/unschedulable", "effect": "NoSchedule"}
+    for i, (pv, ri, si) in enumerate(zip(pod_views, pod_req_ints, pod_sreq_ints)):
+        pod_mask[i] = True
+        for rank, (r, v) in enumerate(ri.items()):
+            j = res_vocab.get(r)
+            pod_req[i, j] = policy.to_units(r, v, up=True)
+            pod_req_rank[i, j] = rank
+        for r, v in si.items():
+            pod_sreq[i, res_vocab.get(r)] = policy.to_units(r, v, up=True)
+        if pv.node_name:
+            pod_node_name[i] = node_idx.get(pv.node_name, MISSING_NODE)
+        pod_tol_unsched[i] = tolerations_tolerate_taint(pv.tolerations, unsched_taint)
+        pod_priority[i] = resolve_pod_priority(pv, pcs)
+
+    # Initial binding state: pods whose nodeName names an existing node are
+    # already bound (oracle: sched/oracle.py Oracle.__init__); the rest are
+    # pending, scheduled in PrioritySort order (priority desc, arrival FIFO).
+    requested = np.zeros((N + 1, R), res_np)
+    s_requested = np.zeros((N + 1, R), res_np)
+    n_pods = np.zeros(N + 1, np.int32)
+    assignment = np.full(P, -1, np.int32)
+    pending: list[int] = []
+    for i in range(len(pods)):
+        tgt = pod_node_name[i]
+        if tgt >= 0:
+            assignment[i] = tgt
+            requested[tgt] += pod_req[i]
+            s_requested[tgt] += pod_sreq[i]
+            n_pods[tgt] += 1
+        else:
+            pending.append(i)
+    pending.sort(key=lambda i: (-int(pod_priority[i]), i))
+    queue = np.asarray(pending, np.int32)
+
+    arrays = ClusterArrays(
+        node_alloc=jnp.asarray(node_alloc, policy.res),
+        node_unsched=jnp.asarray(node_unsched),
+        node_mask=jnp.asarray(node_mask),
+        pod_req=jnp.asarray(pod_req, policy.res),
+        pod_sreq=jnp.asarray(pod_sreq, policy.res),
+        pod_req_rank=jnp.asarray(pod_req_rank),
+        pod_node_name=jnp.asarray(pod_node_name),
+        pod_tol_unsched=jnp.asarray(pod_tol_unsched),
+        pod_priority=jnp.asarray(pod_priority),
+        pod_mask=jnp.asarray(pod_mask),
+    )
+    state0 = SchedState(
+        requested=jnp.asarray(requested, policy.res),
+        s_requested=jnp.asarray(s_requested, policy.res),
+        n_pods=jnp.asarray(n_pods),
+        assignment=jnp.asarray(assignment),
+    )
+    return EncodedCluster(
+        arrays,
+        state0,
+        node_names=[nv.name for nv in node_views],
+        pod_keys=[(pv.namespace, pv.name) for pv in pod_views],
+        pods=list(pods),
+        resource_names=resource_names,
+        queue=queue,
+        policy=policy,
+        config=config,
+        n_nodes=len(nodes),
+        n_pods=len(pods),
+    )

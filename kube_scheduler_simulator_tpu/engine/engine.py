@@ -1,0 +1,270 @@
+"""The batched scheduler: one `lax.scan` over the pod queue.
+
+Each scan step schedules one pod exactly as the upstream framework does
+(PreFilter → Filter → PreScore → Score → Normalize → weight → select →
+bind; reference call stack SURVEY.md §3.3), but every per-node, per-plugin
+evaluation inside the step is a vectorized tensor op over the whole node
+axis — the reference's 16-goroutine per-node loop (upstream `Parallelism`,
+simulator/scheduler/scheduler.go:153) becomes one XLA kernel launch.
+
+Sequential-parity mode: scanning the queue in PrioritySort order with an
+in-scan scatter-update of node state gives bit-identical placements to the
+one-pod-at-a-time reference scheduler (pod i sees pod i-1's binding) while
+still extracting all the node/plugin parallelism. The gang/batched mode
+(parallel/) trades that parity for cross-pod batching.
+
+The scan carries `SchedState` (requested resources, pod counts,
+assignments) and emits dense result tensors; `results()` converts them
+host-side into the reference's exact annotation wire format
+(sched/results.py) — replacing the reference's result stores + informer
+reflector (simulator/scheduler/storereflector/storereflector.go) with the
+kernel's own outputs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..sched.results import (
+    PASSED_FILTER_MESSAGE,
+    SUCCESS_MESSAGE,
+    PodSchedulingResult,
+)
+from . import kernels as K
+from .encode import EncodedCluster
+
+class UnsupportedPluginError(NotImplementedError):
+    pass
+
+
+class BatchedScheduler:
+    """Compiled scheduling engine over one `EncodedCluster`."""
+
+    def __init__(self, enc: EncodedCluster, *, record: bool = True, strict: bool = True):
+        self.enc = enc
+        self.record = record
+        if enc.policy.name == "exact" and not jax.config.jax_enable_x64:
+            raise RuntimeError("EXACT dtype policy requires jax_enable_x64")
+        cfg = enc.config
+        # All prefilter names emitted into the trace (oracle order); the
+        # kernel-backed subset contributes device codes, the trivial subset
+        # is always "success".
+        self._prefilter_names = [
+            n
+            for n in cfg.enabled("preFilter")
+            if n in K.PREFILTER_KERNELS or n in K.TRIVIAL_PREFILTER
+        ]
+        self._prefilter_kernel_names = [
+            n for n in self._prefilter_names if n in K.PREFILTER_KERNELS
+        ]
+        self._filter_names = [n for n in cfg.enabled("filter") if n in K.FILTER_KERNELS]
+        self._prescore_names = [
+            n
+            for n in cfg.enabled("preScore")
+            if n in K.TRIVIAL_PRESCORE or n in K.PRESCORE_KERNELS
+        ]
+        self._score_specs = [
+            (n, w) for n, w in cfg.score_plugins() if n in K.SCORE_KERNELS
+        ]
+        if strict:
+            missing = [n for n in cfg.enabled("filter") if n not in K.FILTER_KERNELS]
+            missing += [n for n, _ in cfg.score_plugins() if n not in K.SCORE_KERNELS]
+            missing += [
+                n
+                for n in cfg.enabled("preFilter")
+                if n not in K.PREFILTER_KERNELS and n not in K.TRIVIAL_PREFILTER
+            ]
+            missing += [
+                n
+                for n in cfg.enabled("preScore")
+                if n not in K.PRESCORE_KERNELS and n not in K.TRIVIAL_PRESCORE
+            ]
+            missing += [
+                n for n in cfg.enabled("postFilter") if n not in K.POSTFILTER_KERNELS
+            ]
+            if missing:
+                raise UnsupportedPluginError(
+                    f"no kernel for enabled plugins: {sorted(set(missing))} "
+                    "(pass strict=False to skip them)"
+                )
+        self._pf_kernels = [
+            K.PREFILTER_KERNELS[n][0](enc) for n in self._prefilter_kernel_names
+        ]
+        self._f_kernels = [K.FILTER_KERNELS[n][0](enc) for n in self._filter_names]
+        self._s_kernels = [K.SCORE_KERNELS[n][0](enc) for n in self._score_specs_names]
+        self._s_normalize = [K.SCORE_KERNELS[n][1] for n in self._score_specs_names]
+        self.weights = jnp.asarray(
+            [w for _, w in self._score_specs], enc.policy.score
+        )
+        self._run = jax.jit(self._build_run())
+        self._trace = None
+        self._final_state = None
+
+    @property
+    def _score_specs_names(self) -> list[str]:
+        return [n for n, _ in self._score_specs]
+
+    # -- compiled program ---------------------------------------------------
+
+    def _build_run(self):
+        enc = self.enc
+        N = enc.N
+        score_dt = enc.policy.score
+        NEG = jnp.iinfo(score_dt).min // 2
+        record = self.record
+        pf_kernels = self._pf_kernels
+        f_kernels = self._f_kernels
+        s_kernels = self._s_kernels
+        s_normalize = self._s_normalize
+
+        def step(carry, p):
+            state, a, weights = carry
+            if pf_kernels:
+                pf_codes = jnp.stack([k(a, state, p) for k in pf_kernels])
+                pf_ok = (pf_codes == 0).all()
+            else:
+                pf_codes = jnp.zeros((0,), jnp.int32)
+                pf_ok = jnp.bool_(True)
+            if f_kernels:
+                codes = jnp.stack([k(a, state, p) for k in f_kernels], axis=1)  # [N,F]
+            else:
+                codes = jnp.zeros((N, 0), jnp.int32)
+            feasible = (codes == 0).all(axis=1) & a.node_mask & pf_ok
+            if s_kernels:
+                raw = jnp.stack([k(a, state, p) for k in s_kernels], axis=1)  # [N,S]
+                finals = []
+                for j, mode in enumerate(s_normalize):
+                    r = raw[:, j]
+                    if mode in ("default", "default_reverse"):
+                        mx = jnp.max(jnp.where(feasible, r, 0))
+                        scaled = r * K.MAX_NODE_SCORE // jnp.maximum(mx, 1)
+                        if mode == "default_reverse":
+                            normed = jnp.where(
+                                mx == 0, K.MAX_NODE_SCORE, K.MAX_NODE_SCORE - scaled
+                            )
+                        else:
+                            normed = jnp.where(mx == 0, r, scaled)
+                    else:
+                        normed = r
+                    finals.append(normed.astype(score_dt) * weights[j])
+                final = jnp.stack(finals, axis=1)  # [N,S]
+                total = final.sum(axis=1)
+            else:
+                raw = jnp.zeros((N, 0), score_dt)
+                final = raw
+                total = jnp.zeros((N,), score_dt)
+            masked = jnp.where(feasible, total, NEG)
+            sel = jnp.argmax(masked).astype(jnp.int32)
+            sel = jnp.where(feasible.any(), sel, -1)
+            tgt = jnp.where(sel >= 0, sel, N)
+            state = state.replace(
+                requested=state.requested.at[tgt].add(a.pod_req[p]),
+                s_requested=state.s_requested.at[tgt].add(a.pod_sreq[p]),
+                n_pods=state.n_pods.at[tgt].add(1),
+                assignment=state.assignment.at[p].set(sel),
+            )
+            out = (pf_codes, codes, raw, final, sel) if record else sel
+            return (state, a, weights), out
+
+        def run(arrays, state0, queue, weights):
+            # arrays ride through the scan carry untouched; passing them as
+            # an argument (not a closure constant) keeps the cluster data
+            # out of the compiled executable, so equal-shape problems reuse
+            # the compilation.
+            (state, _, _), out = jax.lax.scan(step, (state0, arrays, weights), queue)
+            return state, out
+
+        return run
+
+    # -- execution ----------------------------------------------------------
+
+    def run(self, weights: "jnp.ndarray | None" = None):
+        """Execute the scan; returns (final_state, trace)."""
+        w = self.weights if weights is None else weights
+        state, out = self._run(
+            self.enc.arrays, self.enc.state0, jnp.asarray(self.enc.queue), w
+        )
+        self._final_state = state
+        self._trace = out
+        return state, out
+
+    def placements(self) -> dict[tuple[str, str], str]:
+        """pod (ns, name) → node name ("" = unschedulable). Fast path."""
+        if self._final_state is None:
+            self.run()
+        assign = np.asarray(self._final_state.assignment)
+        out = {}
+        for qi in self.enc.queue:
+            sel = int(assign[qi])
+            out[self.enc.pod_keys[qi]] = self.enc.node_names[sel] if sel >= 0 else ""
+        return out
+
+    # -- trace → reference annotation records -------------------------------
+
+    def results(self) -> list[PodSchedulingResult]:
+        """Convert the dense result tensors into the reference's per-pod
+        scheduling records (identical to the oracle's output shape)."""
+        if not self.record:
+            raise RuntimeError("engine built with record=False has no trace")
+        if self._trace is None:
+            self.run()
+        enc = self.enc
+        pf_codes, codes, raw, final, sel = (np.asarray(x) for x in self._trace)
+        results = []
+        n_real = enc.n_nodes
+        for qi, p in enumerate(enc.queue):
+            ns, name = enc.pod_keys[p]
+            res = PodSchedulingResult(pod_namespace=ns, pod_name=name)
+            pf_failed = False
+            for pname in self._prefilter_names:
+                if pname in K.PREFILTER_KERNELS:
+                    j = self._prefilter_kernel_names.index(pname)
+                    c = int(pf_codes[qi, j])
+                else:
+                    c = 0
+                msg = K.PREFILTER_KERNELS[pname][1](c, enc) if c else SUCCESS_MESSAGE
+                res.pre_filter_status[pname] = msg
+                if c:
+                    pf_failed = True
+            if pf_failed:
+                res.status = "Unschedulable"
+                results.append(res)
+                continue
+            feasible = []
+            for n in range(n_real):
+                ok = True
+                for j, fname in enumerate(self._filter_names):
+                    c = int(codes[qi, n, j])
+                    if c:
+                        res.add_filter(
+                            enc.node_names[n],
+                            fname,
+                            K.FILTER_KERNELS[fname][1](c, enc),
+                        )
+                        ok = False
+                        break
+                    res.add_filter(enc.node_names[n], fname, PASSED_FILTER_MESSAGE)
+                if ok:
+                    feasible.append(n)
+            if not feasible:
+                res.status = "Unschedulable"
+                results.append(res)
+                continue
+            for pname in self._prescore_names:
+                res.pre_score[pname] = SUCCESS_MESSAGE
+            for j, sname in enumerate(self._score_specs_names):
+                for n in feasible:
+                    res.add_score(enc.node_names[n], sname, int(raw[qi, n, j]))
+                    res.add_final_score(enc.node_names[n], sname, int(final[qi, n, j]))
+            s = int(sel[qi])
+            res.selected_node = enc.node_names[s]
+            res.status = "Scheduled"
+            # Mirrors the oracle (sched/oracle.py schedule_one), which mirrors
+            # the reference's always-on reserve/prebind/bind recording.
+            res.reserve["VolumeBinding"] = SUCCESS_MESSAGE
+            res.prebind["VolumeBinding"] = SUCCESS_MESSAGE
+            res.bind["DefaultBinder"] = SUCCESS_MESSAGE
+            results.append(res)
+        return results

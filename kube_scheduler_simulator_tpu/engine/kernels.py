@@ -1,0 +1,272 @@
+"""Per-plugin filter/score kernels over the `[nodes]` axis.
+
+Each kernel replaces one upstream scheduler-framework plugin's per-node
+callback (reference: the wrapped plugins' Filter/Score delegation,
+simulator/scheduler/plugin/wrappedplugin.go:491-516 and :388-413) with a
+single vectorized pass over every node at once.
+
+Contracts:
+  * filter kernel: `fn(arrays, state, p) -> codes[N] int32`, 0 = pass,
+    >0 = plugin-specific reason code. Codes are decoded host-side into the
+    exact upstream failure messages the reference records into the
+    `filter-result` annotation.
+  * score kernel: `fn(arrays, state, p) -> raw[N]` in the score dtype,
+    plus a normalize mode: None (raw is final), "default"
+    (helper.DefaultNormalizeScore), or "default_reverse" (reverse=True).
+
+Builders take the `EncodedCluster` so they can bake static plugin args
+(scoring-strategy resources, weights) into the jitted closure — the
+analogue of the reference rebuilding the scheduler on config change
+(simulator/scheduler/scheduler.go:70-87 RestartScheduler).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+
+from ..sched.config import MAX_NODE_SCORE
+from ..sched.oracle_plugins import BALANCED_SCALE
+from .encode import EncodedCluster, PODS_RES, ClusterArrays, SchedState
+
+# ---------------------------------------------------------------------------
+# NodeResourcesFit  (oracle: sched/oracle_plugins.py fit_filter/fit_score;
+# upstream NodeResourcesFit with the LeastAllocated default strategy)
+# ---------------------------------------------------------------------------
+
+
+def build_fit_filter(enc: EncodedCluster):
+    R = enc.R
+
+    def kernel(a: ClusterArrays, s: SchedState, p) -> jnp.ndarray:
+        req = a.pod_req[p]  # [R]
+        free = a.node_alloc - s.requested[:-1]  # [N, R]
+        insuff = (req > 0)[None, :] & (req[None, :] > free)  # [N, R]
+        too_many = s.n_pods[:-1] + 1 > a.node_alloc[:, PODS_RES]
+        # first violating resource in the pod's request-dict order
+        rank = jnp.where(insuff, a.pod_req_rank[p][None, :], R + 1)
+        first_r = jnp.argmin(rank, axis=1)
+        any_insuff = insuff.any(axis=1)
+        return jnp.where(
+            too_many, 1, jnp.where(any_insuff, 2 + first_r, 0)
+        ).astype(jnp.int32)
+
+    return kernel
+
+
+def decode_fit(code: int, enc: EncodedCluster) -> str:
+    if code == 1:
+        return "Too many pods"
+    return f"Insufficient {enc.resource_names[code - 2]}"
+
+
+def build_fit_score(enc: EncodedCluster):
+    args = enc.config.plugin_args("NodeResourcesFit")
+    strategy = args.get("scoringStrategy") or {}
+    resources = strategy.get("resources") or [
+        {"name": "cpu", "weight": 1},
+        {"name": "memory", "weight": 1},
+    ]
+    stype = strategy.get("type", "LeastAllocated")
+    specs = [
+        (enc.resource_names.index(r["name"]), int(r.get("weight", 1)))
+        for r in resources
+        if r["name"] in enc.resource_names
+    ]
+    # Resources never seen in the cluster still contribute weight with
+    # score 0 (capacity 0), as in the oracle's loop over configured specs.
+    zero_weight = sum(
+        int(r.get("weight", 1)) for r in resources if r["name"] not in enc.resource_names
+    )
+    wsum = sum(w for _, w in specs) + zero_weight
+
+    def kernel(a: ClusterArrays, s: SchedState, p) -> jnp.ndarray:
+        total = jnp.zeros(a.node_mask.shape[0], enc.policy.score)
+        for r_idx, w in specs:
+            cap = a.node_alloc[:, r_idx]
+            req = s.s_requested[:-1, r_idx] + a.pod_sreq[p, r_idx]
+            if stype == "MostAllocated":
+                r_score = req * MAX_NODE_SCORE // jnp.maximum(cap, 1)
+            else:  # LeastAllocated
+                r_score = (cap - req) * MAX_NODE_SCORE // jnp.maximum(cap, 1)
+            r_score = jnp.where((cap == 0) | (req > cap), 0, r_score)
+            total = total + r_score.astype(enc.policy.score) * w
+        if wsum == 0:
+            return total
+        return total // wsum
+
+    return kernel
+
+
+# ---------------------------------------------------------------------------
+# NodeResourcesBalancedAllocation  (oracle: balanced_allocation_score;
+# upstream balancedResourceScorer: 100 * (1 - std of usage fractions))
+# ---------------------------------------------------------------------------
+
+
+def _exact_isqrt64(x: jnp.ndarray) -> jnp.ndarray:
+    """floor(sqrt(x)) for int64 x < 2^52, exact: the float64 sqrt of an
+    exactly-representable int is correctly rounded, then one-step adjusted.
+    Requires jax_enable_x64 (EXACT policy only)."""
+    s = jnp.floor(jnp.sqrt(x.astype(jnp.float64))).astype(x.dtype)
+    s = jnp.where(s * s > x, s - 1, s)
+    s = jnp.where((s + 1) * (s + 1) <= x, s + 1, s)
+    return s
+
+
+def _div_scale_exact(num: jnp.ndarray, den: jnp.ndarray, scale_bits: int) -> jnp.ndarray:
+    """floor(num * 2^scale_bits / den) without widening past the input
+    dtype: base-256 long division, exact as long as den < 2^(31-8). This
+    keeps the int32 (TPU) policy overflow-free — the encoder clamps device
+    quantities to 2^23-1 for exactly this reason."""
+    den = jnp.maximum(den, 1)
+    acc = num // den
+    rem = num % den
+    for shift in range(0, scale_bits, 8):
+        bits = min(8, scale_bits - shift)
+        acc = acc * (1 << bits) + (rem * (1 << bits)) // den
+        rem = (rem * (1 << bits)) % den
+    return acc
+
+
+def build_balanced_score(enc: EncodedCluster):
+    """Quantized-integer balanced allocation (see oracle_plugins.py
+    balanced_allocation_score): usage fractions in units of 1/2^16, std
+    decided by integer arithmetic so the kernel is bit-identical to the
+    oracle. The two-resource default config is exact in both dtype
+    policies; the >2-resource variance branch is exact under EXACT (int64 +
+    isqrt) and float32-approximate (±1 point) under the 32-bit TPU policy,
+    where 48-bit intermediates don't exist."""
+    args = enc.config.plugin_args("NodeResourcesBalancedAllocation")
+    resources = args.get("resources") or [
+        {"name": "cpu", "weight": 1},
+        {"name": "memory", "weight": 1},
+    ]
+    idxs = [
+        enc.resource_names.index(r["name"])
+        for r in resources
+        if r["name"] in enc.resource_names
+    ]
+    S = BALANCED_SCALE
+    S_BITS = S.bit_length() - 1
+    exact64 = enc.policy.name == "exact"
+
+    def kernel(a: ClusterArrays, s: SchedState, p) -> jnp.ndarray:
+        N = a.node_mask.shape[0]
+        if not idxs:
+            return jnp.full(N, MAX_NODE_SCORE, enc.policy.score)
+        caps = jnp.stack([a.node_alloc[:, i] for i in idxs], axis=1)  # [N, K]
+        reqs = jnp.stack(
+            [s.s_requested[:-1, i] + a.pod_sreq[p, i] for i in idxs], axis=1
+        )
+        incl = caps > 0
+        q = jnp.minimum(_div_scale_exact(reqs, caps, S_BITS), S)  # [N, K]
+        nf = incl.sum(axis=1).astype(q.dtype)
+        # nf == 2 branch: std = |q0 - q1| / (2S); ints stay under 2^24.
+        qmax = jnp.where(incl, q, jnp.iinfo(q.dtype).min).max(axis=1)
+        qmin = jnp.where(incl, q, jnp.iinfo(q.dtype).max).min(axis=1)
+        d = qmax - qmin
+        score2 = (200 * S - 100 * d) // (2 * S)
+        # general branch: A = nf*Σq² - (Σq)², std = sqrt(A)/(nf*S),
+        # score = 100 - ceil(100*sqrt(A)/(nf*S)).
+        if exact64:
+            q64 = q.astype(jnp.int64)
+            nf64 = nf.astype(jnp.int64)
+            sum_q = jnp.where(incl, q64, 0).sum(axis=1)
+            sum_q2 = jnp.where(incl, q64 * q64, 0).sum(axis=1)
+            A = nf64 * sum_q2 - sum_q * sum_q
+            x2 = 10000 * A
+            D = jnp.maximum(nf64, 1) * S
+            # ceil(sqrt(x2)/D) == isqrt(x2-1)//D + 1 for x2 > 0
+            k = jnp.where(
+                x2 == 0, 0, _exact_isqrt64(jnp.maximum(x2 - 1, 0)) // D + 1
+            )
+            score_n = (MAX_NODE_SCORE - k).astype(q.dtype)
+        else:
+            f = q.astype(jnp.float32) / S
+            nff = jnp.maximum(nf, 1).astype(jnp.float32)
+            mean = jnp.where(incl, f, 0).sum(axis=1) / nff
+            var = jnp.where(incl, (f - mean[:, None]) ** 2, 0).sum(axis=1) / nff
+            std = jnp.sqrt(var)
+            score_n = jnp.floor((1 - std) * MAX_NODE_SCORE).astype(q.dtype)
+        score = jnp.where(nf == 2, score2, score_n)
+        score = jnp.where(nf < 2, MAX_NODE_SCORE, score)
+        return score.astype(enc.policy.score)
+
+    return kernel
+
+
+# ---------------------------------------------------------------------------
+# NodeName / NodeUnschedulable  (oracle: node_name_filter,
+# node_unschedulable_filter)
+# ---------------------------------------------------------------------------
+
+
+def build_node_name_filter(enc: EncodedCluster):
+    def kernel(a: ClusterArrays, s: SchedState, p) -> jnp.ndarray:
+        want = a.pod_node_name[p]
+        node_ids = jnp.arange(a.node_mask.shape[0], dtype=jnp.int32)
+        fail = (want != -1) & (node_ids != want)
+        return fail.astype(jnp.int32)
+
+    return kernel
+
+
+def decode_node_name(code: int, enc: EncodedCluster) -> str:
+    return "node(s) didn't match the requested node name"
+
+
+def build_node_unschedulable_filter(enc: EncodedCluster):
+    def kernel(a: ClusterArrays, s: SchedState, p) -> jnp.ndarray:
+        fail = a.node_unsched & ~a.pod_tol_unsched[p]
+        return fail.astype(jnp.int32)
+
+    return kernel
+
+
+def decode_node_unschedulable(code: int, enc: EncodedCluster) -> str:
+    return "node(s) were unschedulable"
+
+
+# ---------------------------------------------------------------------------
+# registries — populated further by m3 kernel modules
+# ---------------------------------------------------------------------------
+
+# name -> (builder(enc) -> filter kernel, decode(code, enc) -> message)
+FILTER_KERNELS: dict[str, tuple[Callable, Callable]] = {
+    "NodeResourcesFit": (build_fit_filter, decode_fit),
+    "NodeName": (build_node_name_filter, decode_node_name),
+    "NodeUnschedulable": (build_node_unschedulable_filter, decode_node_unschedulable),
+}
+
+# name -> (builder(enc) -> score kernel, normalize mode)
+SCORE_KERNELS: dict[str, tuple[Callable, "str | None"]] = {
+    "NodeResourcesFit": (build_fit_score, None),
+    "NodeResourcesBalancedAllocation": (build_balanced_score, None),
+}
+
+# preFilter plugins that can veto a pod before the per-node loop; name ->
+# (builder(enc) -> fn(arrays, state, p) -> code (0 = pass), decode). M2
+# plugins never fail prefilter; populated by m3 kernels (NodePorts
+# self-conflict etc.).
+PREFILTER_KERNELS: dict[str, tuple[Callable, Callable]] = {}
+
+# preFilter plugins whose oracle implementation only caches state and can
+# never fail — the engine just records "success" for them.
+TRIVIAL_PREFILTER: set[str] = {"NodeResourcesFit"}
+
+# preScore plugins that can fail/skip; name -> (builder, decode). Trivial
+# ones (always "success") are listed in TRIVIAL_PRESCORE.
+PRESCORE_KERNELS: dict[str, tuple[Callable, Callable]] = {}
+
+TRIVIAL_PRESCORE: set[str] = {
+    "TaintToleration",
+    "NodeAffinity",
+    "NodeResourcesFit",
+    "NodeResourcesBalancedAllocation",
+}
+
+# postFilter (preemption) kernels; name -> builder. Empty until the
+# DefaultPreemption victim-selection kernel lands (SURVEY.md §7 M3).
+POSTFILTER_KERNELS: dict[str, Callable] = {}

@@ -309,3 +309,19 @@ def toleration_tolerates_taint(tol: dict, taint: dict) -> bool:
 
 def tolerations_tolerate_taint(tols: list[dict], taint: dict) -> bool:
     return any(toleration_tolerates_taint(t, taint) for t in tols)
+
+
+def resolve_pod_priority(pod: PodView, priorityclasses: dict[str, dict]) -> int:
+    """Effective pod priority: explicit spec.priority, else the named
+    PriorityClass value, else the globalDefault PriorityClass, else 0.
+    Shared by the oracle's snapshot and the engine's encoder so PrioritySort
+    queue order can never diverge between them."""
+    if pod.priority is not None:
+        return int(pod.priority)
+    pc_name = pod.priority_class_name
+    if pc_name and pc_name in priorityclasses:
+        return int(priorityclasses[pc_name].get("value", 0))
+    for pc in priorityclasses.values():
+        if pc.get("globalDefault"):
+            return int(pc.get("value", 0))
+    return 0

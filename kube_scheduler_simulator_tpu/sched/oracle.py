@@ -29,6 +29,7 @@ from ..models.objects import (
     PodView,
     pod_effective_requests,
     pod_scoring_requests,
+    resolve_pod_priority,
 )
 from .config import MAX_NODE_SCORE, SchedulerConfiguration
 from .resources import to_int_resources
@@ -92,15 +93,7 @@ class ClusterSnapshot:
         return [p for ni in self.nodes.values() for p in ni.pods]
 
     def pod_priority(self, pod: PodView) -> int:
-        if pod.priority is not None:
-            return int(pod.priority)
-        pc_name = pod.priority_class_name
-        if pc_name and pc_name in self.priorityclasses:
-            return int(self.priorityclasses[pc_name].get("value", 0))
-        for pc in self.priorityclasses.values():
-            if pc.get("globalDefault"):
-                return int(pc.get("value", 0))
-        return 0
+        return resolve_pod_priority(pod, self.priorityclasses)
 
 
 class CycleContext:

@@ -150,32 +150,49 @@ def fit_score(ctx: "CycleContext", pod: PodView, ni: "NodeInfo") -> int:
 # NodeResourcesBalancedAllocation
 # ---------------------------------------------------------------------------
 
+# Usage fractions are quantized to 1/2^16 so the score is decided purely by
+# integer arithmetic. Upstream computes float64 std (balancedResourceScorer);
+# float division is not bit-portable across compilers (XLA lowers f64 divide
+# to a non-IEEE reciprocal sequence), so this framework defines the score in
+# exact integers instead: results can differ from upstream Go by at most 1
+# point when a usage fraction straddles a 2^-16 quantum. Documented
+# divergence, same class as the selectHost tie-break (see sched/oracle.py).
+BALANCED_SCALE = 1 << 16
+
+
 def balanced_allocation_score(ctx: "CycleContext", pod: PodView, ni: "NodeInfo") -> int:
-    """score = (1 - std(fractions)) * 100, fractions capped at 1; for two
-    resources std = |f0 - f1| / 2 (upstream balancedResourceScorer)."""
+    """score = floor((1 - std(fractions)) * 100), fractions capped at 1 and
+    quantized to 1/BALANCED_SCALE; for two resources std = |f0 - f1| / 2
+    (upstream balancedResourceScorer, in exact integer arithmetic)."""
     args = ctx.args("NodeResourcesBalancedAllocation")
     resources = args.get("resources") or [
         {"name": "cpu", "weight": 1},
         {"name": "memory", "weight": 1},
     ]
     pod_req = to_int_resources(pod_scoring_requests(pod.obj))
-    fractions: list[float] = []
+    S = BALANCED_SCALE
+    q: list[int] = []
     for spec in resources:
         rname = spec["name"]
         capacity = ni.allocatable.get(rname, 0)
         if capacity == 0:
             continue
         requested = ni.nonzero_requested.get(rname, 0) + pod_req.get(rname, 0)
-        f = requested / capacity
-        fractions.append(min(f, 1.0))
-    if len(fractions) == 2:
-        std = abs(fractions[0] - fractions[1]) / 2
-    elif len(fractions) > 2:
-        mean = sum(fractions) / len(fractions)
-        std = math.sqrt(sum((f - mean) ** 2 for f in fractions) / len(fractions))
-    else:
-        std = 0.0
-    return int((1 - std) * MAX_NODE_SCORE)
+        q.append(min(requested * S // capacity, S))
+    nf = len(q)
+    if nf < 2:
+        return MAX_NODE_SCORE
+    if nf == 2:
+        d = abs(q[0] - q[1])  # std = d / (2S)
+        return (200 * S - 100 * d) // (2 * S)
+    # std = sqrt(A) / (nf*S) with A = nf*Σq² - (Σq)²;
+    # floor(100*(1-std)) = 100 - ceil(100*sqrt(A)/(nf*S)), computed exactly
+    # via integer sqrt: ceil(sqrt(x)/D) == isqrt(x-1)//D + 1 for x > 0.
+    A = nf * sum(x * x for x in q) - sum(q) ** 2
+    x2 = 10000 * A
+    if x2 == 0:
+        return MAX_NODE_SCORE
+    return MAX_NODE_SCORE - (math.isqrt(x2 - 1) // (nf * S) + 1)
 
 
 # ---------------------------------------------------------------------------

@@ -11,3 +11,9 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The EXACT dtype policy (engine/encode.py) needs 64-bit ints/floats for
+# bit-parity with the pure-Python oracle on arbitrary quantities.
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,192 @@
+"""Parity: the batched TPU engine vs the pure-Python oracle (M2 plugin set).
+
+Strategy per SURVEY.md §4 implication (3): property tests comparing the
+vectorized kernels to the slow per-pod oracle, on the CPU jax backend.
+Parity is checked at full annotation-wire-format depth (the reference's 13
+per-pod annotation payloads), not just placements.
+"""
+
+import random
+
+import pytest
+
+from kube_scheduler_simulator_tpu.engine import (
+    EXACT,
+    TPU32,
+    BatchedScheduler,
+    encode_cluster,
+)
+from kube_scheduler_simulator_tpu.engine.engine import UnsupportedPluginError
+from kube_scheduler_simulator_tpu.sched.config import SchedulerConfiguration
+from kube_scheduler_simulator_tpu.sched.oracle import Oracle
+
+from helpers import node, pod
+
+
+def restricted_config(
+    filters=("NodeUnschedulable", "NodeName", "NodeResourcesFit"),
+    scores=(("NodeResourcesFit", 1), ("NodeResourcesBalancedAllocation", 1)),
+    prefilters=("NodeResourcesFit",),
+    prescores=("NodeResourcesFit", "NodeResourcesBalancedAllocation"),
+):
+    """A profile enabling only the named plugins (disable '*' + explicit
+    enable, the reference's own plugin-set rewrite semantics)."""
+    star = [{"name": "*"}]
+    plugins = {
+        "preFilter": {"disabled": star, "enabled": [{"name": n} for n in prefilters]},
+        "filter": {"disabled": star, "enabled": [{"name": n} for n in filters]},
+        "postFilter": {"disabled": star, "enabled": []},
+        "preScore": {"disabled": star, "enabled": [{"name": n} for n in prescores]},
+        "score": {
+            "disabled": star,
+            "enabled": [{"name": n, "weight": w} for n, w in scores],
+        },
+    }
+    return SchedulerConfiguration.from_dict(
+        {"profiles": [{"schedulerName": "default-scheduler", "plugins": plugins}]}
+    )
+
+
+def assert_parity(nodes, pods, config, policy=EXACT, **enc_kw):
+    oracle = Oracle([dict(n) for n in nodes], [dict(p) for p in pods], config)
+    want = oracle.schedule_all()
+    enc = encode_cluster(nodes, pods, config, policy=policy, **enc_kw)
+    eng = BatchedScheduler(enc)
+    got = eng.results()
+    assert len(got) == len(want)
+    for w, g in zip(want, got):
+        key = (w.pod_namespace, w.pod_name)
+        assert (g.pod_namespace, g.pod_name) == key
+        assert g.status == w.status, (key, g.status, w.status)
+        assert g.selected_node == w.selected_node, key
+        assert g.to_annotations() == w.to_annotations(), key
+    return got
+
+
+class TestM2Parity:
+    def test_basic_spread_over_capacity(self):
+        nodes = [
+            node("n0", cpu="2", mem="4Gi"),
+            node("n1", cpu="4", mem="8Gi"),
+            node("n2", cpu="8", mem="16Gi"),
+        ]
+        pods = [pod(f"p{i}", cpu="500m", mem="512Mi") for i in range(10)]
+        assert_parity(nodes, pods, restricted_config())
+
+    def test_tpu32_policy_mi_granular(self):
+        nodes = [node("n0", cpu="2", mem="4Gi"), node("n1", cpu="4", mem="8Gi")]
+        pods = [pod(f"p{i}", cpu="250m", mem="256Mi") for i in range(8)]
+        assert_parity(nodes, pods, restricted_config(), policy=TPU32)
+
+    def test_unschedulable_pod_and_node(self):
+        nodes = [
+            node("n0", cpu="1", mem="1Gi"),
+            node("n1", cpu="1", mem="1Gi", unschedulable=True),
+        ]
+        pods = [
+            pod("fits", cpu="500m", mem="256Mi"),
+            pod("too-big", cpu="16", mem="64Gi"),
+            pod("tolerates", cpu="100m", mem="64Mi",
+                tolerations=[{"operator": "Exists"}]),
+        ]
+        results = assert_parity(nodes, pods, restricted_config())
+        by_name = {r.pod_name: r for r in results}
+        assert by_name["too-big"].status == "Unschedulable"
+        assert by_name["fits"].status == "Scheduled"
+
+    def test_node_name_pinning(self):
+        nodes = [node("n0"), node("n1")]
+        pods = [
+            pod("pinned", node_name="n1"),
+            pod("ghost", node_name="gone"),  # names a nonexistent node
+        ]
+        # pods with a nodeName naming an existing node are pre-bound (not
+        # scheduled); 'ghost' stays pending and fails NodeName everywhere.
+        oracle = Oracle([dict(n) for n in nodes], [dict(p) for p in pods],
+                        restricted_config())
+        assert len(oracle.pending) == 1
+        assert_parity(nodes, pods, restricted_config())
+
+    def test_priority_order_and_bound_pods(self):
+        nodes = [node("n0", cpu="2", mem="2Gi"), node("n1", cpu="2", mem="2Gi")]
+        pods = [
+            pod("low", cpu="1500m", mem="512Mi", priority=1),
+            pod("high", cpu="1500m", mem="512Mi", priority=100),
+            pod("bound", cpu="1", mem="1Gi", node_name="n0"),
+        ]
+        # 'high' schedules first (PrioritySort), 'bound' consumes n0 upfront.
+        results = assert_parity(nodes, pods, restricted_config())
+        by_name = {r.pod_name: r for r in results}
+        assert by_name["high"].selected_node == "n1"
+
+    def test_capacity_padding_invariance(self):
+        nodes = [node("n0", cpu="2"), node("n1", cpu="4")]
+        pods = [pod(f"p{i}", cpu="300m") for i in range(6)]
+        a = assert_parity(nodes, pods, restricted_config())
+        b = assert_parity(
+            nodes, pods, restricted_config(), node_capacity=16, pod_capacity=32
+        )
+        for ra, rb in zip(a, b):
+            assert ra.to_annotations() == rb.to_annotations()
+
+    def test_strict_raises_on_unimplemented_plugin(self):
+        cfg = SchedulerConfiguration.default()  # full default set
+        enc = encode_cluster([node("n0")], [pod("p0")], cfg)
+        with pytest.raises(UnsupportedPluginError):
+            BatchedScheduler(enc)
+
+    def test_most_allocated_strategy(self):
+        cfg = restricted_config()
+        cfg.profiles[0]["pluginConfig"] = [
+            {
+                "name": "NodeResourcesFit",
+                "args": {
+                    "scoringStrategy": {
+                        "type": "MostAllocated",
+                        "resources": [
+                            {"name": "cpu", "weight": 1},
+                            {"name": "memory", "weight": 3},
+                        ],
+                    }
+                },
+            }
+        ]
+        nodes = [node("n0", cpu="4", mem="8Gi"), node("n1", cpu="8", mem="8Gi")]
+        pods = [pod(f"p{i}", cpu="1", mem="1Gi") for i in range(5)]
+        assert_parity(nodes, pods, cfg)
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_randomized_clusters(self, seed):
+        rng = random.Random(seed)
+        n_nodes = rng.randint(2, 10)
+        n_pods = rng.randint(5, 40)
+        nodes = []
+        for i in range(n_nodes):
+            nodes.append(
+                node(
+                    f"n{i}",
+                    cpu=f"{rng.randint(1, 16)}",
+                    mem=f"{rng.randint(1, 32)}Gi",
+                    pods=str(rng.choice([3, 10, 110])),
+                    unschedulable=rng.random() < 0.15,
+                )
+            )
+        pods = []
+        for i in range(n_pods):
+            kw = {}
+            if rng.random() < 0.1:
+                kw["node_name"] = f"n{rng.randint(0, n_nodes)}"  # may not exist
+            if rng.random() < 0.3:
+                kw["priority"] = rng.randint(0, 5)
+            if rng.random() < 0.1:
+                kw["tolerations"] = [{"operator": "Exists"}]
+            pods.append(
+                pod(
+                    f"p{i}",
+                    cpu=f"{rng.choice([100, 250, 500, 1000, 4000])}m",
+                    mem=f"{rng.choice([64, 128, 512, 1024, 4096])}Mi",
+                    **kw,
+                )
+            )
+        assert_parity(nodes, pods, restricted_config())
+        assert_parity(nodes, pods, restricted_config(), policy=TPU32)
