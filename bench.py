@@ -1,0 +1,78 @@
+"""Benchmark: batched TPU engine vs the sequential per-pod baseline.
+
+Workload: BASELINE.json config #1 semantics (NodeResourcesFit +
+BalancedAllocation + the basic filters) scaled to a timing-stable size.
+Metric: scheduling decisions/sec — one decision = one pod through the full
+Filter→Score→Normalize→select→bind cycle over every node.
+
+`vs_baseline`: the reference publishes no numbers (BASELINE.md), so the
+baseline here is this repo's own pure-Python oracle — a faithful
+reimplementation of the reference's sequential one-pod-at-a-time loop
+(reference: upstream scheduleOne driven by simulator/scheduler; SURVEY.md
+§3.3) — measured on the same cluster and extrapolated per-pod.
+
+Prints exactly one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+N_NODES = 256
+N_PODS = 2048
+BASELINE_PODS = 128  # oracle sample size (sequential python is slow)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from kube_scheduler_simulator_tpu.engine import TPU32, encode_cluster
+    from kube_scheduler_simulator_tpu.engine.engine import (
+        BatchedScheduler,
+        supported_config,
+    )
+    from kube_scheduler_simulator_tpu.sched.oracle import Oracle
+    from kube_scheduler_simulator_tpu.synth import synthetic_cluster
+
+    cfg = supported_config()
+    nodes, pods = synthetic_cluster(N_NODES, N_PODS, seed=42)
+
+    enc = encode_cluster(nodes, pods, cfg, policy=TPU32)
+    sched = BatchedScheduler(enc, record=False)
+    args = (enc.arrays, enc.state0, jnp.asarray(enc.queue), sched.weights)
+    import numpy as np
+
+    run = jax.jit(sched.run_fn)
+    # NB: sync via host transfer of the (tiny) selection vector —
+    # jax.block_until_ready is a no-op on the experimental axon TPU
+    # backend, which silently turns timings into dispatch-only numbers.
+    np.asarray(run(*args)[1])  # warmup: compile
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(run(*args)[1])
+        best = min(best, time.perf_counter() - t0)
+    dps = N_PODS / best
+
+    # sequential python baseline on a sample of the same workload
+    oracle = Oracle(nodes, pods[:BASELINE_PODS], cfg)
+    t0 = time.perf_counter()
+    oracle.schedule_all()
+    base_dps = BASELINE_PODS / (time.perf_counter() - t0)
+
+    print(
+        json.dumps(
+            {
+                "metric": "scheduling decisions/sec/chip",
+                "value": round(dps, 1),
+                "unit": f"decisions/s ({N_PODS} pods x {N_NODES} nodes, fit+balanced)",
+                "vs_baseline": round(dps / base_dps, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

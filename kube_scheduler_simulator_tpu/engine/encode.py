@@ -24,8 +24,7 @@ Two dtype policies:
 
 from __future__ import annotations
 
-import math
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any
 
 import chex
@@ -109,12 +108,12 @@ class ClusterArrays:
 
 @chex.dataclass
 class SchedState:
-    """Mutable per-step state. Node rows have one extra junk row at index N
-    so scatter-updates for unschedulable pods (target -1) land harmlessly."""
+    """Mutable per-step state. Node axes are exactly [N] so they shard over
+    the mesh's node axis; unschedulable pods scatter zeros to row 0."""
 
-    requested: jnp.ndarray  # [N+1, R] sum of effective requests of bound pods
-    s_requested: jnp.ndarray  # [N+1, R] sum of scoring requests
-    n_pods: jnp.ndarray  # [N+1] int32 bound-pod count
+    requested: jnp.ndarray  # [N, R] sum of effective requests of bound pods
+    s_requested: jnp.ndarray  # [N, R] sum of scoring requests
+    n_pods: jnp.ndarray  # [N] int32 bound-pod count
     assignment: jnp.ndarray  # [P] int32 node idx | -1
 
 
@@ -149,6 +148,9 @@ class EncodedCluster:
         self.n_nodes = n_nodes  # real (unpadded) counts
         self.n_pods = n_pods
         self.aux = aux or {}  # per-plugin extra encodings (filled by kernels)
+        # Non-core objects retained for kernel builders that consume them
+        # (volume plugins, namespace selectors); see encode_cluster.
+        self.objects: dict[str, list[dict]] = {}
 
     @property
     def N(self) -> int:
@@ -251,9 +253,9 @@ def encode_cluster(
     # Initial binding state: pods whose nodeName names an existing node are
     # already bound (oracle: sched/oracle.py Oracle.__init__); the rest are
     # pending, scheduled in PrioritySort order (priority desc, arrival FIFO).
-    requested = np.zeros((N + 1, R), res_np)
-    s_requested = np.zeros((N + 1, R), res_np)
-    n_pods = np.zeros(N + 1, np.int32)
+    requested = np.zeros((N, R), res_np)
+    s_requested = np.zeros((N, R), res_np)
+    n_pods = np.zeros(N, np.int32)
     assignment = np.full(P, -1, np.int32)
     pending: list[int] = []
     for i in range(len(pods)):
@@ -286,7 +288,7 @@ def encode_cluster(
         n_pods=jnp.asarray(n_pods),
         assignment=jnp.asarray(assignment),
     )
-    return EncodedCluster(
+    enc = EncodedCluster(
         arrays,
         state0,
         node_names=[nv.name for nv in node_views],
@@ -299,3 +301,16 @@ def encode_cluster(
         n_nodes=len(nodes),
         n_pods=len(pods),
     )
+    # Retained for the kernel builders that consume them (volume-binding
+    # family, namespace-selector terms). The engine's strict mode refuses
+    # configs whose enabled plugins have no kernel, so these can never be
+    # silently ignored by a strict engine.
+    enc.objects = {
+        "nodes": list(nodes),
+        "pvcs": list(pvcs or []),
+        "pvs": list(pvs or []),
+        "storageclasses": list(storageclasses or []),
+        "priorityclasses": list(priorityclasses or []),
+        "namespaces": list(namespaces or []),
+    }
+    return enc

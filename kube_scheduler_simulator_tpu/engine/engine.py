@@ -39,6 +39,35 @@ class UnsupportedPluginError(NotImplementedError):
     pass
 
 
+def supported_config() -> "SchedulerConfiguration":
+    """The default-plugin-order configuration restricted to extension
+    points the engine has kernels for today. Grows automatically as kernel
+    registries fill in; used by the graft entry point and the benchmark."""
+    from ..sched.config import SchedulerConfiguration, default_plugins
+
+    dp = default_plugins()
+    star = [{"name": "*"}]
+
+    def keep(point, names):
+        return {
+            "disabled": star,
+            "enabled": [e for e in dp[point] if e["name"] in names],
+        }
+
+    plugins = {
+        "preFilter": keep(
+            "preFilter", set(K.PREFILTER_KERNELS) | K.TRIVIAL_PREFILTER
+        ),
+        "filter": keep("filter", set(K.FILTER_KERNELS)),
+        "postFilter": keep("postFilter", set(K.POSTFILTER_KERNELS)),
+        "preScore": keep("preScore", set(K.PRESCORE_KERNELS) | K.TRIVIAL_PRESCORE),
+        "score": keep("score", set(K.SCORE_KERNELS)),
+    }
+    return SchedulerConfiguration.from_dict(
+        {"profiles": [{"schedulerName": "default-scheduler", "plugins": plugins}]}
+    )
+
+
 class BatchedScheduler:
     """Compiled scheduling engine over one `EncodedCluster`."""
 
@@ -98,7 +127,11 @@ class BatchedScheduler:
         self.weights = jnp.asarray(
             [w for _, w in self._score_specs], enc.policy.score
         )
-        self._run = jax.jit(self._build_run())
+        # run_fn is the un-jitted program: (arrays, state0, queue, weights)
+        # -> (final_state, trace). Exposed for the graft entry point, for
+        # vmap over weight variants (Monte-Carlo), and for mesh-sharded jit.
+        self.run_fn = self._build_run()
+        self._run = jax.jit(self.run_fn)
         self._trace = None
         self._final_state = None
 
@@ -158,11 +191,14 @@ class BatchedScheduler:
             masked = jnp.where(feasible, total, NEG)
             sel = jnp.argmax(masked).astype(jnp.int32)
             sel = jnp.where(feasible.any(), sel, -1)
-            tgt = jnp.where(sel >= 0, sel, N)
+            # Unschedulable pods scatter-add zeros to row 0 (valid == 0),
+            # keeping the node axis exactly [N] for mesh sharding.
+            tgt = jnp.maximum(sel, 0)
+            valid = (sel >= 0).astype(a.pod_req.dtype)
             state = state.replace(
-                requested=state.requested.at[tgt].add(a.pod_req[p]),
-                s_requested=state.s_requested.at[tgt].add(a.pod_sreq[p]),
-                n_pods=state.n_pods.at[tgt].add(1),
+                requested=state.requested.at[tgt].add(a.pod_req[p] * valid),
+                s_requested=state.s_requested.at[tgt].add(a.pod_sreq[p] * valid),
+                n_pods=state.n_pods.at[tgt].add(valid.astype(state.n_pods.dtype)),
                 assignment=state.assignment.at[p].set(sel),
             )
             out = (pf_codes, codes, raw, final, sel) if record else sel

@@ -41,9 +41,9 @@ def build_fit_filter(enc: EncodedCluster):
 
     def kernel(a: ClusterArrays, s: SchedState, p) -> jnp.ndarray:
         req = a.pod_req[p]  # [R]
-        free = a.node_alloc - s.requested[:-1]  # [N, R]
+        free = a.node_alloc - s.requested  # [N, R]
         insuff = (req > 0)[None, :] & (req[None, :] > free)  # [N, R]
-        too_many = s.n_pods[:-1] + 1 > a.node_alloc[:, PODS_RES]
+        too_many = s.n_pods + 1 > a.node_alloc[:, PODS_RES]
         # first violating resource in the pod's request-dict order
         rank = jnp.where(insuff, a.pod_req_rank[p][None, :], R + 1)
         first_r = jnp.argmin(rank, axis=1)
@@ -85,7 +85,7 @@ def build_fit_score(enc: EncodedCluster):
         total = jnp.zeros(a.node_mask.shape[0], enc.policy.score)
         for r_idx, w in specs:
             cap = a.node_alloc[:, r_idx]
-            req = s.s_requested[:-1, r_idx] + a.pod_sreq[p, r_idx]
+            req = s.s_requested[:, r_idx] + a.pod_sreq[p, r_idx]
             if stype == "MostAllocated":
                 r_score = req * MAX_NODE_SCORE // jnp.maximum(cap, 1)
             else:  # LeastAllocated
@@ -158,10 +158,14 @@ def build_balanced_score(enc: EncodedCluster):
             return jnp.full(N, MAX_NODE_SCORE, enc.policy.score)
         caps = jnp.stack([a.node_alloc[:, i] for i in idxs], axis=1)  # [N, K]
         reqs = jnp.stack(
-            [s.s_requested[:-1, i] + a.pod_sreq[p, i] for i in idxs], axis=1
+            [s.s_requested[:, i] + a.pod_sreq[p, i] for i in idxs], axis=1
         )
         incl = caps > 0
-        q = jnp.minimum(_div_scale_exact(reqs, caps, S_BITS), S)  # [N, K]
+        # Clamp requested to capacity BEFORE the long division: fractions
+        # cap at 1 anyway (q = S exactly when req >= cap, as in the
+        # oracle), and it preserves _div_scale_exact's no-overflow
+        # precondition when usage wildly exceeds a tiny capacity.
+        q = _div_scale_exact(jnp.minimum(reqs, caps), caps, S_BITS)  # [N, K]
         nf = incl.sum(axis=1).astype(q.dtype)
         # nf == 2 branch: std = |q0 - q1| / (2S); ints stay under 2^24.
         qmax = jnp.where(incl, q, jnp.iinfo(q.dtype).min).max(axis=1)

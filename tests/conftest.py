@@ -7,13 +7,19 @@ partitioning XLA applies on a real TPU slice.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force-set (not setdefault): the image's shell env pins JAX_PLATFORMS=axon
+# (the real TPU), which would silently move the whole suite onto the single
+# real chip — slow compiles and no 8-device mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
-# The EXACT dtype policy (engine/encode.py) needs 64-bit ints/floats for
-# bit-parity with the pure-Python oracle on arbitrary quantities.
 import jax  # noqa: E402
 
+# Belt and braces: the axon sitecustomize registers the TPU plugin at
+# interpreter start; pin the platform at the config level too.
+jax.config.update("jax_platforms", "cpu")
+# The EXACT dtype policy (engine/encode.py) needs 64-bit ints/floats for
+# bit-parity with the pure-Python oracle on arbitrary quantities.
 jax.config.update("jax_enable_x64", True)
