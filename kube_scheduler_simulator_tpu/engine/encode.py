@@ -89,10 +89,24 @@ EXACT = DTypePolicy("exact", jnp.int64, jnp.int64, jnp.float64)
 TPU32 = DTypePolicy("i32", jnp.int32, jnp.int32, jnp.float32, scale_bytes=True)
 
 
+# Taint/toleration effect ids.
+EFFECTS = {"NoSchedule": 0, "PreferNoSchedule": 1, "NoExecute": 2}
+# node-selector expression operator ids.
+OPS = {"In": 0, "NotIn": 1, "Exists": 2, "DoesNotExist": 3, "Gt": 4, "Lt": 5}
+OP_NEVER = 6  # unknown operator: matches nothing (oracle _match_expression)
+# Pseudo label key carrying the node name for matchFields (kept out of the
+# real label-key namespace via the NUL prefix).
+FIELD_NAME_KEY = "\x00metadata.name"
+VAL_PAD = -3  # padding slot in expression value lists; matches no value id
+
+
 @chex.dataclass
 class ClusterArrays:
-    """Static per-problem device arrays. Axes: N = padded nodes (+1 junk
-    row in mutable state), P = padded pods, R = resource kinds."""
+    """Static per-problem device arrays. Axes: N = padded nodes, P = padded
+    pods, R = resource kinds, T = taint slots, L = toleration slots,
+    K = label keys, NS = nodeSelector slots, TM/PR = affinity terms,
+    E = expressions per term, VV = values per expression, Q = (proto,port)
+    pairs, V2 = (proto,ip,port) triples, I = images."""
 
     node_alloc: jnp.ndarray  # [N, R] allocatable, device units
     node_unsched: jnp.ndarray  # [N] bool
@@ -104,6 +118,43 @@ class ClusterArrays:
     pod_tol_unsched: jnp.ndarray  # [P] bool — tolerates the unschedulable taint
     pod_priority: jnp.ndarray  # [P] int32 resolved priority
     pod_mask: jnp.ndarray  # [P] bool — real pod
+    # taints / tolerations (TaintToleration, oracle_plugins.py:207-236)
+    taint_key: jnp.ndarray  # [N, T] int32 | -1 pad
+    taint_val: jnp.ndarray  # [N, T] int32
+    taint_effect: jnp.ndarray  # [N, T] int32 effect id | -1
+    tol_key: jnp.ndarray  # [P, L] int32 | -1 = any key
+    tol_val: jnp.ndarray  # [P, L] int32
+    tol_effect: jnp.ndarray  # [P, L] int32 effect id | -1 = any effect
+    tol_op: jnp.ndarray  # [P, L] int32 0=Equal 1=Exists | -1 pad
+    # node labels (NodeAffinity / nodeSelector)
+    label_val: jnp.ndarray  # [N, K] int32 value id | -1 absent
+    label_num: jnp.ndarray  # [N, K] numeric value (Gt/Lt)
+    label_num_ok: jnp.ndarray  # [N, K] bool parseable
+    nsel_key: jnp.ndarray  # [P, NS] int32 key col | -1 pad
+    nsel_val: jnp.ndarray  # [P, NS] int32
+    raff_key: jnp.ndarray  # [P, TM, E] int32 key col | -1 pad
+    raff_op: jnp.ndarray  # [P, TM, E] int32 op id
+    raff_vals: jnp.ndarray  # [P, TM, E, VV] int32 | VAL_PAD
+    raff_num: jnp.ndarray  # [P, TM, E] numeric rhs
+    raff_num_ok: jnp.ndarray  # [P, TM, E] bool
+    raff_term_valid: jnp.ndarray  # [P, TM] bool — term has >=1 expr
+    pod_has_raff: jnp.ndarray  # [P] bool — required terms present
+    paff_key: jnp.ndarray  # [P, PR, E] int32 | -1 pad
+    paff_op: jnp.ndarray  # [P, PR, E] int32
+    paff_vals: jnp.ndarray  # [P, PR, E, VV] int32
+    paff_num: jnp.ndarray  # [P, PR, E]
+    paff_num_ok: jnp.ndarray  # [P, PR, E] bool
+    paff_weight: jnp.ndarray  # [P, PR] int32
+    paff_term_valid: jnp.ndarray  # [P, PR] bool
+    # host ports (NodePorts)
+    want_wild: jnp.ndarray  # [P, Q] int32 wildcard-ip port counts
+    want_trip: jnp.ndarray  # [P, V2] int32 specific-ip port counts
+    want_pair: jnp.ndarray  # [P, Q] int32 all users of (proto,port)
+    trip_pair: jnp.ndarray  # [V2] int32 triple -> pair index
+    # images (ImageLocality)
+    img_contrib: jnp.ndarray  # [N, I] size*have//total per node-image
+    pod_img: jnp.ndarray  # [P, I] int32 image occurrence counts
+    pod_ncont: jnp.ndarray  # [P] int32 container count
 
 
 @chex.dataclass
@@ -115,6 +166,9 @@ class SchedState:
     s_requested: jnp.ndarray  # [N, R] sum of scoring requests
     n_pods: jnp.ndarray  # [N] int32 bound-pod count
     assignment: jnp.ndarray  # [P] int32 node idx | -1
+    used_pair: jnp.ndarray  # [N, Q] int32 users of (proto,port), any ip
+    used_wild: jnp.ndarray  # [N, Q] int32 wildcard-ip users of (proto,port)
+    used_trip: jnp.ndarray  # [N, V2] int32 users of (proto,ip,port)
 
 
 class EncodedCluster:
@@ -163,6 +217,287 @@ class EncodedCluster:
     @property
     def R(self) -> int:
         return len(self.resource_names)
+
+
+def _encode_taints(node_views, pod_views, N, P):
+    """TaintToleration encodings (oracle: taint_toleration_filter/score,
+    models/objects.py toleration_tolerates_taint)."""
+    kv = Vocab()
+    node_taints = [nv.taints for nv in node_views]
+    pod_tols = [pv.tolerations for pv in pod_views]
+    T = max(1, max((len(t) for t in node_taints), default=0))
+    L = max(1, max((len(t) for t in pod_tols), default=0))
+    taint_key = np.full((N, T), -1, np.int32)
+    taint_val = np.full((N, T), -1, np.int32)
+    taint_effect = np.full((N, T), -1, np.int32)
+    for i, taints in enumerate(node_taints):
+        for j, t in enumerate(taints):
+            taint_key[i, j] = kv.intern(t.get("key") or "")
+            taint_val[i, j] = kv.intern(t.get("value") or "")
+            taint_effect[i, j] = EFFECTS.get(t.get("effect") or "", -1)
+    tol_key = np.full((P, L), -1, np.int32)
+    tol_val = np.full((P, L), -1, np.int32)
+    tol_effect = np.full((P, L), -1, np.int32)
+    tol_op = np.full((P, L), -1, np.int32)
+    for i, tols in enumerate(pod_tols):
+        for j, t in enumerate(tols):
+            k = t.get("key") or ""
+            tol_key[i, j] = kv.intern(k) if k else -1  # empty key = any
+            tol_val[i, j] = kv.intern(t.get("value") or "")
+            eff = t.get("effect") or ""
+            tol_effect[i, j] = EFFECTS.get(eff, -2) if eff else -1  # -1 = any
+            # 0 = Equal, 1 = Exists, 2 = unknown operator (tolerates
+            # nothing, oracle toleration_tolerates_taint fallthrough)
+            op = t.get("operator") or "Equal"
+            tol_op[i, j] = {"Equal": 0, "Exists": 1}.get(op, 2)
+    return dict(
+        taint_key=taint_key,
+        taint_val=taint_val,
+        taint_effect=taint_effect,
+        tol_key=tol_key,
+        tol_val=tol_val,
+        tol_effect=tol_effect,
+        tol_op=tol_op,
+    ), {"node_taints": node_taints}
+
+
+def _num_or_none(s, policy: DTypePolicy):
+    """Parse an int for Gt/Lt; values outside the device int range count as
+    unparseable (they could not be compared exactly on device)."""
+    try:
+        v = int(s)
+    except (TypeError, ValueError):
+        return None
+    lim = 2**62 if policy.name == "exact" else 2**31 - 1
+    if not -lim <= v <= lim:
+        return None
+    return v
+
+
+def _encode_labels_affinity(node_views, pod_views, N, P, policy: DTypePolicy):
+    """NodeAffinity / nodeSelector encodings (oracle: node_affinity_filter/
+    score; models/objects.py match_node_selector_term[s])."""
+    keys, vals = Vocab(), Vocab()
+    num_np = np.int64
+
+    # Pre-pass: parse every pod-side term so the vocabularies are final
+    # before arrays are sized.
+    def parse_expr(e, is_field):
+        if is_field:
+            # matchFields evaluate against {"metadata.name": node.name}
+            # only (oracle match_node_selector_term); any other field key
+            # is absent there — encode it as a never-populated pseudo key
+            # so Exists/In miss and DoesNotExist matches, like the oracle.
+            raw = e.get("key") or ""
+            key = FIELD_NAME_KEY if raw == "metadata.name" else "\x00" + raw
+        else:
+            key = e.get("key") or ""
+        op = OPS.get(e.get("operator") or "", OP_NEVER)
+        values = [str(v) for v in (e.get("values") or [])]
+        num = _num_or_none(values[0], policy) if values else None
+        return (
+            keys.intern(key),
+            op,
+            [vals.intern(v) for v in values],
+            num,
+        )
+
+    def parse_term(term):
+        exprs = [parse_expr(e, False) for e in term.get("matchExpressions") or []]
+        exprs += [parse_expr(e, True) for e in term.get("matchFields") or []]
+        return exprs
+
+    pod_nsel, pod_req_terms, pod_pref_terms = [], [], []
+    for pv in pod_views:
+        pod_nsel.append(
+            [(keys.intern(k), vals.intern(str(v))) for k, v in pv.node_selector.items()]
+        )
+        req = pv.node_affinity.get("requiredDuringSchedulingIgnoredDuringExecution") or {}
+        pod_req_terms.append([parse_term(t) for t in req.get("nodeSelectorTerms") or []])
+        prefs = pv.node_affinity.get("preferredDuringSchedulingIgnoredDuringExecution") or []
+        pod_pref_terms.append(
+            [(int(pr.get("weight", 0)), parse_term(pr.get("preference") or {})) for pr in prefs]
+        )
+    field_col = keys.intern(FIELD_NAME_KEY)
+    for nv in node_views:
+        for k in nv.labels:
+            keys.intern(k)
+        vals.intern(nv.name)
+    # second pass over node label values (vocab must include them all)
+    K = len(keys)
+    label_val = np.full((N, K), -1, np.int32)
+    label_num = np.zeros((N, K), num_np)
+    label_num_ok = np.zeros((N, K), bool)
+    for i, nv in enumerate(node_views):
+        for k, v in nv.labels.items():
+            col = keys.get(k)
+            label_val[i, col] = vals.intern(str(v))
+            num = _num_or_none(v, policy)
+            if num is not None:
+                label_num[i, col] = num
+                label_num_ok[i, col] = True
+        label_val[i, field_col] = vals.intern(nv.name)
+        num = _num_or_none(nv.name, policy)
+        if num is not None:
+            label_num[i, field_col] = num
+            label_num_ok[i, field_col] = True
+
+    NS = max(1, max((len(s) for s in pod_nsel), default=0))
+    nsel_key = np.full((P, NS), -1, np.int32)
+    nsel_val = np.full((P, NS), -1, np.int32)
+    for i, sel in enumerate(pod_nsel):
+        for j, (k, v) in enumerate(sel):
+            nsel_key[i, j] = k
+            nsel_val[i, j] = v
+
+    def fill_terms(all_terms, TM, E, VV):
+        key = np.full((P, TM, E), -1, np.int32)
+        op = np.full((P, TM, E), OP_NEVER, np.int32)
+        vvals = np.full((P, TM, E, VV), VAL_PAD, np.int32)
+        num = np.zeros((P, TM, E), num_np)
+        num_ok = np.zeros((P, TM, E), bool)
+        term_valid = np.zeros((P, TM), bool)
+        for i, terms in enumerate(all_terms):
+            for ti, exprs in enumerate(terms):
+                term_valid[i, ti] = len(exprs) > 0
+                for ei, (k, o, vv, n) in enumerate(exprs):
+                    key[i, ti, ei] = k
+                    op[i, ti, ei] = o
+                    for vi, v in enumerate(vv):
+                        vvals[i, ti, ei, vi] = v
+                    if n is not None:
+                        num[i, ti, ei] = n
+                        num_ok[i, ti, ei] = True
+        return key, op, vvals, num, num_ok, term_valid
+
+    TM = max(1, max((len(t) for t in pod_req_terms), default=0))
+    E = max(
+        1,
+        max((len(e) for t in pod_req_terms for e in t), default=0),
+        max((len(e) for t in pod_pref_terms for _, e in t), default=0),
+    )
+    VV = max(
+        1,
+        max(
+            (len(x[2]) for t in pod_req_terms for e in t for x in e),
+            default=0,
+        ),
+        max(
+            (len(x[2]) for t in pod_pref_terms for _, e in t for x in e),
+            default=0,
+        ),
+    )
+    rk, ro, rv, rn, rno, rtv = fill_terms(pod_req_terms, TM, E, VV)
+    PR = max(1, max((len(t) for t in pod_pref_terms), default=0))
+    pk, po, pvv, pn, pno, ptv = fill_terms(
+        [[e for _, e in t] for t in pod_pref_terms], PR, E, VV
+    )
+    paff_weight = np.zeros((P, PR), np.int32)
+    for i, prefs in enumerate(pod_pref_terms):
+        for j, (w, _) in enumerate(prefs):
+            paff_weight[i, j] = w
+    pod_has_raff = np.asarray([len(t) > 0 for t in pod_req_terms] + [False] * (P - len(pod_req_terms)), bool)
+    return dict(
+        label_val=label_val,
+        label_num=label_num,
+        label_num_ok=label_num_ok,
+        nsel_key=nsel_key,
+        nsel_val=nsel_val,
+        raff_key=rk,
+        raff_op=ro,
+        raff_vals=rv,
+        raff_num=rn,
+        raff_num_ok=rno,
+        raff_term_valid=rtv,
+        pod_has_raff=pod_has_raff,
+        paff_key=pk,
+        paff_op=po,
+        paff_vals=pvv,
+        paff_num=pn,
+        paff_num_ok=pno,
+        paff_weight=paff_weight,
+        paff_term_valid=ptv,
+    )
+
+
+def _encode_ports(pod_views, N, P):
+    """NodePorts encodings (oracle: node_ports_filter/_ports_conflict).
+    (proto, port) pairs index Q; specific-ip (proto, ip, port) triples
+    index V2; hostIP defaults to the wildcard 0.0.0.0 (PodView.host_ports)."""
+    pair_ids: dict[tuple[str, int], int] = {}
+    trip_ids: dict[tuple[str, str, int], int] = {}
+    wants = [pv.host_ports for pv in pod_views]
+    for ports in wants:
+        for proto, ip, port in ports:
+            pair_ids.setdefault((proto, port), len(pair_ids))
+            if ip != "0.0.0.0":
+                trip_ids.setdefault((proto, ip, port), len(trip_ids))
+    Q = max(1, len(pair_ids))
+    V2 = max(1, len(trip_ids))
+    want_wild = np.zeros((P, Q), np.int32)
+    want_trip = np.zeros((P, V2), np.int32)
+    want_pair = np.zeros((P, Q), np.int32)
+    trip_pair = np.zeros(V2, np.int32)
+    for (proto, ip, port), v in trip_ids.items():
+        trip_pair[v] = pair_ids[(proto, port)]
+    for i, ports in enumerate(wants):
+        for proto, ip, port in ports:
+            q = pair_ids[(proto, port)]
+            want_pair[i, q] += 1
+            if ip == "0.0.0.0":
+                want_wild[i, q] += 1
+            else:
+                want_trip[i, trip_ids[(proto, ip, port)]] += 1
+    return dict(
+        want_wild=want_wild,
+        want_trip=want_trip,
+        want_pair=want_pair,
+        trip_pair=trip_pair,
+    )
+
+
+# ImageLocality works in Ki units so every intermediate fits int32 (the
+# thresholds are Mi multiples, so they are exact in Ki); container counts
+# clamp at 64 to keep 100*(ss-MIN) within range. Same definition in the
+# oracle — see image_locality_score.
+IMG_MIN_KI = 23 * 1024
+IMG_MAX_CONTAINER_KI = 1000 * 1024
+IMG_MAX_CONTAINERS = 64
+
+
+def _encode_images(node_views, pod_views, N, P, n_real_nodes):
+    """ImageLocality encodings (oracle: image_locality_score)."""
+    from ..sched.oracle_plugins import _normalized_image_name
+
+    img_ids: dict[str, int] = {}
+    node_imgs = []  # per node: {img_id: size}
+    for nv in node_views:
+        m = {}
+        for names, size in nv.images:
+            for name in names:
+                want = _normalized_image_name(name)
+                i = img_ids.setdefault(want, len(img_ids))
+                m[i] = size
+        node_imgs.append(m)
+    I = max(1, len(img_ids))
+    have = np.zeros(I, np.int64)
+    for m in node_imgs:
+        for i in m:
+            have[i] += 1
+    img_contrib = np.zeros((N, I), np.int64)
+    total = max(1, n_real_nodes)
+    for n, m in enumerate(node_imgs):
+        for i, size in m.items():
+            img_contrib[n, i] = (size * int(have[i]) // total) >> 10  # Ki
+    pod_img = np.zeros((P, I), np.int32)
+    pod_ncont = np.zeros(P, np.int32)
+    for p, pv in enumerate(pod_views):
+        pod_ncont[p] = min(pv.num_containers, IMG_MAX_CONTAINERS)
+        for name in pv.container_images:
+            i = img_ids.get(_normalized_image_name(name))
+            if i is not None:
+                pod_img[p, i] += 1
+    return dict(img_contrib=img_contrib, pod_img=pod_img, pod_ncont=pod_ncont)
 
 
 def encode_cluster(
@@ -250,6 +585,14 @@ def encode_cluster(
         pod_tol_unsched[i] = tolerations_tolerate_taint(pv.tolerations, unsched_taint)
         pod_priority[i] = resolve_pod_priority(pv, pcs)
 
+    taint_arrays, taint_aux = _encode_taints(node_views, pod_views, N, P)
+    label_arrays = _encode_labels_affinity(node_views, pod_views, N, P, policy)
+    port_arrays = _encode_ports(pod_views, N, P)
+    img_arrays = _encode_images(node_views, pod_views, N, P, len(nodes))
+    want_pair = port_arrays["want_pair"]
+    Q = want_pair.shape[1]
+    V2 = port_arrays["want_trip"].shape[1]
+
     # Initial binding state: pods whose nodeName names an existing node are
     # already bound (oracle: sched/oracle.py Oracle.__init__); the rest are
     # pending, scheduled in PrioritySort order (priority desc, arrival FIFO).
@@ -257,6 +600,9 @@ def encode_cluster(
     s_requested = np.zeros((N, R), res_np)
     n_pods = np.zeros(N, np.int32)
     assignment = np.full(P, -1, np.int32)
+    used_pair = np.zeros((N, Q), np.int32)
+    used_wild = np.zeros((N, Q), np.int32)
+    used_trip = np.zeros((N, V2), np.int32)
     pending: list[int] = []
     for i in range(len(pods)):
         tgt = pod_node_name[i]
@@ -265,11 +611,15 @@ def encode_cluster(
             requested[tgt] += pod_req[i]
             s_requested[tgt] += pod_sreq[i]
             n_pods[tgt] += 1
+            used_pair[tgt] += want_pair[i]
+            used_wild[tgt] += port_arrays["want_wild"][i]
+            used_trip[tgt] += port_arrays["want_trip"][i]
         else:
             pending.append(i)
     pending.sort(key=lambda i: (-int(pod_priority[i]), i))
     queue = np.asarray(pending, np.int32)
 
+    num_dt = policy.res  # Gt/Lt numerics and image sums share the res dtype
     arrays = ClusterArrays(
         node_alloc=jnp.asarray(node_alloc, policy.res),
         node_unsched=jnp.asarray(node_unsched),
@@ -281,12 +631,25 @@ def encode_cluster(
         pod_tol_unsched=jnp.asarray(pod_tol_unsched),
         pod_priority=jnp.asarray(pod_priority),
         pod_mask=jnp.asarray(pod_mask),
+        **{k: jnp.asarray(v) for k, v in taint_arrays.items()},
+        **{
+            k: jnp.asarray(v, num_dt if k in ("label_num", "raff_num", "paff_num") else None)
+            for k, v in label_arrays.items()
+        },
+        **{k: jnp.asarray(v) for k, v in port_arrays.items()},
+        **{
+            k: jnp.asarray(v, num_dt if k == "img_contrib" else None)
+            for k, v in img_arrays.items()
+        },
     )
     state0 = SchedState(
         requested=jnp.asarray(requested, policy.res),
         s_requested=jnp.asarray(s_requested, policy.res),
         n_pods=jnp.asarray(n_pods),
         assignment=jnp.asarray(assignment),
+        used_pair=jnp.asarray(used_pair),
+        used_wild=jnp.asarray(used_wild),
+        used_trip=jnp.asarray(used_trip),
     )
     enc = EncodedCluster(
         arrays,
@@ -300,6 +663,7 @@ def encode_cluster(
         config=config,
         n_nodes=len(nodes),
         n_pods=len(pods),
+        aux=taint_aux,
     )
     # Retained for the kernel builders that consume them (volume-binding
     # family, namespace-selector terms). The engine's strict mode refuses

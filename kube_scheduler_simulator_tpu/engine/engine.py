@@ -195,11 +195,15 @@ class BatchedScheduler:
             # keeping the node axis exactly [N] for mesh sharding.
             tgt = jnp.maximum(sel, 0)
             valid = (sel >= 0).astype(a.pod_req.dtype)
+            vi = (sel >= 0).astype(jnp.int32)
             state = state.replace(
                 requested=state.requested.at[tgt].add(a.pod_req[p] * valid),
                 s_requested=state.s_requested.at[tgt].add(a.pod_sreq[p] * valid),
-                n_pods=state.n_pods.at[tgt].add(valid.astype(state.n_pods.dtype)),
+                n_pods=state.n_pods.at[tgt].add(vi),
                 assignment=state.assignment.at[p].set(sel),
+                used_pair=state.used_pair.at[tgt].add(a.want_pair[p] * vi),
+                used_wild=state.used_wild.at[tgt].add(a.want_wild[p] * vi),
+                used_trip=state.used_trip.at[tgt].add(a.want_trip[p] * vi),
             )
             out = (pf_codes, codes, raw, final, sel) if record else sel
             return (state, a, weights), out
@@ -277,7 +281,7 @@ class BatchedScheduler:
                         res.add_filter(
                             enc.node_names[n],
                             fname,
-                            K.FILTER_KERNELS[fname][1](c, enc),
+                            K.FILTER_KERNELS[fname][1](c, enc, n),
                         )
                         ok = False
                         break

@@ -7,9 +7,9 @@ single vectorized pass over every node at once.
 
 Contracts:
   * filter kernel: `fn(arrays, state, p) -> codes[N] int32`, 0 = pass,
-    >0 = plugin-specific reason code. Codes are decoded host-side into the
-    exact upstream failure messages the reference records into the
-    `filter-result` annotation.
+    >0 = plugin-specific reason code. Codes are decoded host-side via
+    `decode(code, enc, node_idx)` into the exact upstream failure messages
+    the reference records into the `filter-result` annotation.
   * score kernel: `fn(arrays, state, p) -> raw[N]` in the score dtype,
     plus a normalize mode: None (raw is final), "default"
     (helper.DefaultNormalizeScore), or "default_reverse" (reverse=True).
@@ -55,7 +55,7 @@ def build_fit_filter(enc: EncodedCluster):
     return kernel
 
 
-def decode_fit(code: int, enc: EncodedCluster) -> str:
+def decode_fit(code: int, enc: EncodedCluster, node_idx: int) -> str:
     if code == 1:
         return "Too many pods"
     return f"Insufficient {enc.resource_names[code - 2]}"
@@ -217,7 +217,7 @@ def build_node_name_filter(enc: EncodedCluster):
     return kernel
 
 
-def decode_node_name(code: int, enc: EncodedCluster) -> str:
+def decode_node_name(code: int, enc: EncodedCluster, node_idx: int) -> str:
     return "node(s) didn't match the requested node name"
 
 
@@ -229,7 +229,7 @@ def build_node_unschedulable_filter(enc: EncodedCluster):
     return kernel
 
 
-def decode_node_unschedulable(code: int, enc: EncodedCluster) -> str:
+def decode_node_unschedulable(code: int, enc: EncodedCluster, node_idx: int) -> str:
     return "node(s) were unschedulable"
 
 
@@ -274,3 +274,208 @@ TRIVIAL_PRESCORE: set[str] = {
 # postFilter (preemption) kernels; name -> builder. Empty until the
 # DefaultPreemption victim-selection kernel lands (SURVEY.md §7 M3).
 POSTFILTER_KERNELS: dict[str, Callable] = {}
+
+
+# ---------------------------------------------------------------------------
+# TaintToleration  (oracle: taint_toleration_filter/score/normalize;
+# models/objects.py toleration_tolerates_taint)
+# ---------------------------------------------------------------------------
+
+
+def _tolerated(a: ClusterArrays, p) -> jnp.ndarray:
+    """[N, T] — is each node taint tolerated by pod p's tolerations?"""
+    tk = a.tol_key[p][:, None, None]  # [L, 1, 1]
+    tv = a.tol_val[p][:, None, None]
+    te = a.tol_effect[p][:, None, None]
+    to = a.tol_op[p][:, None, None]
+    nk = a.taint_key[None, :, :]  # [1, N, T]
+    nv = a.taint_val[None, :, :]
+    ne = a.taint_effect[None, :, :]
+    valid = to >= 0
+    eff_ok = (te == -1) | (te == ne)
+    key_ok = (tk == -1) | (tk == nk)
+    # Exists always matches; Equal needs the value; unknown ops (2) never
+    val_ok = (to == 1) | ((to == 0) & (tv == nv))
+    return (valid & eff_ok & key_ok & val_ok).any(axis=0)  # [N, T]
+
+
+def build_taint_filter(enc: EncodedCluster):
+    def kernel(a: ClusterArrays, s: SchedState, p) -> jnp.ndarray:
+        tolerated = _tolerated(a, p)
+        intolerable = (a.taint_effect == 0) | (a.taint_effect == 2)  # NoSchedule|NoExecute
+        bad = intolerable & ~tolerated  # [N, T]
+        first_bad = jnp.argmax(bad, axis=1)  # first True slot
+        return jnp.where(bad.any(axis=1), first_bad + 1, 0).astype(jnp.int32)
+
+    return kernel
+
+
+def decode_taint(code: int, enc: EncodedCluster, node_idx: int) -> str:
+    taint = enc.aux["node_taints"][node_idx][code - 1]
+    return (
+        "node(s) had untolerated taint "
+        f"{{{taint.get('key', '')}: {taint.get('value', '')}}}"
+    )
+
+
+def build_taint_score(enc: EncodedCluster):
+    def kernel(a: ClusterArrays, s: SchedState, p) -> jnp.ndarray:
+        tolerated = _tolerated(a, p)
+        prefer = a.taint_effect == 1  # PreferNoSchedule
+        return (prefer & ~tolerated).sum(axis=1).astype(enc.policy.score)
+
+    return kernel
+
+
+# ---------------------------------------------------------------------------
+# NodeAffinity / nodeSelector  (oracle: node_affinity_filter/score;
+# models/objects.py match_node_selector_term[s], _match_expression)
+# ---------------------------------------------------------------------------
+
+
+def _terms_match(a: ClusterArrays, key, op, vals, num, num_ok, term_valid):
+    """[..., N] — per term: AND over expressions, against every node.
+
+    key/op/num/num_ok: [TM, E]; vals: [TM, E, VV]; term_valid: [TM].
+    Returns match[TM, N].
+    """
+    key_safe = jnp.maximum(key, 0)
+    nval = a.label_val.T[key_safe]  # [TM, E, N]
+    nnum = a.label_num.T[key_safe]
+    nnum_ok = a.label_num_ok.T[key_safe]
+    present = nval >= 0
+    eq_any = (nval[..., None, :] == vals[..., :, None]).any(axis=-2)  # [TM, E, N]
+    is_in = present & eq_any
+    not_in = present & ~eq_any
+    exists = present
+    dne = ~present
+    num_cmp_ok = present & nnum_ok & num_ok[..., None]
+    gt = num_cmp_ok & (nnum > num[..., None])
+    lt = num_cmp_ok & (nnum < num[..., None])
+    opx = op[..., None]
+    m = jnp.where(
+        opx == 0, is_in,
+        jnp.where(opx == 1, not_in,
+        jnp.where(opx == 2, exists,
+        jnp.where(opx == 3, dne,
+        jnp.where(opx == 4, gt,
+        jnp.where(opx == 5, lt, False))))))
+    # padded expression slots (key == -1) are neutral for the AND
+    m = m | (key == -1)[..., None]
+    return m.all(axis=-2) & term_valid[:, None]  # [TM, N]
+
+
+def build_node_affinity_filter(enc: EncodedCluster):
+    def kernel(a: ClusterArrays, s: SchedState, p) -> jnp.ndarray:
+        # nodeSelector: AND of key == value
+        k = a.nsel_key[p]  # [NS]
+        k_safe = jnp.maximum(k, 0)
+        nval = a.label_val.T[k_safe]  # [NS, N]
+        sel_ok = ((nval == a.nsel_val[p][:, None]) | (k == -1)[:, None]).all(axis=0)
+        # required terms: OR over terms (pass when no terms)
+        tmatch = _terms_match(
+            a,
+            a.raff_key[p],
+            a.raff_op[p],
+            a.raff_vals[p],
+            a.raff_num[p],
+            a.raff_num_ok[p],
+            a.raff_term_valid[p],
+        )
+        req_ok = tmatch.any(axis=0) | ~a.pod_has_raff[p]
+        return jnp.where(sel_ok & req_ok, 0, 1).astype(jnp.int32)
+
+    return kernel
+
+
+def decode_node_affinity(code: int, enc: EncodedCluster, node_idx: int) -> str:
+    return "node(s) didn't match Pod's node affinity/selector"
+
+
+def build_node_affinity_score(enc: EncodedCluster):
+    def kernel(a: ClusterArrays, s: SchedState, p) -> jnp.ndarray:
+        tmatch = _terms_match(
+            a,
+            a.paff_key[p],
+            a.paff_op[p],
+            a.paff_vals[p],
+            a.paff_num[p],
+            a.paff_num_ok[p],
+            a.paff_term_valid[p],
+        )  # [PR, N]
+        w = a.paff_weight[p][:, None]
+        return jnp.where(tmatch, w, 0).sum(axis=0).astype(enc.policy.score)
+
+    return kernel
+
+
+# ---------------------------------------------------------------------------
+# NodePorts  (oracle: node_ports_filter/_ports_conflict; prefilter is a
+# pure state cache and never fails)
+# ---------------------------------------------------------------------------
+
+
+def build_node_ports_filter(enc: EncodedCluster):
+    def kernel(a: ClusterArrays, s: SchedState, p) -> jnp.ndarray:
+        wild = a.want_wild[p] > 0  # [Q]
+        trip = a.want_trip[p] > 0  # [V2]
+        wild_conflict = (wild[None, :] & (s.used_pair > 0)).any(axis=1)
+        trip_conflict = (
+            trip[None, :]
+            & ((s.used_trip > 0) | (s.used_wild[:, a.trip_pair] > 0))
+        ).any(axis=1)
+        return (wild_conflict | trip_conflict).astype(jnp.int32)
+
+    return kernel
+
+
+def decode_node_ports(code: int, enc: EncodedCluster, node_idx: int) -> str:
+    return "node(s) didn't have free ports for the requested pod ports"
+
+
+# ---------------------------------------------------------------------------
+# ImageLocality  (oracle: image_locality_score; Ki-unit integer semantics,
+# see encode.IMG_* constants)
+# ---------------------------------------------------------------------------
+
+
+def build_image_locality_score(enc: EncodedCluster):
+    from .encode import IMG_MAX_CONTAINER_KI, IMG_MIN_KI
+
+    score_dt = enc.policy.score
+
+    def kernel(a: ClusterArrays, s: SchedState, p) -> jnp.ndarray:
+        counts = a.pod_img[p].astype(a.img_contrib.dtype)  # [I]
+        ss = (a.img_contrib * counts[None, :]).sum(axis=1)  # [N]
+        ncont = a.pod_ncont[p].astype(a.img_contrib.dtype)
+        maxth = IMG_MAX_CONTAINER_KI * ncont
+        ss = jnp.clip(ss, IMG_MIN_KI, jnp.maximum(maxth, IMG_MIN_KI + 1))
+        x = ss - IMG_MIN_KI
+        den = jnp.maximum(maxth - IMG_MIN_KI, 1)
+        # (100*x)//den via two base-10 digits to stay in int32: x <= den
+        a1 = x // den
+        r = x % den
+        d1 = (r * 10) // den
+        r2 = (r * 10) % den
+        d2 = (r2 * 10) // den
+        score = a1 * 100 + d1 * 10 + d2
+        return jnp.where(ncont == 0, 0, score).astype(score_dt)
+
+    return kernel
+
+
+FILTER_KERNELS.update(
+    {
+        "TaintToleration": (build_taint_filter, decode_taint),
+        "NodeAffinity": (build_node_affinity_filter, decode_node_affinity),
+        "NodePorts": (build_node_ports_filter, decode_node_ports),
+    }
+)
+SCORE_KERNELS.update(
+    {
+        "TaintToleration": (build_taint_score, "default_reverse"),
+        "NodeAffinity": (build_node_affinity_score, "default"),
+        "ImageLocality": (build_image_locality_score, None),
+    }
+)
+TRIVIAL_PREFILTER.add("NodePorts")

@@ -665,8 +665,14 @@ def interpod_normalize(ctx, pod: PodView, raw: dict[str, int]) -> dict[str, int]
 # ImageLocality
 # ---------------------------------------------------------------------------
 
-_IMG_MIN_THRESHOLD = 23 * 1024 * 1024
-_IMG_MAX_CONTAINER_THRESHOLD = 1000 * 1024 * 1024
+# Thresholds in Ki units (they are Mi multiples, so exact): this framework
+# defines the ImageLocality sum in Ki so every intermediate fits int32 on
+# the TPU (same portability rationale as BALANCED_SCALE above). Container
+# counts clamp at 64 so 100*(sum-min) stays in range; divergence from
+# upstream's byte-granular float math is at most 1 point.
+_IMG_MIN_KI = 23 * 1024
+_IMG_MAX_CONTAINER_KI = 1000 * 1024
+_IMG_MAX_CONTAINERS = 64
 
 
 def _normalized_image_name(name: str) -> str:
@@ -696,10 +702,13 @@ def image_locality_score(ctx, pod: PodView, ni: "NodeInfo") -> int:
             if found:
                 have += 1
         if size:
-            sum_scores += int(size * have / total_nodes)
-    max_threshold = _IMG_MAX_CONTAINER_THRESHOLD * pod.num_containers
-    sum_scores = min(max(sum_scores, _IMG_MIN_THRESHOLD), max_threshold)
-    return MAX_NODE_SCORE * (sum_scores - _IMG_MIN_THRESHOLD) // (max_threshold - _IMG_MIN_THRESHOLD)
+            # per-image Ki contribution, integer floor-div — see the
+            # threshold comment above for why not byte-granular floats
+            sum_scores += (size * have // total_nodes) >> 10
+    ncont = min(pod.num_containers, _IMG_MAX_CONTAINERS)
+    max_threshold = _IMG_MAX_CONTAINER_KI * ncont
+    sum_scores = min(max(sum_scores, _IMG_MIN_KI), max_threshold)
+    return MAX_NODE_SCORE * (sum_scores - _IMG_MIN_KI) // (max_threshold - _IMG_MIN_KI)
 
 
 # ---------------------------------------------------------------------------

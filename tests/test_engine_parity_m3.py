@@ -1,0 +1,315 @@
+"""Parity: M3a plugin kernels (TaintToleration, NodeAffinity, NodePorts,
+ImageLocality) vs the oracle, at annotation depth."""
+
+import random
+
+import pytest
+
+from kube_scheduler_simulator_tpu.engine import EXACT, TPU32
+
+from helpers import node, pod
+from test_engine_parity import assert_parity, restricted_config
+
+
+def m3a_config(extra_filters=(), extra_scores=()):
+    return restricted_config(
+        filters=(
+            "NodeUnschedulable",
+            "NodeName",
+            "TaintToleration",
+            "NodeAffinity",
+            "NodePorts",
+            "NodeResourcesFit",
+        )
+        + tuple(extra_filters),
+        scores=(
+            ("NodeResourcesBalancedAllocation", 1),
+            ("ImageLocality", 1),
+            ("NodeResourcesFit", 1),
+            ("NodeAffinity", 1),
+            ("TaintToleration", 1),
+        )
+        + tuple(extra_scores),
+        prefilters=("NodeResourcesFit", "NodePorts"),
+        prescores=(
+            "TaintToleration",
+            "NodeAffinity",
+            "NodeResourcesFit",
+            "NodeResourcesBalancedAllocation",
+        ),
+    )
+
+
+class TestTaintToleration:
+    def test_filter_and_score(self):
+        nodes = [
+            node("clean"),
+            node("tainted", taints=[
+                {"key": "dedicated", "value": "gpu", "effect": "NoSchedule"},
+            ]),
+            node("prefer-avoid", taints=[
+                {"key": "spot", "value": "true", "effect": "PreferNoSchedule"},
+            ]),
+            node("multi", taints=[
+                {"key": "a", "value": "1", "effect": "PreferNoSchedule"},
+                {"key": "b", "value": "2", "effect": "NoExecute"},
+                {"key": "c", "value": "3", "effect": "NoSchedule"},
+            ]),
+        ]
+        pods = [
+            pod("plain"),
+            pod("tolerates-equal", tolerations=[
+                {"key": "dedicated", "operator": "Equal", "value": "gpu",
+                 "effect": "NoSchedule"},
+            ]),
+            pod("tolerates-exists", tolerations=[
+                {"key": "dedicated", "operator": "Exists"},
+                {"key": "b", "operator": "Exists"},
+                {"key": "c", "operator": "Exists"},
+            ]),
+            pod("tolerates-all", tolerations=[{"operator": "Exists"}]),
+            pod("wrong-value", tolerations=[
+                {"key": "dedicated", "operator": "Equal", "value": "cpu"},
+            ]),
+            pod("effect-scoped", tolerations=[
+                {"key": "b", "operator": "Exists", "effect": "NoExecute"},
+                {"key": "c", "operator": "Exists", "effect": "NoSchedule"},
+            ]),
+        ]
+        for policy in (EXACT, TPU32):
+            assert_parity(nodes, pods, m3a_config(), policy=policy)
+
+
+class TestNodeAffinity:
+    def test_selector_and_required(self):
+        nodes = [
+            node("ssd-east", labels={"disk": "ssd", "zone": "east", "idx": "10"}),
+            node("hdd-east", labels={"disk": "hdd", "zone": "east", "idx": "2"}),
+            node("ssd-west", labels={"disk": "ssd", "zone": "west"}),
+            node("bare"),
+        ]
+        aff_req = {
+            "nodeAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": {
+                    "nodeSelectorTerms": [
+                        {"matchExpressions": [
+                            {"key": "disk", "operator": "In", "values": ["ssd"]},
+                        ]},
+                        {"matchExpressions": [
+                            {"key": "zone", "operator": "NotIn", "values": ["west"]},
+                            {"key": "disk", "operator": "Exists"},
+                        ]},
+                    ]
+                }
+            }
+        }
+        aff_num = {
+            "nodeAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": {
+                    "nodeSelectorTerms": [
+                        {"matchExpressions": [
+                            {"key": "idx", "operator": "Gt", "values": ["5"]},
+                        ]},
+                    ]
+                }
+            }
+        }
+        aff_fields = {
+            "nodeAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": {
+                    "nodeSelectorTerms": [
+                        {"matchFields": [
+                            {"key": "metadata.name", "operator": "In",
+                             "values": ["bare"]},
+                        ]},
+                    ]
+                }
+            }
+        }
+        aff_pref = {
+            "nodeAffinity": {
+                "preferredDuringSchedulingIgnoredDuringExecution": [
+                    {"weight": 10, "preference": {"matchExpressions": [
+                        {"key": "disk", "operator": "In", "values": ["ssd"]},
+                    ]}},
+                    {"weight": 5, "preference": {"matchExpressions": [
+                        {"key": "zone", "operator": "In", "values": ["east"]},
+                    ]}},
+                ]
+            }
+        }
+        pods = [
+            pod("sel", node_selector={"disk": "ssd"}),
+            pod("sel-missing-key", node_selector={"gpu": "a100"}),
+            pod("req-terms", affinity=aff_req),
+            pod("req-numeric", affinity=aff_num),
+            pod("req-fields", affinity=aff_fields),
+            pod("preferred", affinity=aff_pref),
+            pod("dne", affinity={
+                "nodeAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": {
+                        "nodeSelectorTerms": [
+                            {"matchExpressions": [
+                                {"key": "disk", "operator": "DoesNotExist"},
+                            ]},
+                        ]
+                    }
+                }
+            }),
+        ]
+        for policy in (EXACT, TPU32):
+            assert_parity(nodes, pods, m3a_config(), policy=policy)
+
+
+class TestNodePorts:
+    def test_conflicts(self):
+        nodes = [node("n0"), node("n1")]
+        pods = [
+            pod("web-a", ports=[{"hostPort": 80}]),
+            pod("web-b", ports=[{"hostPort": 80}]),  # conflicts with web-a
+            pod("udp", ports=[{"hostPort": 80, "protocol": "UDP"}]),  # no conflict
+            pod("ip-specific", ports=[{"hostPort": 80, "hostIP": "10.0.0.1"}]),
+            pod("other-port", ports=[{"hostPort": 8080}]),
+        ]
+        for policy in (EXACT, TPU32):
+            results = assert_parity(nodes, pods, m3a_config(), policy=policy)
+        by = {r.pod_name: r for r in results}
+        assert by["web-a"].selected_node != by["web-b"].selected_node
+        # the wildcard-ip 80 conflicts with the specific-ip 80 on both used
+        # nodes once web-a/web-b hold them
+        assert by["ip-specific"].status == "Unschedulable"
+
+    def test_bound_pods_occupy_ports(self):
+        nodes = [node("n0"), node("n1")]
+        pods = [
+            pod("existing", ports=[{"hostPort": 443}], node_name="n0"),
+            pod("incoming", ports=[{"hostPort": 443}]),
+        ]
+        results = assert_parity(nodes, pods, m3a_config())
+        assert results[0].selected_node == "n1"
+
+
+class TestImageLocality:
+    def test_score(self):
+        big = 500 * 1024 * 1024
+        nodes = [
+            node("has-both", images=[
+                {"names": ["nginx:latest"], "sizeBytes": big},
+                {"names": ["redis"], "sizeBytes": big // 2},
+            ]),
+            node("has-one", images=[{"names": ["nginx"], "sizeBytes": big}]),
+            node("has-none"),
+        ]
+        pods = [
+            pod("uses-both", images=["nginx", "redis:latest"]),
+            pod("uses-one", images=["nginx:latest"]),
+            pod("uses-unknown", images=["mysql"]),
+        ]
+        for policy in (EXACT, TPU32):
+            results = assert_parity(nodes, pods, m3a_config(), policy=policy)
+        by = {r.pod_name: r for r in results}
+        assert by["uses-both"].selected_node == "has-both"
+
+
+class TestRandomizedM3a:
+    @pytest.mark.parametrize("seed", range(6))
+    def test_randomized(self, seed):
+        rng = random.Random(1000 + seed)
+        zones = ["a", "b", "c"]
+        disks = ["ssd", "hdd"]
+        n_nodes = rng.randint(3, 10)
+        nodes = []
+        for i in range(n_nodes):
+            taints = []
+            if rng.random() < 0.3:
+                taints.append({
+                    "key": rng.choice(["t1", "t2"]),
+                    "value": rng.choice(["x", "y"]),
+                    "effect": rng.choice(
+                        ["NoSchedule", "PreferNoSchedule", "NoExecute"]),
+                })
+            images = []
+            if rng.random() < 0.5:
+                images.append({
+                    "names": [rng.choice(["nginx", "redis", "mysql"])],
+                    "sizeBytes": rng.randint(30, 900) * 1024 * 1024,
+                })
+            nodes.append(node(
+                f"n{i}",
+                cpu=f"{rng.randint(2, 16)}",
+                mem=f"{rng.randint(2, 32)}Gi",
+                labels={"zone": rng.choice(zones), "disk": rng.choice(disks)},
+                taints=taints or None,
+                images=images or None,
+                unschedulable=rng.random() < 0.1,
+            ))
+        pods = []
+        for i in range(rng.randint(10, 30)):
+            kw = {}
+            r = rng.random()
+            if r < 0.2:
+                kw["node_selector"] = {"zone": rng.choice(zones)}
+            elif r < 0.4:
+                kw["affinity"] = {"nodeAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": {
+                        "nodeSelectorTerms": [{"matchExpressions": [{
+                            "key": "disk",
+                            "operator": rng.choice(["In", "NotIn"]),
+                            "values": [rng.choice(disks)],
+                        }]}]
+                    }
+                }}
+            elif r < 0.55:
+                kw["affinity"] = {"nodeAffinity": {
+                    "preferredDuringSchedulingIgnoredDuringExecution": [{
+                        "weight": rng.randint(1, 100),
+                        "preference": {"matchExpressions": [{
+                            "key": "zone", "operator": "In",
+                            "values": [rng.choice(zones)],
+                        }]},
+                    }]
+                }}
+            if rng.random() < 0.3:
+                kw["tolerations"] = [{
+                    "key": rng.choice(["t1", "t2"]),
+                    "operator": rng.choice(["Exists", "Equal"]),
+                    "value": rng.choice(["x", "y"]),
+                }]
+            if rng.random() < 0.25:
+                kw["ports"] = [{"hostPort": rng.choice([80, 443, 8080])}]
+            if rng.random() < 0.3:
+                kw["images"] = [rng.choice(["nginx", "redis", "mysql"])]
+            pods.append(pod(
+                f"p{i}",
+                cpu=f"{rng.choice([100, 500, 1000])}m",
+                mem=f"{rng.choice([128, 512, 1024])}Mi",
+                **kw,
+            ))
+        assert_parity(nodes, pods, m3a_config(), policy=EXACT)
+        assert_parity(nodes, pods, m3a_config(), policy=TPU32)
+
+
+class TestReviewEdgeCases:
+    def test_match_fields_bogus_key(self):
+        # oracle evaluates matchFields against {"metadata.name": name} only:
+        # unknown field keys are absent (In misses, DoesNotExist matches).
+        nodes = [node("n0"), node("n1")]
+        for op in ("In", "DoesNotExist"):
+            pods = [pod("p", affinity={"nodeAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": {
+                    "nodeSelectorTerms": [{"matchFields": [{
+                        "key": "metadata.bogus", "operator": op,
+                        "values": ["n1"] if op == "In" else [],
+                    }]}]
+                }
+            }})]
+            assert_parity(nodes, pods, m3a_config())
+
+    def test_unknown_toleration_operator(self):
+        nodes = [node("n0", taints=[
+            {"key": "k", "value": "v", "effect": "NoSchedule"}])]
+        pods = [pod("p", tolerations=[
+            {"key": "k", "operator": "Bogus", "value": "v",
+             "effect": "NoSchedule"}])]
+        results = assert_parity(nodes, pods, m3a_config())
+        assert results[0].status == "Unschedulable"
