@@ -155,6 +155,8 @@ class ClusterArrays:
     img_contrib: jnp.ndarray  # [N, I] size*have//total per node-image
     pod_img: jnp.ndarray  # [P, I] int32 image occurrence counts
     pod_ncont: jnp.ndarray  # [P] int32 container count
+    # pod-relational encodings (PodTopologySpread, InterPodAffinity)
+    rel: Any  # PodRelArrays (encode_rel.py)
 
 
 @chex.dataclass
@@ -274,10 +276,14 @@ def _num_or_none(s, policy: DTypePolicy):
     return v
 
 
-def _encode_labels_affinity(node_views, pod_views, N, P, policy: DTypePolicy):
+def _encode_labels_affinity(node_views, pod_views, N, P, policy: DTypePolicy, extra_keys=()):
     """NodeAffinity / nodeSelector encodings (oracle: node_affinity_filter/
-    score; models/objects.py match_node_selector_term[s])."""
+    score; models/objects.py match_node_selector_term[s]). `extra_keys` are
+    interned up front so other consumers of the key vocab (spread topology
+    keys) index the same label_val columns."""
     keys, vals = Vocab(), Vocab()
+    for k in extra_keys:
+        keys.intern(k)
     num_np = np.int64
 
     # Pre-pass: parse every pod-side term so the vocabularies are final
@@ -417,7 +423,7 @@ def _encode_labels_affinity(node_views, pod_views, N, P, policy: DTypePolicy):
         paff_num_ok=pno,
         paff_weight=paff_weight,
         paff_term_valid=ptv,
-    )
+    ), keys
 
 
 def _encode_ports(pod_views, N, P):
@@ -456,13 +462,14 @@ def _encode_ports(pod_views, N, P):
     )
 
 
-# ImageLocality works in Ki units so every intermediate fits int32 (the
-# thresholds are Mi multiples, so they are exact in Ki); container counts
-# clamp at 64 to keep 100*(ss-MIN) within range. Same definition in the
-# oracle — see image_locality_score.
-IMG_MIN_KI = 23 * 1024
-IMG_MAX_CONTAINER_KI = 1000 * 1024
-IMG_MAX_CONTAINERS = 64
+# ImageLocality thresholds are defined once in the oracle (Ki-unit integer
+# semantics, see oracle_plugins image_locality_score) and shared here so
+# engine and oracle can never drift.
+from ..sched.oracle_plugins import (  # noqa: E402
+    _IMG_MAX_CONTAINER_KI as IMG_MAX_CONTAINER_KI,
+    _IMG_MAX_CONTAINERS as IMG_MAX_CONTAINERS,
+    _IMG_MIN_KI as IMG_MIN_KI,
+)
 
 
 def _encode_images(node_views, pod_views, N, P, n_real_nodes):
@@ -585,10 +592,27 @@ def encode_cluster(
         pod_tol_unsched[i] = tolerations_tolerate_taint(pv.tolerations, unsched_taint)
         pod_priority[i] = resolve_pod_priority(pv, pcs)
 
+    from ..sched.oracle_plugins import resolve_spread_constraints
+    from .encode_rel import encode_pod_relations
+
+    spread_args = config.plugin_args("PodTopologySpread")
+    pod_constraints = [
+        resolve_spread_constraints(pv.topology_spread_constraints, spread_args)
+        for pv in pod_views
+    ]
+    topo_keys = [
+        c["topologyKey"] for h, s, _ in pod_constraints for c in h + s
+    ]
+
     taint_arrays, taint_aux = _encode_taints(node_views, pod_views, N, P)
-    label_arrays = _encode_labels_affinity(node_views, pod_views, N, P, policy)
+    label_arrays, label_keys = _encode_labels_affinity(
+        node_views, pod_views, N, P, policy, extra_keys=topo_keys
+    )
     port_arrays = _encode_ports(pod_views, N, P)
     img_arrays = _encode_images(node_views, pod_views, N, P, len(nodes))
+    rel, rel_aux = encode_pod_relations(
+        node_views, pod_views, N, P, label_keys=label_keys, constraints=pod_constraints
+    )
     want_pair = port_arrays["want_pair"]
     Q = want_pair.shape[1]
     V2 = port_arrays["want_trip"].shape[1]
@@ -641,6 +665,7 @@ def encode_cluster(
             k: jnp.asarray(v, num_dt if k == "img_contrib" else None)
             for k, v in img_arrays.items()
         },
+        rel=rel,
     )
     state0 = SchedState(
         requested=jnp.asarray(requested, policy.res),
@@ -663,7 +688,7 @@ def encode_cluster(
         config=config,
         n_nodes=len(nodes),
         n_pods=len(pods),
-        aux=taint_aux,
+        aux={**taint_aux, **rel_aux},
     )
     # Retained for the kernel builders that consume them (volume-binding
     # family, namespace-selector terms). The engine's strict mode refuses

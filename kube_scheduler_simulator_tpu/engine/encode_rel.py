@@ -1,0 +1,274 @@
+"""Pod-relational encodings: label-selector clause tensors + topology pairs.
+
+PodTopologySpread and InterPodAffinity aggregate over the set of *currently
+bound* pods, which changes at every scan step. The reference recomputes
+these aggregations in PreFilter/PreScore per pod over object graphs
+(oracle: spread_pre_filter / interpod_pre_filter); the TPU engine instead
+compiles every label selector into fixed clause tensors at encode time and
+evaluates them per step against static pod-label bitsets, reducing the
+counts by scatter-adds keyed on `state.assignment` — no P×P matrix is ever
+materialized.
+
+Selector → clauses (oracle match_label_selector semantics):
+  * matchLabels k=v and In(k, vs)  → PAIR_ANY over the (k,v) pair ids
+  * NotIn(k, vs)                   → key present AND no pair hit
+  * Exists(k) / DoesNotExist(k)    → key-presence bit
+  * nil selector                   → NEVER (matches nothing)
+  * empty selector                 → zero clauses (matches everything)
+"""
+
+from __future__ import annotations
+
+import chex
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.vocab import Vocab
+from ..sched.oracle_plugins import spread_log_weight
+
+PAIR_ANY, NOTIN, EXISTS, DNE, NEVER = 0, 1, 2, 3, 4
+CL_PAD = -1
+
+
+@chex.dataclass
+class PodRelArrays:
+    """Pod-relational device arrays (nested in ClusterArrays.rel)."""
+
+    # pod label bitsets
+    pair_present: jnp.ndarray  # [P, LP] bool — pod has (key,value) pair
+    key_present: jnp.ndarray  # [P, KK] bool — pod has label key
+    ns_id: jnp.ndarray  # [P] int32 namespace id
+    deleted: jnp.ndarray  # [P] bool — metadata.deletionTimestamp set
+    # node topology pairs: id+1 into the node-pair vocab (0 = key absent)
+    node_pair: jnp.ndarray  # [N, K] int32
+    # PodTopologySpread hard (DoNotSchedule) constraints
+    sph_key: jnp.ndarray  # [P, HC] int32 node-label key col | -1 pad
+    sph_skew: jnp.ndarray  # [P, HC] int32 maxSkew
+    sph_self: jnp.ndarray  # [P, HC] bool — selector matches the pod itself
+    sph_ctype: jnp.ndarray  # [P, HC, C] int32 clause type | CL_PAD
+    sph_ckey: jnp.ndarray  # [P, HC, C] int32 pod-label key id | -1
+    sph_cpairs: jnp.ndarray  # [P, HC, C, VP] int32 pod-label pair id | -1
+    # PodTopologySpread soft (ScheduleAnyway) constraints
+    sps_key: jnp.ndarray  # [P, SC]
+    sps_skew: jnp.ndarray  # [P, SC]
+    sps_host: jnp.ndarray  # [P, SC] bool — topologyKey == kubernetes.io/hostname
+    sps_ctype: jnp.ndarray  # [P, SC, C]
+    sps_ckey: jnp.ndarray  # [P, SC, C]
+    sps_cpairs: jnp.ndarray  # [P, SC, C, VP]
+    req_all: jnp.ndarray  # [P] bool — pod has explicit constraints
+    spread_lut: jnp.ndarray  # [N+2] int32 fixed-point log weights
+
+
+class _ClauseBuilder:
+    """Compiles label selectors against shared pod-label vocabularies."""
+
+    def __init__(self):
+        self.pair_vocab = Vocab()  # "key\x00value"
+        self.key_vocab = Vocab()
+
+    def pair_id(self, k: str, v: str) -> int:
+        return self.pair_vocab.intern(f"{k}\x00{v}")
+
+    def compile(self, selector: "dict | None") -> "list[tuple[int, int, list[int]]]":
+        """selector -> [(ctype, key_id, pair_ids)]"""
+        if selector is None:
+            return [(NEVER, -1, [])]
+        clauses = []
+        for k, v in (selector.get("matchLabels") or {}).items():
+            clauses.append((PAIR_ANY, self.key_vocab.intern(k), [self.pair_id(k, str(v))]))
+        for req in selector.get("matchExpressions") or []:
+            k = req.get("key") or ""
+            op = req.get("operator") or ""
+            vals = [str(x) for x in (req.get("values") or [])]
+            kid = self.key_vocab.intern(k)
+            if op == "In":
+                clauses.append((PAIR_ANY, kid, [self.pair_id(k, v) for v in vals]))
+            elif op == "NotIn":
+                clauses.append((NOTIN, kid, [self.pair_id(k, v) for v in vals]))
+            elif op == "Exists":
+                clauses.append((EXISTS, kid, []))
+            elif op == "DoesNotExist":
+                clauses.append((DNE, kid, []))
+            else:
+                # Gt/Lt or unknown in a metav1.LabelSelector: matches nothing
+                # (oracle _match_expression with allow_numeric=False)
+                clauses.append((NEVER, -1, []))
+        return clauses
+
+
+def _fill_clauses(slots, builder_dims, P):
+    """Pack per-(pod, term) clause lists into dense arrays."""
+    TC, C, VP = builder_dims
+    ctype = np.full((P, TC, C), CL_PAD, np.int32)
+    ckey = np.full((P, TC, C), -1, np.int32)
+    cpairs = np.full((P, TC, C, VP), -1, np.int32)
+    for p, terms in enumerate(slots):
+        for t, clauses in enumerate(terms):
+            for c, (ct, k, pairs) in enumerate(clauses):
+                ctype[p, t, c] = ct
+                ckey[p, t, c] = k
+                for vi, pid in enumerate(pairs):
+                    cpairs[p, t, c, vi] = pid
+    return ctype, ckey, cpairs
+
+
+def encode_pod_relations(
+    node_views,
+    pod_views,
+    N: int,
+    P: int,
+    *,
+    label_keys: Vocab,
+    constraints,
+) -> tuple[PodRelArrays, dict]:
+    """Build PodRelArrays.
+
+    `label_keys` is the node-label key vocab from the affinity encoder
+    (topology keys are pre-interned there, so they index the same
+    label_val columns). `constraints[i] = (hard, soft, explicit)` is each
+    pod's resolved spread-constraint split (oracle _spread_constraints
+    semantics).
+    """
+    from ..models.objects import match_label_selector
+
+    cb = _ClauseBuilder()
+    ns_vocab = Vocab()
+
+    # -- per-pod spread constraints, compiled --------------------------------
+    hard_all, soft_all = [], []
+    req_all = np.zeros(P, bool)
+    for i, pv in enumerate(pod_views):
+        hard, soft, explicit = constraints[i]
+        req_all[i] = explicit
+        hard_all.append(
+            [
+                (
+                    label_keys.intern(c["topologyKey"]),
+                    int(c.get("maxSkew", 1)),
+                    match_label_selector(c.get("labelSelector"), pv.labels),
+                    cb.compile(c.get("labelSelector")),
+                    False,
+                )
+                for c in hard
+            ]
+        )
+        soft_all.append(
+            [
+                (
+                    label_keys.intern(c["topologyKey"]),
+                    int(c.get("maxSkew", 1)),
+                    False,
+                    cb.compile(c.get("labelSelector")),
+                    c["topologyKey"] == "kubernetes.io/hostname",
+                )
+                for c in soft
+            ]
+        )
+
+    # -- pod label bitsets (vocabs now final for pods' own labels too) -------
+    for pv in pod_views:
+        for k, v in pv.labels.items():
+            cb.key_vocab.intern(k)
+            cb.pair_id(k, str(v))
+        ns_vocab.intern(pv.namespace)
+    LP = max(1, len(cb.pair_vocab))
+    KK = max(1, len(cb.key_vocab))
+    pair_present = np.zeros((P, LP), bool)
+    key_present = np.zeros((P, KK), bool)
+    ns_id = np.zeros(P, np.int32)
+    deleted = np.zeros(P, bool)
+    for i, pv in enumerate(pod_views):
+        for k, v in pv.labels.items():
+            key_present[i, cb.key_vocab.get(k)] = True
+            pair_present[i, cb.pair_id(k, str(v))] = True
+        ns_id[i] = ns_vocab.get(pv.namespace)
+        deleted[i] = bool((pv.obj.get("metadata", {}) or {}).get("deletionTimestamp"))
+
+    # -- node topology pairs -------------------------------------------------
+    K = len(label_keys)
+    node_pair_vocab = Vocab()
+    node_pair = np.zeros((N, K), np.int32)  # 0 = absent
+    for n, nv in enumerate(node_views):
+        for k, v in nv.labels.items():
+            col = label_keys.get(k)
+            if col >= 0:
+                node_pair[n, col] = node_pair_vocab.intern(f"{k}\x00{v}") + 1
+
+    # -- pack constraint tensors ---------------------------------------------
+    def pack(all_terms):
+        TC = max(1, max((len(t) for t in all_terms), default=0))
+        C = max(
+            1, max((len(cl) for t in all_terms for (_, _, _, cl, _) in t), default=0)
+        )
+        VP = max(
+            1,
+            max(
+                (len(pr) for t in all_terms for (_, _, _, cl, _) in t for (_, _, pr) in cl),
+                default=0,
+            ),
+        )
+        key = np.full((P, TC), -1, np.int32)
+        skew = np.ones((P, TC), np.int32)
+        selfm = np.zeros((P, TC), bool)
+        host = np.zeros((P, TC), bool)
+        for p, terms in enumerate(all_terms):
+            for t, (k, ms, sm, _cl, hh) in enumerate(terms):
+                key[p, t] = k
+                skew[p, t] = ms
+                selfm[p, t] = sm
+                host[p, t] = hh
+        ctype, ckey, cpairs = _fill_clauses(
+            [[cl for (_, _, _, cl, _) in t] for t in all_terms], (TC, C, VP), P
+        )
+        return key, skew, selfm, host, ctype, ckey, cpairs
+
+    hk, hs, hself, _, hct, hck, hcp = pack(hard_all)
+    sk, ss_, _, shost, sct, sck, scp = pack(soft_all)
+
+    lut = np.asarray([spread_log_weight(m) for m in range(N + 2)], np.int32)
+
+    rel = PodRelArrays(
+        pair_present=jnp.asarray(pair_present),
+        key_present=jnp.asarray(key_present),
+        ns_id=jnp.asarray(ns_id),
+        deleted=jnp.asarray(deleted),
+        node_pair=jnp.asarray(node_pair),
+        sph_key=jnp.asarray(hk),
+        sph_skew=jnp.asarray(hs),
+        sph_self=jnp.asarray(hself),
+        sph_ctype=jnp.asarray(hct),
+        sph_ckey=jnp.asarray(hck),
+        sph_cpairs=jnp.asarray(hcp),
+        sps_key=jnp.asarray(sk),
+        sps_skew=jnp.asarray(ss_),
+        sps_host=jnp.asarray(shost),
+        sps_ctype=jnp.asarray(sct),
+        sps_ckey=jnp.asarray(sck),
+        sps_cpairs=jnp.asarray(scp),
+        req_all=jnp.asarray(req_all),
+        spread_lut=jnp.asarray(lut),
+    )
+    aux = {"n_node_pairs": len(node_pair_vocab), "clause_builder": cb, "ns_vocab": ns_vocab}
+    return rel, aux
+
+
+def match_clauses(rel: PodRelArrays, ctype, ckey, cpairs) -> jnp.ndarray:
+    """Evaluate clause tensors for ONE pod's terms against EVERY pod.
+
+    ctype/ckey: [T, C]; cpairs: [T, C, VP]. Returns match[T, P] (label part
+    only — callers add namespace / mask / liveness conditions).
+    """
+    pp = rel.pair_present  # [P, LP]
+    kp = rel.key_present  # [P, KK]
+    pair_hit = (
+        pp.T[jnp.maximum(cpairs, 0)] & (cpairs >= 0)[..., None]
+    ).any(axis=-2)  # [T, C, P]
+    key_hit = kp.T[jnp.maximum(ckey, 0)] & (ckey >= 0)[..., None]  # [T, C, P]
+    t = ctype[..., None]
+    m = jnp.where(
+        t == PAIR_ANY, pair_hit,
+        jnp.where(t == NOTIN, key_hit & ~pair_hit,
+        jnp.where(t == EXISTS, key_hit,
+        jnp.where(t == DNE, ~key_hit, False))))
+    m = m | (t == CL_PAD)  # padded clauses are neutral for the AND
+    return m.all(axis=-2)  # [T, P]

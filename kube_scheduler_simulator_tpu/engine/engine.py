@@ -123,7 +123,16 @@ class BatchedScheduler:
         ]
         self._f_kernels = [K.FILTER_KERNELS[n][0](enc) for n in self._filter_names]
         self._s_kernels = [K.SCORE_KERNELS[n][0](enc) for n in self._score_specs_names]
-        self._s_normalize = [K.SCORE_KERNELS[n][1] for n in self._score_specs_names]
+        # normalize mode: None | "default" | "default_reverse" | "custom".
+        # "custom" plugins attach fn(a, state, p, raw, feasible) as
+        # kernel._normalize (PodTopologySpread, InterPodAffinity).
+        self._s_normalize = [
+            getattr(k, "_normalize", None) if mode == "custom" else mode
+            for k, mode in zip(
+                self._s_kernels,
+                (K.SCORE_KERNELS[n][1] for n in self._score_specs_names),
+            )
+        ]
         self.weights = jnp.asarray(
             [w for _, w in self._score_specs], enc.policy.score
         )
@@ -166,7 +175,9 @@ class BatchedScheduler:
                 codes = jnp.zeros((N, 0), jnp.int32)
             feasible = (codes == 0).all(axis=1) & a.node_mask & pf_ok
             if s_kernels:
-                raw = jnp.stack([k(a, state, p) for k in s_kernels], axis=1)  # [N,S]
+                raw = jnp.stack(
+                    [k(a, state, p, feasible) for k in s_kernels], axis=1
+                )  # [N,S]
                 finals = []
                 for j, mode in enumerate(s_normalize):
                     r = raw[:, j]
@@ -179,6 +190,8 @@ class BatchedScheduler:
                             )
                         else:
                             normed = jnp.where(mx == 0, r, scaled)
+                    elif callable(mode):
+                        normed = mode(a, state, p, r, feasible)  # "custom"
                     else:
                         normed = r
                     finals.append(normed.astype(score_dt) * weights[j])

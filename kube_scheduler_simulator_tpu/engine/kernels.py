@@ -81,7 +81,7 @@ def build_fit_score(enc: EncodedCluster):
     )
     wsum = sum(w for _, w in specs) + zero_weight
 
-    def kernel(a: ClusterArrays, s: SchedState, p) -> jnp.ndarray:
+    def kernel(a: ClusterArrays, s: SchedState, p, feasible=None) -> jnp.ndarray:
         total = jnp.zeros(a.node_mask.shape[0], enc.policy.score)
         for r_idx, w in specs:
             cap = a.node_alloc[:, r_idx]
@@ -152,7 +152,7 @@ def build_balanced_score(enc: EncodedCluster):
     S_BITS = S.bit_length() - 1
     exact64 = enc.policy.name == "exact"
 
-    def kernel(a: ClusterArrays, s: SchedState, p) -> jnp.ndarray:
+    def kernel(a: ClusterArrays, s: SchedState, p, feasible=None) -> jnp.ndarray:
         N = a.node_mask.shape[0]
         if not idxs:
             return jnp.full(N, MAX_NODE_SCORE, enc.policy.score)
@@ -319,7 +319,7 @@ def decode_taint(code: int, enc: EncodedCluster, node_idx: int) -> str:
 
 
 def build_taint_score(enc: EncodedCluster):
-    def kernel(a: ClusterArrays, s: SchedState, p) -> jnp.ndarray:
+    def kernel(a: ClusterArrays, s: SchedState, p, feasible=None) -> jnp.ndarray:
         tolerated = _tolerated(a, p)
         prefer = a.taint_effect == 1  # PreferNoSchedule
         return (prefer & ~tolerated).sum(axis=1).astype(enc.policy.score)
@@ -393,7 +393,7 @@ def decode_node_affinity(code: int, enc: EncodedCluster, node_idx: int) -> str:
 
 
 def build_node_affinity_score(enc: EncodedCluster):
-    def kernel(a: ClusterArrays, s: SchedState, p) -> jnp.ndarray:
+    def kernel(a: ClusterArrays, s: SchedState, p, feasible=None) -> jnp.ndarray:
         tmatch = _terms_match(
             a,
             a.paff_key[p],
@@ -444,7 +444,7 @@ def build_image_locality_score(enc: EncodedCluster):
 
     score_dt = enc.policy.score
 
-    def kernel(a: ClusterArrays, s: SchedState, p) -> jnp.ndarray:
+    def kernel(a: ClusterArrays, s: SchedState, p, feasible=None) -> jnp.ndarray:
         counts = a.pod_img[p].astype(a.img_contrib.dtype)  # [I]
         ss = (a.img_contrib * counts[None, :]).sum(axis=1)  # [N]
         ncont = a.pod_ncont[p].astype(a.img_contrib.dtype)
@@ -479,3 +479,178 @@ SCORE_KERNELS.update(
     }
 )
 TRIVIAL_PREFILTER.add("NodePorts")
+
+
+# ---------------------------------------------------------------------------
+# PodTopologySpread  (oracle: spread_pre_filter/spread_filter/
+# spread_pre_score/spread_score/spread_normalize). The per-topology-value
+# match counts are reduced on-device each step by scatter-adds keyed on
+# state.assignment — the oracle's PreFilter/PreScore dict-building loops
+# become two scatters and two gathers.
+# ---------------------------------------------------------------------------
+
+
+def _spread_counts(a: ClusterArrays, s: SchedState, p, ctype, ckey, cpairs):
+    """[T, N] — per constraint, matching bound pods on each node (same
+    namespace as pod p, not deleted; oracle _count_matching_pods)."""
+    from .encode_rel import match_clauses
+
+    rel = a.rel
+    m = match_clauses(rel, ctype, ckey, cpairs)  # [T, P]
+    live = (
+        (rel.ns_id == rel.ns_id[p])[None, :]
+        & ~rel.deleted[None, :]
+        & a.pod_mask[None, :]
+        & (s.assignment >= 0)[None, :]
+    )
+    mm = (m & live).astype(jnp.int32)  # [T, P]
+    T = ctype.shape[0]
+    N = a.node_mask.shape[0]
+    tgt = jnp.maximum(s.assignment, 0)
+    return jnp.zeros((T, N), jnp.int32).at[:, tgt].add(mm)
+
+
+def build_spread_filter(enc: EncodedCluster):
+    aff_kernel = build_node_affinity_filter(enc)
+    NP1 = enc.aux["n_node_pairs"] + 1
+    BIG = jnp.iinfo(jnp.int32).max
+
+    def kernel(a: ClusterArrays, s: SchedState, p) -> jnp.ndarray:
+        rel = a.rel
+        N = a.node_mask.shape[0]
+        keys = rel.sph_key[p]  # [HC]
+        HC = keys.shape[0]
+        valid = keys >= 0
+        pairs = rel.node_pair[:, jnp.maximum(keys, 0)]  # [N, HC], 0 = absent
+        has_key = pairs > 0
+        has_all = (has_key | ~valid[None, :]).all(axis=1)  # [N]
+        elig = (aff_kernel(a, s, p) == 0) & has_all & a.node_mask
+        cnt_node = _spread_counts(
+            a, s, p, rel.sph_ctype[p], rel.sph_ckey[p], rel.sph_cpairs[p]
+        )  # [HC, N]
+        hc_ix = jnp.arange(HC)[:, None]
+        val_cnt = jnp.zeros((HC, NP1), jnp.int32).at[hc_ix, pairs.T].add(
+            cnt_node * elig[None, :]
+        )
+        present = jnp.zeros((HC, NP1), jnp.int32).at[hc_ix, pairs.T].add(
+            (elig[:, None] & has_key).T.astype(jnp.int32)
+        )
+        pmask = (present > 0) & (jnp.arange(NP1) > 0)[None, :]
+        min_c = jnp.where(pmask, val_cnt, BIG).min(axis=1)
+        min_c = jnp.where(pmask.any(axis=1), min_c, 0)  # [HC]
+        node_cnt = val_cnt[hc_ix.T, pairs]  # [N, HC]
+        skew = node_cnt + rel.sph_self[p][None, :].astype(jnp.int32) - min_c[None, :]
+        fail_skew = skew > rel.sph_skew[p][None, :]
+        code_c = jnp.where(
+            ~valid[None, :], 0, jnp.where(~has_key, 1, jnp.where(fail_skew, 2, 0))
+        )  # [N, HC]
+        first = jnp.argmax(code_c != 0, axis=1)
+        return jnp.where(
+            (code_c != 0).any(axis=1), code_c[jnp.arange(N), first], 0
+        ).astype(jnp.int32)
+
+    return kernel
+
+
+def decode_spread(code: int, enc: EncodedCluster, node_idx: int) -> str:
+    if code == 1:
+        return (
+            "node(s) didn't match pod topology spread constraints "
+            "(missing required label)"
+        )
+    return "node(s) didn't match pod topology spread constraints"
+
+
+def build_spread_score(enc: EncodedCluster):
+    """Raw score: Σ_c count(c) * log-weight(c) in SPREAD_SCALE fixed point,
+    plus Σ(maxSkew-1), banker's-rounded — bit-identical to the oracle's
+    integer rewrite. Counts stay < 2^31/weight for P ≤ ~50k pods."""
+    from ..sched.oracle_plugins import SPREAD_SCALE
+
+    # The score path consumes PreScore state (oracle spread_pre_score →
+    # spread_score): with the PreScore plugin disabled, the oracle scores 0
+    # and normalizes to 0 — mirror that exactly.
+    if "PodTopologySpread" not in enc.config.enabled("preScore"):
+
+        def zero_kernel(a, s, p, feasible):
+            return jnp.zeros(a.node_mask.shape[0], enc.policy.score)
+
+        zero_kernel._normalize = lambda a, s, p, raw, feasible: jnp.zeros_like(raw)
+        return zero_kernel
+
+    aff_kernel = build_node_affinity_filter(enc)
+    NP1 = enc.aux["n_node_pairs"] + 1
+
+    def soft_ignored(a: ClusterArrays, s: SchedState, p, feasible):
+        rel = a.rel
+        keys = rel.sps_key[p]
+        valid = keys >= 0
+        pairs = rel.node_pair[:, jnp.maximum(keys, 0)]
+        has_key = pairs > 0
+        has_all = (has_key | ~valid[None, :]).all(axis=1)
+        ignored = feasible & rel.req_all[p] & ~has_all
+        return keys, valid, pairs, has_key, has_all, ignored
+
+    def kernel(a: ClusterArrays, s: SchedState, p, feasible) -> jnp.ndarray:
+        rel = a.rel
+        keys, valid, pairs, has_key, has_all, ignored = soft_ignored(
+            a, s, p, feasible
+        )
+        SC = keys.shape[0]
+        scored = feasible & ~ignored
+        n_scored = scored.sum().astype(jnp.int32)
+        count_mask = (
+            (aff_kernel(a, s, p) == 0)
+            & jnp.where(rel.req_all[p], has_all, True)
+            & a.node_mask
+        )
+        cnt_node = _spread_counts(
+            a, s, p, rel.sps_ctype[p], rel.sps_ckey[p], rel.sps_cpairs[p]
+        )  # [SC, N]
+        sc_ix = jnp.arange(SC)[:, None]
+        val_cnt = jnp.zeros((SC, NP1), jnp.int32).at[sc_ix, pairs.T].add(
+            cnt_node * count_mask[None, :]
+        )
+        present = jnp.zeros((SC, NP1), jnp.int32).at[sc_ix, pairs.T].add(
+            (scored[:, None] & has_key).T.astype(jnp.int32)
+        )
+        topo_size = ((present > 0) & (jnp.arange(NP1) > 0)[None, :]).sum(axis=1)
+        host = rel.sps_host[p]  # [SC]
+        w_m = jnp.where(host, n_scored, topo_size)
+        w_q = rel.spread_lut[jnp.clip(w_m, 0, rel.spread_lut.shape[0] - 1)]  # [SC]
+        node_cnt = val_cnt[sc_ix.T, pairs]  # [N, SC]
+        val_ok = present[sc_ix.T, pairs] > 0
+        cnt = jnp.where(host[None, :], cnt_node.T, node_cnt)
+        apply = valid[None, :] & has_key & (host[None, :] | val_ok)
+        totq = (jnp.where(apply, cnt, 0) * w_q[None, :]).sum(axis=1)
+        mssum = jnp.where(apply, rel.sps_skew[p][None, :] - 1, 0).sum(axis=1)
+        q, r = totq // SPREAD_SCALE, totq % SPREAD_SCALE
+        up = (2 * r > SPREAD_SCALE) | ((2 * r == SPREAD_SCALE) & (q % 2 == 1))
+        raw = mssum + q + up.astype(jnp.int32)
+        return jnp.where(ignored, 0, raw).astype(enc.policy.score)
+
+    def normalize(a: ClusterArrays, s: SchedState, p, raw, feasible):
+        rel = a.rel
+        keys = rel.sps_key[p]
+        *_, ignored = soft_ignored(a, s, p, feasible)
+        live = feasible & ~ignored
+        BIG = jnp.iinfo(jnp.int32).max
+        minv = jnp.where(live, raw, BIG).min()
+        maxv = jnp.where(live, raw, -BIG).max()
+        normed = jnp.where(
+            maxv == 0,
+            MAX_NODE_SCORE,
+            MAX_NODE_SCORE * (maxv + minv - raw) // jnp.maximum(maxv, 1),
+        )
+        normed = jnp.where(ignored, 0, normed)
+        active = (keys >= 0).any() & live.any()
+        return jnp.where(active, normed, 0).astype(raw.dtype)
+
+    kernel._normalize = normalize
+    return kernel
+
+
+FILTER_KERNELS["PodTopologySpread"] = (build_spread_filter, decode_spread)
+SCORE_KERNELS["PodTopologySpread"] = (build_spread_score, "custom")
+TRIVIAL_PREFILTER.add("PodTopologySpread")
+TRIVIAL_PRESCORE.add("PodTopologySpread")

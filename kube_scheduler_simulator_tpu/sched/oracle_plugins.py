@@ -324,24 +324,63 @@ _SYSTEM_DEFAULT_CONSTRAINTS = [
     {"maxSkew": 5, "topologyKey": "kubernetes.io/hostname", "whenUnsatisfiable": "ScheduleAnyway"},
 ]
 
+# Spread score weights log(topoSize+2) are quantized to 1/2^12 fixed-point
+# (computed host-side by this exact Python expression on both the oracle
+# and engine paths) so the score is decided by integer arithmetic — same
+# float-portability rationale as BALANCED_SCALE. Divergence from upstream's
+# float64 math is bounded by the quantization (<0.1%% of a raw score).
+SPREAD_SCALE = 1 << 12
+
+
+def spread_log_weight(m: int) -> int:
+    """floor(log(m+2) * 2^12) — the fixed-point topology weight."""
+    return int(math.log(m + 2) * SPREAD_SCALE)
+
+
+def round_half_even_div(x: int, d: int) -> int:
+    """round(x/d) with banker's rounding, for x >= 0, d > 0 — the integer
+    equivalent of Python round() on the quantized spread total."""
+    q, r = divmod(x, d)
+    if 2 * r > d:
+        return q + 1
+    if 2 * r == d:
+        return q + (q & 1)
+    return q
+
+
+def resolve_spread_constraints(
+    explicit: list[dict], args: dict
+) -> tuple[list[dict], list[dict], bool]:
+    """(hard, soft, is_explicit) — the constraint resolution shared by the
+    oracle and the engine encoder.
+
+    System defaulting (PodTopologySpreadArgs.defaultingType=System): two
+    ScheduleAnyway constraints whose selector is derived from the pod's
+    owning services/controllers. The simulator's store has no Service kind
+    (same as the reference's 7 watched kinds), so the derived selector
+    matches nothing — defaults contribute uniformly to scores."""
+    if explicit:
+        source = explicit
+    elif args.get("defaultingType", "System") == "System":
+        source = _SYSTEM_DEFAULT_CONSTRAINTS
+    else:
+        source = args.get("defaultConstraints") or []
+    hard = [
+        c for c in source
+        if (c.get("whenUnsatisfiable") or "DoNotSchedule") == "DoNotSchedule"
+    ]
+    soft = [
+        c for c in source
+        if (c.get("whenUnsatisfiable") or "DoNotSchedule") == "ScheduleAnyway"
+    ]
+    return hard, soft, bool(explicit)
+
 
 def _spread_constraints(ctx, pod: PodView, when: str) -> list[dict]:
-    explicit = [
-        c
-        for c in pod.topology_spread_constraints
-        if (c.get("whenUnsatisfiable") or "DoNotSchedule") == when
-    ]
-    if pod.topology_spread_constraints:
-        return explicit
-    # System defaulting (PodTopologySpreadArgs.defaultingType=System): two
-    # ScheduleAnyway constraints whose selector is derived from the pod's
-    # owning services/controllers. The simulator's store has no Service
-    # kind (same as the reference's 7 watched kinds), so the derived
-    # selector matches nothing — defaults contribute uniformly to scores.
-    args = ctx.args("PodTopologySpread")
-    if args.get("defaultingType", "System") == "System":
-        return [c for c in _SYSTEM_DEFAULT_CONSTRAINTS if c["whenUnsatisfiable"] == when]
-    return [c for c in (args.get("defaultConstraints") or []) if (c.get("whenUnsatisfiable") or "DoNotSchedule") == when]
+    hard, soft, _ = resolve_spread_constraints(
+        pod.topology_spread_constraints, ctx.args("PodTopologySpread")
+    )
+    return hard if when == "DoNotSchedule" else soft
 
 
 def _node_eligible_for_spread(pod: PodView, ni: "NodeInfo") -> bool:
@@ -454,7 +493,9 @@ def spread_pre_score(ctx: "CycleContext", pod: PodView, feasible: list) -> "str 
     state["counts"] = eligible_pairs
     n_scored = len(feasible) - len(state["ignored"])
     state["weights"] = [
-        math.log((n_scored if c["topologyKey"] == "kubernetes.io/hostname" else topo_size[i]) + 2)
+        spread_log_weight(
+            n_scored if c["topologyKey"] == "kubernetes.io/hostname" else topo_size[i]
+        )
         for i, c in enumerate(constraints)
     ]
     return None
@@ -466,7 +507,8 @@ def spread_score(ctx, pod: PodView, ni: "NodeInfo") -> int:
         return 0
     if ni.node.name in state["ignored"]:
         return 0
-    total = 0.0
+    total_q = 0  # Σ cnt * w_q, in 1/SPREAD_SCALE units
+    ms_sum = 0  # Σ (maxSkew - 1), exact integer part
     for i, c in enumerate(state["constraints"]):
         key = c["topologyKey"]
         val = ni.node.labels.get(key)
@@ -479,8 +521,10 @@ def spread_score(ctx, pod: PodView, ni: "NodeInfo") -> int:
             if val not in pair_counts:
                 continue
             cnt = pair_counts[val]
-        total += cnt * state["weights"][i] + (int(c.get("maxSkew", 1)) - 1)
-    return round(total)
+        total_q += cnt * state["weights"][i]
+        ms_sum += int(c.get("maxSkew", 1)) - 1
+    # round(Σ cnt*w + Σ(ms-1)) == Σ(ms-1) + round(Σ cnt*w_q / SCALE)
+    return ms_sum + round_half_even_div(total_q, SPREAD_SCALE)
 
 
 def spread_normalize(ctx, pod: PodView, raw: dict[str, int]) -> dict[str, int]:
@@ -655,8 +699,9 @@ def interpod_normalize(ctx, pod: PodView, raw: dict[str, int]) -> dict[str, int]
         return {k: 0 for k in raw}
     min_c, max_c = min(raw.values()), max(raw.values())
     diff = max_c - min_c
+    # integer floor-div (values nonneg) — float-portability, see SPREAD_SCALE
     return {
-        k: int(MAX_NODE_SCORE * (v - min_c) / diff) if diff > 0 else 0
+        k: MAX_NODE_SCORE * (v - min_c) // diff if diff > 0 else 0
         for k, v in raw.items()
     }
 

@@ -1,0 +1,163 @@
+"""Parity: PodTopologySpread kernel vs oracle (M3b)."""
+
+import random
+
+import pytest
+
+from kube_scheduler_simulator_tpu.engine import EXACT, TPU32
+
+from helpers import node, pod
+from test_engine_parity import assert_parity, restricted_config
+from test_engine_parity_m3 import m3a_config
+
+
+def spread_config():
+    cfg = m3a_config(
+        extra_filters=("PodTopologySpread",),
+        extra_scores=(("PodTopologySpread", 2),),
+    )
+    cfg.profile()["plugins"]["preScore"]["enabled"].append(
+        {"name": "PodTopologySpread"}
+    )
+    return cfg
+
+
+def zone_nodes(n_per_zone=2, zones=("a", "b", "c"), cpu="8"):
+    out = []
+    for z in zones:
+        for i in range(n_per_zone):
+            out.append(
+                node(
+                    f"n-{z}{i}",
+                    cpu=cpu,
+                    labels={
+                        "topology.kubernetes.io/zone": z,
+                        "kubernetes.io/hostname": f"n-{z}{i}",
+                    },
+                )
+            )
+    return out
+
+
+def spread_pod(name, max_skew=1, when="DoNotSchedule", key="topology.kubernetes.io/zone",
+               labels=None, selector_labels=None, **kw):
+    labels = labels or {"app": "web"}
+    return pod(
+        name,
+        labels=labels,
+        spread=[{
+            "maxSkew": max_skew,
+            "topologyKey": key,
+            "whenUnsatisfiable": when,
+            "labelSelector": {"matchLabels": selector_labels or {"app": "web"}},
+        }],
+        **kw,
+    )
+
+
+class TestSpreadFilter:
+    def test_hard_spread_across_zones(self):
+        nodes = zone_nodes()
+        pods = [spread_pod(f"w{i}") for i in range(9)]
+        results = assert_parity(nodes, pods, spread_config())
+        # pods must spread: each zone gets 3
+        zones = {}
+        for r in results:
+            z = r.selected_node.split("-")[1][0]
+            zones[z] = zones.get(z, 0) + 1
+        assert zones == {"a": 3, "b": 3, "c": 3}
+
+    def test_missing_topology_label(self):
+        nodes = zone_nodes() + [node("unlabeled")]
+        pods = [spread_pod(f"w{i}") for i in range(4)]
+        assert_parity(nodes, pods, spread_config())
+
+    def test_hard_spread_becomes_unschedulable(self):
+        # one zone saturated by bound pods: maxSkew 1 forces alternation and
+        # capacity limits eventually make pods unschedulable
+        nodes = zone_nodes(n_per_zone=1, zones=("a", "b"), cpu="1")
+        pods = [spread_pod("pre-a", node_name="n-a0")] + [
+            spread_pod(f"w{i}", cpu="400m") for i in range(4)
+        ]
+        assert_parity(nodes, pods, spread_config())
+
+    def test_hostname_spread(self):
+        nodes = zone_nodes(n_per_zone=2, zones=("a",))
+        pods = [
+            spread_pod(f"w{i}", key="kubernetes.io/hostname") for i in range(4)
+        ]
+        assert_parity(nodes, pods, spread_config())
+
+
+class TestSpreadScore:
+    def test_soft_spread(self):
+        nodes = zone_nodes()
+        pods = [spread_pod(f"w{i}", when="ScheduleAnyway", max_skew=2)
+                for i in range(7)]
+        for policy in (EXACT, TPU32):
+            assert_parity(nodes, pods, spread_config(), policy=policy)
+
+    def test_system_defaults_no_explicit_constraints(self):
+        nodes = zone_nodes()
+        pods = [pod(f"w{i}", labels={"app": "web"}) for i in range(5)]
+        assert_parity(nodes, pods, spread_config())
+
+    def test_mixed_hard_soft(self):
+        nodes = zone_nodes()
+        pods = []
+        for i in range(6):
+            pods.append(pod(
+                f"w{i}", labels={"app": "web"},
+                spread=[
+                    {"maxSkew": 1, "topologyKey": "topology.kubernetes.io/zone",
+                     "whenUnsatisfiable": "DoNotSchedule",
+                     "labelSelector": {"matchLabels": {"app": "web"}}},
+                    {"maxSkew": 1, "topologyKey": "kubernetes.io/hostname",
+                     "whenUnsatisfiable": "ScheduleAnyway",
+                     "labelSelector": {"matchLabels": {"app": "web"}}},
+                ],
+            ))
+        for policy in (EXACT, TPU32):
+            assert_parity(nodes, pods, spread_config(), policy=policy)
+
+
+class TestSpreadRandomized:
+    @pytest.mark.parametrize("seed", range(5))
+    def test_randomized(self, seed):
+        rng = random.Random(2000 + seed)
+        zones = ["a", "b"]
+        nodes = []
+        for i in range(rng.randint(3, 8)):
+            labels = {"kubernetes.io/hostname": f"n{i}"}
+            if rng.random() < 0.8:
+                labels["topology.kubernetes.io/zone"] = rng.choice(zones)
+            nodes.append(node(f"n{i}", cpu=f"{rng.randint(2, 8)}", labels=labels))
+        apps = ["web", "db"]
+        pods = []
+        for i in range(rng.randint(8, 20)):
+            app = rng.choice(apps)
+            kw = {"labels": {"app": app}}
+            r = rng.random()
+            if r < 0.4:
+                kw["spread"] = [{
+                    "maxSkew": rng.randint(1, 2),
+                    "topologyKey": "topology.kubernetes.io/zone",
+                    "whenUnsatisfiable": rng.choice(
+                        ["DoNotSchedule", "ScheduleAnyway"]),
+                    "labelSelector": {"matchLabels": {"app": app}},
+                }]
+            elif r < 0.55:
+                kw["spread"] = [{
+                    "maxSkew": 1,
+                    "topologyKey": "kubernetes.io/hostname",
+                    "whenUnsatisfiable": rng.choice(
+                        ["DoNotSchedule", "ScheduleAnyway"]),
+                    "labelSelector": {
+                        "matchExpressions": [
+                            {"key": "app", "operator": "In", "values": apps},
+                        ]
+                    },
+                }]
+            pods.append(pod(f"p{i}", cpu="200m", mem="128Mi", **kw))
+        assert_parity(nodes, pods, spread_config(), policy=EXACT)
+        assert_parity(nodes, pods, spread_config(), policy=TPU32)
