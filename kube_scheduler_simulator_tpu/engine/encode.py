@@ -603,6 +603,13 @@ def encode_cluster(
     topo_keys = [
         c["topologyKey"] for h, s, _ in pod_constraints for c in h + s
     ]
+    # InterPodAffinity term topology keys index the same label_val columns
+    for pv in pod_views:
+        for aff in (pv.pod_affinity, pv.pod_anti_affinity):
+            for t in aff.get("requiredDuringSchedulingIgnoredDuringExecution") or []:
+                topo_keys.append(t.get("topologyKey", ""))
+            for pr in aff.get("preferredDuringSchedulingIgnoredDuringExecution") or []:
+                topo_keys.append((pr.get("podAffinityTerm") or {}).get("topologyKey", ""))
 
     taint_arrays, taint_aux = _encode_taints(node_views, pod_views, N, P)
     label_arrays, label_keys = _encode_labels_affinity(
@@ -611,7 +618,13 @@ def encode_cluster(
     port_arrays = _encode_ports(pod_views, N, P)
     img_arrays = _encode_images(node_views, pod_views, N, P, len(nodes))
     rel, rel_aux = encode_pod_relations(
-        node_views, pod_views, N, P, label_keys=label_keys, constraints=pod_constraints
+        node_views,
+        pod_views,
+        N,
+        P,
+        label_keys=label_keys,
+        constraints=pod_constraints,
+        namespaces=namespaces,
     )
     want_pair = port_arrays["want_pair"]
     Q = want_pair.shape[1]

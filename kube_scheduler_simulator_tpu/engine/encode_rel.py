@@ -57,6 +57,38 @@ class PodRelArrays:
     sps_cpairs: jnp.ndarray  # [P, SC, C, VP]
     req_all: jnp.ndarray  # [P] bool — pod has explicit constraints
     spread_lut: jnp.ndarray  # [N+2] int32 fixed-point log weights
+    # InterPodAffinity term domains. Each domain d has: d_key [P, T] (node
+    # label key col | -1), d_ctype/d_ckey [P, T, C], d_cpairs [P, T, C, VP],
+    # d_nsall [P, T] bool, d_ns [P, T, NSV] bool. The same tensors serve
+    # both directions (incoming pod's terms vs all pods, and all pods'
+    # terms vs the incoming pod).
+    ia_key: jnp.ndarray  # required affinity
+    ia_ctype: jnp.ndarray
+    ia_ckey: jnp.ndarray
+    ia_cpairs: jnp.ndarray
+    ia_nsall: jnp.ndarray
+    ia_ns: jnp.ndarray
+    ia_self: jnp.ndarray  # [P, T] bool — term matches its own pod
+    ian_key: jnp.ndarray  # required anti-affinity
+    ian_ctype: jnp.ndarray
+    ian_ckey: jnp.ndarray
+    ian_cpairs: jnp.ndarray
+    ian_nsall: jnp.ndarray
+    ian_ns: jnp.ndarray
+    ipa_key: jnp.ndarray  # preferred affinity
+    ipa_ctype: jnp.ndarray
+    ipa_ckey: jnp.ndarray
+    ipa_cpairs: jnp.ndarray
+    ipa_nsall: jnp.ndarray
+    ipa_ns: jnp.ndarray
+    ipa_weight: jnp.ndarray  # [P, T] int32
+    ipan_key: jnp.ndarray  # preferred anti-affinity
+    ipan_ctype: jnp.ndarray
+    ipan_ckey: jnp.ndarray
+    ipan_cpairs: jnp.ndarray
+    ipan_nsall: jnp.ndarray
+    ipan_ns: jnp.ndarray
+    ipan_weight: jnp.ndarray  # [P, T] int32
 
 
 class _ClauseBuilder:
@@ -120,6 +152,7 @@ def encode_pod_relations(
     *,
     label_keys: Vocab,
     constraints,
+    namespaces: "list[dict] | None" = None,
 ) -> tuple[PodRelArrays, dict]:
     """Build PodRelArrays.
 
@@ -129,10 +162,23 @@ def encode_pod_relations(
     pod's resolved spread-constraint split (oracle _spread_constraints
     semantics).
     """
+    from types import SimpleNamespace
+
     from ..models.objects import match_label_selector
+    from ..sched.oracle_plugins import (
+        _namespaces_for_term,
+        _preferred_terms,
+        _required_terms,
+        _term_matches_pod,
+    )
 
     cb = _ClauseBuilder()
     ns_vocab = Vocab()
+    ns_objs = {
+        (ns.get("metadata", {}) or {}).get("name", ""): ns for ns in namespaces or []
+    }
+    # the shape _namespaces_for_term expects (oracle ClusterSnapshot)
+    fake_snapshot = SimpleNamespace(namespaces=ns_objs)
 
     # -- per-pod spread constraints, compiled --------------------------------
     hard_all, soft_all = [], []
@@ -162,6 +208,52 @@ def encode_pod_relations(
                     c["topologyKey"] == "kubernetes.io/hostname",
                 )
                 for c in soft
+            ]
+        )
+
+    # -- InterPodAffinity terms, parsed (oracle interpod_pre_filter /
+    # interpod_pre_score term handling; _term_matches_pod semantics) --------
+    def parse_term(term, owner_ns):
+        key = term.get("topologyKey", "")
+        kcol = label_keys.get(key)  # pre-interned via encode.py topo_keys
+        ns_set = _namespaces_for_term(term, owner_ns, fake_snapshot)
+        return {
+            "kcol": kcol,
+            "clauses": cb.compile(term.get("labelSelector")),
+            "nsall": ns_set is None,
+            "nsids": [ns_vocab.intern(n) for n in (ns_set or [])],
+        }
+
+    ia_parsed, ian_parsed, ipa_parsed, ipan_parsed = [], [], [], []
+    for pv in pod_views:
+        ia_parsed.append(
+            [
+                dict(
+                    parse_term(t, pv.namespace),
+                    selfm=_term_matches_pod(t, pv.namespace, pv, fake_snapshot),
+                )
+                for t in _required_terms(pv.pod_affinity)
+            ]
+        )
+        ian_parsed.append(
+            [parse_term(t, pv.namespace) for t in _required_terms(pv.pod_anti_affinity)]
+        )
+        ipa_parsed.append(
+            [
+                dict(
+                    parse_term(pr.get("podAffinityTerm") or {}, pv.namespace),
+                    weight=int(pr.get("weight", 0)),
+                )
+                for pr in _preferred_terms(pv.pod_affinity)
+            ]
+        )
+        ipan_parsed.append(
+            [
+                dict(
+                    parse_term(pr.get("podAffinityTerm") or {}, pv.namespace),
+                    weight=int(pr.get("weight", 0)),
+                )
+                for pr in _preferred_terms(pv.pod_anti_affinity)
             ]
         )
 
@@ -225,6 +317,43 @@ def encode_pod_relations(
     hk, hs, hself, _, hct, hck, hcp = pack(hard_all)
     sk, ss_, _, shost, sct, sck, scp = pack(soft_all)
 
+    NSV = max(1, len(ns_vocab))
+
+    def pack_terms(parsed):
+        T = max(1, max((len(x) for x in parsed), default=0))
+        C = max(
+            1, max((len(t["clauses"]) for x in parsed for t in x), default=0)
+        )
+        VP = max(
+            1,
+            max(
+                (len(pr) for x in parsed for t in x for (_, _, pr) in t["clauses"]),
+                default=0,
+            ),
+        )
+        key = np.full((P, T), -1, np.int32)
+        nsall = np.zeros((P, T), bool)
+        nsmh = np.zeros((P, T, NSV), bool)
+        weight = np.zeros((P, T), np.int32)
+        selfm = np.zeros((P, T), bool)
+        for p, terms in enumerate(parsed):
+            for t, term in enumerate(terms):
+                key[p, t] = term["kcol"]
+                nsall[p, t] = term["nsall"]
+                for nid in term["nsids"]:
+                    nsmh[p, t, nid] = True
+                weight[p, t] = term.get("weight", 0)
+                selfm[p, t] = term.get("selfm", False)
+        ctype, ckey, cpairs = _fill_clauses(
+            [[t["clauses"] for t in x] for x in parsed], (T, C, VP), P
+        )
+        return key, ctype, ckey, cpairs, nsall, nsmh, weight, selfm
+
+    iak, iact, iack, iacp, iana, ians_, _, iaself = pack_terms(ia_parsed)
+    nk, nct, nck, ncp, nna, nns, _, _ = pack_terms(ian_parsed)
+    pak, pact, pack_, pacp, pana, pans, paw, _ = pack_terms(ipa_parsed)
+    qk, qct, qck, qcp, qna, qns, qw, _ = pack_terms(ipan_parsed)
+
     lut = np.asarray([spread_log_weight(m) for m in range(N + 2)], np.int32)
 
     rel = PodRelArrays(
@@ -247,9 +376,48 @@ def encode_pod_relations(
         sps_cpairs=jnp.asarray(scp),
         req_all=jnp.asarray(req_all),
         spread_lut=jnp.asarray(lut),
+        ia_key=jnp.asarray(iak),
+        ia_ctype=jnp.asarray(iact),
+        ia_ckey=jnp.asarray(iack),
+        ia_cpairs=jnp.asarray(iacp),
+        ia_nsall=jnp.asarray(iana),
+        ia_ns=jnp.asarray(ians_),
+        ia_self=jnp.asarray(iaself),
+        ian_key=jnp.asarray(nk),
+        ian_ctype=jnp.asarray(nct),
+        ian_ckey=jnp.asarray(nck),
+        ian_cpairs=jnp.asarray(ncp),
+        ian_nsall=jnp.asarray(nna),
+        ian_ns=jnp.asarray(nns),
+        ipa_key=jnp.asarray(pak),
+        ipa_ctype=jnp.asarray(pact),
+        ipa_ckey=jnp.asarray(pack_),
+        ipa_cpairs=jnp.asarray(pacp),
+        ipa_nsall=jnp.asarray(pana),
+        ipa_ns=jnp.asarray(pans),
+        ipa_weight=jnp.asarray(paw),
+        ipan_key=jnp.asarray(qk),
+        ipan_ctype=jnp.asarray(qct),
+        ipan_ckey=jnp.asarray(qck),
+        ipan_cpairs=jnp.asarray(qcp),
+        ipan_nsall=jnp.asarray(qna),
+        ipan_ns=jnp.asarray(qns),
+        ipan_weight=jnp.asarray(qw),
     )
     aux = {"n_node_pairs": len(node_pair_vocab), "clause_builder": cb, "ns_vocab": ns_vocab}
     return rel, aux
+
+
+def _eval_clauses(t, pair_hit, key_hit) -> jnp.ndarray:
+    """The selector-semantics decision table, shared by both matching
+    directions. t/pair_hit/key_hit broadcast together; CL_PAD clauses are
+    neutral for the enclosing AND."""
+    m = jnp.where(
+        t == PAIR_ANY, pair_hit,
+        jnp.where(t == NOTIN, key_hit & ~pair_hit,
+        jnp.where(t == EXISTS, key_hit,
+        jnp.where(t == DNE, ~key_hit, False))))
+    return m | (t == CL_PAD)
 
 
 def match_clauses(rel: PodRelArrays, ctype, ckey, cpairs) -> jnp.ndarray:
@@ -264,11 +432,15 @@ def match_clauses(rel: PodRelArrays, ctype, ckey, cpairs) -> jnp.ndarray:
         pp.T[jnp.maximum(cpairs, 0)] & (cpairs >= 0)[..., None]
     ).any(axis=-2)  # [T, C, P]
     key_hit = kp.T[jnp.maximum(ckey, 0)] & (ckey >= 0)[..., None]  # [T, C, P]
-    t = ctype[..., None]
-    m = jnp.where(
-        t == PAIR_ANY, pair_hit,
-        jnp.where(t == NOTIN, key_hit & ~pair_hit,
-        jnp.where(t == EXISTS, key_hit,
-        jnp.where(t == DNE, ~key_hit, False))))
-    m = m | (t == CL_PAD)  # padded clauses are neutral for the AND
-    return m.all(axis=-2)  # [T, P]
+    return _eval_clauses(ctype[..., None], pair_hit, key_hit).all(axis=-2)  # [T, P]
+
+
+def match_clauses_rev(rel: PodRelArrays, ctype, ckey, cpairs, b) -> jnp.ndarray:
+    """Evaluate EVERY pod's term clauses against ONE pod `b` (the reverse
+    direction: existing pods' affinity/anti-affinity terms vs the incoming
+    pod). ctype/ckey: [P, T, C]; cpairs: [P, T, C, VP]. Returns [P, T]."""
+    pp = rel.pair_present[b]  # [LP]
+    kp = rel.key_present[b]  # [KK]
+    pair_hit = (pp[jnp.maximum(cpairs, 0)] & (cpairs >= 0)).any(axis=-1)  # [P, T, C]
+    key_hit = kp[jnp.maximum(ckey, 0)] & (ckey >= 0)
+    return _eval_clauses(ctype, pair_hit, key_hit).all(axis=-1)  # [P, T]

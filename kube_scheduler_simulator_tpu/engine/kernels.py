@@ -654,3 +654,206 @@ FILTER_KERNELS["PodTopologySpread"] = (build_spread_filter, decode_spread)
 SCORE_KERNELS["PodTopologySpread"] = (build_spread_score, "custom")
 TRIVIAL_PREFILTER.add("PodTopologySpread")
 TRIVIAL_PRESCORE.add("PodTopologySpread")
+
+
+# ---------------------------------------------------------------------------
+# InterPodAffinity  (oracle: interpod_pre_filter/interpod_filter/
+# interpod_pre_score/interpod_score/interpod_normalize). Both matching
+# directions run on-device: the incoming pod's terms vs every pod
+# (match_clauses) and every pod's terms vs the incoming pod
+# (match_clauses_rev); topology localization reduces through the node
+# (key,value)-pair vocab with scatter-adds keyed on state.assignment.
+# ---------------------------------------------------------------------------
+
+
+def _ipa_forward_live(a: ClusterArrays, s: SchedState, p, nsall, nsmh):
+    """[T, P] liveness+namespace mask for the incoming pod's terms against
+    every candidate target pod (bound, real, in the term's namespaces)."""
+    rel = a.rel
+    bound = (s.assignment >= 0) & a.pod_mask
+    ns_ok = nsall[p][:, None] | nsmh[p][:, rel.ns_id]  # [T, P]
+    return ns_ok & bound[None, :]
+
+
+def _pair_of_assigned(a: ClusterArrays, s: SchedState, key_cols):
+    """[..., P]→ for each pod, the node-pair id of its assigned node at the
+    given key columns. key_cols [T] → returns [T, P]; 0 where unbound or
+    key absent on the node."""
+    rel = a.rel
+    np_assigned = rel.node_pair[jnp.maximum(s.assignment, 0)]  # [P, K]
+    pair = np_assigned[:, jnp.maximum(key_cols, 0)].T  # [T, P]
+    ok = (key_cols >= 0)[:, None] & (s.assignment >= 0)[None, :]
+    return jnp.where(ok, pair, 0)
+
+
+def _forward_match(a, s, p, key_cols, ctype, ckey, cpairs, nsall, nsmh):
+    """(m [T, P], pair_tp [T, P]) — per incoming term: which bound pods
+    match, and the (topologyKey, value) pair id of each pod's node."""
+    from .encode_rel import match_clauses
+
+    m = match_clauses(a.rel, ctype[p], ckey[p], cpairs[p])  # [T, P]
+    m = m & _ipa_forward_live(a, s, p, nsall, nsmh)
+    pair_tp = _pair_of_assigned(a, s, key_cols[p])  # [T, P]
+    return m, pair_tp
+
+
+def _forward_pair_counts(a, s, p, key_cols, ctype, ckey, cpairs, nsall, nsmh, NP1):
+    """[T, NP1] — per incoming term, matching bound pods grouped by the
+    (topologyKey, value) pair of their node."""
+    m, pair_tp = _forward_match(a, s, p, key_cols, ctype, ckey, cpairs, nsall, nsmh)
+    T = pair_tp.shape[0]
+    return (
+        jnp.zeros((T, NP1), jnp.int32)
+        .at[jnp.arange(T)[:, None], pair_tp]
+        .add(m.astype(jnp.int32))
+    )
+
+
+def build_interpod_filter(enc: EncodedCluster):
+    from .encode_rel import match_clauses_rev
+
+    NP1 = enc.aux["n_node_pairs"] + 1
+
+    def kernel(a: ClusterArrays, s: SchedState, p) -> jnp.ndarray:
+        rel = a.rel
+        bound = (s.assignment >= 0) & a.pod_mask
+        # (1) existing pods' required anti-affinity vs the incoming pod
+        rev = match_clauses_rev(rel, rel.ian_ctype, rel.ian_ckey, rel.ian_cpairs, p)
+        ns_ok = rel.ian_nsall | rel.ian_ns[:, :, rel.ns_id[p]]  # [P, T]
+        np_assigned = rel.node_pair[jnp.maximum(s.assignment, 0)]  # [P, K]
+        pair_ot = jnp.take_along_axis(
+            np_assigned, jnp.maximum(rel.ian_key, 0), axis=1
+        )  # [P, T]
+        contrib = (
+            rev
+            & ns_ok
+            & (rel.ian_key >= 0)
+            & bound[:, None]
+            & (pair_ot > 0)
+        )
+        ea_cnt = jnp.zeros(NP1, jnp.int32).at[pair_ot].add(contrib.astype(jnp.int32))
+        ea_node = ea_cnt[rel.node_pair]  # [N, K]
+        fail1 = ((ea_node > 0) & (rel.node_pair > 0)).any(axis=1)
+        # (2) incoming pod's required anti-affinity
+        anti_cnt = _forward_pair_counts(
+            a, s, p, rel.ian_key, rel.ian_ctype, rel.ian_ckey, rel.ian_cpairs,
+            rel.ian_nsall, rel.ian_ns, NP1,
+        )  # [T, NP1]
+        key2 = rel.ian_key[p]  # [T]
+        T2 = key2.shape[0]
+        npair2 = rel.node_pair[:, jnp.maximum(key2, 0)]  # [N, T]
+        cnt2 = anti_cnt[jnp.arange(T2)[None, :], npair2]  # [N, T]
+        fail2 = ((npair2 > 0) & (cnt2 > 0) & (key2 >= 0)[None, :]).any(axis=1)
+        # (3) incoming pod's required affinity
+        aff_cnt = _forward_pair_counts(
+            a, s, p, rel.ia_key, rel.ia_ctype, rel.ia_ckey, rel.ia_cpairs,
+            rel.ia_nsall, rel.ia_ns, NP1,
+        )
+        key3 = rel.ia_key[p]
+        T3 = key3.shape[0]
+        tvalid3 = key3 >= 0
+        has_terms = tvalid3.any()
+        npair3 = rel.node_pair[:, jnp.maximum(key3, 0)]
+        cnt3 = aff_cnt[jnp.arange(T3)[None, :], npair3]
+        ok_t = (npair3 > 0) & (cnt3 > 0)
+        satisfied = (ok_t | ~tvalid3[None, :]).all(axis=1)
+        # first-pod-in-series: no term matched anything anywhere AND the
+        # pod matches all of its own terms (oracle interpod_filter)
+        total_matches = aff_cnt[:, 1:].sum()
+        self_all = (rel.ia_self[p] | ~tvalid3).all()
+        pass3 = satisfied | ((total_matches == 0) & self_all)
+        fail3 = has_terms & ~pass3
+        return jnp.where(
+            fail1, 1, jnp.where(fail2, 2, jnp.where(fail3, 3, 0))
+        ).astype(jnp.int32)
+
+    return kernel
+
+
+def decode_interpod(code: int, enc: EncodedCluster, node_idx: int) -> str:
+    return {
+        1: "node(s) didn't satisfy existing pods anti-affinity rules",
+        2: "node(s) didn't match pod anti-affinity rules",
+        3: "node(s) didn't match pod affinity rules",
+    }[code]
+
+
+def build_interpod_score(enc: EncodedCluster):
+    """topology_score[(key,val)] accumulated into a node-pair weight array,
+    gathered per node (oracle interpod_pre_score/interpod_score)."""
+    from .encode_rel import match_clauses_rev
+
+    if "InterPodAffinity" not in enc.config.enabled("preScore"):
+
+        def zero_kernel(a, s, p, feasible):
+            return jnp.zeros(a.node_mask.shape[0], enc.policy.score)
+
+        zero_kernel._normalize = lambda a, s, p, raw, feasible: jnp.zeros_like(raw)
+        return zero_kernel
+
+    NP1 = enc.aux["n_node_pairs"] + 1
+    hard_w = int(
+        enc.config.plugin_args("InterPodAffinity").get("hardPodAffinityWeight", 1)
+    )
+    score_dt = enc.policy.score
+
+    def kernel(a: ClusterArrays, s: SchedState, p, feasible) -> jnp.ndarray:
+        rel = a.rel
+        bound = (s.assignment >= 0) & a.pod_mask
+        wsum = jnp.zeros(NP1, score_dt)
+        # incoming pod's preferred terms vs existing pods (weight ±w)
+        for key, ct, ck, cp, na, nm, w, sign in (
+            (rel.ipa_key, rel.ipa_ctype, rel.ipa_ckey, rel.ipa_cpairs,
+             rel.ipa_nsall, rel.ipa_ns, rel.ipa_weight, 1),
+            (rel.ipan_key, rel.ipan_ctype, rel.ipan_ckey, rel.ipan_cpairs,
+             rel.ipan_nsall, rel.ipan_ns, rel.ipan_weight, -1),
+        ):
+            m, pair_tp = _forward_match(a, s, p, key, ct, ck, cp, na, nm)
+            wt = (sign * w[p]).astype(score_dt)[:, None]  # [T, 1]
+            wsum = wsum.at[pair_tp].add(jnp.where(m, wt, 0))
+        # existing pods' terms vs the incoming pod: preferred ±w, and
+        # required affinity at hardPodAffinityWeight
+        rev_domains = [
+            (rel.ipa_key, rel.ipa_ctype, rel.ipa_ckey, rel.ipa_cpairs,
+             rel.ipa_nsall, rel.ipa_ns, rel.ipa_weight, 1),
+            (rel.ipan_key, rel.ipan_ctype, rel.ipan_ckey, rel.ipan_cpairs,
+             rel.ipan_nsall, rel.ipan_ns, rel.ipan_weight, -1),
+        ]
+        if hard_w > 0:
+            rev_domains.append(
+                (rel.ia_key, rel.ia_ctype, rel.ia_ckey, rel.ia_cpairs,
+                 rel.ia_nsall, rel.ia_ns, None, hard_w)
+            )
+        for key, ct, ck, cp, na, nm, w, sign in rev_domains:
+            rev = match_clauses_rev(rel, ct, ck, cp, p)  # [P, T]
+            ns_ok = na | nm[:, :, rel.ns_id[p]]
+            pair_ot = jnp.take_along_axis(
+                rel.node_pair[jnp.maximum(s.assignment, 0)],
+                jnp.maximum(key, 0),
+                axis=1,
+            )  # [P, T]
+            contrib = rev & ns_ok & (key >= 0) & bound[:, None] & (pair_ot > 0)
+            wt = (sign * w).astype(score_dt) if w is not None else jnp.full(
+                key.shape, sign, score_dt
+            )
+            wsum = wsum.at[pair_ot].add(jnp.where(contrib, wt, 0))
+        vals = jnp.where(rel.node_pair > 0, wsum[rel.node_pair], 0)  # [N, K]
+        return vals.sum(axis=1).astype(score_dt)
+
+    def normalize(a, s, p, raw, feasible):
+        BIG = jnp.iinfo(jnp.int32).max
+        minv = jnp.where(feasible, raw, BIG).min()
+        maxv = jnp.where(feasible, raw, -BIG).max()
+        diff = maxv - minv
+        return jnp.where(
+            diff > 0, MAX_NODE_SCORE * (raw - minv) // jnp.maximum(diff, 1), 0
+        ).astype(raw.dtype)
+
+    kernel._normalize = normalize
+    return kernel
+
+
+FILTER_KERNELS["InterPodAffinity"] = (build_interpod_filter, decode_interpod)
+SCORE_KERNELS["InterPodAffinity"] = (build_interpod_score, "custom")
+TRIVIAL_PREFILTER.add("InterPodAffinity")
+TRIVIAL_PRESCORE.add("InterPodAffinity")

@@ -1,0 +1,200 @@
+"""Parity: InterPodAffinity kernel vs oracle (M3b)."""
+
+import random
+
+import pytest
+
+from kube_scheduler_simulator_tpu.engine import EXACT, TPU32
+
+from helpers import node, pod
+from test_engine_parity import assert_parity
+from test_engine_parity_m3 import m3a_config
+
+
+def ipa_config():
+    cfg = m3a_config(
+        extra_filters=("InterPodAffinity",),
+        extra_scores=(("InterPodAffinity", 1),),
+    )
+    cfg.profile()["plugins"]["preScore"]["enabled"].append(
+        {"name": "InterPodAffinity"}
+    )
+    return cfg
+
+
+def zone_nodes():
+    out = []
+    for z in ("a", "b"):
+        for i in range(2):
+            out.append(node(f"n-{z}{i}", labels={
+                "topology.kubernetes.io/zone": z,
+                "kubernetes.io/hostname": f"n-{z}{i}"}))
+    return out
+
+
+def aff(required=None, preferred=None, anti_required=None, anti_preferred=None):
+    out = {}
+    pa = {}
+    if required:
+        pa["requiredDuringSchedulingIgnoredDuringExecution"] = required
+    if preferred:
+        pa["preferredDuringSchedulingIgnoredDuringExecution"] = preferred
+    if pa:
+        out["podAffinity"] = pa
+    paa = {}
+    if anti_required:
+        paa["requiredDuringSchedulingIgnoredDuringExecution"] = anti_required
+    if anti_preferred:
+        paa["preferredDuringSchedulingIgnoredDuringExecution"] = anti_preferred
+    if paa:
+        out["podAntiAffinity"] = paa
+    return out
+
+
+def term(app, key="topology.kubernetes.io/zone", ns=None, ns_selector=None):
+    t = {"topologyKey": key,
+         "labelSelector": {"matchLabels": {"app": app}}}
+    if ns is not None:
+        t["namespaces"] = ns
+    if ns_selector is not None:
+        t["namespaceSelector"] = ns_selector
+    return t
+
+
+class TestInterPodAffinity:
+    def test_required_affinity_colocation(self):
+        nodes = zone_nodes()
+        pods = [
+            pod("db", labels={"app": "db"}, node_name="n-b0"),
+            pod("web", labels={"app": "web"},
+                affinity=aff(required=[term("db")])),  # must land in zone b
+        ]
+        results = assert_parity(nodes, pods, ipa_config())
+        assert results[0].selected_node.startswith("n-b")
+
+    def test_required_anti_affinity_exclusion(self):
+        nodes = zone_nodes()
+        pods = [
+            pod("db", labels={"app": "db"}, node_name="n-a0"),
+            pod("web", labels={"app": "web"},
+                affinity=aff(anti_required=[term("db")])),  # avoid zone a
+        ]
+        results = assert_parity(nodes, pods, ipa_config())
+        assert results[0].selected_node.startswith("n-b")
+
+    def test_anti_affinity_chain_hostname(self):
+        # classic one-replica-per-node chain: each pod anti-affines itself
+        nodes = zone_nodes()
+        pods = [
+            pod(f"r{i}", labels={"app": "web"},
+                affinity=aff(anti_required=[term("web", key="kubernetes.io/hostname")]))
+            for i in range(6)  # only 4 nodes -> last two unschedulable
+        ]
+        results = assert_parity(nodes, pods, ipa_config())
+        statuses = [r.status for r in results]
+        assert statuses.count("Scheduled") == 4
+        assert statuses.count("Unschedulable") == 2
+
+    def test_existing_pods_anti_affinity_symmetry(self):
+        nodes = zone_nodes()
+        pods = [
+            # bound pod that repels app=web in its zone
+            pod("grumpy", labels={"app": "db"}, node_name="n-a0",
+                affinity=aff(anti_required=[term("web")])),
+            pod("web", labels={"app": "web"}),
+        ]
+        results = assert_parity(nodes, pods, ipa_config())
+        assert results[0].selected_node.startswith("n-b")
+
+    def test_first_pod_in_series_self_match(self):
+        nodes = zone_nodes()
+        # nothing matches anywhere, but the pod matches its own term -> pass
+        pods = [pod("web", labels={"app": "web"},
+                    affinity=aff(required=[term("web")]))]
+        results = assert_parity(nodes, pods, ipa_config())
+        assert results[0].status == "Scheduled"
+
+    def test_first_pod_no_self_match_unschedulable(self):
+        nodes = zone_nodes()
+        pods = [pod("web", labels={"app": "web"},
+                    affinity=aff(required=[term("db")]))]
+        results = assert_parity(nodes, pods, ipa_config())
+        assert results[0].status == "Unschedulable"
+
+    def test_preferred_affinity_scoring(self):
+        nodes = zone_nodes()
+        pods = [
+            pod("db", labels={"app": "db"}, node_name="n-b1"),
+            pod("web", labels={"app": "web"}, affinity=aff(preferred=[
+                {"weight": 50, "podAffinityTerm": term("db")}])),
+            pod("loner", labels={"app": "loner"}, affinity=aff(anti_preferred=[
+                {"weight": 80, "podAffinityTerm": term("db")}])),
+        ]
+        for policy in (EXACT, TPU32):
+            results = assert_parity(nodes, pods, ipa_config(), policy=policy)
+        by = {r.pod_name: r for r in results}
+        assert by["web"].selected_node.startswith("n-b")
+        assert by["loner"].selected_node.startswith("n-a")
+
+    def test_hard_pod_affinity_weight_symmetry(self):
+        nodes = zone_nodes()
+        pods = [
+            # bound pod with REQUIRED affinity toward app=web: symmetric
+            # scoring pulls web toward it at hardPodAffinityWeight
+            pod("clingy", labels={"app": "db"}, node_name="n-b0",
+                affinity=aff(required=[term("web")])),
+            pod("web", labels={"app": "web"}),
+        ]
+        results = assert_parity(nodes, pods, ipa_config())
+        assert results[0].selected_node.startswith("n-b")
+
+    def test_namespaces_scoping(self):
+        nodes = zone_nodes()
+        pods = [
+            pod("other-ns-db", labels={"app": "db"}, ns="prod", node_name="n-a0"),
+            pod("db", labels={"app": "db"}, node_name="n-b0"),
+            # same-namespace term: only 'db' in default ns counts
+            pod("web1", labels={"app": "web"},
+                affinity=aff(required=[term("db")])),
+            # explicit namespaces: targets prod
+            pod("web2", labels={"app": "web"},
+                affinity=aff(required=[term("db", ns=["prod"])])),
+        ]
+        results = assert_parity(nodes, pods, ipa_config())
+        by = {r.pod_name: r for r in results}
+        assert by["web1"].selected_node.startswith("n-b")
+        assert by["web2"].selected_node.startswith("n-a")
+
+
+class TestInterpodRandomized:
+    @pytest.mark.parametrize("seed", range(5))
+    def test_randomized(self, seed):
+        rng = random.Random(3000 + seed)
+        nodes = []
+        for i in range(rng.randint(3, 6)):
+            nodes.append(node(f"n{i}", cpu="8", labels={
+                "topology.kubernetes.io/zone": rng.choice(["a", "b"]),
+                "kubernetes.io/hostname": f"n{i}"}))
+        apps = ["web", "db", "cache"]
+        pods = []
+        for i in range(rng.randint(8, 16)):
+            app = rng.choice(apps)
+            kw = {"labels": {"app": app}}
+            r = rng.random()
+            key = rng.choice(["topology.kubernetes.io/zone", "kubernetes.io/hostname"])
+            target = rng.choice(apps)
+            if r < 0.25:
+                kw["affinity"] = aff(required=[term(target, key=key)])
+            elif r < 0.45:
+                kw["affinity"] = aff(anti_required=[term(target, key=key)])
+            elif r < 0.6:
+                kw["affinity"] = aff(preferred=[
+                    {"weight": rng.randint(1, 100),
+                     "podAffinityTerm": term(target, key=key)}])
+            elif r < 0.7:
+                kw["affinity"] = aff(anti_preferred=[
+                    {"weight": rng.randint(1, 100),
+                     "podAffinityTerm": term(target, key=key)}])
+            pods.append(pod(f"p{i}", cpu="200m", mem="128Mi", **kw))
+        assert_parity(nodes, pods, ipa_config(), policy=EXACT)
+        assert_parity(nodes, pods, ipa_config(), policy=TPU32)
