@@ -171,6 +171,10 @@ class SchedState:
     used_pair: jnp.ndarray  # [N, Q] int32 users of (proto,port), any ip
     used_wild: jnp.ndarray  # [N, Q] int32 wildcard-ip users of (proto,port)
     used_trip: jnp.ndarray  # [N, V2] int32 users of (proto,ip,port)
+    # bind chronology: pre-bound pods get their input index, scan-bound pods
+    # get P + step. Preemption's victim-reprieve tie-break (equal priority)
+    # follows NodeInfo.pods insertion order in the oracle — this mirrors it.
+    bound_seq: jnp.ndarray  # [P] int32 | -1 unbound
 
 
 class EncodedCluster:
@@ -640,6 +644,7 @@ def encode_cluster(
     used_pair = np.zeros((N, Q), np.int32)
     used_wild = np.zeros((N, Q), np.int32)
     used_trip = np.zeros((N, V2), np.int32)
+    bound_seq = np.full(P, -1, np.int32)
     pending: list[int] = []
     for i in range(len(pods)):
         tgt = pod_node_name[i]
@@ -651,6 +656,7 @@ def encode_cluster(
             used_pair[tgt] += want_pair[i]
             used_wild[tgt] += port_arrays["want_wild"][i]
             used_trip[tgt] += port_arrays["want_trip"][i]
+            bound_seq[i] = i
         else:
             pending.append(i)
     pending.sort(key=lambda i: (-int(pod_priority[i]), i))
@@ -688,6 +694,7 @@ def encode_cluster(
         used_pair=jnp.asarray(used_pair),
         used_wild=jnp.asarray(used_wild),
         used_trip=jnp.asarray(used_trip),
+        bound_seq=jnp.asarray(bound_seq),
     )
     enc = EncodedCluster(
         arrays,

@@ -133,6 +133,14 @@ class BatchedScheduler:
                 (K.SCORE_KERNELS[n][1] for n in self._score_specs_names),
             )
         ]
+        self._postfilter_names = [
+            n for n in cfg.enabled("postFilter") if n in K.POSTFILTER_KERNELS
+        ]
+        self._preempt = (
+            K.POSTFILTER_KERNELS["DefaultPreemption"](enc, self._f_kernels)
+            if "DefaultPreemption" in self._postfilter_names
+            else None
+        )
         self.weights = jnp.asarray(
             [w for _, w in self._score_specs], enc.policy.score
         )
@@ -153,6 +161,7 @@ class BatchedScheduler:
     def _build_run(self):
         enc = self.enc
         N = enc.N
+        P = enc.P
         score_dt = enc.policy.score
         NEG = jnp.iinfo(score_dt).min // 2
         record = self.record
@@ -160,9 +169,10 @@ class BatchedScheduler:
         f_kernels = self._f_kernels
         s_kernels = self._s_kernels
         s_normalize = self._s_normalize
+        preempt_fn = self._preempt
 
-        def step(carry, p):
-            state, a, weights = carry
+        def attempt(state, a, weights, p):
+            """One full Filter→Score→Normalize→select pass for pod p."""
             if pf_kernels:
                 pf_codes = jnp.stack([k(a, state, p) for k in pf_kernels])
                 pf_ok = (pf_codes == 0).all()
@@ -204,12 +214,15 @@ class BatchedScheduler:
             masked = jnp.where(feasible, total, NEG)
             sel = jnp.argmax(masked).astype(jnp.int32)
             sel = jnp.where(feasible.any(), sel, -1)
+            return pf_codes, codes, raw, final, sel, pf_ok
+
+        def bind(state, a, p, sel, qi):
             # Unschedulable pods scatter-add zeros to row 0 (valid == 0),
             # keeping the node axis exactly [N] for mesh sharding.
             tgt = jnp.maximum(sel, 0)
             valid = (sel >= 0).astype(a.pod_req.dtype)
             vi = (sel >= 0).astype(jnp.int32)
-            state = state.replace(
+            return state.replace(
                 requested=state.requested.at[tgt].add(a.pod_req[p] * valid),
                 s_requested=state.s_requested.at[tgt].add(a.pod_sreq[p] * valid),
                 n_pods=state.n_pods.at[tgt].add(vi),
@@ -217,8 +230,80 @@ class BatchedScheduler:
                 used_pair=state.used_pair.at[tgt].add(a.want_pair[p] * vi),
                 used_wild=state.used_wild.at[tgt].add(a.want_wild[p] * vi),
                 used_trip=state.used_trip.at[tgt].add(a.want_trip[p] * vi),
+                bound_seq=state.bound_seq.at[p].set(
+                    jnp.where(sel >= 0, jnp.int32(P) + qi, jnp.int32(-1))
+                ),
             )
-            out = (pf_codes, codes, raw, final, sel) if record else sel
+
+        def evict_all(state, a, mask):
+            """Remove every masked pod from its node (preemption victims;
+            oracle Oracle.evict)."""
+            tgtv = jnp.maximum(state.assignment, 0)
+            mf = mask.astype(a.pod_req.dtype)[:, None]
+            mi = mask.astype(jnp.int32)
+            return state.replace(
+                requested=state.requested.at[tgtv].add(-(a.pod_req * mf)),
+                s_requested=state.s_requested.at[tgtv].add(-(a.pod_sreq * mf)),
+                n_pods=state.n_pods.at[tgtv].add(-mi),
+                assignment=jnp.where(mask, -1, state.assignment),
+                used_pair=state.used_pair.at[tgtv].add(-(a.want_pair * mi[:, None])),
+                used_wild=state.used_wild.at[tgtv].add(-(a.want_wild * mi[:, None])),
+                used_trip=state.used_trip.at[tgtv].add(-(a.want_trip * mi[:, None])),
+                bound_seq=jnp.where(mask, -1, state.bound_seq),
+            )
+
+        def step(carry, x):
+            state, a, weights = carry
+            p, qi = x
+            pf_codes, codes, raw, final, sel, pf_ok = attempt(state, a, weights, p)
+            if preempt_fn is None:
+                state = bind(state, a, p, sel, qi)
+                out = (pf_codes, codes, raw, final, sel) if record else sel
+                return (state, a, weights), out
+
+            # PostFilter path: when the pod is unschedulable, run the
+            # preemption dry-run; on nomination, evict victims and retry the
+            # full cycle within the same step (oracle schedule_all re-queues
+            # the pod at the queue head — nothing schedules in between).
+            do = (sel < 0) & pf_ok & a.pod_mask[p]
+
+            def with_preempt(st):
+                pcode, vmask, nominated = preempt_fn(a, st, p)
+                evict = vmask[jnp.maximum(nominated, 0)] & (nominated >= 0)
+                st2 = evict_all(st, a, evict)
+                _, codes2, raw2, final2, sel2, _ = attempt(st2, a, weights, p)
+                # retry-failure postfilter (recorded, never evicts — the
+                # oracle's retried-set forces Unschedulable on 2nd failure)
+                pcode2, vmask2, nominated2 = preempt_fn(a, st2, p)
+                return st2, (
+                    pcode, vmask, nominated, evict,
+                    codes2, raw2, final2, sel2, pcode2, vmask2, nominated2,
+                )
+
+            def without(st):
+                return st, (
+                    jnp.zeros(N, jnp.int32), jnp.zeros((N, P), bool),
+                    jnp.int32(-1), jnp.zeros(P, bool),
+                    jnp.zeros_like(codes), jnp.zeros_like(raw),
+                    jnp.zeros_like(final), jnp.int32(-1),
+                    jnp.zeros(N, jnp.int32), jnp.zeros((N, P), bool),
+                    jnp.int32(-1),
+                )
+
+            state, extra = jax.lax.cond(do, with_preempt, without, state)
+            (pcode, vmask, nominated, evict,
+             codes2, raw2, final2, sel2, pcode2, vmask2, nominated2) = extra
+            final_sel = jnp.where(do & (nominated >= 0), sel2, sel)
+            state = bind(state, a, p, final_sel, qi)
+            if record:
+                out = (
+                    pf_codes, codes, raw, final, sel, do,
+                    pcode, vmask, nominated,
+                    codes2, raw2, final2, sel2, pcode2, vmask2, nominated2,
+                    final_sel,
+                )
+            else:
+                out = final_sel
             return (state, a, weights), out
 
         def run(arrays, state0, queue, weights):
@@ -226,7 +311,8 @@ class BatchedScheduler:
             # an argument (not a closure constant) keeps the cluster data
             # out of the compiled executable, so equal-shape problems reuse
             # the compilation.
-            (state, _, _), out = jax.lax.scan(step, (state0, arrays, weights), queue)
+            xs = (queue, jnp.arange(queue.shape[0], dtype=jnp.int32))
+            (state, _, _), out = jax.lax.scan(step, (state0, arrays, weights), xs)
             return state, out
 
         return run
@@ -256,6 +342,65 @@ class BatchedScheduler:
 
     # -- trace → reference annotation records -------------------------------
 
+    def _fill_attempt(self, res, codes_row, raw_row, final_row, sel_val):
+        """Fill one Filter→Score attempt into a result record. Returns True
+        when the attempt scheduled the pod."""
+        enc = self.enc
+        feasible = []
+        for n in range(enc.n_nodes):
+            ok = True
+            for j, fname in enumerate(self._filter_names):
+                c = int(codes_row[n, j])
+                if c:
+                    res.add_filter(
+                        enc.node_names[n],
+                        fname,
+                        K.FILTER_KERNELS[fname][1](c, enc, n),
+                    )
+                    ok = False
+                    break
+                res.add_filter(enc.node_names[n], fname, PASSED_FILTER_MESSAGE)
+            if ok:
+                feasible.append(n)
+        if not feasible:
+            res.status = "Unschedulable"
+            return False
+        for pname in self._prescore_names:
+            res.pre_score[pname] = SUCCESS_MESSAGE
+        for j, sname in enumerate(self._score_specs_names):
+            for n in feasible:
+                res.add_score(enc.node_names[n], sname, int(raw_row[n, j]))
+                res.add_final_score(enc.node_names[n], sname, int(final_row[n, j]))
+        s = int(sel_val)
+        res.selected_node = enc.node_names[s]
+        res.status = "Scheduled"
+        # Mirrors the oracle (sched/oracle.py schedule_one), which mirrors
+        # the reference's always-on reserve/prebind/bind recording.
+        res.reserve["VolumeBinding"] = SUCCESS_MESSAGE
+        res.prebind["VolumeBinding"] = SUCCESS_MESSAGE
+        res.bind["DefaultBinder"] = SUCCESS_MESSAGE
+        return True
+
+    def _fill_postfilter(self, res, pcode_row, vmask_row, seq):
+        """Attach DefaultPreemption messages (oracle default_preemption's
+        per-node messages dict). Returns (nominated victims by node)."""
+        enc = self.enc
+        prio = np.asarray(enc.arrays.pod_priority)
+        victims_by_node = {}
+        for n in range(enc.n_nodes):
+            code = int(pcode_row[n])
+            vs = [int(v) for v in np.nonzero(vmask_row[n])[0]]
+            # reprieve processing order: priority desc, bind order asc
+            vs.sort(key=lambda v: (-int(prio[v]), int(seq[v])))
+            names = [f"{enc.pod_keys[v][0]}/{enc.pod_keys[v][1]}" for v in vs]
+            victims_by_node[n] = names
+            if code == K.PREEMPT_SILENT:
+                continue
+            res.post_filter.setdefault(enc.node_names[n], {})[
+                "DefaultPreemption"
+            ] = K.decode_preemption(code, enc, n, names)
+        return victims_by_node
+
     def results(self) -> list[PodSchedulingResult]:
         """Convert the dense result tensors into the reference's per-pod
         scheduling records (identical to the oracle's output shape)."""
@@ -264,9 +409,19 @@ class BatchedScheduler:
         if self._trace is None:
             self.run()
         enc = self.enc
-        pf_codes, codes, raw, final, sel = (np.asarray(x) for x in self._trace)
+        has_pf = self._preempt is not None
+        if has_pf:
+            (pf_codes, codes, raw, final, sel, did, pcode, vmask, nominated,
+             codes2, raw2, final2, sel2, pcode2, vmask2, nominated2,
+             final_sel) = (np.asarray(x) for x in self._trace)
+        else:
+            pf_codes, codes, raw, final, sel = (
+                np.asarray(x) for x in self._trace
+            )
+            final_sel = sel
         results = []
-        n_real = enc.n_nodes
+        # bind chronology for victim-ordering (mirrors state.bound_seq)
+        seq = np.asarray(enc.state0.bound_seq).copy()
         for qi, p in enumerate(enc.queue):
             ns, name = enc.pod_keys[p]
             res = PodSchedulingResult(pod_namespace=ns, pod_name=name)
@@ -285,39 +440,39 @@ class BatchedScheduler:
                 res.status = "Unschedulable"
                 results.append(res)
                 continue
-            feasible = []
-            for n in range(n_real):
-                ok = True
-                for j, fname in enumerate(self._filter_names):
-                    c = int(codes[qi, n, j])
-                    if c:
-                        res.add_filter(
-                            enc.node_names[n],
-                            fname,
-                            K.FILTER_KERNELS[fname][1](c, enc, n),
-                        )
-                        ok = False
-                        break
-                    res.add_filter(enc.node_names[n], fname, PASSED_FILTER_MESSAGE)
-                if ok:
-                    feasible.append(n)
-            if not feasible:
-                res.status = "Unschedulable"
+            self._fill_attempt(res, codes[qi], raw[qi], final[qi], sel[qi])
+            if has_pf and bool(did[qi]):
+                victims_by_node = self._fill_postfilter(
+                    res, pcode[qi], vmask[qi], seq
+                )
+                nom = int(nominated[qi])
+                if nom >= 0:
+                    res.status = "Nominated"
+                    res.nominated_node = enc.node_names[nom]
+                    res.preemption_victims = victims_by_node[nom]
+                    results.append(res)
+                    # the retry attempt (oracle re-queues the pod at the
+                    # head; a second failure is terminally Unschedulable)
+                    res2 = PodSchedulingResult(pod_namespace=ns, pod_name=name)
+                    res2.pre_filter_status = dict(res.pre_filter_status)
+                    ok = self._fill_attempt(
+                        res2, codes2[qi], raw2[qi], final2[qi], sel2[qi]
+                    )
+                    if not ok:
+                        self._fill_postfilter(res2, pcode2[qi], vmask2[qi], seq)
+                        nom2 = int(nominated2[qi])
+                        if nom2 >= 0:
+                            res2.nominated_node = enc.node_names[nom2]
+                        res2.status = "Unschedulable"
+                    results.append(res2)
+                else:
+                    res.status = "Unschedulable"
+                    results.append(res)
+            else:
                 results.append(res)
-                continue
-            for pname in self._prescore_names:
-                res.pre_score[pname] = SUCCESS_MESSAGE
-            for j, sname in enumerate(self._score_specs_names):
-                for n in feasible:
-                    res.add_score(enc.node_names[n], sname, int(raw[qi, n, j]))
-                    res.add_final_score(enc.node_names[n], sname, int(final[qi, n, j]))
-            s = int(sel[qi])
-            res.selected_node = enc.node_names[s]
-            res.status = "Scheduled"
-            # Mirrors the oracle (sched/oracle.py schedule_one), which mirrors
-            # the reference's always-on reserve/prebind/bind recording.
-            res.reserve["VolumeBinding"] = SUCCESS_MESSAGE
-            res.prebind["VolumeBinding"] = SUCCESS_MESSAGE
-            res.bind["DefaultBinder"] = SUCCESS_MESSAGE
-            results.append(res)
+            if int(final_sel[qi]) >= 0:
+                seq[p] = enc.P + qi
+            if has_pf and bool(did[qi]) and int(nominated[qi]) >= 0:
+                for v in np.nonzero(vmask[qi][int(nominated[qi])])[0]:
+                    seq[int(v)] = -1
         return results

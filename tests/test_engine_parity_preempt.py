@@ -1,0 +1,141 @@
+"""Parity: DefaultPreemption (PostFilter) kernel vs oracle (M3c)."""
+
+import random
+
+import pytest
+
+from kube_scheduler_simulator_tpu.engine import EXACT, TPU32
+
+from helpers import node, pod
+from test_engine_parity import assert_parity, restricted_config
+
+
+def preempt_config():
+    cfg = restricted_config(
+        filters=("NodeUnschedulable", "NodeName", "NodeResourcesFit"),
+        scores=(("NodeResourcesFit", 1), ("NodeResourcesBalancedAllocation", 1)),
+        prefilters=("NodeResourcesFit",),
+        prescores=("NodeResourcesFit", "NodeResourcesBalancedAllocation"),
+    )
+    cfg.profile()["plugins"]["postFilter"]["enabled"].append(
+        {"name": "DefaultPreemption"}
+    )
+    return cfg
+
+
+class TestPreemption:
+    def test_basic_preempt_and_retry(self):
+        nodes = [node("n0", cpu="2"), node("n1", cpu="2")]
+        pods = [
+            pod("low-a", cpu="1500m", priority=1, node_name="n0"),
+            pod("low-b", cpu="1500m", priority=1, node_name="n1"),
+            pod("high", cpu="1500m", priority=100),
+        ]
+        results = assert_parity(nodes, pods, preempt_config())
+        # two records for 'high': Nominated then Scheduled
+        assert [r.status for r in results] == ["Nominated", "Scheduled"]
+        assert results[0].nominated_node in ("n0", "n1")
+        assert len(results[0].preemption_victims) == 1
+
+    def test_rank_min_highest_victim_priority(self):
+        nodes = [node("n0", cpu="2"), node("n1", cpu="2")]
+        pods = [
+            pod("vip", cpu="1500m", priority=50, node_name="n0"),
+            pod("pleb", cpu="1500m", priority=1, node_name="n1"),
+            pod("high", cpu="1500m", priority=100),
+        ]
+        results = assert_parity(nodes, pods, preempt_config())
+        # prefers evicting the lower-priority victim set (n1)
+        assert results[0].nominated_node == "n1"
+        assert results[0].preemption_victims == ["default/pleb"]
+
+    def test_reprieve_keeps_small_victims(self):
+        # node has two low-priority pods; evicting just one frees enough
+        nodes = [node("n0", cpu="3", pods="10")]
+        pods = [
+            pod("small", cpu="500m", priority=1, node_name="n0"),
+            pod("big", cpu="2", priority=2, node_name="n0"),
+            pod("high", cpu="2500m", priority=100),
+        ]
+        results = assert_parity(nodes, pods, preempt_config())
+        by_status = [r.status for r in results]
+        assert "Nominated" in by_status
+
+    def test_no_lower_priority_pods(self):
+        nodes = [node("n0", cpu="1")]
+        pods = [
+            pod("equal", cpu="800m", priority=100, node_name="n0"),
+            pod("high", cpu="800m", priority=100),
+        ]
+        results = assert_parity(nodes, pods, preempt_config())
+        assert results[0].status == "Unschedulable"
+
+    def test_preemption_would_not_help(self):
+        nodes = [node("n0", cpu="1")]
+        pods = [
+            pod("low", cpu="500m", priority=1, node_name="n0"),
+            pod("huge", cpu="4", priority=100),  # doesn't fit even empty
+        ]
+        results = assert_parity(nodes, pods, preempt_config())
+        assert results[0].status == "Unschedulable"
+
+    def test_priorityclass_resolution(self):
+        nodes = [node("n0", cpu="2")]
+        pcs = [
+            {"metadata": {"name": "critical"}, "value": 1000},
+            {"metadata": {"name": "batch"}, "value": 1, "globalDefault": True},
+        ]
+        pods = [
+            pod("old", cpu="1500m", node_name="n0"),  # batch via globalDefault
+            pod("vip", cpu="1500m", priority_class="critical"),
+        ]
+        from kube_scheduler_simulator_tpu.engine import encode_cluster, BatchedScheduler
+        from kube_scheduler_simulator_tpu.sched.oracle import Oracle
+
+        cfg = preempt_config()
+        oracle = Oracle([dict(n) for n in nodes], [dict(p) for p in pods], cfg,
+                        priorityclasses=[dict(p) for p in pcs])
+        want = oracle.schedule_all()
+        enc = encode_cluster(nodes, pods, cfg, priorityclasses=pcs, policy=EXACT)
+        from kube_scheduler_simulator_tpu.engine.engine import BatchedScheduler as BS
+        got = BS(enc).results()
+        assert len(got) == len(want)
+        for w, g in zip(want, got):
+            assert g.status == w.status
+            assert g.selected_node == w.selected_node
+            assert g.to_annotations() == w.to_annotations()
+        assert want[0].status == "Nominated"
+
+    def test_cascade_preemption_multiple_pods(self):
+        nodes = [node("n0", cpu="2"), node("n1", cpu="2")]
+        pods = [
+            pod("l0", cpu="1500m", priority=1, node_name="n0"),
+            pod("l1", cpu="1500m", priority=2, node_name="n1"),
+            pod("h0", cpu="1500m", priority=100),
+            pod("h1", cpu="1500m", priority=100),
+        ]
+        assert_parity(nodes, pods, preempt_config())
+
+    @pytest.mark.parametrize("seed", range(5))
+    def test_randomized_preemption(self, seed):
+        rng = random.Random(4000 + seed)
+        n_nodes = rng.randint(2, 5)
+        nodes = [node(f"n{i}", cpu=f"{rng.randint(1, 4)}") for i in range(n_nodes)]
+        pods = []
+        # bound low-priority filler
+        for i in range(rng.randint(2, 6)):
+            pods.append(pod(
+                f"f{i}", cpu=f"{rng.choice([500, 1000, 1500])}m",
+                priority=rng.randint(0, 10),
+                node_name=f"n{rng.randint(0, n_nodes - 1)}",
+            ))
+        # incoming mixed-priority pods
+        for i in range(rng.randint(3, 8)):
+            pods.append(pod(
+                f"p{i}", cpu=f"{rng.choice([500, 1000, 2000])}m",
+                priority=rng.choice([0, 5, 50, 100]),
+            ))
+        # skip manifests that over-commit a node at encode time (bound pods
+        # may exceed capacity; that's legal and both sides must agree)
+        assert_parity(nodes, pods, preempt_config(), policy=EXACT)
+        assert_parity(nodes, pods, preempt_config(), policy=TPU32)
