@@ -137,7 +137,7 @@ class BatchedScheduler:
             n for n in cfg.enabled("postFilter") if n in K.POSTFILTER_KERNELS
         ]
         self._preempt = (
-            K.POSTFILTER_KERNELS["DefaultPreemption"](enc, self._f_kernels)
+            K.POSTFILTER_KERNELS["DefaultPreemption"](enc, self._filter_names)
             if "DefaultPreemption" in self._postfilter_names
             else None
         )

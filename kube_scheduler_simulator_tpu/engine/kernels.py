@@ -459,6 +459,9 @@ def build_image_locality_score(enc: EncodedCluster):
         r2 = (r * 10) % den
         d2 = (r2 * 10) // den
         score = a1 * 100 + d1 * 10 + d2
+        # zero-container pods score 0, pinned on both sides (oracle
+        # image_locality_score guards num_containers == 0 the same way;
+        # unreachable for valid k8s pods, which always have >= 1 container)
         return jnp.where(ncont == 0, 0, score).astype(score_dt)
 
     return kernel
@@ -758,10 +761,13 @@ def build_interpod_filter(enc: EncodedCluster):
         ok_t = (npair3 > 0) & (cnt3 > 0)
         satisfied = (ok_t | ~tvalid3[None, :]).all(axis=1)
         # first-pod-in-series: no term matched anything anywhere AND the
-        # pod matches all of its own terms (oracle interpod_filter)
+        # pod matches all of its own terms (oracle interpod_filter) — gated
+        # on the node carrying every requested topology key (upstream
+        # satisfyPodAffinity fails such nodes before the special case)
         total_matches = aff_cnt[:, 1:].sum()
         self_all = (rel.ia_self[p] | ~tvalid3).all()
-        pass3 = satisfied | ((total_matches == 0) & self_all)
+        has_all_keys = ((npair3 > 0) | ~tvalid3[None, :]).all(axis=1)  # [N]
+        pass3 = satisfied | (has_all_keys & (total_matches == 0) & self_all)
         fail3 = has_terms & ~pass3
         return jnp.where(
             fail1, 1, jnp.where(fail2, 2, jnp.where(fail3, 3, 0))
@@ -860,147 +866,20 @@ TRIVIAL_PRESCORE.add("InterPodAffinity")
 
 
 # ---------------------------------------------------------------------------
-# DefaultPreemption (PostFilter)  (oracle: default_preemption /
-# _feasible_after_removal). Dry-run victim selection: per candidate node,
-# remove every lower-priority pod, re-check feasibility with the full
-# filter-kernel stack, then reprieve victims (highest priority first) that
-# keep the pod feasible. All candidate nodes evaluate in parallel (vmap);
-# the reprieve is a lax.scan over victim slots. Cost is O(P·N·filters) per
-# preempting pod — fine for simulation-scale preemption; BASELINE config
-# #5 scale needs a resource-only fast path in a later round.
+# DefaultPreemption (PostFilter) lives in preempt.py — an incremental-
+# counter dry run: O(P·T) prepare + O(N·V·(T+NP1)) reprieve, replacing the
+# round-1 full-kernel re-evaluation (O(N²·V·F)). Builders take
+# (enc, filter_names).
 # ---------------------------------------------------------------------------
 
-PREEMPT_NO_LOWER = 0  # "no lower-priority pods to preempt"
-PREEMPT_NO_FIT = 1  # "preemption would not make pod schedulable"
-PREEMPT_CANDIDATE = 2  # "can preempt k victim(s): ..."
-PREEMPT_SELECTED = 3  # "preemption victim(s): ..."
-PREEMPT_SILENT = 4  # fits with zero victims: oracle records no message
-
-
-def build_preemption(enc: EncodedCluster, f_kernels):
-    """Returns preempt(a, state, p) -> (pf_code [N] int32, victim_mask
-    [N, P] bool, nominated int32)."""
-    P = enc.P
-    BIG = jnp.iinfo(jnp.int32).max
-
-    def feasible_row(a, st, p, n):
-        ok = a.node_mask[n]
-        for k in f_kernels:
-            ok = ok & (k(a, st, p)[n] == 0)
-        return ok
-
-    def add_pod(st, v, node):
-        return st.replace(
-            requested=st.requested.at[node].add(_a.pod_req[v]),
-            s_requested=st.s_requested.at[node].add(_a.pod_sreq[v]),
-            n_pods=st.n_pods.at[node].add(1),
-            assignment=st.assignment.at[v].set(node),
-            used_pair=st.used_pair.at[node].add(_a.want_pair[v]),
-            used_wild=st.used_wild.at[node].add(_a.want_wild[v]),
-            used_trip=st.used_trip.at[node].add(_a.want_trip[v]),
-        )
-
-    _a = None  # bound per call below (kernels close over arrays argument)
-
-    def preempt(a, state, p):
-        nonlocal _a
-        _a = a
-        import jax
-
-        prio_p = a.pod_priority[p]
-        lower_all = (
-            (state.assignment >= 0) & a.pod_mask & (a.pod_priority < prio_p)
-        )  # [P]
-        N = a.node_mask.shape[0]
-
-        def eval_node(n):
-            vm = lower_all & (state.assignment == n)  # lower pods ON n
-            any_lower = vm.any()
-            # remove all lower pods on n
-            delta_req = (a.pod_req * vm[:, None].astype(a.pod_req.dtype)).sum(0)
-            delta_sreq = (a.pod_sreq * vm[:, None].astype(a.pod_sreq.dtype)).sum(0)
-            vm32 = vm.astype(jnp.int32)
-            st = state.replace(
-                requested=state.requested.at[n].add(-delta_req),
-                s_requested=state.s_requested.at[n].add(-delta_sreq),
-                n_pods=state.n_pods.at[n].add(-vm32.sum()),
-                assignment=jnp.where(vm, -1, state.assignment),
-                used_pair=state.used_pair.at[n].add(-(a.want_pair * vm32[:, None]).sum(0)),
-                used_wild=state.used_wild.at[n].add(-(a.want_wild * vm32[:, None]).sum(0)),
-                used_trip=state.used_trip.at[n].add(-(a.want_trip * vm32[:, None]).sum(0)),
-            )
-            fits = feasible_row(a, st, p, n)
-            # reprieve: re-add victims, highest priority first (ties by bind
-            # order, oracle NodeInfo.pods insertion order), keep those that
-            # leave the pod feasible
-            sort_prio = jnp.where(vm, -a.pod_priority, BIG)
-            sort_seq = jnp.where(vm, state.bound_seq, BIG)
-            order = jnp.lexsort((sort_seq, sort_prio))  # [P]
-
-            def reprieve(carry, v):
-                st_c, victims = carry
-                valid = vm[v]
-                st_try = add_pod(st_c, v, n)
-                ok = feasible_row(a, st_try, p, n)
-                keep = valid & ok
-                st_c = jax.tree.map(
-                    lambda x, y: jnp.where(keep, x, y), st_try, st_c
-                )
-                victims = victims.at[v].set(valid & ~ok)
-                return (st_c, victims), None
-
-            (st_final, victims), _ = jax.lax.scan(
-                reprieve, (st, jnp.zeros(P, bool)), order
-            )
-            has_victims = victims.any()
-            code = jnp.where(
-                ~any_lower,
-                PREEMPT_NO_LOWER,
-                jnp.where(
-                    ~fits,
-                    PREEMPT_NO_FIT,
-                    jnp.where(has_victims, PREEMPT_CANDIDATE, PREEMPT_SILENT),
-                ),
-            )
-            # SILENT: fits with zero surviving victims (possible when the
-            # infeasibility came from another node via spread/inter-pod
-            # coupling) — the oracle records no message and no candidate.
-            victims = victims & (code == PREEMPT_CANDIDATE)
-            return code.astype(jnp.int32), victims
-
-        pf_code, victim_mask = jax.vmap(eval_node)(jnp.arange(N))  # [N], [N, P]
-        # node choice (oracle rank): min highest-victim-priority, then min
-        # priority sum, then fewest victims, then lowest node index
-        cand = pf_code == PREEMPT_CANDIDATE
-        prios = jnp.where(victim_mask, a.pod_priority[None, :], 0)
-        maxp = jnp.where(victim_mask, a.pod_priority[None, :], -BIG).max(axis=1)
-        sump = prios.sum(axis=1)
-        cnt = victim_mask.sum(axis=1)
-        alive = cand
-        for key in (maxp, sump, cnt):
-            best = jnp.where(alive, key, BIG).min()
-            alive = alive & (key == best)
-        nominated = jnp.where(alive.any(), jnp.argmax(alive), -1).astype(jnp.int32)
-        pf_code = jnp.where(
-            (jnp.arange(N) == nominated) & (nominated >= 0),
-            PREEMPT_SELECTED,
-            pf_code,
-        )
-        return pf_code, victim_mask, nominated
-
-    return preempt
-
-
-def decode_preemption(
-    code: int, enc: EncodedCluster, node_idx: int, victims: "list[str]"
-) -> str:
-    if code == PREEMPT_NO_LOWER:
-        return "no lower-priority pods to preempt"
-    if code == PREEMPT_NO_FIT:
-        return "preemption would not make pod schedulable"
-    if code == PREEMPT_CANDIDATE:
-        return f"can preempt {len(victims)} victim(s): " + ", ".join(victims)
-    return "preemption victim(s): " + ", ".join(victims)
-
+from .preempt import (  # noqa: E402
+    PREEMPT_CANDIDATE,
+    PREEMPT_NO_FIT,
+    PREEMPT_NO_LOWER,
+    PREEMPT_SELECTED,
+    PREEMPT_SILENT,
+    build_preemption,
+    decode_preemption,
+)
 
 POSTFILTER_KERNELS["DefaultPreemption"] = build_preemption

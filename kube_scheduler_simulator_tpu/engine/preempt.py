@@ -1,0 +1,486 @@
+"""DefaultPreemption (PostFilter) as an incremental-counter kernel.
+
+Semantics (oracle: sched/oracle_plugins.py default_preemption, mirroring
+upstream dry-run preemption; reference records the result via the wrapped
+PostFilter plugin, simulator/scheduler/plugin/wrappedplugin.go:518-546):
+per candidate node, remove every lower-priority pod, check feasibility of
+the preemptor, then re-add victims highest-priority-first keeping those
+that leave the pod feasible; rank candidate nodes by (min highest-victim
+priority, min priority sum, fewest victims, lowest index).
+
+TPU-first structure — the expensive part of the dry run is re-running the
+filter stack per (candidate node x reprieve step). Round 1 evaluated every
+full `[N]` filter kernel inside that double loop: O(N²·V·F) compute and a
+full SchedState pytree merge per step, which is what blew up both compile
+and run time (VERDICT round 1). This rewrite splits every state-dependent
+filter into:
+
+  * `prepare`  — per preemption call, state-level: label/selector match
+    matrices (assignment-independent) and base aggregation counters from
+    the *current* assignment. O(P·T) once, matmul/scatter shaped.
+  * `node_init` — per candidate node: subtract the victims' contributions
+    from the base counters (victims all sit on the candidate node, so the
+    deltas collapse to one dot product + one scatter row).
+  * `add_back` — per reprieve step: one victim's O(T) counter delta.
+  * `check`    — per reprieve step: feasibility of the preemptor on the
+    candidate node from counters alone; no `[N]`-wide intermediates.
+
+State-independent filters (NodeName, NodeUnschedulable, TaintToleration,
+NodeAffinity) are evaluated once per call with their ordinary kernels —
+victim removal cannot change them.
+
+Total cost: O(P·T) prepare + O(N·P) node-init (batched matmuls) +
+O(N·V·(T + NP1)) reprieve, where V is bounded by the max pods-per-node
+capacity — versus round 1's O(N²·V·F·N). The reprieve scan carry is a few
+KB of counters instead of the full cluster state.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .encode import PODS_RES, ClusterArrays, EncodedCluster, SchedState
+
+PREEMPT_NO_LOWER = 0  # "no lower-priority pods to preempt"
+PREEMPT_NO_FIT = 1  # "preemption would not make pod schedulable"
+PREEMPT_CANDIDATE = 2  # "can preempt k victim(s): ..."
+PREEMPT_SELECTED = 3  # "preemption victim(s): ..."
+PREEMPT_SILENT = 4  # fits with zero victims: oracle records no message
+
+# Filters whose codes do not read SchedState: safe to evaluate once per
+# preemption call on the unmodified state. Every other enabled filter must
+# provide a row implementation below.
+STATELESS_FILTERS = frozenset(
+    {"NodeName", "NodeUnschedulable", "TaintToleration", "NodeAffinity"}
+)
+
+
+class _FitRow:
+    """NodeResourcesFit under victim removal (oracle fit_filter)."""
+
+    def __init__(self, enc: EncodedCluster):
+        self.res_dt = enc.policy.res
+
+    def prepare(self, a: ClusterArrays, state: SchedState, p):
+        return ()
+
+    def node_init(self, a, ctx, state, vm, n):
+        vmf = vm.astype(self.res_dt)
+        return {
+            "requested": state.requested[n] - vmf @ a.pod_req,
+            "n_pods": state.n_pods[n] - vm.sum(dtype=jnp.int32),
+        }
+
+    def add_back(self, a, ctx, cnt, v, n):
+        return {
+            "requested": cnt["requested"] + a.pod_req[v],
+            "n_pods": cnt["n_pods"] + 1,
+        }
+
+    def check(self, a, ctx, cnt, p, n):
+        req = a.pod_req[p]
+        free = a.node_alloc[n] - cnt["requested"]
+        fits = ~((req > 0) & (req > free)).any()
+        return fits & (cnt["n_pods"] + 1 <= a.node_alloc[n, PODS_RES])
+
+
+class _PortsRow:
+    """NodePorts under victim removal (oracle node_ports_filter)."""
+
+    def __init__(self, enc: EncodedCluster):
+        pass
+
+    def prepare(self, a, state, p):
+        return ()
+
+    def node_init(self, a, ctx, state, vm, n):
+        vmi = vm.astype(jnp.int32)
+        return {
+            "used_pair": state.used_pair[n] - vmi @ a.want_pair,
+            "used_wild": state.used_wild[n] - vmi @ a.want_wild,
+            "used_trip": state.used_trip[n] - vmi @ a.want_trip,
+        }
+
+    def add_back(self, a, ctx, cnt, v, n):
+        return {
+            "used_pair": cnt["used_pair"] + a.want_pair[v],
+            "used_wild": cnt["used_wild"] + a.want_wild[v],
+            "used_trip": cnt["used_trip"] + a.want_trip[v],
+        }
+
+    def check(self, a, ctx, cnt, p, n):
+        wild = a.want_wild[p] > 0
+        trip = a.want_trip[p] > 0
+        wild_conflict = (wild & (cnt["used_pair"] > 0)).any()
+        trip_conflict = (
+            trip & ((cnt["used_trip"] > 0) | (cnt["used_wild"][a.trip_pair] > 0))
+        ).any()
+        return ~(wild_conflict | trip_conflict)
+
+
+class _SpreadRow:
+    """PodTopologySpread hard constraints under victim removal (oracle
+    spread_filter over a mutated NodeInfo; kernels.build_spread_filter is
+    the [N]-wide analogue). Counters: matching bound pods per (constraint,
+    topology-value) over *eligible* nodes."""
+
+    def __init__(self, enc: EncodedCluster):
+        from . import kernels as K
+
+        self.aff_kernel = K.build_node_affinity_filter(enc)
+        self.NP1 = enc.aux["n_node_pairs"] + 1
+        self.BIG = jnp.iinfo(jnp.int32).max
+
+    def prepare(self, a: ClusterArrays, state: SchedState, p):
+        from .encode_rel import match_clauses
+
+        rel = a.rel
+        keys = rel.sph_key[p]  # [HC]
+        valid = keys >= 0
+        # assignment-independent liveness factors of _count_matching_pods
+        m_live = (
+            match_clauses(rel, rel.sph_ctype[p], rel.sph_ckey[p], rel.sph_cpairs[p])
+            & (rel.ns_id == rel.ns_id[p])[None, :]
+            & ~rel.deleted[None, :]
+            & a.pod_mask[None, :]
+        )  # [HC, P]
+        pairs_all = rel.node_pair[:, jnp.maximum(keys, 0)]  # [N, HC]
+        has_key_all = pairs_all > 0
+        has_all = (has_key_all | ~valid[None, :]).all(axis=1)  # [N]
+        elig = (self.aff_kernel(a, state, p) == 0) & has_all & a.node_mask
+        HC = keys.shape[0]
+        hc_ix = jnp.arange(HC)[:, None]
+        # which topology values exist on eligible nodes (min domain)
+        present = (
+            jnp.zeros((HC, self.NP1), jnp.int32)
+            .at[hc_ix, pairs_all.T]
+            .add((elig[None, :] & has_key_all.T).astype(jnp.int32))
+        )
+        pmask = (present > 0) & (jnp.arange(self.NP1) > 0)[None, :]
+        # base counts from the current assignment (pods on eligible nodes)
+        bound = state.assignment >= 0
+        tgt = jnp.maximum(state.assignment, 0)
+        w = (m_live & bound[None, :] & elig[tgt][None, :]).astype(jnp.int32)
+        pair_q = pairs_all[tgt].T  # [HC, P] — value of each pod's node
+        base_cnt = (
+            jnp.zeros((HC, self.NP1), jnp.int32).at[hc_ix, pair_q].add(w)
+        )
+        return {
+            "keys_valid": valid,
+            "m_live": m_live,
+            "pairs_all": pairs_all,
+            "has_key_all": has_key_all,
+            "elig": elig,
+            "pmask": pmask,
+            "base_cnt": base_cnt,
+            "self_add": rel.sph_self[p].astype(jnp.int32),
+            "maxskew": rel.sph_skew[p],
+            "hc_ix": hc_ix[:, 0],
+        }
+
+    def node_init(self, a, ctx, state, vm, n):
+        # victims all sit on node n: their per-constraint contribution is a
+        # dot product, scattered at node n's topology values.
+        delta = (ctx["m_live"] @ vm.astype(jnp.int32)) * ctx["elig"][n].astype(
+            jnp.int32
+        )  # [HC]
+        pairs_n = ctx["pairs_all"][n]
+        return {"cnt": ctx["base_cnt"].at[ctx["hc_ix"], pairs_n].add(-delta)}
+
+    def add_back(self, a, ctx, cnt, v, n):
+        d = ctx["m_live"][:, v].astype(jnp.int32) * ctx["elig"][n].astype(jnp.int32)
+        pairs_n = ctx["pairs_all"][n]
+        return {"cnt": cnt["cnt"].at[ctx["hc_ix"], pairs_n].add(d)}
+
+    def check(self, a, ctx, cnt, p, n):
+        c = cnt["cnt"]
+        min_c = jnp.where(ctx["pmask"], c, self.BIG).min(axis=1)
+        min_c = jnp.where(ctx["pmask"].any(axis=1), min_c, 0)  # [HC]
+        pairs_n = ctx["pairs_all"][n]
+        node_cnt = c[ctx["hc_ix"], pairs_n]
+        skew = node_cnt + ctx["self_add"] - min_c
+        has_key_n = ctx["has_key_all"][n]
+        fail = ctx["keys_valid"] & (~has_key_n | (skew > ctx["maxskew"]))
+        return ~fail.any()
+
+
+class _InterpodRow:
+    """InterPodAffinity under victim removal (oracle interpod_filter over a
+    recomputed cycle state; kernels.build_interpod_filter is the [N]-wide
+    analogue). Three counter families: existing pods' required
+    anti-affinity vs the incoming pod (by node (key,value) pair), and the
+    incoming pod's required anti-affinity / affinity term counts."""
+
+    def __init__(self, enc: EncodedCluster):
+        self.NP1 = enc.aux["n_node_pairs"] + 1
+
+    def prepare(self, a: ClusterArrays, state: SchedState, p):
+        from .encode_rel import match_clauses, match_clauses_rev
+
+        rel = a.rel
+        bound = (state.assignment >= 0) & a.pod_mask
+        tgt = jnp.maximum(state.assignment, 0)
+        np_assigned = rel.node_pair[tgt]  # [P, K]
+
+        # (1) existing pods' required anti-affinity vs the incoming pod
+        rev = match_clauses_rev(rel, rel.ian_ctype, rel.ian_ckey, rel.ian_cpairs, p)
+        ns_ok1 = rel.ian_nsall | rel.ian_ns[:, :, rel.ns_id[p]]
+        contrib1 = rev & ns_ok1 & (rel.ian_key >= 0)  # [P, T1]
+        pair_ot = jnp.take_along_axis(
+            np_assigned, jnp.maximum(rel.ian_key, 0), axis=1
+        )  # [P, T1]
+        pair_ot = jnp.where((rel.ian_key >= 0) & bound[:, None], pair_ot, 0)
+        w1 = (contrib1 & bound[:, None] & (pair_ot > 0)).astype(jnp.int32)
+        ea_base = jnp.zeros(self.NP1, jnp.int32).at[pair_ot].add(w1)
+
+        # (2)/(3) the incoming pod's required anti-affinity / affinity
+        def forward(key_all, ctype, ckey, cpairs, nsall, nsmh):
+            key = key_all[p]  # [T]
+            valid = key >= 0
+            m = (
+                match_clauses(rel, ctype[p], ckey[p], cpairs[p])
+                & (nsall[p][:, None] | nsmh[p][:, rel.ns_id])
+                & a.pod_mask[None, :]
+            )  # [T, P]
+            pair_tp = np_assigned[:, jnp.maximum(key, 0)].T  # [T, P]
+            pair_tp = jnp.where(
+                valid[:, None] & bound[None, :], pair_tp, 0
+            )
+            T = key.shape[0]
+            t_ix = jnp.arange(T)
+            base = (
+                jnp.zeros((T, self.NP1), jnp.int32)
+                .at[t_ix[:, None], pair_tp]
+                .add((m & bound[None, :]).astype(jnp.int32))
+            )
+            npair_n = rel.node_pair[:, jnp.maximum(key, 0)]  # [N, T]
+            npair_n = jnp.where(valid[None, :], npair_n, 0)
+            return {
+                "valid": valid,
+                "m": m,
+                "base": base,
+                "npair_n": npair_n,
+                "t_ix": t_ix,
+            }
+
+        f2 = forward(
+            rel.ian_key, rel.ian_ctype, rel.ian_ckey, rel.ian_cpairs,
+            rel.ian_nsall, rel.ian_ns,
+        )
+        f3 = forward(
+            rel.ia_key, rel.ia_ctype, rel.ia_ckey, rel.ia_cpairs,
+            rel.ia_nsall, rel.ia_ns,
+        )
+        total3 = (f3["base"] * (jnp.arange(self.NP1) > 0)[None, :]).sum()
+        self_all = (rel.ia_self[p] | ~f3["valid"]).all()
+        return {
+            "contrib1": contrib1,
+            "pair_ot": pair_ot,
+            "ea_base": ea_base,
+            "f2": f2,
+            "f3": f3,
+            "total3": total3,
+            "self_all": self_all,
+            "has_terms": f3["valid"].any(),
+        }
+
+    def node_init(self, a, ctx, state, vm, n):
+        rel = a.rel
+        vmi = vm.astype(jnp.int32)
+        # (1): victims' own anti-affinity contributions leave with them
+        w1 = (ctx["contrib1"] & vm[:, None] & (ctx["pair_ot"] > 0)).astype(jnp.int32)
+        ea = ctx["ea_base"].at[ctx["pair_ot"]].add(-w1)
+        out = {"ea": ea}
+        for fk in ("f2", "f3"):
+            f = ctx[fk]
+            npair_row = f["npair_n"][n]  # [T] — victims all sit on node n
+            delta = f["m"] @ vmi  # [T]
+            delta = delta * (npair_row > 0)
+            out[fk] = f["base"].at[f["t_ix"], npair_row].add(-delta)
+        out["total3"] = ctx["total3"] - (
+            (ctx["f3"]["m"] @ vmi) * (ctx["f3"]["npair_n"][n] > 0)
+        ).sum()
+        return out
+
+    def add_back(self, a, ctx, cnt, v, n):
+        w1 = (ctx["contrib1"][v] & (ctx["pair_ot"][v] > 0)).astype(jnp.int32)
+        out = {"ea": cnt["ea"].at[ctx["pair_ot"][v]].add(w1)}
+        for fk in ("f2", "f3"):
+            f = ctx[fk]
+            npair_row = f["npair_n"][n]
+            d = f["m"][:, v].astype(jnp.int32) * (npair_row > 0)
+            out[fk] = cnt[fk].at[f["t_ix"], npair_row].add(d)
+        out["total3"] = cnt["total3"] + (
+            ctx["f3"]["m"][:, v].astype(jnp.int32) * (ctx["f3"]["npair_n"][n] > 0)
+        ).sum()
+        return out
+
+    def check(self, a, ctx, cnt, p, n):
+        rel = a.rel
+        np_n = rel.node_pair[n]  # [K]
+        fail1 = ((cnt["ea"][np_n] > 0) & (np_n > 0)).any()
+        f2 = ctx["f2"]
+        npair2 = f2["npair_n"][n]
+        cnt2 = cnt["f2"][f2["t_ix"], npair2]
+        fail2 = (f2["valid"] & (npair2 > 0) & (cnt2 > 0)).any()
+        f3 = ctx["f3"]
+        npair3 = f3["npair_n"][n]
+        cnt3 = cnt["f3"][f3["t_ix"], npair3]
+        ok_t = (npair3 > 0) & (cnt3 > 0)
+        satisfied = (ok_t | ~f3["valid"]).all()
+        # first-pod-in-series special case, gated on the node carrying every
+        # requested topology key (upstream satisfyPodAffinity fails such
+        # nodes before the special case is reached)
+        has_all_keys = ((npair3 > 0) | ~f3["valid"]).all()
+        pass3 = satisfied | (
+            has_all_keys & (cnt["total3"] == 0) & ctx["self_all"]
+        )
+        fail3 = ctx["has_terms"] & ~pass3
+        return ~(fail1 | fail2 | fail3)
+
+
+ROW_FILTERS = {
+    "NodeResourcesFit": _FitRow,
+    "NodePorts": _PortsRow,
+    "PodTopologySpread": _SpreadRow,
+    "InterPodAffinity": _InterpodRow,
+}
+
+
+def _victim_bound(enc: EncodedCluster, filter_names) -> int:
+    """Static bound on victims per node: with NodeResourcesFit enabled no
+    node ever holds more pods than max(pods capacity, its initial load)."""
+    P = enc.P
+    if "NodeResourcesFit" not in filter_names:
+        return P
+    caps = np.asarray(enc.arrays.node_alloc[:, PODS_RES])
+    mask = np.asarray(enc.arrays.node_mask)
+    cap_max = int(caps[mask].max()) if mask.any() else 0
+    assign0 = np.asarray(enc.state0.assignment)
+    bound0 = assign0[assign0 >= 0]
+    init_max = int(np.bincount(bound0).max()) if bound0.size else 0
+    return max(1, min(P, max(cap_max, init_max)))
+
+
+def build_preemption(enc: EncodedCluster, filter_names):
+    """Returns preempt(a, state, p) -> (pf_code [N] int32, victim_mask
+    [N, P] bool, nominated int32)."""
+    from . import kernels as K
+
+    P = enc.P
+    BIG = jnp.iinfo(jnp.int32).max
+    row_filters = []
+    static_kernels = []
+    for name in filter_names:
+        if name in ROW_FILTERS:
+            row_filters.append(ROW_FILTERS[name](enc))
+        elif name in STATELESS_FILTERS:
+            static_kernels.append(K.FILTER_KERNELS[name][0](enc))
+        else:
+            raise NotImplementedError(
+                f"filter {name!r} has no preemption row implementation and is "
+                "not declared state-independent (preempt.STATELESS_FILTERS)"
+            )
+    V = _victim_bound(enc, filter_names)
+
+    def preempt(a: ClusterArrays, state: SchedState, p):
+        prio_p = a.pod_priority[p]
+        lower_all = (
+            (state.assignment >= 0) & a.pod_mask & (a.pod_priority < prio_p)
+        )  # [P]
+        N = a.node_mask.shape[0]
+        static_ok = a.node_mask
+        for k in static_kernels:
+            static_ok = static_ok & (k(a, state, p) == 0)
+        ctxs = [rf.prepare(a, state, p) for rf in row_filters]
+
+        def eval_node(n):
+            vm = lower_all & (state.assignment == n)
+            any_lower = vm.any()
+            cnts = tuple(
+                rf.node_init(a, ctx, state, vm, n)
+                for rf, ctx in zip(row_filters, ctxs)
+            )
+
+            def feasible(cnts_now):
+                ok = static_ok[n]
+                for rf, ctx, cnt in zip(row_filters, ctxs, cnts_now):
+                    ok = ok & rf.check(a, ctx, cnt, p, n)
+                return ok
+
+            fits = feasible(cnts)
+            # reprieve order: priority desc, bind order asc (oracle
+            # NodeInfo.pods insertion order for ties)
+            sort_prio = jnp.where(vm, -a.pod_priority, BIG)
+            sort_seq = jnp.where(vm, state.bound_seq, BIG)
+            order = jnp.lexsort((sort_seq, sort_prio))[:V]
+
+            def reprieve(carry, v):
+                cnts_c, victims = carry
+                valid = vm[v]
+                cnts_try = tuple(
+                    rf.add_back(a, ctx, cnt, v, n)
+                    for rf, ctx, cnt in zip(row_filters, ctxs, cnts_c)
+                )
+                ok = feasible(cnts_try)
+                keep = valid & ok
+                cnts_c = jax.tree.map(
+                    lambda x, y: jnp.where(keep, x, y), cnts_try, cnts_c
+                )
+                victims = victims.at[v].set(valid & ~ok)
+                return (cnts_c, victims), None
+
+            (_, victims), _ = jax.lax.scan(
+                reprieve, (cnts, jnp.zeros(P, bool)), order
+            )
+            has_victims = victims.any()
+            code = jnp.where(
+                ~any_lower,
+                PREEMPT_NO_LOWER,
+                jnp.where(
+                    ~fits,
+                    PREEMPT_NO_FIT,
+                    jnp.where(has_victims, PREEMPT_CANDIDATE, PREEMPT_SILENT),
+                ),
+            )
+            # SILENT: fits with zero surviving victims (possible when the
+            # infeasibility came from another node via spread/inter-pod
+            # coupling) — the oracle records no message and no candidate.
+            victims = victims & (code == PREEMPT_CANDIDATE)
+            return code.astype(jnp.int32), victims
+
+        pf_code, victim_mask = jax.vmap(eval_node)(jnp.arange(N))  # [N], [N, P]
+        # node choice (oracle rank): min highest-victim-priority, then min
+        # priority sum, then fewest victims, then lowest node index
+        cand = pf_code == PREEMPT_CANDIDATE
+        prios = jnp.where(victim_mask, a.pod_priority[None, :], 0)
+        maxp = jnp.where(victim_mask, a.pod_priority[None, :], -BIG).max(axis=1)
+        sump = prios.sum(axis=1)
+        cnt = victim_mask.sum(axis=1)
+        alive = cand
+        for key in (maxp, sump, cnt):
+            best = jnp.where(alive, key, BIG).min()
+            alive = alive & (key == best)
+        nominated = jnp.where(alive.any(), jnp.argmax(alive), -1).astype(jnp.int32)
+        pf_code = jnp.where(
+            (jnp.arange(N) == nominated) & (nominated >= 0),
+            PREEMPT_SELECTED,
+            pf_code,
+        )
+        return pf_code, victim_mask, nominated
+
+    return preempt
+
+
+def decode_preemption(
+    code: int, enc: EncodedCluster, node_idx: int, victims: "list[str]"
+) -> str:
+    if code == PREEMPT_NO_LOWER:
+        return "no lower-priority pods to preempt"
+    if code == PREEMPT_NO_FIT:
+        return "preemption would not make pod schedulable"
+    if code == PREEMPT_CANDIDATE:
+        return f"can preempt {len(victims)} victim(s): " + ", ".join(victims)
+    return "preemption victim(s): " + ", ".join(victims)

@@ -630,9 +630,16 @@ def interpod_filter(ctx, pod: PodView, ni: "NodeInfo") -> "str | None":
                 break
         if not satisfied:
             # first-pod-in-series rule: nothing matches anywhere AND the pod
-            # matches its own terms
-            if not state["affinity_counts"] and all(
-                _term_matches_pod(t, pod.namespace, pod, ctx.snapshot) for t in terms
+            # matches its own terms — only on nodes that carry every
+            # requested topology key (upstream satisfyPodAffinity fails
+            # key-less nodes before the special case is considered)
+            if (
+                not state["affinity_counts"]
+                and all(t.get("topologyKey", "") in node_labels for t in terms)
+                and all(
+                    _term_matches_pod(t, pod.namespace, pod, ctx.snapshot)
+                    for t in terms
+                )
             ):
                 return None
             return "node(s) didn't match pod affinity rules"

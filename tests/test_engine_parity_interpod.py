@@ -198,3 +198,43 @@ class TestInterpodRandomized:
             pods.append(pod(f"p{i}", cpu="200m", mem="128Mi", **kw))
         assert_parity(nodes, pods, ipa_config(), policy=EXACT)
         assert_parity(nodes, pods, ipa_config(), policy=TPU32)
+
+
+class TestFirstPodTopologyKeyGate:
+    """Pins the upstream satisfyPodAffinity behavior: the first-pod-in-series
+    special case (required affinity, nothing matches anywhere, pod matches
+    its own terms) only passes on nodes that carry every requested topology
+    key — keyless nodes fail the filter before the special case applies."""
+
+    def _cluster(self):
+        nodes = [
+            node("keyed", labels={"topology.kubernetes.io/zone": "a"}),
+            node("keyless", labels={}),
+        ]
+        pods = [pod(
+            "first", cpu="100m", labels={"app": "self"},
+            affinity={"podAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [{
+                    "topologyKey": "topology.kubernetes.io/zone",
+                    "labelSelector": {"matchLabels": {"app": "self"}},
+                }]
+            }},
+        )]
+        return nodes, pods
+
+    def test_keyless_node_fails_filter(self):
+        nodes, pods = self._cluster()
+        results = assert_parity(nodes, pods, ipa_config())
+        r = results[0]
+        assert r.status == "Scheduled"
+        assert r.selected_node == "keyed"
+        assert (
+            r.filter["keyless"]["InterPodAffinity"]
+            == "node(s) didn't match pod affinity rules"
+        )
+
+    def test_all_nodes_keyless_unschedulable(self):
+        nodes, pods = self._cluster()
+        nodes = [n for n in nodes if n["metadata"]["name"] == "keyless"]
+        results = assert_parity(nodes, pods, ipa_config())
+        assert results[0].status == "Unschedulable"

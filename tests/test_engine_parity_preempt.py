@@ -139,3 +139,140 @@ class TestPreemption:
         # may exceed capacity; that's legal and both sides must agree)
         assert_parity(nodes, pods, preempt_config(), policy=EXACT)
         assert_parity(nodes, pods, preempt_config(), policy=TPU32)
+
+
+def row_config(filters, prefilters=("NodeResourcesFit",)):
+    cfg = restricted_config(filters=filters, prefilters=prefilters)
+    cfg.profile()["plugins"]["postFilter"]["enabled"].append(
+        {"name": "DefaultPreemption"}
+    )
+    return cfg
+
+
+class TestPreemptionRowFilters:
+    """Parity for the state-dependent preemption row filters beyond
+    NodeResourcesFit (engine/preempt.py _PortsRow/_SpreadRow/_InterpodRow):
+    victim removal must be visible to ports/spread/inter-pod feasibility
+    during the dry run, exactly as the oracle's _feasible_after_removal."""
+
+    def test_ports_row_eviction_frees_port(self):
+        cfg = row_config(("NodeResourcesFit", "NodePorts"),
+                         prefilters=("NodeResourcesFit", "NodePorts"))
+        nodes = [node("n0", cpu="4")]
+        pods = [
+            pod("holder", cpu="100m", priority=1, node_name="n0",
+                ports=[{"containerPort": 80, "hostPort": 80}]),
+            pod("high", cpu="100m", priority=100,
+                ports=[{"containerPort": 80, "hostPort": 80}]),
+        ]
+        results = assert_parity(nodes, pods, cfg)
+        assert results[0].status == "Nominated"
+        assert results[0].preemption_victims == ["default/holder"]
+        assert results[1].status == "Scheduled"
+
+    def test_ports_row_no_preempt_when_port_held_by_higher(self):
+        cfg = row_config(("NodeResourcesFit", "NodePorts"),
+                         prefilters=("NodeResourcesFit", "NodePorts"))
+        nodes = [node("n0", cpu="4")]
+        pods = [
+            pod("holder", cpu="100m", priority=200, node_name="n0",
+                ports=[{"containerPort": 80, "hostPort": 80}]),
+            pod("high", cpu="100m", priority=100,
+                ports=[{"containerPort": 80, "hostPort": 80}]),
+        ]
+        results = assert_parity(nodes, pods, cfg)
+        assert results[0].status == "Unschedulable"
+
+    def test_spread_row_dry_run_counts(self):
+        cfg = row_config(("NodeResourcesFit", "PodTopologySpread"))
+        spread = [{
+            "maxSkew": 1, "topologyKey": "zone",
+            "whenUnsatisfiable": "DoNotSchedule",
+            "labelSelector": {"matchLabels": {"app": "x"}},
+        }]
+        nodes = [
+            node("n0", cpu="1", labels={"zone": "z0"}),
+            node("n1", cpu="1", labels={"zone": "z1"}),
+        ]
+        pods = [
+            pod("a1", cpu="600m", priority=1, node_name="n0", labels={"app": "x"}),
+            pod("a2", cpu="400m", priority=1, node_name="n0", labels={"app": "x"}),
+            pod("b1", cpu="1", priority=1, node_name="n1", labels={"app": "x"}),
+            pod("hi", cpu="500m", priority=10, labels={"app": "x"}, spread=spread),
+        ]
+        results = assert_parity(nodes, pods, cfg)
+        assert results[0].status == "Nominated"
+
+    def test_interpod_row_anti_affinity_victim(self):
+        cfg = row_config(("NodeResourcesFit", "InterPodAffinity"))
+        anti = {
+            "podAntiAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [{
+                    "topologyKey": "kubernetes.io/hostname",
+                    "labelSelector": {"matchLabels": {"app": "db"}},
+                }]
+            }
+        }
+        nodes = [node("n0", cpu="4", labels={"kubernetes.io/hostname": "n0"})]
+        pods = [
+            pod("dbpod", cpu="100m", priority=1, node_name="n0",
+                labels={"app": "db"}),
+            pod("high", cpu="100m", priority=100, affinity=anti),
+        ]
+        results = assert_parity(nodes, pods, cfg)
+        assert results[0].status == "Nominated"
+        assert results[0].preemption_victims == ["default/dbpod"]
+        assert results[1].status == "Scheduled"
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_randomized_full_row_set(self, seed):
+        """Randomized clusters with ports + spread + inter-pod constraints
+        active during preemption, both dtype policies."""
+        cfg = row_config(
+            ("NodeUnschedulable", "NodeName", "NodeResourcesFit", "NodePorts",
+             "PodTopologySpread", "InterPodAffinity"),
+            prefilters=("NodeResourcesFit", "NodePorts"),
+        )
+        rng = random.Random(7000 + seed)
+        n_nodes = rng.randint(2, 4)
+        nodes = [
+            node(f"n{i}", cpu=f"{rng.randint(1, 3)}",
+                 labels={"zone": f"z{i % 2}", "kubernetes.io/hostname": f"n{i}"})
+            for i in range(n_nodes)
+        ]
+        spread = [{
+            "maxSkew": 1, "topologyKey": "zone",
+            "whenUnsatisfiable": "DoNotSchedule",
+            "labelSelector": {"matchLabels": {"app": "x"}},
+        }]
+        anti = {
+            "podAntiAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [{
+                    "topologyKey": "zone",
+                    "labelSelector": {"matchLabels": {"app": "y"}},
+                }]
+            }
+        }
+        pods = []
+        for i in range(rng.randint(2, 5)):
+            pods.append(pod(
+                f"f{i}", cpu=f"{rng.choice([500, 1000])}m",
+                priority=rng.randint(0, 10),
+                node_name=f"n{rng.randint(0, n_nodes - 1)}",
+                labels={"app": rng.choice(["x", "y", "z"])},
+                ports=[{"containerPort": 80, "hostPort": 8000 + (i % 2)}]
+                if rng.random() < 0.5 else None,
+            ))
+        for i in range(rng.randint(3, 6)):
+            kind = rng.random()
+            pods.append(pod(
+                f"p{i}", cpu=f"{rng.choice([500, 1000, 1500])}m",
+                priority=rng.choice([0, 50, 100]),
+                labels={"app": rng.choice(["x", "y"])},
+                spread=spread if kind < 0.4 else None,
+                affinity=anti if 0.4 <= kind < 0.7 else None,
+                ports=[{"containerPort": 80, "hostPort": 8000}]
+                if kind >= 0.9 else None,
+            ))
+        assert_parity(nodes, pods, cfg, policy=EXACT)
+        assert_parity(nodes, pods, cfg, policy=TPU32)
