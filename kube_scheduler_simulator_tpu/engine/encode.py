@@ -155,6 +155,16 @@ class ClusterArrays:
     img_contrib: jnp.ndarray  # [N, I] size*have//total per node-image
     pod_img: jnp.ndarray  # [P, I] int32 image occurrence counts
     pod_ncont: jnp.ndarray  # [P] int32 container count
+    # volume family (encode_vol.py). VB = claim-pods, C = RWOP claims,
+    # D = exclusive-disk identities, V3 = limit plugin count.
+    vb_row: jnp.ndarray  # [P] int32 row into vb/vz code tables | -1 no claims
+    vb_code: jnp.ndarray  # [N, VB] int32 VolumeBinding message id (0 = pass)
+    vz_code: jnp.ndarray  # [N, VB] int32 VolumeZone message id
+    vb_pf: jnp.ndarray  # [P] int32 VolumeBinding prefilter message id
+    pod_claim: jnp.ndarray  # [P, C] bool — pod references RWOP claim c
+    pod_disk_any: jnp.ndarray  # [P, D] int32 mounts of disk d
+    pod_disk_rw: jnp.ndarray  # [P, D] int32 non-read-only mounts
+    pod_vol3: jnp.ndarray  # [P, V3] int32 per-type volume counts
     # pod-relational encodings (PodTopologySpread, InterPodAffinity)
     rel: Any  # PodRelArrays (encode_rel.py)
 
@@ -171,6 +181,11 @@ class SchedState:
     used_pair: jnp.ndarray  # [N, Q] int32 users of (proto,port), any ip
     used_wild: jnp.ndarray  # [N, Q] int32 wildcard-ip users of (proto,port)
     used_trip: jnp.ndarray  # [N, V2] int32 users of (proto,ip,port)
+    # volume counters (VolumeRestrictions + volume-count limits)
+    used_claims: jnp.ndarray  # [C] int32 bound pods using RWOP claim c
+    node_disk_any: jnp.ndarray  # [N, D] int32 mounts of disk d on node
+    node_disk_rw: jnp.ndarray  # [N, D] int32 non-read-only mounts on node
+    node_vol3: jnp.ndarray  # [N, V3] int32 per-type volume counts on node
     # bind chronology: pre-bound pods get their input index, scan-bound pods
     # get P + step. Preemption's victim-reprieve tie-break (equal priority)
     # follows NodeInfo.pods insertion order in the oracle — this mirrors it.
@@ -615,6 +630,12 @@ def encode_cluster(
             for pr in aff.get("preferredDuringSchedulingIgnoredDuringExecution") or []:
                 topo_keys.append((pr.get("podAffinityTerm") or {}).get("topologyKey", ""))
 
+    from .encode_vol import encode_volumes
+
+    vol_arrays, vol_aux = encode_volumes(
+        node_views, pod_views, nodes, N, P,
+        pvcs or [], pvs or [], storageclasses or [], config,
+    )
     taint_arrays, taint_aux = _encode_taints(node_views, pod_views, N, P)
     label_arrays, label_keys = _encode_labels_affinity(
         node_views, pod_views, N, P, policy, extra_keys=topo_keys
@@ -644,6 +665,10 @@ def encode_cluster(
     used_pair = np.zeros((N, Q), np.int32)
     used_wild = np.zeros((N, Q), np.int32)
     used_trip = np.zeros((N, V2), np.int32)
+    used_claims = np.zeros(vol_arrays["pod_claim"].shape[1], np.int32)
+    node_disk_any = np.zeros((N, vol_arrays["pod_disk_any"].shape[1]), np.int32)
+    node_disk_rw = np.zeros_like(node_disk_any)
+    node_vol3 = np.zeros((N, vol_arrays["pod_vol3"].shape[1]), np.int32)
     bound_seq = np.full(P, -1, np.int32)
     pending: list[int] = []
     for i in range(len(pods)):
@@ -656,6 +681,10 @@ def encode_cluster(
             used_pair[tgt] += want_pair[i]
             used_wild[tgt] += port_arrays["want_wild"][i]
             used_trip[tgt] += port_arrays["want_trip"][i]
+            used_claims += vol_arrays["pod_claim"][i]
+            node_disk_any[tgt] += vol_arrays["pod_disk_any"][i]
+            node_disk_rw[tgt] += vol_arrays["pod_disk_rw"][i]
+            node_vol3[tgt] += vol_arrays["pod_vol3"][i]
             bound_seq[i] = i
         else:
             pending.append(i)
@@ -684,6 +713,7 @@ def encode_cluster(
             k: jnp.asarray(v, num_dt if k == "img_contrib" else None)
             for k, v in img_arrays.items()
         },
+        **{k: jnp.asarray(v) for k, v in vol_arrays.items()},
         rel=rel,
     )
     state0 = SchedState(
@@ -694,6 +724,10 @@ def encode_cluster(
         used_pair=jnp.asarray(used_pair),
         used_wild=jnp.asarray(used_wild),
         used_trip=jnp.asarray(used_trip),
+        used_claims=jnp.asarray(used_claims),
+        node_disk_any=jnp.asarray(node_disk_any),
+        node_disk_rw=jnp.asarray(node_disk_rw),
+        node_vol3=jnp.asarray(node_vol3),
         bound_seq=jnp.asarray(bound_seq),
     )
     enc = EncodedCluster(
@@ -708,7 +742,7 @@ def encode_cluster(
         config=config,
         n_nodes=len(nodes),
         n_pods=len(pods),
-        aux={**taint_aux, **rel_aux},
+        aux={**taint_aux, **rel_aux, **vol_aux},
     )
     # Retained for the kernel builders that consume them (volume-binding
     # family, namespace-selector terms). The engine's strict mode refuses

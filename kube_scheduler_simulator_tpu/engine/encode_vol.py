@@ -1,0 +1,174 @@
+"""Volume-family encodings (VolumeBinding, VolumeZone, VolumeRestrictions,
+EBS/GCEPD/AzureDisk limits).
+
+TPU-first split of the reference's volume plugins (upstream semantics
+re-derived in sched/oracle_plugins.py:767-980; reference default filter
+set simulator/scheduler/config/plugin.go:38-59):
+
+  * VolumeBinding and VolumeZone consult only *static* objects — PVCs,
+    PVs, StorageClasses and node labels, none of which change while pods
+    schedule (the simulator binds pods, not volumes). Their per-(pod,
+    node) verdicts are therefore evaluated ONCE host-side — by calling
+    the oracle's own plugin functions, so engine and oracle cannot drift
+    — and shipped to the device as compact gather tables over only the
+    pods that reference claims ([N, VB], VB = #claim-pods, not [N, P]).
+  * VolumeRestrictions and the volume-count limits depend on which pods
+    are bound where, so they become counter kernels: `SchedState` grows
+    per-node disk/volume counters plus a global ReadWriteOncePod claim
+    usage vector, scatter-updated at bind/evict time and consumed by
+    pure vector filters (engine/kernels_vol.py).
+
+Failure messages are interned into one table (`aux["vol_messages"]`,
+id 0 = pass) so device codes decode to the reference's exact annotation
+strings.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# Column order of the per-type volume-count arrays; rows of
+# oracle_plugins._VOLUME_LIMITS (plugin → (volume type, limit)).
+VOL_LIMIT_PLUGINS = ("EBSLimits", "GCEPDLimits", "AzureDiskLimits")
+
+
+def encode_volumes(
+    node_views: list,
+    pod_views: list,
+    nodes: list[dict],
+    N: int,
+    P: int,
+    pvcs: list[dict],
+    pvs: list[dict],
+    storageclasses: list[dict],
+    config,
+) -> tuple[dict, dict]:
+    """Returns (arrays dict for ClusterArrays, aux dict)."""
+    from ..models.objects import PodView
+    from ..sched import oracle_plugins as op
+    from ..sched.oracle import ClusterSnapshot, CycleContext
+
+    snapshot = ClusterSnapshot.build(nodes, pvcs, pvs, storageclasses)
+    ctx = CycleContext(snapshot, config)
+    nis = snapshot.node_list()
+
+    messages = [""]
+    msg_ids: dict[str, int] = {"": 0}
+
+    def intern(msg: "str | None") -> int:
+        if not msg:
+            return 0
+        if msg not in msg_ids:
+            msg_ids[msg] = len(messages)
+            messages.append(msg)
+        return msg_ids[msg]
+
+    # -- static verdict tables (VolumeBinding / VolumeZone) -----------------
+    # The oracle filters evaluate a pod's claims in order and return the
+    # first failure, and every per-claim verdict depends only on the claim —
+    # so verdicts are memoized per (ns/claim, node) via a synthetic
+    # single-claim pod, and a pod's code is its first failing claim's.
+    claim_cache: dict[str, tuple[int, np.ndarray, np.ndarray]] = {}
+
+    def claim_verdicts(ns: str, claim: str):
+        key = f"{ns}/{claim}"
+        hit = claim_cache.get(key)
+        if hit is None:
+            probe = PodView(
+                {
+                    "metadata": {"name": "_probe", "namespace": ns},
+                    "spec": {
+                        "volumes": [
+                            {"name": "v",
+                             "persistentVolumeClaim": {"claimName": claim}}
+                        ]
+                    },
+                }
+            )
+            pf = intern(op.volume_binding_pre_filter(ctx, probe))
+            vb = np.asarray(
+                [intern(op.volume_binding_filter(ctx, probe, ni)) for ni in nis],
+                np.int32,
+            )
+            vz = np.asarray(
+                [intern(op.volume_zone_filter(ctx, probe, ni)) for ni in nis],
+                np.int32,
+            )
+            hit = claim_cache[key] = (pf, vb, vz)
+        return hit
+
+    claim_pods = [i for i, pv in enumerate(pod_views) if pv.pvc_names]
+    VB = max(1, len(claim_pods))
+    vb_row = np.full(P, -1, np.int32)
+    vb_code = np.zeros((N, VB), np.int32)
+    vz_code = np.zeros((N, VB), np.int32)
+    vb_pf = np.zeros(P, np.int32)
+    n_real = len(nis)
+    for r, i in enumerate(claim_pods):
+        vb_row[i] = r
+        pv = pod_views[i]
+        for claim in pv.pvc_names:
+            pf, vb, vz = claim_verdicts(pv.namespace, claim)
+            if vb_pf[i] == 0:
+                vb_pf[i] = pf
+            # first failing claim wins per node (oracle claim-order return)
+            col_b = vb_code[:n_real, r]
+            vb_code[:n_real, r] = np.where(col_b != 0, col_b, vb)
+            col_z = vz_code[:n_real, r]
+            vz_code[:n_real, r] = np.where(col_z != 0, col_z, vz)
+
+    # -- ReadWriteOncePod claim usage (VolumeRestrictions, global) ----------
+    rwop_ids: dict[str, int] = {}
+    for pv in pod_views:
+        for claim in pv.pvc_names:
+            key = f"{pv.namespace}/{claim}"
+            pvc = snapshot.pvcs.get(key)
+            if pvc and "ReadWriteOncePod" in (
+                (pvc.get("spec", {}) or {}).get("accessModes") or []
+            ):
+                rwop_ids.setdefault(key, len(rwop_ids))
+    C = max(1, len(rwop_ids))
+    pod_claim = np.zeros((P, C), bool)
+    for i, pv in enumerate(pod_views):
+        for claim in pv.pvc_names:
+            cid = rwop_ids.get(f"{pv.namespace}/{claim}")
+            if cid is not None:
+                pod_claim[i, cid] = True
+
+    # -- exclusive-disk conflict identities (VolumeRestrictions, per node) --
+    disk_ids: dict[tuple[str, str], int] = {}
+    pod_disks = [op.pod_disk_keys(pv) for pv in pod_views]
+    for keys in pod_disks:
+        for kind, ident, _ in keys:
+            disk_ids.setdefault((kind, ident), len(disk_ids))
+    D = max(1, len(disk_ids))
+    pod_disk_any = np.zeros((P, D), np.int32)
+    pod_disk_rw = np.zeros((P, D), np.int32)
+    for i, keys in enumerate(pod_disks):
+        for kind, ident, ro in keys:
+            d = disk_ids[(kind, ident)]
+            pod_disk_any[i, d] += 1
+            if not ro:
+                pod_disk_rw[i, d] += 1
+
+    # -- per-type volume counts (EBS/GCEPD/AzureDisk limits) ----------------
+    V3 = len(VOL_LIMIT_PLUGINS)
+    pod_vol3 = np.zeros((P, V3), np.int32)
+    for i, pv in enumerate(pod_views):
+        for j, plugin in enumerate(VOL_LIMIT_PLUGINS):
+            vol_type, _ = op._VOLUME_LIMITS[plugin]
+            pod_vol3[i, j] = sum(
+                1 for v in pv.spec.get("volumes", []) or [] if v.get(vol_type)
+            )
+
+    arrays = dict(
+        vb_row=vb_row,
+        vb_code=vb_code,
+        vz_code=vz_code,
+        vb_pf=vb_pf,
+        pod_claim=pod_claim,
+        pod_disk_any=pod_disk_any,
+        pod_disk_rw=pod_disk_rw,
+        pod_vol3=pod_vol3,
+    )
+    return arrays, {"vol_messages": messages}

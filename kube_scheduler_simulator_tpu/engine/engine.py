@@ -230,6 +230,15 @@ class BatchedScheduler:
                 used_pair=state.used_pair.at[tgt].add(a.want_pair[p] * vi),
                 used_wild=state.used_wild.at[tgt].add(a.want_wild[p] * vi),
                 used_trip=state.used_trip.at[tgt].add(a.want_trip[p] * vi),
+                used_claims=state.used_claims
+                + a.pod_claim[p].astype(jnp.int32) * vi,
+                node_disk_any=state.node_disk_any.at[tgt].add(
+                    a.pod_disk_any[p] * vi
+                ),
+                node_disk_rw=state.node_disk_rw.at[tgt].add(
+                    a.pod_disk_rw[p] * vi
+                ),
+                node_vol3=state.node_vol3.at[tgt].add(a.pod_vol3[p] * vi),
                 bound_seq=state.bound_seq.at[p].set(
                     jnp.where(sel >= 0, jnp.int32(P) + qi, jnp.int32(-1))
                 ),
@@ -249,6 +258,15 @@ class BatchedScheduler:
                 used_pair=state.used_pair.at[tgtv].add(-(a.want_pair * mi[:, None])),
                 used_wild=state.used_wild.at[tgtv].add(-(a.want_wild * mi[:, None])),
                 used_trip=state.used_trip.at[tgtv].add(-(a.want_trip * mi[:, None])),
+                used_claims=state.used_claims
+                - mi @ a.pod_claim.astype(jnp.int32),
+                node_disk_any=state.node_disk_any.at[tgtv].add(
+                    -(a.pod_disk_any * mi[:, None])
+                ),
+                node_disk_rw=state.node_disk_rw.at[tgtv].add(
+                    -(a.pod_disk_rw * mi[:, None])
+                ),
+                node_vol3=state.node_vol3.at[tgtv].add(-(a.pod_vol3 * mi[:, None])),
                 bound_seq=jnp.where(mask, -1, state.bound_seq),
             )
 

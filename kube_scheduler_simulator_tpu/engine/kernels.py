@@ -866,6 +866,53 @@ TRIVIAL_PRESCORE.add("InterPodAffinity")
 
 
 # ---------------------------------------------------------------------------
+# Volume family (kernels_vol.py): static gather tables for VolumeBinding /
+# VolumeZone, counter kernels for VolumeRestrictions + volume-count limits.
+# ---------------------------------------------------------------------------
+
+from . import kernels_vol as _KV  # noqa: E402
+
+FILTER_KERNELS.update(
+    {
+        "VolumeBinding": (
+            _KV._build_static_table_filter("vb_code"),
+            _KV._vol_message,
+        ),
+        "VolumeZone": (
+            _KV._build_static_table_filter("vz_code"),
+            _KV._vol_message,
+        ),
+        "VolumeRestrictions": (
+            _KV.build_volume_restrictions_filter,
+            _KV.decode_volume_restrictions,
+        ),
+        "NodeVolumeLimits": (
+            _KV.build_node_volume_limits_filter,
+            _KV.decode_never,
+        ),
+        "EBSLimits": (
+            _KV._build_volume_limits_filter("EBSLimits"),
+            _KV.decode_volume_limits,
+        ),
+        "GCEPDLimits": (
+            _KV._build_volume_limits_filter("GCEPDLimits"),
+            _KV.decode_volume_limits,
+        ),
+        "AzureDiskLimits": (
+            _KV._build_volume_limits_filter("AzureDiskLimits"),
+            _KV.decode_volume_limits,
+        ),
+    }
+)
+PREFILTER_KERNELS["VolumeBinding"] = (
+    _KV.build_volume_binding_prefilter,
+    _KV.decode_volume_binding_prefilter,
+)
+# Recorded-but-unfailable prefilters (oracle PREFILTER_PLUGINS lambdas).
+TRIVIAL_PREFILTER.update({"VolumeRestrictions", "VolumeZone", "NodeAffinity"})
+
+
+# ---------------------------------------------------------------------------
 # DefaultPreemption (PostFilter) lives in preempt.py — an incremental-
 # counter dry run: O(P·T) prepare + O(N·V·(T+NP1)) reprieve, replacing the
 # round-1 full-kernel re-evaluation (O(N²·V·F)). Builders take

@@ -51,9 +51,18 @@ PREEMPT_SILENT = 4  # fits with zero victims: oracle records no message
 
 # Filters whose codes do not read SchedState: safe to evaluate once per
 # preemption call on the unmodified state. Every other enabled filter must
-# provide a row implementation below.
+# provide a row implementation below. (VolumeBinding/VolumeZone verdicts
+# are host-precomputed static tables; NodeVolumeLimits is a pass-through.)
 STATELESS_FILTERS = frozenset(
-    {"NodeName", "NodeUnschedulable", "TaintToleration", "NodeAffinity"}
+    {
+        "NodeName",
+        "NodeUnschedulable",
+        "TaintToleration",
+        "NodeAffinity",
+        "VolumeBinding",
+        "VolumeZone",
+        "NodeVolumeLimits",
+    }
 )
 
 
@@ -341,11 +350,23 @@ class _InterpodRow:
         return ~(fail1 | fail2 | fail3)
 
 
+def _vol_rows():
+    from .kernels_vol import VolRestrictionsRow, make_vol_limits_row
+
+    return {
+        "VolumeRestrictions": VolRestrictionsRow,
+        "EBSLimits": make_vol_limits_row("EBSLimits"),
+        "GCEPDLimits": make_vol_limits_row("GCEPDLimits"),
+        "AzureDiskLimits": make_vol_limits_row("AzureDiskLimits"),
+    }
+
+
 ROW_FILTERS = {
     "NodeResourcesFit": _FitRow,
     "NodePorts": _PortsRow,
     "PodTopologySpread": _SpreadRow,
     "InterPodAffinity": _InterpodRow,
+    **_vol_rows(),
 }
 
 

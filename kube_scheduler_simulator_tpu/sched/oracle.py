@@ -86,6 +86,36 @@ class ClusterSnapshot:
     priorityclasses: dict[str, dict] = field(default_factory=dict)
     namespaces: dict[str, dict] = field(default_factory=dict)
 
+    @classmethod
+    def build(
+        cls,
+        nodes: list[dict],
+        pvcs: "list[dict] | None" = None,
+        pvs: "list[dict] | None" = None,
+        storageclasses: "list[dict] | None" = None,
+        priorityclasses: "list[dict] | None" = None,
+        namespaces: "list[dict] | None" = None,
+    ) -> "ClusterSnapshot":
+        """Index raw manifests (the one place key derivation lives; PVCs
+        key as "ns/name", everything else by name)."""
+        snap = cls()
+        for n in nodes:
+            snap.nodes[NodeView(n).name] = NodeInfo(n)
+        for obj, store_ in (
+            (pvcs, snap.pvcs),
+            (pvs, snap.pvs),
+            (storageclasses, snap.storageclasses),
+            (priorityclasses, snap.priorityclasses),
+            (namespaces, snap.namespaces),
+        ):
+            for o in obj or []:
+                meta = o.get("metadata", {})
+                if store_ is snap.pvcs:
+                    store_[f"{meta.get('namespace', 'default')}/{meta['name']}"] = o
+                else:
+                    store_[meta["name"]] = o
+        return snap
+
     def node_list(self) -> list[NodeInfo]:
         return list(self.nodes.values())
 
@@ -124,22 +154,9 @@ class Oracle:
         namespaces: "list[dict] | None" = None,
     ):
         self.config = config or SchedulerConfiguration.default()
-        self.snapshot = ClusterSnapshot()
-        for n in nodes:
-            self.snapshot.nodes[NodeView(n).name] = NodeInfo(n)
-        for obj, store_ in (
-            (pvcs, self.snapshot.pvcs),
-            (pvs, self.snapshot.pvs),
-            (storageclasses, self.snapshot.storageclasses),
-            (priorityclasses, self.snapshot.priorityclasses),
-            (namespaces, self.snapshot.namespaces),
-        ):
-            for o in obj or []:
-                meta = o.get("metadata", {})
-                if store_ is self.snapshot.pvcs:
-                    store_[f"{meta.get('namespace', 'default')}/{meta['name']}"] = o
-                else:
-                    store_[meta["name"]] = o
+        self.snapshot = ClusterSnapshot.build(
+            nodes, pvcs, pvs, storageclasses, priorityclasses, namespaces
+        )
         self.pending: list[dict] = []
         for p in pods:
             pv = PodView(p)

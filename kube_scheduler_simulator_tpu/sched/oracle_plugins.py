@@ -864,6 +864,27 @@ def volume_zone_filter(ctx, pod: PodView, ni: "NodeInfo") -> "str | None":
     return None
 
 
+def pod_disk_keys(p: PodView) -> "list[tuple[str, str, bool]]":
+    """(kind, identity, readOnly) per exclusive-disk volume of the pod —
+    the conflict identity VolumeRestrictions compares (shared with the
+    engine's volume featurizer, engine/encode_vol.py)."""
+    keys = []
+    for v in p.spec.get("volumes", []) or []:
+        gce = v.get("gcePersistentDisk")
+        if gce:
+            keys.append(("gce", gce.get("pdName"), bool(gce.get("readOnly"))))
+        ebs = v.get("awsElasticBlockStore")
+        if ebs:
+            keys.append(("ebs", ebs.get("volumeID"), bool(ebs.get("readOnly"))))
+        rbd = v.get("rbd")
+        if rbd:
+            keys.append(("rbd", f"{rbd.get('pool')}/{rbd.get('image')}", bool(rbd.get("readOnly"))))
+        iscsi = v.get("iscsi")
+        if iscsi:
+            keys.append(("iscsi", f"{iscsi.get('targetPortal')}/{iscsi.get('iqn')}", bool(iscsi.get("readOnly"))))
+    return keys
+
+
 def volume_restrictions_filter(ctx, pod: PodView, ni: "NodeInfo") -> "str | None":
     # ReadWriteOncePod: the claim must not be used by any other pod.
     for claim, pvc in _pod_pvcs(ctx, pod):
@@ -877,27 +898,10 @@ def volume_restrictions_filter(ctx, pod: PodView, ni: "NodeInfo") -> "str | None
                         return "node has pod using PersistentVolumeClaim with the same name and ReadWriteOncePod access mode"
     # GCEPD / AWS EBS: no two pods on a node may mount the same volume unless
     # both read-only.
-    def disk_keys(p: PodView):
-        keys = []
-        for v in p.spec.get("volumes", []) or []:
-            gce = v.get("gcePersistentDisk")
-            if gce:
-                keys.append(("gce", gce.get("pdName"), bool(gce.get("readOnly"))))
-            ebs = v.get("awsElasticBlockStore")
-            if ebs:
-                keys.append(("ebs", ebs.get("volumeID"), bool(ebs.get("readOnly"))))
-            rbd = v.get("rbd")
-            if rbd:
-                keys.append(("rbd", f"{rbd.get('pool')}/{rbd.get('image')}", bool(rbd.get("readOnly"))))
-            iscsi = v.get("iscsi")
-            if iscsi:
-                keys.append(("iscsi", f"{iscsi.get('targetPortal')}/{iscsi.get('iqn')}", bool(iscsi.get("readOnly"))))
-        return keys
-
-    mine = disk_keys(pod)
+    mine = pod_disk_keys(pod)
     if mine:
         for other in ni.pods:
-            for kind, ident, ro in disk_keys(other):
+            for kind, ident, ro in pod_disk_keys(other):
                 for mkind, mident, mro in mine:
                     if kind == mkind and ident == mident and not (ro and mro):
                         return "node(s) conflicted with the pod's volumes"
@@ -1001,13 +1005,12 @@ def _restore(ni: "NodeInfo", saved_pods: list):
 
 
 def _feasible_after_removal(ctx: "CycleContext", pod: PodView, ni: "NodeInfo") -> bool:
-    """Re-run the filter plugins against the mutated NodeInfo. Cycle state
-    that depends on existing pods (inter-pod affinity, topology spread) is
-    recomputed so victim removal is visible."""
+    """Re-run every *enabled* filter plugin against the mutated NodeInfo
+    (upstream dry-run preemption re-runs the full filter stack). Cycle
+    state that depends on existing pods (inter-pod affinity, topology
+    spread) is recomputed so victim removal is visible."""
     sub_ctx = type(ctx)(ctx.snapshot, ctx.config)
-    for name in ("NodeResourcesFit", "NodeUnschedulable", "NodeName", "TaintToleration",
-                 "NodeAffinity", "NodePorts", "PodTopologySpread", "InterPodAffinity",
-                 "VolumeRestrictions", "VolumeBinding", "VolumeZone"):
+    for name in ctx.config.enabled("filter"):
         fn = FILTER_PLUGINS.get(name)
         if fn is None:
             continue
@@ -1026,6 +1029,13 @@ PREFILTER_PLUGINS: dict[str, Callable] = {
     "PodTopologySpread": spread_pre_filter,
     "InterPodAffinity": interpod_pre_filter,
     "VolumeBinding": volume_binding_pre_filter,
+    # State-caching-only prefilters: can never fail here, but the reference
+    # records a success status for every enabled prefilter plugin (wrapped
+    # PreFilter, simulator/scheduler/plugin/wrappedplugin.go:459-489), so
+    # they must appear in the record.
+    "VolumeRestrictions": lambda ctx, pod: None,
+    "VolumeZone": lambda ctx, pod: None,
+    "NodeAffinity": lambda ctx, pod: None,
 }
 
 FILTER_PLUGINS: dict[str, Callable] = {

@@ -48,7 +48,18 @@ def restricted_config(
 
 
 def assert_parity(nodes, pods, config, policy=EXACT, **enc_kw):
-    oracle = Oracle([dict(n) for n in nodes], [dict(p) for p in pods], config)
+    # object kinds both the oracle and the encoder consume
+    shared = {
+        k: enc_kw[k]
+        for k in ("pvcs", "pvs", "storageclasses", "priorityclasses", "namespaces")
+        if k in enc_kw
+    }
+    oracle = Oracle(
+        [dict(n) for n in nodes],
+        [dict(p) for p in pods],
+        config,
+        **{k: [dict(o) for o in v] for k, v in shared.items()},
+    )
     want = oracle.schedule_all()
     enc = encode_cluster(nodes, pods, config, policy=policy, **enc_kw)
     eng = BatchedScheduler(enc)
@@ -130,7 +141,8 @@ class TestM2Parity:
             assert ra.to_annotations() == rb.to_annotations()
 
     def test_strict_raises_on_unimplemented_plugin(self):
-        cfg = SchedulerConfiguration.default()  # full default set
+        # the full default set is supported; a plugin with no kernel is not
+        cfg = restricted_config(filters=("NodeResourcesFit", "NoSuchPlugin"))
         enc = encode_cluster([node("n0")], [pod("p0")], cfg)
         with pytest.raises(UnsupportedPluginError):
             BatchedScheduler(enc)

@@ -1,0 +1,346 @@
+"""Parity: volume-family kernels (VolumeBinding, VolumeZone,
+VolumeRestrictions, EBS/GCEPD/Azure limits) vs the oracle, at annotation
+depth — and strict acceptance of the full default plugin configuration."""
+
+import random
+
+from kube_scheduler_simulator_tpu.engine import (
+    EXACT,
+    TPU32,
+    BatchedScheduler,
+    encode_cluster,
+)
+from kube_scheduler_simulator_tpu.engine.engine import supported_config
+from kube_scheduler_simulator_tpu.sched.config import SchedulerConfiguration
+
+from helpers import node, pod
+from test_engine_parity import assert_parity, restricted_config
+
+
+def pvc(name, ns="default", sc=None, volume_name=None, modes=None,
+        storage="1Gi", selector=None):
+    spec = {"resources": {"requests": {"storage": storage}}}
+    if sc is not None:
+        spec["storageClassName"] = sc
+    if volume_name:
+        spec["volumeName"] = volume_name
+    if modes:
+        spec["accessModes"] = list(modes)
+    if selector:
+        spec["selector"] = selector
+    return {"metadata": {"name": name, "namespace": ns}, "spec": spec}
+
+
+def pv(name, sc=None, capacity="10Gi", modes=("ReadWriteOnce",),
+       claim_ref=None, node_affinity=None, labels=None):
+    spec = {
+        "capacity": {"storage": capacity},
+        "accessModes": list(modes),
+    }
+    if sc is not None:
+        spec["storageClassName"] = sc
+    if claim_ref:
+        spec["claimRef"] = claim_ref
+    if node_affinity:
+        spec["nodeAffinity"] = node_affinity
+    return {"metadata": {"name": name, "labels": labels or {}}, "spec": spec}
+
+
+def storageclass(name, mode="Immediate"):
+    return {"metadata": {"name": name}, "volumeBindingMode": mode}
+
+
+def claim_vol(claim):
+    return {"name": f"v-{claim}", "persistentVolumeClaim": {"claimName": claim}}
+
+
+def vol_config(extra_filters=(), postfilters=()):
+    cfg = restricted_config(
+        filters=(
+            "NodeUnschedulable",
+            "NodeName",
+            "NodeResourcesFit",
+            "VolumeRestrictions",
+            "EBSLimits",
+            "GCEPDLimits",
+            "NodeVolumeLimits",
+            "AzureDiskLimits",
+            "VolumeBinding",
+            "VolumeZone",
+        )
+        + tuple(extra_filters),
+        scores=(("NodeResourcesFit", 1), ("NodeResourcesBalancedAllocation", 1)),
+        prefilters=("NodeResourcesFit", "VolumeRestrictions", "VolumeBinding",
+                    "VolumeZone"),
+        prescores=("NodeResourcesFit", "NodeResourcesBalancedAllocation"),
+    )
+    if postfilters:
+        d = cfg.to_dict()
+        d["profiles"][0]["plugins"]["postFilter"]["enabled"] = [
+            {"name": n} for n in postfilters
+        ]
+        return SchedulerConfiguration.from_dict(d)
+    return cfg
+
+
+ZONE = "topology.kubernetes.io/zone"
+
+
+class TestVolumeBinding:
+    def test_missing_pvc_fails_prefilter(self):
+        nodes = [node("n0")]
+        pods = [pod("p0", volumes=[claim_vol("ghost")]), pod("ok")]
+        assert_parity(nodes, pods, vol_config())
+
+    def test_bound_pv_node_affinity(self):
+        aff = {
+            "required": {
+                "nodeSelectorTerms": [
+                    {"matchExpressions": [
+                        {"key": ZONE, "operator": "In", "values": ["z1"]},
+                    ]}
+                ]
+            }
+        }
+        nodes = [node("in-zone", labels={ZONE: "z1"}),
+                 node("off-zone", labels={ZONE: "z2"})]
+        pods = [pod("p0", volumes=[claim_vol("data")])]
+        kw = dict(
+            pvcs=[pvc("data", volume_name="pv-data")],
+            pvs=[pv("pv-data", node_affinity=aff)],
+        )
+        for policy in (EXACT, TPU32):
+            got = assert_parity(nodes, pods, vol_config(), policy=policy, **kw)
+        assert got[0].selected_node == "in-zone"
+
+    def test_wait_for_first_consumer_skips(self):
+        nodes = [node("n0")]
+        pods = [pod("p0", volumes=[claim_vol("lazy")])]
+        kw = dict(
+            pvcs=[pvc("lazy", sc="wffc")],
+            storageclasses=[storageclass("wffc", mode="WaitForFirstConsumer")],
+        )
+        got = assert_parity(nodes, pods, vol_config(), **kw)
+        assert got[0].status == "Scheduled"
+
+    def test_immediate_binding_needs_compatible_pv(self):
+        nodes = [node("n0"), node("n1")]
+        # claim asks 5Gi from sc "std": only a too-small PV exists
+        pods = [pod("p0", volumes=[claim_vol("big")]),
+                pod("p1", volumes=[claim_vol("ok")])]
+        kw = dict(
+            pvcs=[pvc("big", sc="std", storage="5Gi"),
+                  pvc("ok", sc="std", storage="1Gi")],
+            pvs=[pv("small", sc="std", capacity="2Gi")],
+            storageclasses=[storageclass("std")],
+        )
+        got = assert_parity(nodes, pods, vol_config(), **kw)
+        by = {r.pod_name: r for r in got}
+        assert by["p0"].status == "Unschedulable"
+        assert by["p1"].status == "Scheduled"
+
+
+class TestVolumeZone:
+    def test_zone_conflict(self):
+        nodes = [node("a", labels={ZONE: "z1"}), node("b", labels={ZONE: "z2"})]
+        pods = [pod("p0", volumes=[claim_vol("zonal")])]
+        kw = dict(
+            pvcs=[pvc("zonal", volume_name="pv-z")],
+            pvs=[pv("pv-z", labels={ZONE: "z1"})],
+        )
+        for policy in (EXACT, TPU32):
+            got = assert_parity(nodes, pods, vol_config(), policy=policy, **kw)
+        assert got[0].selected_node == "a"
+
+    def test_multi_zone_value(self):
+        nodes = [node("a", labels={ZONE: "z1"}), node("b", labels={ZONE: "z3"})]
+        pods = [pod("p0", volumes=[claim_vol("multi")])]
+        kw = dict(
+            pvcs=[pvc("multi", volume_name="pv-m")],
+            pvs=[pv("pv-m", labels={ZONE: "z1__z2"})],
+        )
+        got = assert_parity(nodes, pods, vol_config(), **kw)
+        assert got[0].selected_node == "a"
+
+
+class TestVolumeRestrictions:
+    def test_rwop_claim_in_use(self):
+        nodes = [node("n0"), node("n1")]
+        pods = [
+            pod("holder", node_name="n0", volumes=[claim_vol("solo")]),
+            pod("wants", volumes=[claim_vol("solo")]),
+        ]
+        kw = dict(pvcs=[pvc("solo", modes=("ReadWriteOncePod",),
+                             volume_name="pv-s")],
+                  pvs=[pv("pv-s")])
+        got = assert_parity(nodes, pods, vol_config(), **kw)
+        by = {r.pod_name: r for r in got}
+        assert by["wants"].status == "Unschedulable"
+
+    def test_rwop_freed_when_sequenced(self):
+        # claim not yet used by any bound pod -> first pending pod takes it,
+        # second fails (sequential semantics: pod i sees pod i-1's binding)
+        nodes = [node("n0"), node("n1")]
+        pods = [
+            pod("first", priority=10, volumes=[claim_vol("solo")]),
+            pod("second", priority=1, volumes=[claim_vol("solo")]),
+        ]
+        kw = dict(pvcs=[pvc("solo", modes=("ReadWriteOncePod",),
+                             volume_name="pv-s")],
+                  pvs=[pv("pv-s")])
+        got = assert_parity(nodes, pods, vol_config(), **kw)
+        by = {r.pod_name: r for r in got}
+        assert by["first"].status == "Scheduled"
+        assert by["second"].status == "Unschedulable"
+
+    def test_disk_conflict_and_readonly(self):
+        gce_rw = {"name": "d", "gcePersistentDisk": {"pdName": "disk-1"}}
+        gce_ro = {"name": "d",
+                  "gcePersistentDisk": {"pdName": "disk-1", "readOnly": True}}
+        nodes = [node("n0"), node("n1")]
+        pods = [
+            pod("holder-ro", node_name="n0", volumes=[gce_ro]),
+            pod("rw-pod", volumes=[gce_rw]),     # conflicts with ro on n0
+            pod("ro-pod", volumes=[gce_ro]),     # ro+ro is fine anywhere
+        ]
+        for policy in (EXACT, TPU32):
+            got = assert_parity(nodes, pods, vol_config(), policy=policy)
+        by = {r.pod_name: r for r in got}
+        assert by["rw-pod"].selected_node == "n1"
+
+    def test_rbd_and_iscsi_identity(self):
+        rbd = {"name": "r", "rbd": {"pool": "rp", "image": "img1"}}
+        nodes = [node("n0"), node("n1")]
+        pods = [
+            pod("a", volumes=[rbd]),
+            pod("b", volumes=[dict(rbd)]),
+        ]
+        got = assert_parity(nodes, pods, vol_config())
+        # second pod must avoid the first pod's node
+        sel = {r.pod_name: r.selected_node for r in got}
+        assert sel["a"] != sel["b"]
+
+
+class TestVolumeLimits:
+    def test_gce_pd_limit(self):
+        def disks(tag, k):
+            return [
+                {"name": f"{tag}-{i}",
+                 "gcePersistentDisk": {"pdName": f"{tag}-{i}", "readOnly": True}}
+                for i in range(k)
+            ]
+
+        nodes = [node("n0")]
+        pods = [
+            pod("bulk", node_name="n0", volumes=disks("a", 10)),
+            pod("fits", volumes=disks("b", 6)),     # 10+6 = 16 (limit)
+            pod("over", volumes=disks("c", 7)),     # 16+7 > 16 after 'fits'
+        ]
+        for policy in (EXACT, TPU32):
+            got = assert_parity(nodes, pods, vol_config(), policy=policy)
+        by = {r.pod_name: r for r in got}
+        assert by["fits"].status == "Scheduled"
+        assert by["over"].status == "Unschedulable"
+
+    def test_azure_and_ebs_types_counted_separately(self):
+        vols = [{"name": "az", "azureDisk": {"diskName": "d1"}},
+                {"name": "eb", "awsElasticBlockStore": {"volumeID": "v1",
+                                                        "readOnly": True}}]
+        nodes = [node("n0")]
+        pods = [pod("mixed", volumes=vols), pod("plain")]
+        assert_parity(nodes, pods, vol_config())
+
+
+class TestVolumePreemption:
+    def test_preempt_disk_holder(self):
+        gce = {"name": "d", "gcePersistentDisk": {"pdName": "hot-disk"}}
+        nodes = [node("only")]
+        pods = [
+            pod("victim", priority=1, node_name="only", volumes=[dict(gce)]),
+            pod("urgent", priority=100, volumes=[dict(gce)]),
+        ]
+        cfg = vol_config(postfilters=("DefaultPreemption",))
+        got = assert_parity(nodes, pods, cfg)
+        by_status = [(r.pod_name, r.status) for r in got]
+        assert ("urgent", "Nominated") in by_status
+
+    def test_preempt_rwop_holder(self):
+        nodes = [node("only")]
+        pods = [
+            pod("victim", priority=1, node_name="only",
+                volumes=[claim_vol("solo")]),
+            pod("urgent", priority=100, volumes=[claim_vol("solo")]),
+        ]
+        kw = dict(pvcs=[pvc("solo", modes=("ReadWriteOncePod",),
+                             volume_name="pv-s")],
+                  pvs=[pv("pv-s")])
+        cfg = vol_config(postfilters=("DefaultPreemption",))
+        got = assert_parity(nodes, pods, cfg, **kw)
+        assert any(r.status == "Nominated" for r in got)
+
+    def test_preempt_volume_limit_holder(self):
+        def disks(tag, k):
+            return [
+                {"name": f"{tag}-{i}",
+                 "gcePersistentDisk": {"pdName": f"{tag}-{i}", "readOnly": True}}
+                for i in range(k)
+            ]
+
+        nodes = [node("only")]
+        pods = [
+            pod("victim", priority=1, node_name="only", volumes=disks("a", 16)),
+            pod("urgent", priority=100, volumes=disks("b", 1)),
+        ]
+        cfg = vol_config(postfilters=("DefaultPreemption",))
+        got = assert_parity(nodes, pods, cfg)
+        assert any(r.status == "Nominated" for r in got)
+
+
+class TestFullDefaultConfig:
+    def test_strict_accepts_default(self):
+        """The engine's supported set now covers the entire default
+        KubeSchedulerConfiguration (reference default filter set:
+        simulator/scheduler/config/plugin.go:38-59)."""
+        cfg = SchedulerConfiguration.default()
+        nodes = [node(f"n{i}") for i in range(3)]
+        pods = [pod(f"p{i}") for i in range(4)]
+        enc = encode_cluster(nodes, pods, cfg, policy=EXACT)
+        BatchedScheduler(enc, strict=True)  # must not raise
+
+    def test_supported_config_is_default_sets(self):
+        sup = supported_config()
+        dflt = SchedulerConfiguration.default()
+        for point in ("preFilter", "filter", "postFilter", "preScore", "score"):
+            assert sup.enabled(point) == dflt.enabled(point), point
+
+    def test_default_config_parity_with_volumes(self):
+        rng = random.Random(11)
+        zones = ["z1", "z2"]
+        nodes = [
+            node(f"n{i}", cpu="4", mem="8Gi", labels={ZONE: zones[i % 2]})
+            for i in range(4)
+        ]
+        pvs_ = [pv(f"pv{i}", sc="std", capacity="10Gi",
+                   labels={ZONE: zones[i % 2]}) for i in range(3)]
+        pvcs_ = (
+            [pvc(f"c{i}", sc="std", storage="1Gi") for i in range(2)]
+            + [pvc("zonal", volume_name="pv0")]
+        )
+        sc = [storageclass("std")]
+        pods = []
+        for i in range(12):
+            vols = []
+            r = rng.random()
+            if r < 0.3:
+                vols.append(claim_vol(rng.choice(["c0", "c1", "zonal"])))
+            elif r < 0.5:
+                vols.append({"name": "d", "gcePersistentDisk": {
+                    "pdName": f"disk-{rng.randrange(3)}",
+                    "readOnly": rng.random() < 0.5}})
+            pods.append(pod(f"p{i}", cpu="200m", mem="256Mi",
+                            volumes=vols or None,
+                            priority=rng.choice([0, 0, 10])))
+        cfg = SchedulerConfiguration.default()
+        for policy in (EXACT, TPU32):
+            assert_parity(nodes, pods, cfg, policy=policy,
+                          pvcs=pvcs_, pvs=pvs_, storageclasses=sc)
