@@ -10,14 +10,14 @@ scenario / Monte-Carlo engine that shards thousands of cluster replicas and
 policy variants over a TPU mesh.
 
 Layout:
-  models/    typed object model, string vocabularies, columnar device state,
-             in-memory resource store (list/watch), snapshot import/export
-  sched/     scheduler configuration, plugin registry semantics, the pure
-             Python oracle scheduler, and the batched JAX engine
-  ops/       per-plugin filter/score kernels (jax.numpy / vmap / pallas)
-  parallel/  device mesh construction, shardings, Monte-Carlo sweeps
-  scenario/  KEP-140 scenario VM + deterministic controllers
-  server/    REST + SSE serving layer with the reference API surface
+  models/    typed object model, string vocabularies, in-memory resource
+             store (list/watch), snapshot import/export
+  sched/     scheduler configuration + plugin registry semantics, the pure
+             Python oracle scheduler, per-pod result records
+  engine/    the batched JAX engine: cluster featurizer, per-plugin
+             filter/score kernels, preemption dry-run, lax.scan scheduler
+  server/    REST + watch-stream serving layer with the reference API
+             surface, scheduler lifecycle service, CLI driver
   utils/     quantities, small helpers
 """
 

@@ -1,0 +1,19 @@
+"""Deterministic controller step functions (the kube-controller-manager
+subset the reference runs: deployment, replicaset, persistent-volume —
+simulator/controller/controller.go:77-86)."""
+
+from .steps import (
+    CONTROLLERS,
+    deployment_controller_step,
+    pv_controller_step,
+    replicaset_controller_step,
+    run_to_fixpoint,
+)
+
+__all__ = [
+    "CONTROLLERS",
+    "deployment_controller_step",
+    "replicaset_controller_step",
+    "pv_controller_step",
+    "run_to_fixpoint",
+]

@@ -1,0 +1,287 @@
+"""Deterministic controller step functions over the `ResourceStore`.
+
+The reference runs three upstream kube-controller-manager controllers as
+concurrent watch-driven loops (simulator/controller/controller.go:77-86:
+deployment, replicaset, persistent-volume). Re-expressed here as *pure
+deterministic step functions*: each takes the store, reconciles one round,
+and reports whether it changed anything; `run_to_fixpoint` iterates the
+set until the state stops moving. Determinism is a KEP-140 requirement
+(keps/140-scenario-based-simulation/README.md:329-330 — same scenario,
+same result), so every generated name is derived (template hash, ordinal
+index), never random, and scale-down removes the highest ordinals first.
+
+    deployment → replicaset:  one ReplicaSet per deployment template
+                              (name = <deploy>-<template-hash>, stale
+                              template RSes scale to 0 then delete)
+    replicaset → pods:        pods <rs>-<i> up/down to spec.replicas
+    pv controller:            bind pending PVCs to the smallest matching
+                              available PV (claimRef ↔ volumeName, both
+                              phases → Bound; upstream pv_controller
+                              smallest-adequate-volume match)
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+from ..models.store import ResourceStore
+
+
+def _meta(obj: dict) -> dict:
+    return obj.get("metadata", {}) or {}
+
+
+def _template_hash(template: dict) -> str:
+    """Stable analogue of the pod-template-hash label: a short digest of
+    the canonical template JSON."""
+    blob = json.dumps(template, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:10]
+
+
+def deployment_controller_step(store: ResourceStore) -> bool:
+    """One reconcile round: every deployment owns exactly one ReplicaSet
+    per current template; old-template ReplicaSets are deleted (recreate-
+    style rollout — deterministic, no rolling-update surge modeling)."""
+    changed = False
+    # list once, index by owner (store.list deep-copies; per-object
+    # re-listing would make a round O(objects^2) in copies)
+    owned_by: dict[tuple[str, str], dict[str, dict]] = {}
+    for rs in store.list("replicasets"):
+        rmeta = _meta(rs)
+        for ref in rmeta.get("ownerReferences") or []:
+            if ref.get("kind") == "Deployment":
+                owned_by.setdefault(
+                    (rmeta.get("namespace", "default"), ref.get("name")), {}
+                )[rmeta["name"]] = rs
+    for deploy in sorted(
+        store.list("deployments"), key=lambda d: ResourceStore.key("deployments", d)
+    ):
+        meta = _meta(deploy)
+        ns = meta.get("namespace", "default")
+        name = meta.get("name", "")
+        spec = deploy.get("spec", {}) or {}
+        template = spec.get("template", {}) or {}
+        replicas = spec.get("replicas", 1)
+        want_rs = f"{name}-{_template_hash(template)}"
+        have = owned_by.get((ns, name), {})
+        if want_rs not in have:
+            store.apply(
+                "replicasets",
+                {
+                    "metadata": {
+                        "name": want_rs,
+                        "namespace": ns,
+                        "ownerReferences": [
+                            {"kind": "Deployment", "name": name}
+                        ],
+                        "labels": dict(
+                            (template.get("metadata", {}) or {}).get("labels")
+                            or {}
+                        ),
+                    },
+                    "spec": {
+                        "replicas": replicas,
+                        "selector": spec.get("selector"),
+                        "template": template,
+                    },
+                },
+            )
+            changed = True
+        elif (have[want_rs].get("spec", {}) or {}).get("replicas") != replicas:
+            store.apply(
+                "replicasets",
+                {
+                    "metadata": {"name": want_rs, "namespace": ns},
+                    "spec": {"replicas": replicas},
+                },
+            )
+            changed = True
+        for rs_name in sorted(have):
+            if rs_name != want_rs:
+                store.delete("replicasets", rs_name, ns)
+                changed = True
+    return changed
+
+
+def replicaset_controller_step(store: ResourceStore) -> bool:
+    """One reconcile round: each ReplicaSet owns pods named <rs>-<i>;
+    scale up fills the lowest free ordinals, scale down deletes the
+    highest ones (deterministic victim choice)."""
+    changed = False
+    # list once; index pods by (ns, name) and by owning ReplicaSet
+    rs_list = sorted(
+        store.list("replicasets"), key=lambda r: ResourceStore.key("replicasets", r)
+    )
+    live_rs = {
+        (_meta(rs).get("namespace", "default"), _meta(rs).get("name", ""))
+        for rs in rs_list
+    }
+    pods_by_key: dict[tuple[str, str], dict] = {}
+    pods_by_owner: dict[tuple[str, str], dict[str, dict]] = {}
+    for p in store.list("pods"):
+        pmeta = _meta(p)
+        ns = pmeta.get("namespace", "default")
+        pods_by_key[(ns, pmeta["name"])] = p
+        owners = [
+            ref
+            for ref in pmeta.get("ownerReferences") or []
+            if ref.get("kind") == "ReplicaSet"
+        ]
+        # owner-reference GC (upstream garbage collector): pods whose
+        # owning ReplicaSet is gone are deleted before reconciling counts
+        if owners and all((ns, ref.get("name")) not in live_rs for ref in owners):
+            store.delete("pods", pmeta["name"], ns)
+            del pods_by_key[(ns, pmeta["name"])]
+            changed = True
+            continue
+        for ref in owners:
+            pods_by_owner.setdefault((ns, ref.get("name")), {})[
+                pmeta["name"]
+            ] = p
+    for rs in rs_list:
+        meta = _meta(rs)
+        ns = meta.get("namespace", "default")
+        name = meta.get("name", "")
+        spec = rs.get("spec", {}) or {}
+        want = int(spec.get("replicas", 1))
+        template = spec.get("template", {}) or {}
+        owned = pods_by_owner.get((ns, name), {})
+        if len(owned) == want:
+            continue
+        if len(owned) < want:
+            i = 0
+            while len(owned) < want:
+                pod_name = f"{name}-{i}"
+                i += 1
+                if pod_name in owned:
+                    continue
+                if (ns, pod_name) in pods_by_key:
+                    # an unrelated pod occupies this name (upstream create
+                    # would fail AlreadyExists) — skip the ordinal rather
+                    # than adopt/overwrite it
+                    continue
+                manifest = {
+                    "metadata": {
+                        **json.loads(
+                            json.dumps(template.get("metadata", {}) or {})
+                        ),
+                        "name": pod_name,
+                        "namespace": ns,
+                        "ownerReferences": [
+                            {"kind": "ReplicaSet", "name": name}
+                        ],
+                    },
+                    "spec": json.loads(
+                        json.dumps(template.get("spec", {}) or {})
+                    ),
+                }
+                created = store.apply("pods", manifest)
+                owned[pod_name] = created
+                pods_by_key[(ns, pod_name)] = created
+                changed = True
+        else:
+            # highest ordinal (then name) first — deterministic scale-down
+            def ordinal(n: str) -> tuple:
+                suffix = n.rsplit("-", 1)[-1]
+                return (int(suffix) if suffix.isdigit() else -1, n)
+
+            for victim in sorted(owned, key=ordinal, reverse=True)[
+                : len(owned) - want
+            ]:
+                store.delete("pods", victim, ns)
+                changed = True
+    return changed
+
+
+def pv_controller_step(store: ResourceStore) -> bool:
+    """One reconcile round of the PV binding controller: each pending PVC
+    (no spec.volumeName) binds to the smallest compatible available PV
+    (oracle _static_pv_matches is the compatibility predicate — the same
+    one VolumeBinding uses), setting claimRef/volumeName and both statuses
+    to Bound."""
+    from ..sched.oracle_plugins import _static_pv_matches
+    from ..utils.quantity import parse_quantity
+
+    changed = False
+    pvs = store.list("pvs")
+    all_pvcs = sorted(
+        store.list("pvcs"), key=lambda c: ResourceStore.key("pvcs", c)
+    )
+    # a PV is unavailable if any PVC already points at it via
+    # spec.volumeName (static pre-binding), even before claimRef is synced
+    # — otherwise two claims could double-bind one volume
+    reserved = {
+        (c.get("spec", {}) or {}).get("volumeName")
+        for c in all_pvcs
+        if (c.get("spec", {}) or {}).get("volumeName")
+    }
+
+    def capacity(pv: dict) -> int:
+        cap = ((pv.get("spec", {}) or {}).get("capacity") or {}).get("storage")
+        return parse_quantity(cap).value if cap else 0
+
+    for pvc in sorted(
+        all_pvcs, key=lambda c: ResourceStore.key("pvcs", c)
+    ):
+        meta = _meta(pvc)
+        if (pvc.get("spec", {}) or {}).get("volumeName"):
+            continue
+        candidates = [
+            pv
+            for pv in pvs
+            if _meta(pv)["name"] not in reserved
+            and not ((pv.get("spec", {}) or {}).get("claimRef") or {}).get("name")
+            and _static_pv_matches(pv, pvc)
+        ]
+        if not candidates:
+            continue
+        best = min(candidates, key=lambda pv: (capacity(pv), _meta(pv)["name"]))
+        reserved.add(_meta(best)["name"])
+        store.apply(
+            "pvs",
+            {
+                "metadata": {"name": _meta(best)["name"]},
+                "spec": {
+                    "claimRef": {
+                        "name": meta["name"],
+                        "namespace": meta.get("namespace", "default"),
+                        "uid": meta.get("uid", ""),
+                    }
+                },
+                "status": {"phase": "Bound"},
+            },
+        )
+        store.apply(
+            "pvcs",
+            {
+                "metadata": {
+                    "name": meta["name"],
+                    "namespace": meta.get("namespace", "default"),
+                },
+                "spec": {"volumeName": _meta(best)["name"]},
+                "status": {"phase": "Bound"},
+            },
+        )
+        # claimed: remove from this round's candidate pool
+        pvs = [p for p in pvs if _meta(p)["name"] != _meta(best)["name"]]
+        changed = True
+    return changed
+
+
+CONTROLLERS = (
+    deployment_controller_step,
+    replicaset_controller_step,
+    pv_controller_step,
+)
+
+
+def run_to_fixpoint(store: ResourceStore, controllers=CONTROLLERS, max_rounds: int = 100) -> int:
+    """Iterate the controller set until nothing changes (KEP-140's
+    ControllerWaiter run-to-convergence between scenario operations,
+    keps/140 README.md:366-391). Returns rounds executed."""
+    for round_no in range(1, max_rounds + 1):
+        results = [c(store) for c in controllers]  # all run every round
+        if not any(results):
+            return round_no
+    raise RuntimeError(f"controllers did not converge in {max_rounds} rounds")

@@ -10,8 +10,7 @@ simulator/scheduler/scheduler.go:153) becomes one XLA kernel launch.
 Sequential-parity mode: scanning the queue in PrioritySort order with an
 in-scan scatter-update of node state gives bit-identical placements to the
 one-pod-at-a-time reference scheduler (pod i sees pod i-1's binding) while
-still extracting all the node/plugin parallelism. The gang/batched mode
-(parallel/) trades that parity for cross-pod batching.
+still extracting all the node/plugin parallelism.
 
 The scan carries `SchedState` (requested resources, pod counts,
 assignments) and emits dense result tensors; `results()` converts them
@@ -31,6 +30,7 @@ from ..sched.results import (
     PASSED_FILTER_MESSAGE,
     SUCCESS_MESSAGE,
     PodSchedulingResult,
+    record_bind_points,
 )
 from . import kernels as K
 from .encode import EncodedCluster
@@ -68,6 +68,29 @@ def supported_config() -> "SchedulerConfiguration":
     )
 
 
+def unsupported_plugins(cfg: "SchedulerConfiguration") -> list[str]:
+    """Enabled plugins the engine has no kernel for (the strict-mode check,
+    exposed so a config can be validated before the scheduler is rebuilt —
+    the lifecycle service's rollback test, reference
+    simulator/scheduler/scheduler.go:70-87)."""
+    missing = [n for n in cfg.enabled("filter") if n not in K.FILTER_KERNELS]
+    missing += [n for n, _ in cfg.score_plugins() if n not in K.SCORE_KERNELS]
+    missing += [
+        n
+        for n in cfg.enabled("preFilter")
+        if n not in K.PREFILTER_KERNELS and n not in K.TRIVIAL_PREFILTER
+    ]
+    missing += [
+        n
+        for n in cfg.enabled("preScore")
+        if n not in K.PRESCORE_KERNELS and n not in K.TRIVIAL_PRESCORE
+    ]
+    missing += [
+        n for n in cfg.enabled("postFilter") if n not in K.POSTFILTER_KERNELS
+    ]
+    return sorted(set(missing))
+
+
 class BatchedScheduler:
     """Compiled scheduling engine over one `EncodedCluster`."""
 
@@ -98,24 +121,10 @@ class BatchedScheduler:
             (n, w) for n, w in cfg.score_plugins() if n in K.SCORE_KERNELS
         ]
         if strict:
-            missing = [n for n in cfg.enabled("filter") if n not in K.FILTER_KERNELS]
-            missing += [n for n, _ in cfg.score_plugins() if n not in K.SCORE_KERNELS]
-            missing += [
-                n
-                for n in cfg.enabled("preFilter")
-                if n not in K.PREFILTER_KERNELS and n not in K.TRIVIAL_PREFILTER
-            ]
-            missing += [
-                n
-                for n in cfg.enabled("preScore")
-                if n not in K.PRESCORE_KERNELS and n not in K.TRIVIAL_PRESCORE
-            ]
-            missing += [
-                n for n in cfg.enabled("postFilter") if n not in K.POSTFILTER_KERNELS
-            ]
+            missing = unsupported_plugins(cfg)
             if missing:
                 raise UnsupportedPluginError(
-                    f"no kernel for enabled plugins: {sorted(set(missing))} "
+                    f"no kernel for enabled plugins: {missing} "
                     "(pass strict=False to skip them)"
                 )
         self._pf_kernels = [
@@ -149,12 +158,76 @@ class BatchedScheduler:
         # vmap over weight variants (Monte-Carlo), and for mesh-sharded jit.
         self.run_fn = self._build_run()
         self._run = jax.jit(self.run_fn)
+        # single-pod segments for host-callback (extender) scheduling
+        self.attempt_fn = jax.jit(
+            lambda arrays, state, weights, p: self._attempt(state, arrays, weights, p)
+        )
+        self.bind_fn = jax.jit(
+            lambda arrays, state, p, sel, qi: self._bind(state, arrays, p, sel, qi)
+        )
         self._trace = None
         self._final_state = None
 
     @property
     def _score_specs_names(self) -> list[str]:
         return [n for n, _ in self._score_specs]
+
+    # -- compile reuse ------------------------------------------------------
+
+    @staticmethod
+    def compile_signature(enc: EncodedCluster, record: bool = True) -> tuple:
+        """Everything the compiled program bakes in beyond its argument
+        shapes: the configuration (kernel selection + static plugin args),
+        dtype policy, the resource-vocabulary order (score-resource indices
+        are baked), the node-pair vocabulary size, the preemption victim
+        bound (derived from node capacities + initial assignment), and the
+        full shape/dtype signature of the argument pytrees. Two encodings
+        with equal signatures can share one compiled scheduler via
+        `retarget` — the serving layer's recompile-avoidance contract."""
+        from .preempt import _victim_bound
+
+        shapes = tuple(
+            (tuple(leaf.shape), str(leaf.dtype))
+            for leaf in jax.tree.leaves((enc.arrays, enc.state0))
+        )
+        filter_names = [
+            n for n in enc.config.enabled("filter") if n in K.FILTER_KERNELS
+        ]
+        has_preempt = "DefaultPreemption" in enc.config.enabled("postFilter")
+        victim_bound = _victim_bound(enc, filter_names) if has_preempt else 0
+        # content baked by custom kernels (K.COMPILE_STATICS registry)
+        enabled = set(filter_names)
+        for point in ("preFilter", "preScore", "score"):
+            enabled.update(enc.config.enabled(point))
+        custom_statics = tuple(
+            (name, K.COMPILE_STATICS[name](enc))
+            for name in sorted(enabled & set(K.COMPILE_STATICS))
+        )
+        return (
+            enc.config.fingerprint(),
+            enc.policy.name,
+            tuple(enc.resource_names),
+            enc.aux.get("n_node_pairs"),
+            victim_bound,
+            len(enc.queue),
+            record,
+            custom_statics,
+            shapes,
+        )
+
+    def retarget(self, enc: EncodedCluster) -> "BatchedScheduler":
+        """Point this compiled scheduler at a new encoding with an equal
+        compile signature (same shapes + baked statics, different array
+        contents). The jitted program is reused; host-side decode tables
+        come from the new encoding."""
+        if self.compile_signature(enc, self.record) != self.compile_signature(
+            self.enc, self.record
+        ):
+            raise ValueError("encoding is not compile-compatible; rebuild")
+        self.enc = enc
+        self._trace = None
+        self._final_state = None
+        return self
 
     # -- compiled program ---------------------------------------------------
 
@@ -269,6 +342,12 @@ class BatchedScheduler:
                 node_vol3=state.node_vol3.at[tgtv].add(-(a.pod_vol3 * mi[:, None])),
                 bound_seq=jnp.where(mask, -1, state.bound_seq),
             )
+
+        # Exposed segment programs: the extender loop (extender_loop.py)
+        # schedules pod-by-pod with HTTP callbacks between these device
+        # segments (SURVEY.md §7 hard part #6).
+        self._attempt = attempt
+        self._bind = bind
 
         def step(carry, x):
             state, a, weights = carry
@@ -392,11 +471,7 @@ class BatchedScheduler:
         s = int(sel_val)
         res.selected_node = enc.node_names[s]
         res.status = "Scheduled"
-        # Mirrors the oracle (sched/oracle.py schedule_one), which mirrors
-        # the reference's always-on reserve/prebind/bind recording.
-        res.reserve["VolumeBinding"] = SUCCESS_MESSAGE
-        res.prebind["VolumeBinding"] = SUCCESS_MESSAGE
-        res.bind["DefaultBinder"] = SUCCESS_MESSAGE
+        record_bind_points(enc.config, res)
         return True
 
     def _fill_postfilter(self, res, pcode_row, vmask_row, seq):

@@ -1,0 +1,257 @@
+"""Extender-aware scheduling: device segments with HTTP callbacks between.
+
+With extenders configured, control must leave the device after Filter
+(extender filter verb) and after Score (extender prioritize verb) — the
+reference's upstream scheduler does the same HTTP round-trips one pod at
+a time (SURVEY.md §3.4). The loop here runs the compiled single-pod
+segments (`BatchedScheduler.attempt_fn` / `bind_fn`) and interleaves the
+extender calls host-side:
+
+    per pod (PrioritySort order):
+      attempt_fn  (device)  → per-node filter codes + framework scores
+      extender.filter       → feasible set shrinks (FailedNodes recorded)
+      extender.prioritize   → weight-rescaled scores add to the totals
+      argmax + tie-break    (host; same lowest-index rule as the engine)
+      [extender.bind]       → delegated bind when a bind-verb extender
+                              manages the pod (upstream binder delegation)
+      bind_fn     (device)  → state update
+
+Documented divergence: preemption is not attempted in extender mode — a
+pod that fails all filters is recorded Unschedulable without the dry-run
+(upstream would also invoke the extender preempt verb). The preempt verb
+is still proxied and recorded for external schedulers that call it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..sched.results import (
+    PASSED_FILTER_MESSAGE,
+    SUCCESS_MESSAGE,
+    PodSchedulingResult,
+    record_bind_points,
+)
+from ..sched.extender import ExtenderError, ExtenderService
+from . import kernels as K
+from .engine import BatchedScheduler
+from .encode import EncodedCluster
+
+
+class ExtenderScheduler:
+    """Sequential scheduler with extender callbacks (one compiled segment
+    pair, reused across all pods)."""
+
+    def __init__(
+        self,
+        enc: EncodedCluster,
+        service: ExtenderService,
+        *,
+        strict: bool = True,
+    ):
+        self.enc = enc
+        self.service = service
+        self.sched = BatchedScheduler(enc, record=True, strict=strict)
+        self._results: "list[PodSchedulingResult] | None" = None
+        self.final_state = None
+
+    def retarget(self, enc: EncodedCluster, service: ExtenderService):
+        """Reuse the compiled segments for a compile-compatible encoding
+        (see BatchedScheduler.retarget); the extender service is swapped
+        too — a config restart replaces it even at equal fingerprint."""
+        self.sched.retarget(enc)
+        self.enc = enc
+        self.service = service
+        self._results = None
+        self.final_state = None
+        return self
+
+    # -- extender interplay -------------------------------------------------
+
+    def _extender_args(self, pod: dict, ext, node_names: list[str]) -> dict:
+        if ext.node_cache_capable:
+            return {"Pod": pod, "NodeNames": node_names}
+        nodes = {
+            (n.get("metadata", {}) or {}).get("name"): n
+            for n in self.enc.objects.get("nodes", [])
+        }
+        return {
+            "Pod": pod,
+            "Nodes": {"items": [nodes[n] for n in node_names if n in nodes]},
+        }
+
+    def _apply_extenders(self, pod: dict, feasible: list[int], totals):
+        """Filter then prioritize through every interested extender;
+        results (incl. FailedNodes) are recorded by `service.handle` into
+        the 4 extender annotations — the reference keeps extender verdicts
+        out of the 13 framework annotations too. Returns the surviving
+        node indices and updated totals."""
+        enc = self.enc
+        name_to_idx = {enc.node_names[n]: n for n in feasible}
+        for i, ext in enumerate(self.service.extenders):
+            if not ext.is_interested(pod):
+                continue
+            surviving = [enc.node_names[n] for n in feasible]
+            if ext.filter_verb:
+                try:
+                    out = self.service.handle(
+                        "filter", i, self._extender_args(pod, ext, surviving)
+                    )
+                except ExtenderError:
+                    if ext.ignorable:
+                        continue
+                    raise
+                if out.get("Error"):
+                    if ext.ignorable:
+                        continue
+                    raise ExtenderError(out["Error"])
+                if ext.node_cache_capable:
+                    kept = out.get("NodeNames")
+                    kept = surviving if kept is None else list(kept)
+                else:
+                    items = (out.get("Nodes") or {}).get("items")
+                    kept = (
+                        surviving
+                        if items is None
+                        else [
+                            (n.get("metadata", {}) or {}).get("name")
+                            for n in items
+                        ]
+                    )
+                feasible = [name_to_idx[n] for n in kept if n in name_to_idx]
+            if ext.prioritize_verb and feasible:
+                surviving = [enc.node_names[n] for n in feasible]
+                try:
+                    hosts = self.service.handle(
+                        "prioritize", i, self._extender_args(pod, ext, surviving)
+                    )
+                except ExtenderError:
+                    if ext.ignorable:
+                        continue
+                    raise
+                for h in hosts:
+                    n = name_to_idx.get(h.get("Host"))
+                    if n is not None:
+                        totals[n] += int(h.get("Score", 0))
+        return feasible, totals
+
+    def _delegated_bind(self, pod: dict, node_name: str) -> bool:
+        """Call the first interested bind-verb extender; False = no
+        delegation (local bind), raise on extender-reported error."""
+        for i, ext in enumerate(self.service.extenders):
+            if ext.bind_verb and ext.is_interested(pod):
+                meta = pod.get("metadata", {}) or {}
+                out = self.service.handle(
+                    "bind",
+                    i,
+                    {
+                        "PodName": meta.get("name", ""),
+                        "PodNamespace": meta.get("namespace", "default"),
+                        "PodUID": meta.get("uid", ""),
+                        "Node": node_name,
+                    },
+                )
+                if out and out.get("Error"):
+                    raise ExtenderError(out["Error"])
+                return True
+        return False
+
+    # -- the loop -----------------------------------------------------------
+
+    def run(self) -> list[PodSchedulingResult]:
+        enc = self.enc
+        sched = self.sched
+        import jax.numpy as jnp
+
+        state = enc.state0
+        arrays = enc.arrays
+        weights = sched.weights
+        results = []
+        for qi, p in enumerate(np.asarray(enc.queue)):  # PrioritySort order
+            pod = enc.pods[int(p)]
+            ns, name = enc.pod_keys[int(p)]
+            res = PodSchedulingResult(pod_namespace=ns, pod_name=name)
+            pf_codes, codes, raw, final, sel, pf_ok = sched.attempt_fn(
+                arrays, state, weights, jnp.int32(p)
+            )
+            pf_failed = False
+            for j, pname in enumerate(sched._prefilter_names):
+                if pname in K.PREFILTER_KERNELS:
+                    k = sched._prefilter_kernel_names.index(pname)
+                    c = int(np.asarray(pf_codes)[k])
+                else:
+                    c = 0
+                res.pre_filter_status[pname] = (
+                    K.PREFILTER_KERNELS[pname][1](c, enc) if c else SUCCESS_MESSAGE
+                )
+                pf_failed = pf_failed or bool(c)
+            if pf_failed:
+                res.status = "Unschedulable"
+                results.append(res)
+                continue
+
+            codes = np.asarray(codes)
+            raw = np.asarray(raw)
+            final = np.asarray(final)
+            feasible = []
+            for n in range(enc.n_nodes):
+                ok = True
+                for j, fname in enumerate(sched._filter_names):
+                    c = int(codes[n, j])
+                    if c:
+                        res.add_filter(
+                            enc.node_names[n], fname,
+                            K.FILTER_KERNELS[fname][1](c, enc, n),
+                        )
+                        ok = False
+                        break
+                    res.add_filter(enc.node_names[n], fname, PASSED_FILTER_MESSAGE)
+                if ok:
+                    feasible.append(n)
+            if feasible:
+                for pname in sched._prescore_names:
+                    res.pre_score[pname] = SUCCESS_MESSAGE
+                for j, sname in enumerate(sched._score_specs_names):
+                    for n in feasible:
+                        res.add_score(enc.node_names[n], sname, int(raw[n, j]))
+                        res.add_final_score(
+                            enc.node_names[n], sname, int(final[n, j])
+                        )
+            totals = {n: int(final[n].sum()) for n in feasible}
+            feasible, totals = self._apply_extenders(pod, feasible, totals)
+            if not feasible:
+                res.status = "Unschedulable"
+                results.append(res)
+                continue
+            best = min(feasible, key=lambda n: (-totals[n], n))
+            res.selected_node = enc.node_names[best]
+            res.status = "Scheduled"
+            record_bind_points(enc.config, res)
+            try:
+                delegated = self._delegated_bind(pod, enc.node_names[best])
+            except ExtenderError as e:
+                res.status = "Unschedulable"
+                res.bind["ExtenderBinder"] = str(e)
+                results.append(res)
+                continue
+            if delegated:
+                res.bind["ExtenderBinder"] = SUCCESS_MESSAGE
+            state = sched.bind_fn(
+                arrays, state, jnp.int32(p), jnp.int32(best), jnp.int32(qi)
+            )
+            results.append(res)
+        self.final_state = state
+        self._results = results
+        return results
+
+    def placements(self) -> dict[tuple[str, str], str]:
+        if self._results is None:
+            self.run()
+        assign = np.asarray(self.final_state.assignment)
+        out = {}
+        for qi in self.enc.queue:
+            sel = int(assign[qi])
+            out[self.enc.pod_keys[qi]] = (
+                self.enc.node_names[sel] if sel >= 0 else ""
+            )
+        return out

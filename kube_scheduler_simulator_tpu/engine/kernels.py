@@ -275,6 +275,17 @@ TRIVIAL_PRESCORE: set[str] = {
 # DefaultPreemption victim-selection kernel lands (SURVEY.md §7 M3).
 POSTFILTER_KERNELS: dict[str, Callable] = {}
 
+# Plugins whose kernel builders bake *cluster content* (not just shapes /
+# config args) into the compiled closure must register a statics function
+# here: name -> fn(enc) -> hashable. `BatchedScheduler.compile_signature`
+# folds it in so the serving layer's compiled-engine cache can never reuse
+# a program whose baked features went stale (e.g. the NetworkBandwidth
+# demo bakes annotation-derived arrays; plugins/networkbandwidth.py).
+# In-tree kernels read content only through `arrays`/`state` arguments —
+# except the preemption victim bound, which compile_signature already
+# includes directly.
+COMPILE_STATICS: dict[str, Callable] = {}
+
 
 # ---------------------------------------------------------------------------
 # TaintToleration  (oracle: taint_toleration_filter/score/normalize;

@@ -16,11 +16,15 @@ from __future__ import annotations
 import copy
 import itertools
 import threading
+from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Iterable
 
 # The seven watched kinds, in the reference's order
-# (resourcewatcher.go:22-30).
+# (resourcewatcher.go:22-30), plus the workload kinds the controller
+# subset manages (reference: simulator/controller/controller.go:77-86 runs
+# deployment/replicaset controllers against its apiserver; those objects
+# are stored but not part of the 7-kind watch/export surface).
 KINDS = (
     "pods",
     "nodes",
@@ -29,9 +33,11 @@ KINDS = (
     "storageclasses",
     "priorityclasses",
     "namespaces",
+    "deployments",
+    "replicasets",
 )
 
-NAMESPACED = {"pods": True, "pvcs": True}
+NAMESPACED = {"pods": True, "pvcs": True, "deployments": True, "replicasets": True}
 
 
 class StaleResourceVersion(Exception):
@@ -57,6 +63,12 @@ class ResourceStore:
         self._pruned_through = 0  # highest resourceVersion dropped from the log
         self._subscribers: list[Callable[[WatchEvent], None]] = []
         self._initial_snapshot: "dict | None" = None
+        # Subscriber delivery happens OUTSIDE self._lock (a subscriber that
+        # re-enters the store must not deadlock or corrupt event order):
+        # mutations append to _delivery under the lock, then drain it under
+        # the re-entrant dispatch lock after releasing the state lock.
+        self._delivery: deque[WatchEvent] = deque()
+        self._dispatch_lock = threading.RLock()
 
     # -- keys ---------------------------------------------------------------
 
@@ -79,26 +91,31 @@ class ResourceStore:
         if kind not in KINDS:
             raise KeyError(f"unknown kind {kind}")
         with self._lock:
-            obj = copy.deepcopy(obj)
-            if not (obj.get("metadata", {}) or {}).get("name"):
-                raise ValueError("object has no metadata.name")
-            k = self.key(kind, obj)
-            existing = self._objs[kind].get(k)
-            if existing is not None:
-                merged = _merge(copy.deepcopy(existing), obj)
-                event_type = "MODIFIED"
-            else:
-                merged = obj
-                event_type = "ADDED"
-            rv = next(self._rv)
-            meta = merged.setdefault("metadata", {})
-            meta["resourceVersion"] = str(rv)
-            meta.setdefault("uid", f"uid-{kind}-{k}-{rv}")
-            if NAMESPACED.get(kind):
-                meta.setdefault("namespace", "default")
-            self._objs[kind][k] = merged
-            self._emit(WatchEvent(event_type, kind, copy.deepcopy(merged), rv))
-            return copy.deepcopy(merged)
+            out = copy.deepcopy(self._apply_locked(kind, obj))
+        self._dispatch()
+        return out
+
+    def _apply_locked(self, kind: str, obj: dict) -> dict:
+        obj = copy.deepcopy(obj)
+        if not (obj.get("metadata", {}) or {}).get("name"):
+            raise ValueError("object has no metadata.name")
+        k = self.key(kind, obj)
+        existing = self._objs[kind].get(k)
+        if existing is not None:
+            merged = _merge(copy.deepcopy(existing), obj)
+            event_type = "MODIFIED"
+        else:
+            merged = obj
+            event_type = "ADDED"
+        rv = next(self._rv)
+        meta = merged.setdefault("metadata", {})
+        meta["resourceVersion"] = str(rv)
+        meta.setdefault("uid", f"uid-{kind}-{k}-{rv}")
+        if NAMESPACED.get(kind):
+            meta.setdefault("namespace", "default")
+        self._objs[kind][k] = merged
+        self._emit(WatchEvent(event_type, kind, copy.deepcopy(merged), rv))
+        return merged
 
     def get(self, kind: str, name: str, namespace: str = "default") -> "dict | None":
         with self._lock:
@@ -111,24 +128,31 @@ class ResourceStore:
 
     def delete(self, kind: str, name: str, namespace: str = "default") -> bool:
         with self._lock:
-            k = self.obj_key(kind, name, namespace)
-            obj = self._objs[kind].pop(k, None)
-            if obj is None:
-                return False
-            rv = next(self._rv)
-            self._emit(WatchEvent("DELETED", kind, copy.deepcopy(obj), rv))
-            if kind == "nodes":
-                # Cascade: deleting a node deletes the pods scheduled on it
-                # (reference: simulator/node/node.go:69-92).
-                doomed = [
-                    p
-                    for p in self._objs["pods"].values()
-                    if (p.get("spec", {}) or {}).get("nodeName") == name
-                ]
-                for p in doomed:
-                    meta = p.get("metadata", {})
-                    self.delete("pods", meta.get("name", ""), meta.get("namespace", "default"))
-            return True
+            ok = self._delete_locked(kind, name, namespace)
+        self._dispatch()
+        return ok
+
+    def _delete_locked(self, kind: str, name: str, namespace: str) -> bool:
+        k = self.obj_key(kind, name, namespace)
+        obj = self._objs[kind].pop(k, None)
+        if obj is None:
+            return False
+        rv = next(self._rv)
+        self._emit(WatchEvent("DELETED", kind, copy.deepcopy(obj), rv))
+        if kind == "nodes":
+            # Cascade: deleting a node deletes the pods scheduled on it
+            # (reference: simulator/node/node.go:69-92).
+            doomed = [
+                p
+                for p in self._objs["pods"].values()
+                if (p.get("spec", {}) or {}).get("nodeName") == name
+            ]
+            for p in doomed:
+                meta = p.get("metadata", {})
+                self._delete_locked(
+                    "pods", meta.get("name", ""), meta.get("namespace", "default")
+                )
+        return True
 
     # -- watch --------------------------------------------------------------
 
@@ -169,12 +193,28 @@ class ResourceStore:
             return self._events[-1].resource_version if self._events else 0
 
     def _emit(self, ev: WatchEvent):
+        """Append to the event log (under self._lock) and queue for
+        subscriber delivery — callbacks run later, outside the lock."""
         self._events.append(ev)
         if len(self._events) > 100_000:
             self._pruned_through = self._events[49_999].resource_version
             del self._events[:50_000]
-        for fn in list(self._subscribers):
-            fn(ev)
+        self._delivery.append(ev)
+
+    def _dispatch(self):
+        """Drain queued events to subscribers, outside self._lock. The
+        dispatch lock serializes delivery so cross-thread event order
+        matches log order; being re-entrant, a subscriber that mutates the
+        store delivers its own events in its nested frame."""
+        while True:
+            with self._dispatch_lock:
+                with self._lock:
+                    if not self._delivery:
+                        return
+                    ev = self._delivery.popleft()
+                    subs = list(self._subscribers)
+                for fn in subs:
+                    fn(ev)
 
     # -- reset --------------------------------------------------------------
 
@@ -193,10 +233,13 @@ class ResourceStore:
             for kind in KINDS:
                 for obj in list(self._objs[kind].values()):
                     meta = obj.get("metadata", {})
-                    self.delete(kind, meta.get("name", ""), meta.get("namespace", "default"))
+                    self._delete_locked(
+                        kind, meta.get("name", ""), meta.get("namespace", "default")
+                    )
             for kind, objs in (self._initial_snapshot or {}).items():
                 for obj in objs.values():
-                    self.apply(kind, copy.deepcopy(obj))
+                    self._apply_locked(kind, copy.deepcopy(obj))
+        self._dispatch()
 
     # -- convenience --------------------------------------------------------
 

@@ -1,0 +1,55 @@
+"""Mesh construction: a 2-axis ('replicas', 'nodes') device mesh.
+
+The two parallelism styles the framework composes (SURVEY.md §2):
+
+  * 'replicas' — the data-parallel / Monte-Carlo axis: independent policy
+    variants (or cluster replicas) with no cross-talk; collectives never
+    cross it.
+  * 'nodes'    — the model-parallel analogue: the cluster's node axis,
+    sharded when nodes ≫ one chip's HBM; per-node filter/score kernels
+    run shard-local and the argmax-select reduces across it (XLA inserts
+    the ICI collectives from the shardings — no hand-written psum).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+
+def build_mesh(
+    n_devices: "int | None" = None,
+    *,
+    replicas: "int | None" = None,
+    node_shards: "int | None" = None,
+    devices=None,
+) -> Mesh:
+    """Factor `n_devices` into a (replicas, nodes) mesh.
+
+    Default factorization keeps the node axis narrow (2 when even) — the
+    Monte-Carlo axis is embarrassingly parallel and should get the bulk of
+    the devices; widen `node_shards` explicitly for huge clusters.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    if n_devices > len(devices):
+        raise ValueError(f"{n_devices} devices requested, {len(devices)} present")
+    if replicas is None and node_shards is None:
+        node_shards = 2 if n_devices % 2 == 0 else 1
+        replicas = n_devices // node_shards
+    elif replicas is None:
+        replicas = n_devices // node_shards
+    elif node_shards is None:
+        node_shards = n_devices // replicas
+    if replicas * node_shards != n_devices:
+        raise ValueError(
+            f"replicas ({replicas}) x node_shards ({node_shards}) != "
+            f"{n_devices} devices"
+        )
+    grid = mesh_utils.create_device_mesh(
+        (replicas, node_shards), devices=devices[:n_devices]
+    )
+    return Mesh(grid, ("replicas", "nodes"))

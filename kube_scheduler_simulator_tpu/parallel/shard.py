@@ -1,0 +1,86 @@
+"""Sharding the encoded cluster over the mesh's 'nodes' axis.
+
+Node-axis placement is by FIELD NAME, not shape inspection: a field whose
+leading dimension coincidentally equals N (a claim or disk vocabulary the
+same size as the node count) must stay replicated, so the authoritative
+list of node-axis fields lives here and a unit test asserts it complete
+against the dataclasses (tests/test_parallel.py).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..engine.encode import EncodedCluster
+
+# Fields of ClusterArrays / SchedState / PodRelArrays whose axis 0 is the
+# node axis [N, ...]. Everything else is replicated across 'nodes'.
+NODE_AXIS_FIELDS = frozenset(
+    {
+        # ClusterArrays
+        "node_alloc",
+        "node_unsched",
+        "node_mask",
+        "taint_key",
+        "taint_val",
+        "taint_effect",
+        "label_val",
+        "label_num",
+        "label_num_ok",
+        "img_contrib",
+        "vb_code",
+        "vz_code",
+        # SchedState
+        "requested",
+        "s_requested",
+        "n_pods",
+        "used_pair",
+        "used_wild",
+        "used_trip",
+        "node_disk_any",
+        "node_disk_rw",
+        "node_vol3",
+        # PodRelArrays
+        "node_pair",
+    }
+)
+
+
+def _shard_dataclass(obj, mesh: Mesh):
+    """device_put each field: node-axis fields split over 'nodes',
+    everything else replicated. Nested chex dataclasses recurse."""
+    updates = {}
+    for name in obj.__dataclass_fields__:
+        leaf = getattr(obj, name)
+        if hasattr(leaf, "__dataclass_fields__"):
+            updates[name] = _shard_dataclass(leaf, mesh)
+        elif name in NODE_AXIS_FIELDS:
+            spec = P("nodes", *([None] * (leaf.ndim - 1)))
+            updates[name] = jax.device_put(leaf, NamedSharding(mesh, spec))
+        else:
+            updates[name] = jax.device_put(leaf, NamedSharding(mesh, P()))
+    return obj.replace(**updates)
+
+
+def shard_encoded(enc: EncodedCluster, mesh: Mesh):
+    """Returns (arrays, state0, queue) placed on the mesh: node axis split
+    over 'nodes', pod-axis and vocabulary arrays replicated.
+
+    The node capacity must divide the 'nodes' mesh axis; encode with
+    `node_capacity=k * mesh.shape['nodes']`.
+    """
+    import jax.numpy as jnp
+
+    n_shards = mesh.shape["nodes"]
+    if enc.N % n_shards != 0:
+        raise ValueError(
+            f"node capacity {enc.N} not divisible by the {n_shards}-way "
+            "'nodes' mesh axis; pad with node_capacity="
+        )
+    arrays = _shard_dataclass(enc.arrays, mesh)
+    state0 = _shard_dataclass(enc.state0, mesh)
+    queue = jax.device_put(
+        jnp.asarray(enc.queue), NamedSharding(mesh, P())
+    )
+    return arrays, state0, queue

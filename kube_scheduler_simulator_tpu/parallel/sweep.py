@@ -1,0 +1,98 @@
+"""Monte-Carlo policy sweeps: one compiled program, many weight variants.
+
+The reference sweeps policies by editing the KubeSchedulerConfiguration
+and re-running the whole simulator per variant (scheduler restart,
+scheduler.go:70-87). Here a policy variant that only changes score
+*weights* is a vector argument: `vmap` the batched scheduling scan over a
+`[V, S]` weight matrix — V complete cluster simulations in one XLA
+program — and shard V over the mesh's 'replicas' axis (the dp analogue;
+BASELINE "1k policy variants" axis). Variants that change the plugin
+*set* re-jit per set (kernel selection is static), then sweep weights
+within each set.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..engine.encode import EncodedCluster
+from ..engine.engine import BatchedScheduler
+from .shard import shard_encoded
+
+
+def weights_for(enc: EncodedCluster, overrides: "dict[str, int]") -> np.ndarray:
+    """One weight vector in the engine's score-plugin order, starting from
+    the configuration's weights with `overrides` applied by plugin name."""
+    specs = [
+        (n, w)
+        for n, w in enc.config.score_plugins()
+    ]
+    unknown = set(overrides) - {n for n, _ in specs}
+    if unknown:
+        raise KeyError(f"not score plugins in this config: {sorted(unknown)}")
+    return np.asarray(
+        [overrides.get(n, w) for n, w in specs], dtype=np.int32
+    )
+
+
+class WeightSweep:
+    """vmap'd scheduling sweep over score-weight variants."""
+
+    def __init__(
+        self,
+        enc: EncodedCluster,
+        *,
+        mesh: "Mesh | None" = None,
+        record: bool = False,
+    ):
+        self.enc = enc
+        self.mesh = mesh
+        self.sched = BatchedScheduler(enc, record=record, strict=True)
+        self._vrun = jax.jit(
+            jax.vmap(self.sched.run_fn, in_axes=(None, None, None, 0))
+        )
+        if mesh is not None:
+            self._args = shard_encoded(enc, mesh)
+        else:
+            self._args = (enc.arrays, enc.state0, jnp.asarray(enc.queue))
+
+    def run(self, weight_matrix) -> tuple:
+        """weight_matrix: [V, S] ints (S = score plugins in config order).
+        Returns (final_states, selections[V, Q]). V shards over 'replicas'
+        when a mesh is attached (pad V to a multiple of the axis)."""
+        w = np.asarray(weight_matrix, np.int32)
+        if w.ndim != 2 or w.shape[1] != len(self.sched.weights):
+            raise ValueError(
+                f"weight matrix must be [V, {len(self.sched.weights)}], "
+                f"got {w.shape}"
+            )
+        wj = jnp.asarray(w, self.enc.policy.score)
+        if self.mesh is not None:
+            reps = self.mesh.shape["replicas"]
+            if w.shape[0] % reps != 0:
+                raise ValueError(
+                    f"{w.shape[0]} variants not divisible by the {reps}-way "
+                    "'replicas' mesh axis"
+                )
+            wj = jax.device_put(
+                wj, NamedSharding(self.mesh, P("replicas", None))
+            )
+        states, sels = self._vrun(*self._args, wj)
+        return states, sels
+
+    def placements(self, sels) -> list[dict]:
+        """Decode selections into per-variant {(ns, name): node} dicts."""
+        sels = np.asarray(sels)
+        out = []
+        for v in range(sels.shape[0]):
+            d = {}
+            for qi, p in enumerate(self.enc.queue):
+                s = int(sels[v, qi])
+                d[self.enc.pod_keys[p]] = (
+                    self.enc.node_names[s] if s >= 0 else ""
+                )
+            out.append(d)
+        return out

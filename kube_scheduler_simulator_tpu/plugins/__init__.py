@@ -1,0 +1,10 @@
+"""Out-of-tree plugins: import a module here to register its oracle
+functions and engine kernels (the analogue of the reference's
+out-of-tree registry, simulator/scheduler/plugin/plugins.go:22-44).
+
+    import kube_scheduler_simulator_tpu.plugins.networkbandwidth  # registers
+
+After the import, a KubeSchedulerConfiguration may enable the plugin by
+name at its extension points; strict mode accepts it, the oracle and the
+batched engine both execute it, and preemption dry-runs account for it.
+"""

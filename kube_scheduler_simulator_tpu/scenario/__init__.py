@@ -1,0 +1,17 @@
+"""KEP-140 scenario engine: a deterministic discrete-event scenario VM."""
+
+from .runner import (
+    Operation,
+    ScenarioResult,
+    ScenarioRunner,
+    ScenarioStep,
+    TimelineEvent,
+)
+
+__all__ = [
+    "Operation",
+    "ScenarioResult",
+    "ScenarioRunner",
+    "ScenarioStep",
+    "TimelineEvent",
+]

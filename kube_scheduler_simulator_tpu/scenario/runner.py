@@ -1,0 +1,245 @@
+"""The KEP-140 scenario VM (reference design:
+keps/140-scenario-based-simulation/README.md — the Scenario CRD spec
+:117-183, the ScenarioStep virtual clock :180-183/:393-519, controllers
+run to convergence between operations :366-391, the result Timeline
+:259-312, and the determinism requirement :329-330 "the result from the
+same Scenario won't be much changed run by run" — here strengthened to
+bit-identical).
+
+Execution model per MajorStep:
+
+  1. Operating      — apply every operation whose `major_step` equals the
+                      current major, in spec order; each mutation advances
+                      the MinorStep and is recorded in the Timeline.
+  2. ControllerRunning — run the SimulationControllers (the deterministic
+                      deployment/replicaset/PV step functions plus the
+                      batched scheduler) to a fixpoint. Scheduler binds
+                      append PodScheduled events; preemption victim
+                      deletions append Delete events (KEP: "additional
+                      PodScheduled and Delete operations for Pods").
+  3. StepCompleted  — advance to the next MajorStep.
+
+A Done operation marks the scenario Succeeded at the end of its step; with
+operations exhausted and no Done, the scenario is Paused (KEP phases
+:236-258). The VM is pure host-side orchestration — every scheduling
+decision inside step 2 is the TPU engine's batched pass.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+
+from ..controllers import CONTROLLERS
+from ..models.store import ResourceStore
+from ..sched.config import SchedulerConfiguration
+from ..server.service import SchedulerService
+
+
+@dataclass(frozen=True)
+class ScenarioStep:
+    major: int
+    minor: int
+
+    def as_dict(self) -> dict:
+        return {"major": self.major, "minor": self.minor}
+
+
+@dataclass
+class Operation:
+    """One ScenarioOperation: exactly one of create/patch/delete/done."""
+
+    id: str = ""
+    major_step: int = 0
+    create: "dict | None" = None  # {"kind": ..., "object": {...}}
+    patch: "dict | None" = None  # {"kind", "name", "namespace", "patch"}
+    delete: "dict | None" = None  # {"kind", "name", "namespace"}
+    done: bool = False
+
+    def validate(self):
+        set_fields = sum(
+            1 for f in (self.create, self.patch, self.delete) if f is not None
+        ) + (1 if self.done else 0)
+        if set_fields != 1:
+            raise ValueError(
+                f"operation {self.id!r}: exactly one of create/patch/delete/"
+                f"done must be set (got {set_fields})"
+            )
+
+
+@dataclass
+class TimelineEvent:
+    id: str
+    step: ScenarioStep
+    type: str  # Create | Patch | Delete | Done | PodScheduled
+    payload: dict = field(default_factory=dict)
+
+
+@dataclass
+class ScenarioResult:
+    phase: str  # Succeeded | Paused | Failed
+    message: str = ""
+    # MajorStep (stringified) → events, the KEP Timeline shape
+    timeline: dict[str, list[TimelineEvent]] = field(default_factory=dict)
+
+    def as_dict(self) -> dict:
+        return {
+            "phase": self.phase,
+            "message": self.message,
+            "timeline": {
+                k: [
+                    {
+                        "id": e.id,
+                        "step": e.step.as_dict(),
+                        "type": e.type,
+                        "payload": e.payload,
+                    }
+                    for e in evs
+                ]
+                for k, evs in self.timeline.items()
+            },
+        }
+
+
+class ScenarioRunner:
+    """Runs one scenario over a fresh (or provided) store."""
+
+    def __init__(
+        self,
+        operations: list[Operation],
+        *,
+        store: "ResourceStore | None" = None,
+        config: "SchedulerConfiguration | None" = None,
+        controllers=CONTROLLERS,
+        max_controller_rounds: int = 100,
+    ):
+        self.operations = operations
+        self.store = store or ResourceStore()
+        self.scheduler = SchedulerService(self.store, config)
+        self.controllers = controllers
+        self.max_controller_rounds = max_controller_rounds
+        self._seq = 0
+
+    def _gen_id(self, prefix: str) -> str:
+        self._seq += 1
+        return f"{prefix}-{self._seq}"
+
+    # -- one scheduler "controller" round ----------------------------------
+
+    def _scheduler_step(self, record) -> bool:
+        results = self.scheduler.schedule()
+        changed = False
+        for res in results:
+            if res.status == "Scheduled":
+                record(
+                    "PodScheduled",
+                    {
+                        "namespace": res.pod_namespace,
+                        "name": res.pod_name,
+                        "node": res.selected_node,
+                    },
+                )
+                changed = True
+            for victim in res.preemption_victims:
+                ns, _, name = victim.partition("/")
+                record(
+                    "Delete",
+                    {"kind": "pods", "namespace": ns, "name": name,
+                     "reason": "preempted"},
+                )
+                changed = True
+        return changed
+
+    # -- the VM -------------------------------------------------------------
+
+    def run(self) -> ScenarioResult:
+        for op in self.operations:
+            op.validate()
+        by_major: dict[int, list[Operation]] = {}
+        for i, op in enumerate(self.operations):
+            if not op.id:
+                op.id = f"op-{i}"
+            by_major.setdefault(op.major_step, []).append(op)
+        if not by_major:
+            return ScenarioResult(phase="Paused", message="no operations")
+
+        timeline: dict[str, list[TimelineEvent]] = {}
+        done_at: "int | None" = None
+        try:
+            for major in sorted(by_major):
+                minor = 0
+                events = timeline.setdefault(str(major), [])
+
+                def record(ev_type: str, payload: dict, op_id: "str | None" = None):
+                    nonlocal minor
+                    minor += 1
+                    events.append(
+                        TimelineEvent(
+                            id=op_id or self._gen_id(ev_type.lower()),
+                            step=ScenarioStep(major, minor),
+                            type=ev_type,
+                            payload=payload,
+                        )
+                    )
+
+                # 1) Operating: the step's operations, in order
+                for op in by_major[major]:
+                    if op.done:
+                        record("Done", {}, op.id)
+                        done_at = major
+                    elif op.create is not None:
+                        obj = self.store.apply(
+                            op.create["kind"], copy.deepcopy(op.create["object"])
+                        )
+                        record(
+                            "Create",
+                            {"kind": op.create["kind"], "result": obj},
+                            op.id,
+                        )
+                    elif op.patch is not None:
+                        p = op.patch
+                        patch_obj = copy.deepcopy(p["patch"])
+                        patch_obj.setdefault("metadata", {})["name"] = p["name"]
+                        if p.get("namespace"):
+                            patch_obj["metadata"]["namespace"] = p["namespace"]
+                        obj = self.store.apply(p["kind"], patch_obj)
+                        record("Patch", {"kind": p["kind"], "result": obj}, op.id)
+                    elif op.delete is not None:
+                        d = op.delete
+                        ok = self.store.delete(
+                            d["kind"], d["name"], d.get("namespace", "default")
+                        )
+                        if not ok:
+                            raise RuntimeError(
+                                f"operation {op.id}: delete target "
+                                f"{d['kind']}/{d['name']} not found"
+                            )
+                        record("Delete", {"kind": d["kind"], "name": d["name"]},
+                               op.id)
+
+                # 2) SimulationControllers to fixpoint (controllers + the
+                # scheduler are each one "controller"; a round in which any
+                # of them acts keeps the clock in this major step)
+                for _ in range(self.max_controller_rounds):
+                    moved = [c(self.store) for c in self.controllers]
+                    moved.append(self._scheduler_step(record))
+                    if not any(moved):
+                        break
+                else:
+                    raise RuntimeError(
+                        f"step {major}: controllers did not converge in "
+                        f"{self.max_controller_rounds} rounds"
+                    )
+
+                if done_at is not None:
+                    return ScenarioResult(phase="Succeeded", timeline=timeline)
+        except Exception as e:  # noqa: BLE001 — scenario failure is a result
+            return ScenarioResult(
+                phase="Failed", message=f"{type(e).__name__}: {e}",
+                timeline=timeline,
+            )
+        return ScenarioResult(
+            phase="Paused",
+            message="operations exhausted without a Done operation",
+            timeline=timeline,
+        )

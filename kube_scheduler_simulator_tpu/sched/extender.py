@@ -1,0 +1,216 @@
+"""Extender client + service: out-of-process scheduler callbacks.
+
+The reference proxies every extender call through its own server so the
+results can be recorded (simulator/scheduler/extender/extender.go:86-199
+HTTP client, service.go:45-109 record + URL rewrite, the four annotation
+keys extender/annotation/annotation.go:4-11, result shapes
+extender/resultstore/resultstore.go:39-70). Same structure here:
+
+  * `Extender` — HTTP client for one configured extender: filter /
+    prioritize / preempt / bind verbs, prioritize scores rescaled by
+    weight x MAX_NODE_SCORE/MAX_EXTENDER_PRIORITY (extender.go:134-148).
+  * `ExtenderService` — calls extender `id`, records the result keyed by
+    the extender's original URL, and serializes the four
+    `scheduler-simulator/extender-*-result` annotations.
+  * `override_extenders_for_simulator` — config rewrite pointing verbs at
+    `http://localhost:PORT/api/v1/extender/<verb>/<id>` so an *external*
+    scheduler's extender traffic transits (and is recorded by) the
+    simulator (service.go:88-109).
+
+Wire shapes follow k8s extender v1: ExtenderArgs{Pod, Nodes|NodeNames},
+ExtenderFilterResult{Nodes|NodeNames, FailedNodes,
+FailedAndUnresolvableNodes, Error}, HostPriorityList[{Host, Score}],
+ExtenderBindingArgs{PodName, PodNamespace, PodUID, Node}.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import threading
+import urllib.request
+
+from .config import MAX_NODE_SCORE
+
+MAX_EXTENDER_PRIORITY = 10
+DEFAULT_TIMEOUT_S = 30.0
+
+ANNOTATION_KEYS = {
+    "filter": "scheduler-simulator/extender-filter-result",
+    "prioritize": "scheduler-simulator/extender-prioritize-result",
+    "preempt": "scheduler-simulator/extender-preempt-result",
+    "bind": "scheduler-simulator/extender-bind-result",
+}
+
+
+class ExtenderError(RuntimeError):
+    pass
+
+
+class Extender:
+    """HTTP client for one configured extender."""
+
+    def __init__(self, cfg: dict):
+        self.url_prefix = cfg.get("urlPrefix") or ""
+        self.filter_verb = cfg.get("filterVerb") or ""
+        self.prioritize_verb = cfg.get("prioritizeVerb") or ""
+        self.preempt_verb = cfg.get("preemptVerb") or ""
+        self.bind_verb = cfg.get("bindVerb") or ""
+        self.weight = int(cfg.get("weight") or 1)
+        self.node_cache_capable = bool(cfg.get("nodeCacheCapable"))
+        self.ignorable = bool(cfg.get("ignorable"))
+        self.managed_resources = {
+            r.get("name") for r in cfg.get("managedResources") or []
+        }
+        timeout = cfg.get("httpTimeout")
+        self.timeout = _parse_timeout(timeout)
+
+    @property
+    def name(self) -> str:
+        return self.url_prefix
+
+    def is_interested(self, pod: dict) -> bool:
+        """An extender with managedResources only sees pods requesting one
+        of them (upstream IsInterested)."""
+        if not self.managed_resources:
+            return True
+        for c in (pod.get("spec", {}) or {}).get("containers") or []:
+            res = c.get("resources") or {}
+            for section in ("requests", "limits"):
+                if self.managed_resources & set(res.get(section) or {}):
+                    return True
+        return False
+
+    def _send(self, verb: str, args: dict) -> dict:
+        url = self.url_prefix.rstrip("/") + "/" + verb
+        req = urllib.request.Request(
+            url,
+            data=json.dumps(args).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                if resp.status != 200:
+                    raise ExtenderError(
+                        f"failed {verb} with extender at {url}, code {resp.status}"
+                    )
+                return json.loads(resp.read() or b"null")
+        except ExtenderError:
+            raise
+        except Exception as e:  # noqa: BLE001 — network boundary
+            raise ExtenderError(f"send {verb} to {url}: {e}") from e
+
+    def filter(self, args: dict) -> dict:
+        if not self.filter_verb:
+            raise ExtenderError("filterVerb is empty")
+        return self._send(self.filter_verb, args) or {}
+
+    def prioritize(self, args: dict) -> list[dict]:
+        if not self.prioritize_verb:
+            raise ExtenderError("prioritizeVerb is empty")
+        result = self._send(self.prioritize_verb, args) or []
+        scale = self.weight * (MAX_NODE_SCORE // MAX_EXTENDER_PRIORITY)
+        return [
+            {"Host": h.get("Host"), "Score": int(h.get("Score", 0)) * scale}
+            for h in result
+        ]
+
+    def preempt(self, args: dict) -> dict:
+        if not self.preempt_verb:
+            raise ExtenderError("preemptVerb is empty")
+        return self._send(self.preempt_verb, args) or {}
+
+    def bind(self, args: dict) -> dict:
+        if not self.bind_verb:
+            raise ExtenderError("bindVerb is empty")
+        return self._send(self.bind_verb, args) or {}
+
+
+def _parse_timeout(v) -> float:
+    if not v:
+        return DEFAULT_TIMEOUT_S
+    if isinstance(v, (int, float)):
+        return float(v)
+    s = str(v)
+    if s.endswith("ms"):
+        return float(s[:-2]) / 1000
+    if s.endswith("s"):
+        return float(s[:-1])
+    return DEFAULT_TIMEOUT_S
+
+
+class ExtenderService:
+    """Extender calls + per-pod result records (reference service.go +
+    extender/resultstore)."""
+
+    VERBS = ("filter", "prioritize", "preempt", "bind")
+
+    def __init__(self, extender_cfgs: list[dict]):
+        self.extenders = [Extender(c) for c in extender_cfgs or []]
+        self._lock = threading.Lock()
+        # (ns, pod) → verb → extender name → result
+        self._results: dict[tuple[str, str], dict[str, dict]] = {}
+
+    def _record(self, verb: str, pod_key: tuple[str, str], name: str, result):
+        with self._lock:
+            self._results.setdefault(pod_key, {}).setdefault(verb, {})[
+                name
+            ] = result
+
+    @staticmethod
+    def _pod_key_from_args(verb: str, args: dict) -> tuple[str, str]:
+        if verb == "bind":
+            return (args.get("PodNamespace", "default"), args.get("PodName", ""))
+        pod = args.get("Pod") or {}
+        meta = pod.get("metadata", {}) or {}
+        return (meta.get("namespace", "default"), meta.get("name", ""))
+
+    def handle(self, verb: str, id: int, args: dict):
+        """The proxy endpoint body: call extender `id`, record, return the
+        response verbatim (service.go:45-85)."""
+        if verb not in self.VERBS:
+            raise ExtenderError(f"unknown extender verb {verb!r}")
+        if not 0 <= id < len(self.extenders):
+            raise ExtenderError(f"no extender with id {id}")
+        ext = self.extenders[id]
+        result = getattr(ext, verb)(args or {})
+        self._record(verb, self._pod_key_from_args(verb, args or {}), ext.name, result)
+        return result
+
+    def annotations_for(self, namespace: str, name: str) -> dict[str, str]:
+        """The 4 extender annotations for one pod (resultstore
+        AddStoredResultToPod)."""
+        with self._lock:
+            rec = self._results.get((namespace, name))
+            if not rec:
+                return {}
+            return {
+                ANNOTATION_KEYS[verb]: json.dumps(rec.get(verb, {}))
+                for verb in self.VERBS
+                if verb in rec
+            }
+
+    def delete_data(self, namespace: str, name: str):
+        with self._lock:
+            self._results.pop((namespace, name), None)
+
+
+def override_extenders_for_simulator(cfg_dict: dict, port: int) -> dict:
+    """Rewrite .extenders so calls route through the simulator proxy
+    (service.go:88-109): URL prefix → the simulator, each verb → its proxy
+    path carrying the extender index."""
+    out = copy.deepcopy(cfg_dict)
+    for i, ext in enumerate(out.get("extenders") or []):
+        ext["enableHTTPS"] = False
+        ext.pop("tlsConfig", None)
+        ext["urlPrefix"] = f"http://localhost:{port}/api/v1/extender/"
+        for verb_key, verb in (
+            ("filterVerb", "filter"),
+            ("prioritizeVerb", "prioritize"),
+            ("preemptVerb", "preempt"),
+            ("bindVerb", "bind"),
+        ):
+            if ext.get(verb_key):
+                ext[verb_key] = f"{verb}/{i}"
+    return out

@@ -33,7 +33,12 @@ from ..models.objects import (
 )
 from .config import MAX_NODE_SCORE, SchedulerConfiguration
 from .resources import to_int_resources
-from .results import PASSED_FILTER_MESSAGE, SUCCESS_MESSAGE, PodSchedulingResult
+from .results import (
+    PASSED_FILTER_MESSAGE,
+    SUCCESS_MESSAGE,
+    PodSchedulingResult,
+    record_bind_points,
+)
 from . import oracle_plugins as plugins_mod
 
 
@@ -253,9 +258,7 @@ class Oracle:
         )[0]
         res.selected_node = best
         res.status = "Scheduled"
-        res.reserve["VolumeBinding"] = SUCCESS_MESSAGE
-        res.prebind["VolumeBinding"] = SUCCESS_MESSAGE
-        res.bind["DefaultBinder"] = SUCCESS_MESSAGE
+        record_bind_points(self.config, res)
         return res
 
     def _run_post_filter(self, ctx: CycleContext, pv: PodView, res: PodSchedulingResult):

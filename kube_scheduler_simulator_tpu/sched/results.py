@@ -19,6 +19,24 @@ PASSED_FILTER_MESSAGE = "passed"
 SUCCESS_MESSAGE = "success"
 WAIT_MESSAGE = "wait"
 
+
+def record_bind_points(config, res: "PodSchedulingResult") -> None:
+    """Record the post-selection extension points for a scheduled pod —
+    one status per *enabled* plugin at each point, as the reference's
+    wrapped plugins do (wrappedplugin.go:549-695: Reserve/Permit/PreBind/
+    Bind/PostBind each record per registered plugin). None of the
+    simulator-supported plugins can fail these points in-process (no real
+    volume provisioning, no wait-permits), so every recorded status is
+    "success" — but the *set* of records follows the configuration."""
+    for name in config.enabled("reserve"):
+        res.reserve[name] = SUCCESS_MESSAGE
+    for name in config.enabled("permit"):
+        res.permit[name] = SUCCESS_MESSAGE
+    for name in config.enabled("preBind"):
+        res.prebind[name] = SUCCESS_MESSAGE
+    for name in config.enabled("bind"):
+        res.bind[name] = SUCCESS_MESSAGE
+
 ANNOTATION_KEYS = {
     "pre_filter_status": "scheduler-simulator/prefilter-result-status",
     "pre_filter_result": "scheduler-simulator/prefilter-result",

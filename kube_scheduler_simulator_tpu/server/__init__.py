@@ -1,0 +1,15 @@
+"""Serving shell: the reference simulator's HTTP API over the TPU engine."""
+
+from .httpserver import SimulatorServer
+from .service import (
+    InvalidSchedulerConfiguration,
+    SchedulerService,
+    SimulatorService,
+)
+
+__all__ = [
+    "SimulatorServer",
+    "SimulatorService",
+    "SchedulerService",
+    "InvalidSchedulerConfiguration",
+]

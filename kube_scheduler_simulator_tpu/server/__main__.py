@@ -1,0 +1,59 @@
+"""CLI driver: boot the simulator server (the reference's entry point,
+simulator/simulator.go:23-106, minus the etcd/apiserver/controller
+processes the in-memory store replaces).
+
+    python -m kube_scheduler_simulator_tpu.server [--port 1212]
+                                                  [--auto-schedule]
+
+Boot order mirrors startSimulator: env config → store + services →
+optional boot snapshot import → HTTP server → wait for interrupt.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from . import config as envconfig
+from .httpserver import SimulatorServer
+from .service import SimulatorService
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    parser = argparse.ArgumentParser(prog="kube-scheduler-simulator-tpu")
+    parser.add_argument("--port", type=int, default=None)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument(
+        "--auto-schedule",
+        action="store_true",
+        help="run a scheduling pass automatically after resource changes",
+    )
+    args = parser.parse_args(argv)
+
+    cfg = envconfig.from_env()
+    if args.port is not None:
+        cfg.port = args.port
+    service = SimulatorService(initial_config=cfg.initial_scheduler_config)
+    if cfg.external_import_enabled and cfg.snapshot_path:
+        errors = service.import_(
+            envconfig.load_snapshot(cfg.snapshot_path), ignore_err=True
+        )
+        for e in errors:
+            print(f"import: skipped: {e}")
+    server = SimulatorServer(
+        service,
+        host=args.host,
+        port=cfg.port,
+        auto_schedule=args.auto_schedule,
+        cors_allowed_origins=cfg.cors_allowed_origins,
+    )
+    server.start()
+    print(f"simulator serving on http://{args.host}:{server.port}/api/v1")
+    try:
+        server._thread.join()
+    except KeyboardInterrupt:
+        server.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,59 @@
+"""Environment-variable configuration (reference:
+simulator/config/config.go:39-228, documented in
+simulator/docs/environment-variables.md).
+
+Honored variables — the reference's names where the concept carries over:
+
+    PORT                        simulator server port (default 1212)
+    CORS_ALLOWED_ORIGIN_LIST    comma-separated origins
+    KUBE_SCHEDULER_CONFIG_PATH  initial KubeSchedulerConfiguration YAML
+    EXTERNAL_IMPORT_ENABLED     import a snapshot at boot (see SNAPSHOT_PATH)
+    SNAPSHOT_PATH               snapshot JSON for the boot import
+
+etcd/kube-apiserver variables have no analogue: the typed in-process store
+replaces both (SURVEY.md §2 #3).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+
+from ..sched.config import SchedulerConfiguration
+
+
+@dataclass
+class Config:
+    port: int = 1212
+    cors_allowed_origins: list[str] = field(default_factory=list)
+    initial_scheduler_config: "SchedulerConfiguration | None" = None
+    external_import_enabled: bool = False
+    snapshot_path: str = ""
+
+
+def from_env(env: "dict | None" = None) -> Config:
+    env = os.environ if env is None else env
+    cfg = Config()
+    if env.get("PORT"):
+        cfg.port = int(env["PORT"])
+    if env.get("CORS_ALLOWED_ORIGIN_LIST"):
+        cfg.cors_allowed_origins = [
+            o.strip()
+            for o in env["CORS_ALLOWED_ORIGIN_LIST"].split(",")
+            if o.strip()
+        ]
+    path = env.get("KUBE_SCHEDULER_CONFIG_PATH")
+    if path:
+        with open(path) as f:
+            cfg.initial_scheduler_config = SchedulerConfiguration.from_yaml(
+                f.read()
+            )
+    cfg.external_import_enabled = env.get("EXTERNAL_IMPORT_ENABLED") == "true"
+    cfg.snapshot_path = env.get("SNAPSHOT_PATH", "")
+    return cfg
+
+
+def load_snapshot(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)

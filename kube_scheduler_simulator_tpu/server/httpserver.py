@@ -1,0 +1,357 @@
+"""The simulator HTTP server — the reference's full API surface.
+
+Routes (reference: simulator/server/server.go:42-57):
+
+    GET  /api/v1/schedulerconfiguration      current config (200)
+    POST /api/v1/schedulerconfiguration      restart w/ new config (202)
+    PUT  /api/v1/reset                       reset resources + config (202)
+    GET  /api/v1/export                      ResourcesForImport JSON (200)
+    POST /api/v1/import                      apply snapshot (200)
+    GET  /api/v1/listwatchresources          list+watch stream (SSE-style)
+    POST /api/v1/extender/<verb>/<id>        extender proxy (extender.py)
+
+Two deliberate extensions (the reference exposes resource CRUD through its
+embedded kube-apiserver, which this framework replaces with the in-process
+typed store — SURVEY.md §2 #3):
+
+    GET/PUT            /api/v1/resources/<kind>
+    GET/DELETE         /api/v1/resources/<kind>/<ns>/<name>  (or /<name>)
+    POST               /api/v1/schedule      run one batched scheduling pass
+
+The watch stream mirrors the reference's wire shape — a sequence of JSON
+objects `{"Kind": ..., "EventType": ..., "Obj": {...}}` flushed per event
+(simulator/resourcewatcher/streamwriter/streamwriter.go:18-51), with the
+same `<kind>LastResourceVersion` query parameters and list-as-ADDED replay
+when a version is absent (resourcewatcher.go:94-120). A stale version gets
+a relist (the 410-Gone analogue) instead of silently dropped events.
+
+Implementation is stdlib-only (ThreadingHTTPServer): the serving shell has
+no third-party dependencies, matching the zero-install environment.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from ..models.store import KINDS, StaleResourceVersion
+from .service import InvalidSchedulerConfiguration, SimulatorService
+
+# kind → (watch wire name, lastResourceVersion query param); reference
+# resourcewatcher.go:22-30 + handler/watcher.go:27-34 (note the singular
+# "namespaceLastResourceVersion").
+WATCH_KINDS = {
+    "pods": ("pods", "podsLastResourceVersion"),
+    "nodes": ("nodes", "nodesLastResourceVersion"),
+    "pvs": ("persistentvolumes", "pvsLastResourceVersion"),
+    "pvcs": ("persistentvolumeclaims", "pvcsLastResourceVersion"),
+    "storageclasses": ("storageclasses", "scsLastResourceVersion"),
+    "priorityclasses": ("priorityclasses", "pcsLastResourceVersion"),
+    "namespaces": ("namespaces", "namespaceLastResourceVersion"),
+}
+
+
+class SimulatorServer:
+    """Owns the HTTP server thread over one `SimulatorService`."""
+
+    def __init__(
+        self,
+        service: "SimulatorService | None" = None,
+        host: str = "127.0.0.1",
+        port: int = 1212,
+        auto_schedule: bool = False,
+        extender_service=None,
+        cors_allowed_origins: "list[str] | None" = None,
+    ):
+        self.service = service or SimulatorService()
+        self.auto_schedule = auto_schedule
+        self.extender_service = extender_service
+        self.cors_allowed_origins = cors_allowed_origins or []
+        handler = _make_handler(self)
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.httpd.daemon_threads = True
+        self._thread: "threading.Thread | None" = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def shutdown(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def maybe_schedule(self):
+        if self.auto_schedule:
+            self.service.scheduler.schedule()
+
+
+def _make_handler(server: SimulatorServer):
+    service = server.service
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        # -- plumbing -------------------------------------------------------
+
+        def log_message(self, fmt, *args):  # quiet by default
+            pass
+
+        def _cors_headers(self):
+            """CORS per the configured allowlist (reference: echo CORS
+            middleware fed by CORS_ALLOWED_ORIGIN_LIST, server.go:29-32)."""
+            origin = self.headers.get("Origin")
+            if origin and origin in server.cors_allowed_origins:
+                self.send_header("Access-Control-Allow-Origin", origin)
+                self.send_header("Access-Control-Allow-Credentials", "true")
+
+        def _json(self, code: int, payload=None):
+            body = b"" if payload is None else json.dumps(payload).encode()
+            self.send_response(code)
+            self._cors_headers()
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            if body:
+                self.wfile.write(body)
+
+        def _error(self, code: int, msg: str):
+            self._json(code, {"message": msg})
+
+        def _body(self):
+            length = int(self.headers.get("Content-Length") or 0)
+            raw = self.rfile.read(length) if length else b""
+            return json.loads(raw) if raw else None
+
+        # -- dispatch -------------------------------------------------------
+
+        def do_GET(self):  # noqa: N802 (stdlib casing)
+            self._route("GET")
+
+        def do_POST(self):  # noqa: N802
+            self._route("POST")
+
+        def do_PUT(self):  # noqa: N802
+            self._route("PUT")
+
+        def do_DELETE(self):  # noqa: N802
+            self._route("DELETE")
+
+        def do_OPTIONS(self):  # noqa: N802 — CORS preflight
+            self.send_response(204)
+            self._cors_headers()
+            self.send_header(
+                "Access-Control-Allow-Methods", "GET, POST, PUT, DELETE"
+            )
+            self.send_header("Access-Control-Allow-Headers", "Content-Type")
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def _route(self, method: str):
+            url = urlparse(self.path)
+            parts = [p for p in url.path.split("/") if p]
+            try:
+                if parts[:2] != ["api", "v1"]:
+                    return self._error(404, "not found")
+                rest = parts[2:]
+                if rest == ["schedulerconfiguration"]:
+                    return self._scheduler_config(method)
+                if rest == ["reset"] and method == "PUT":
+                    service.reset()
+                    return self._json(202)
+                if rest == ["export"] and method == "GET":
+                    return self._json(200, service.export())
+                if rest == ["import"] and method == "POST":
+                    errs = service.import_(self._body() or {})
+                    server.maybe_schedule()
+                    return self._json(200, {"errors": errs})
+                if rest == ["listwatchresources"] and method == "GET":
+                    return self._list_watch(parse_qs(url.query))
+                if rest == ["schedule"] and method == "POST":
+                    results = service.scheduler.schedule()
+                    return self._json(
+                        200,
+                        {
+                            "scheduled": sum(
+                                1 for r in results if r.status == "Scheduled"
+                            ),
+                            "results": [
+                                {
+                                    "namespace": r.pod_namespace,
+                                    "name": r.pod_name,
+                                    "status": r.status,
+                                    "selectedNode": r.selected_node,
+                                }
+                                for r in results
+                            ],
+                        },
+                    )
+                if rest and rest[0] == "extender":
+                    return self._extender(method, rest[1:])
+                if rest and rest[0] == "resources":
+                    return self._resources(method, rest[1:], parse_qs(url.query))
+                return self._error(404, "not found")
+            except BrokenPipeError:
+                raise
+            except InvalidSchedulerConfiguration as e:
+                return self._error(500, str(e))
+            except Exception as e:  # noqa: BLE001 — boundary
+                return self._error(500, f"{type(e).__name__}: {e}")
+
+        # -- handlers -------------------------------------------------------
+
+        def _scheduler_config(self, method: str):
+            if method == "GET":
+                return self._json(200, service.scheduler.get_config())
+            if method == "POST":
+                # only .profiles (+ .extenders) are honored, reference
+                # convertConfigurationForSimulator semantics (config parse
+                # enforces this downstream)
+                service.scheduler.restart(self._body() or {})
+                return self._json(202)
+            return self._error(405, "method not allowed")
+
+        def _resources(self, method: str, rest: list[str], q: dict):
+            if not rest or rest[0] not in KINDS:
+                return self._error(404, f"unknown kind {rest[:1]}")
+            kind = rest[0]
+            if len(rest) == 1:
+                if method == "GET":
+                    return self._json(200, {"items": service.store.list(kind)})
+                if method in ("POST", "PUT"):
+                    obj = service.store.apply(kind, self._body() or {})
+                    server.maybe_schedule()
+                    return self._json(201, obj)
+            else:
+                if len(rest) == 3:
+                    namespace, name = rest[1], rest[2]
+                elif len(rest) == 2:
+                    namespace, name = "default", rest[1]
+                else:
+                    return self._error(404, "bad resource path")
+                if method == "GET":
+                    obj = service.store.get(kind, name, namespace)
+                    if obj is None:
+                        return self._error(404, "not found")
+                    return self._json(200, obj)
+                if method == "DELETE":
+                    ok = service.store.delete(kind, name, namespace)
+                    if not ok:
+                        return self._error(404, "not found")
+                    server.maybe_schedule()
+                    return self._json(200)
+            return self._error(405, "method not allowed")
+
+        def _extender(self, method: str, rest: list[str]):
+            ext = server.extender_service or service.scheduler.extender_service
+            if method != "POST" or len(rest) != 2:
+                return self._error(404, "bad extender path")
+            verb, id_str = rest
+            out = ext.handle(verb, int(id_str), self._body())
+            return self._json(200, out)
+
+        # -- watch stream ---------------------------------------------------
+
+        def _list_watch(self, q: dict):
+            store = service.store
+            # validate every lastResourceVersion BEFORE the 200/chunked
+            # headers go out — past that point errors can't be reported
+            last_rvs: dict[str, "int | None"] = {}
+            for kind, (_, param) in WATCH_KINDS.items():
+                raw = q.get(param, [None])[0]
+                if raw is None:
+                    last_rvs[kind] = None
+                    continue
+                try:
+                    last_rvs[kind] = int(raw)
+                except ValueError:
+                    return self._error(400, f"bad {param}: {raw!r}")
+                # a version older than the retained log cannot be resumed
+                # (deletions in the gap would be lost): 410 Gone, client
+                # relists from scratch — the reference apiserver behavior
+                try:
+                    store.events_since(kind, last_rvs[kind])
+                except StaleResourceVersion as e:
+                    return self._error(410, str(e))
+            events: "queue.Queue" = queue.Queue()
+            store.subscribe(events.put)
+            try:
+                self.send_response(200)
+                self._cors_headers()
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def push(ev):
+                    wire, _ = WATCH_KINDS[ev.kind]
+                    data = (
+                        json.dumps(
+                            {
+                                "Kind": wire,
+                                "EventType": ev.event_type,
+                                "Obj": ev.obj,
+                            }
+                        ).encode()
+                        + b"\n"  # one JSON object per line
+                    )
+                    self.wfile.write(b"%x\r\n%s\r\n" % (len(data), data))
+                    self.wfile.flush()
+
+                # initial replay per kind: events since the client's last
+                # seen version, or the full list as ADDED (reference
+                # doListAndWatch, resourcewatcher.go:94-120)
+                seen: dict[str, int] = {}
+                for kind, (wire, param) in WATCH_KINDS.items():
+                    last = last_rvs[kind]
+                    if last is not None:
+                        try:
+                            replay = store.events_since(kind, last)
+                        except StaleResourceVersion:
+                            # pruned between validation and here: nothing
+                            # safe to send — drop the stream so the client
+                            # reconnects and gets the 410
+                            return
+                    else:
+                        replay = store.list_as_added(kind)
+                    for ev in replay:
+                        push(ev)
+                        seen[kind] = max(seen.get(kind, 0), ev.resource_version)
+                # live stream until the client disconnects; events that
+                # raced into the queue during replay are deduped by rv.
+                # An idle stream sends a blank-line heartbeat every ~15s so
+                # a vanished client is detected and the handler thread +
+                # subscription are reclaimed (consumers skip blank lines).
+                idle = 0
+                while True:
+                    try:
+                        ev = events.get(timeout=1.0)
+                    except queue.Empty:
+                        idle += 1
+                        if idle >= 15:
+                            idle = 0
+                            self.wfile.write(b"1\r\n\n\r\n")
+                            self.wfile.flush()
+                        continue
+                    idle = 0
+                    if ev.kind not in WATCH_KINDS:
+                        continue  # workload kinds are stored, not watched
+                    if ev.resource_version <= seen.get(ev.kind, 0):
+                        continue
+                    push(ev)
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+            finally:
+                store.unsubscribe(events.put)
+
+    return Handler

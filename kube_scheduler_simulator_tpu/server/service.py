@@ -1,0 +1,240 @@
+"""Simulator services: scheduler lifecycle + scheduling passes + the
+export/import/reset composites over one `ResourceStore`.
+
+This is the analogue of the reference's DI-wired service layer
+(simulator/server/di/di.go:44-78) collapsed to plain constructors:
+
+  * `SchedulerService` owns the scheduler lifecycle — current
+    KubeSchedulerConfiguration, restart-with-new-config with rollback on a
+    config the engine cannot run (reference:
+    simulator/scheduler/scheduler.go:70-91), and the batched scheduling
+    pass itself.
+  * Scheduling results are written straight back onto the pod objects in
+    the store — `spec.nodeName` plus the 13 result annotations — replacing
+    the reference's informer-hooked store reflector
+    (simulator/scheduler/storereflector/storereflector.go:54-119): the
+    batched engine's outputs ARE the record, so there is no informer race
+    and no conflict-retry loop.
+  * Preemption victims are deleted from the store, mirroring the upstream
+    scheduler's API-delete of victims.
+  * `SimulatorService` composes store + scheduler with export / import /
+    reset (reference: simulator/export/export.go:187-263,
+    simulator/reset/reset.go:57-84).
+
+Divergence (documented): the reference scheduler is a long-running loop
+that drains a watch-fed queue one pod at a time; here a scheduling pass is
+an explicit, synchronous batch (`schedule()`), optionally auto-triggered
+after imports/CRUD by the HTTP layer. One pass schedules every pending pod
+in PrioritySort order with identical placement semantics.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..engine import TPU32, BatchedScheduler, encode_cluster
+from ..engine.engine import unsupported_plugins
+from ..models.snapshot import export_snapshot, import_snapshot
+from ..models.store import ResourceStore
+from ..sched.config import SchedulerConfiguration
+from ..sched.extender import ExtenderService
+from ..sched.results import PodSchedulingResult
+
+
+class InvalidSchedulerConfiguration(ValueError):
+    pass
+
+
+def _pow2(n: int, lo: int = 8) -> int:
+    """Pad capacities to powers of two so repeated passes over a growing
+    cluster reuse XLA compilations instead of recompiling per size."""
+    c = lo
+    while c < n:
+        c *= 2
+    return c
+
+
+class SchedulerService:
+    """Scheduler lifecycle + batched scheduling passes."""
+
+    def __init__(
+        self,
+        store: ResourceStore,
+        initial_config: "SchedulerConfiguration | None" = None,
+    ):
+        self.store = store
+        self._initial = initial_config or SchedulerConfiguration.default()
+        self._config = self._initial
+        self._lock = threading.Lock()
+        # whole-pass serialization + one-slot compiled-engine cache
+        # (signature → BatchedScheduler; see BatchedScheduler.retarget)
+        self._schedule_lock = threading.Lock()
+        self._engine_cache: "tuple[tuple, BatchedScheduler] | None" = None
+        self._extender_engine_cache: "tuple[tuple, object] | None" = None
+        self.extender_service = ExtenderService(self._config.extenders)
+
+    # -- configuration lifecycle -------------------------------------------
+
+    @property
+    def config(self) -> SchedulerConfiguration:
+        return self._config
+
+    def get_config(self) -> dict:
+        return self._config.to_dict()
+
+    def restart(self, new_config: "dict | SchedulerConfiguration") -> None:
+        """Swap in a new configuration; on an unusable one, keep the old
+        (reference RestartScheduler rolls back to oldSchedulerCfg,
+        scheduler.go:70-87)."""
+        if not isinstance(new_config, SchedulerConfiguration):
+            new_config = SchedulerConfiguration.from_dict(new_config)
+        missing = unsupported_plugins(new_config)
+        if missing:
+            raise InvalidSchedulerConfiguration(
+                f"no kernel for enabled plugins: {missing}"
+            )
+        with self._lock:
+            self._config = new_config
+            self.extender_service = ExtenderService(new_config.extenders)
+
+    def reset(self) -> None:
+        """Restore the boot-time configuration (reference
+        ResetScheduler, scheduler.go:89-91)."""
+        with self._lock:
+            self._config = self._initial
+            self.extender_service = ExtenderService(self._initial.extenders)
+
+    # -- scheduling ---------------------------------------------------------
+
+    def schedule(self) -> list[PodSchedulingResult]:
+        """One batched scheduling pass over the store's current state.
+
+        Encodes the cluster, runs the engine, writes `spec.nodeName` and
+        the 13 result annotations back onto pod objects, and deletes
+        preemption victims. Returns the per-pod records. Passes are
+        serialized — concurrent HTTP triggers queue up rather than
+        interleaving their write-backs.
+        """
+        with self._schedule_lock:
+            return self._schedule_locked()
+
+    def _schedule_locked(self) -> list[PodSchedulingResult]:
+        with self._lock:
+            config = self._config
+        nodes = self.store.list("nodes")
+        pods = self.store.list("pods")
+        if not nodes or not pods:
+            return []
+        pending = [
+            p for p in pods if not (p.get("spec", {}) or {}).get("nodeName")
+        ]
+        if not pending:
+            return []
+        enc = encode_cluster(
+            nodes,
+            pods,
+            config,
+            policy=TPU32,
+            priorityclasses=self.store.list("priorityclasses"),
+            namespaces=self.store.list("namespaces"),
+            pvcs=self.store.list("pvcs"),
+            pvs=self.store.list("pvs"),
+            storageclasses=self.store.list("storageclasses"),
+            node_capacity=_pow2(len(nodes)),
+            pod_capacity=_pow2(len(pods)),
+        )
+        if config.extenders:
+            # host-callback loop: device segments + extender HTTP calls,
+            # with the same compiled-program reuse as the batch path
+            from ..engine.extender_loop import ExtenderScheduler
+
+            sig = BatchedScheduler.compile_signature(enc)
+            cache = self._extender_engine_cache
+            if cache and cache[0] == sig:
+                ext_sched = cache[1].retarget(enc, self.extender_service)
+            else:
+                ext_sched = ExtenderScheduler(enc, self.extender_service)
+                self._extender_engine_cache = (sig, ext_sched)
+            results = ext_sched.run()
+            placements = ext_sched.placements()
+            final_assignment = ext_sched.final_state.assignment
+        else:
+            # reuse the previous pass's compiled program when the encoding
+            # is compile-compatible (same padded shapes + baked statics)
+            sig = BatchedScheduler.compile_signature(enc)
+            if self._engine_cache and self._engine_cache[0] == sig:
+                sched = self._engine_cache[1].retarget(enc)
+            else:
+                sched = BatchedScheduler(enc, record=True, strict=True)
+                self._engine_cache = (sig, sched)
+            sched.run()
+            results = sched.results()
+            placements = sched.placements()
+            final_assignment = sched._final_state.assignment
+
+        # preemption victims: pre-bound pods that lost their node (upstream
+        # preemption deletes victims through the API)
+        import numpy as np
+
+        before = np.asarray(enc.state0.assignment)
+        after = np.asarray(final_assignment)
+        for p_idx in np.nonzero((before >= 0) & (after < 0))[0]:
+            ns, name = enc.pod_keys[int(p_idx)]
+            self.store.delete("pods", name, ns)
+
+        # write results back onto the pod objects (last record per pod wins
+        # — a nominated pod's retry attempt overwrites its first record,
+        # like the reference's sequential annotation updates)
+        for res in results:
+            annotations = res.to_annotations()
+            annotations.update(
+                self.extender_service.annotations_for(
+                    res.pod_namespace, res.pod_name
+                )
+            )
+            patch: dict = {
+                "metadata": {
+                    "name": res.pod_name,
+                    "namespace": res.pod_namespace,
+                    "annotations": annotations,
+                }
+            }
+            sel = placements.get((res.pod_namespace, res.pod_name), "")
+            if sel:
+                patch["spec"] = {"nodeName": sel}
+            if self.store.get("pods", res.pod_name, res.pod_namespace) is not None:
+                self.store.apply("pods", patch)
+            # flushed results are purged, like the reference reflector's
+            # DeleteData after AddStoredResultToPod (storereflector.go:70-119)
+            self.extender_service.delete_data(res.pod_namespace, res.pod_name)
+        return results
+
+
+class SimulatorService:
+    """Store + scheduler + snapshot composites (the DI container analogue)."""
+
+    def __init__(
+        self, initial_config: "SchedulerConfiguration | None" = None
+    ):
+        self.store = ResourceStore()
+        self.scheduler = SchedulerService(self.store, initial_config)
+        self.store.snapshot_initial()
+
+    # -- export / import / reset -------------------------------------------
+
+    def export(self) -> dict:
+        return export_snapshot(self.store, self.scheduler.get_config())
+
+    def import_(self, snapshot: dict, ignore_err: bool = False) -> list[str]:
+        """Restart the scheduler with the imported config (unless absent),
+        then apply resources in dependency order (reference
+        export.go:246-263 Import)."""
+        cfg = snapshot.get("schedulerConfig")
+        if cfg:
+            self.scheduler.restart(cfg)
+        _, errors = import_snapshot(self.store, snapshot, ignore_err=ignore_err)
+        return errors
+
+    def reset(self) -> None:
+        self.store.reset()
+        self.scheduler.reset()

@@ -1,0 +1,199 @@
+"""Controller step functions: deterministic reconcile over the store
+(reference: the kube-controller-manager subset,
+simulator/controller/controller.go:77-86)."""
+
+import pytest
+
+from kube_scheduler_simulator_tpu.controllers import (
+    deployment_controller_step,
+    pv_controller_step,
+    replicaset_controller_step,
+    run_to_fixpoint,
+)
+from kube_scheduler_simulator_tpu.models import ResourceStore
+
+
+def deployment(name, replicas, labels=None, cpu="100m", ns="default"):
+    labels = labels or {"app": name}
+    return {
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {
+            "replicas": replicas,
+            "selector": {"matchLabels": labels},
+            "template": {
+                "metadata": {"labels": labels},
+                "spec": {
+                    "containers": [
+                        {"name": "c", "resources": {"requests": {"cpu": cpu}}}
+                    ]
+                },
+            },
+        },
+    }
+
+
+def pvc(name, storage="1Gi", sc=""):
+    return {
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "storageClassName": sc,
+            "resources": {"requests": {"storage": storage}},
+        },
+    }
+
+
+def pv(name, capacity="1Gi", sc=""):
+    return {
+        "metadata": {"name": name},
+        "spec": {
+            "storageClassName": sc,
+            "capacity": {"storage": capacity},
+            "accessModes": ["ReadWriteOnce"],
+        },
+    }
+
+
+class TestDeploymentReplicaSet:
+    def test_expansion_to_pods(self):
+        store = ResourceStore()
+        store.apply("deployments", deployment("web", 3))
+        rounds = run_to_fixpoint(store)
+        assert rounds >= 2
+        rses = store.list("replicasets")
+        assert len(rses) == 1
+        assert rses[0]["spec"]["replicas"] == 3
+        pods = sorted(p["metadata"]["name"] for p in store.list("pods"))
+        rs_name = rses[0]["metadata"]["name"]
+        assert pods == [f"{rs_name}-{i}" for i in range(3)]
+        # template labels propagate to pods
+        assert all(
+            p["metadata"]["labels"] == {"app": "web"} for p in store.list("pods")
+        )
+
+    def test_scale_down_deletes_highest_ordinals(self):
+        store = ResourceStore()
+        store.apply("deployments", deployment("web", 4))
+        run_to_fixpoint(store)
+        store.apply(
+            "deployments",
+            {"metadata": {"name": "web", "namespace": "default"},
+             "spec": {"replicas": 2}},
+        )
+        run_to_fixpoint(store)
+        pods = sorted(p["metadata"]["name"] for p in store.list("pods"))
+        rs_name = store.list("replicasets")[0]["metadata"]["name"]
+        assert pods == [f"{rs_name}-0", f"{rs_name}-1"]
+
+    def test_template_change_replaces_replicaset(self):
+        store = ResourceStore()
+        store.apply("deployments", deployment("web", 2, cpu="100m"))
+        run_to_fixpoint(store)
+        old_rs = store.list("replicasets")[0]["metadata"]["name"]
+        store.apply("deployments", deployment("web", 2, cpu="200m"))
+        run_to_fixpoint(store)
+        rses = store.list("replicasets")
+        assert len(rses) == 1 and rses[0]["metadata"]["name"] != old_rs
+        for p in store.list("pods"):
+            req = p["spec"]["containers"][0]["resources"]["requests"]
+            assert req["cpu"] == "200m"
+
+    def test_determinism_two_runs_identical(self):
+        def run():
+            store = ResourceStore()
+            store.apply("deployments", deployment("a", 3))
+            store.apply("deployments", deployment("b", 2))
+            run_to_fixpoint(store)
+            return sorted(
+                (p["metadata"]["name"],
+                 tuple(sorted(p["metadata"].get("labels", {}).items())))
+                for p in store.list("pods")
+            )
+
+        assert run() == run()
+
+
+class TestPVController:
+    def test_binds_smallest_adequate(self):
+        store = ResourceStore()
+        store.apply("pvs", pv("big", "10Gi"))
+        store.apply("pvs", pv("small", "2Gi"))
+        store.apply("pvcs", pvc("claim", "1Gi"))
+        assert pv_controller_step(store) is True
+        got_pvc = store.get("pvcs", "claim")
+        assert got_pvc["spec"]["volumeName"] == "small"
+        assert got_pvc["status"]["phase"] == "Bound"
+        got_pv = store.get("pvs", "small")
+        assert got_pv["spec"]["claimRef"]["name"] == "claim"
+        assert got_pv["status"]["phase"] == "Bound"
+        # second round: nothing left to do
+        assert pv_controller_step(store) is False
+
+    def test_two_claims_do_not_share_a_pv(self):
+        store = ResourceStore()
+        store.apply("pvs", pv("only", "5Gi"))
+        store.apply("pvcs", pvc("c1", "1Gi"))
+        store.apply("pvcs", pvc("c2", "1Gi"))
+        pv_controller_step(store)
+        bound = [
+            store.get("pvcs", n)["spec"].get("volumeName") for n in ("c1", "c2")
+        ]
+        assert sorted(b or "" for b in bound) == ["", "only"]
+
+    def test_statically_prebound_pv_not_double_bound(self):
+        store = ResourceStore()
+        store.apply("pvs", pv("only", "5Gi"))
+        # claim-a statically pre-binds 'only' via volumeName (no claimRef)
+        a = pvc("a", "1Gi")
+        a["spec"]["volumeName"] = "only"
+        store.apply("pvcs", a)
+        store.apply("pvcs", pvc("b", "1Gi"))
+        pv_controller_step(store)
+        assert "volumeName" not in store.get("pvcs", "b")["spec"]
+        assert "claimRef" not in store.get("pvs", "only")["spec"]
+
+    def test_storage_class_must_match(self):
+        store = ResourceStore()
+        store.apply("pvs", pv("fast", "5Gi", sc="ssd"))
+        store.apply("pvcs", pvc("claim", "1Gi", sc="hdd"))
+        assert pv_controller_step(store) is False
+        assert "volumeName" not in store.get("pvcs", "claim")["spec"]
+
+
+class TestOrdinalCollision:
+    def test_unrelated_pod_not_adopted(self):
+        store = ResourceStore()
+        store.apply(
+            "pods",
+            {"metadata": {"name": "web-0", "namespace": "default"},
+             "spec": {"containers": [{"name": "mine"}]}},
+        )
+        store.apply(
+            "replicasets",
+            {
+                "metadata": {"name": "web", "namespace": "default"},
+                "spec": {
+                    "replicas": 1,
+                    "template": {"spec": {"containers": [{"name": "rs-c"}]}},
+                },
+            },
+        )
+        replicaset_controller_step(store)
+        # the user's pod is untouched; the RS takes the next ordinal
+        mine = store.get("pods", "web-0")
+        assert mine["spec"]["containers"][0]["name"] == "mine"
+        assert "ownerReferences" not in mine["metadata"]
+        assert store.get("pods", "web-1") is not None
+
+
+class TestFixpoint:
+    def test_diverging_controller_raises(self):
+        store = ResourceStore()
+        counter = {"n": 0}
+
+        def restless(_):
+            counter["n"] += 1
+            return True
+
+        with pytest.raises(RuntimeError):
+            run_to_fixpoint(store, controllers=(restless,), max_rounds=5)
+        assert counter["n"] == 5

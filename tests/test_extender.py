@@ -1,0 +1,241 @@
+"""Extender proxy: HTTP client, result recording, config URL rewrite, and
+the host-callback scheduling loop against a live test extender server
+(reference: simulator/scheduler/extender/)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kube_scheduler_simulator_tpu.models import ResourceStore
+from kube_scheduler_simulator_tpu.sched.config import SchedulerConfiguration
+from kube_scheduler_simulator_tpu.sched.extender import (
+    ExtenderService,
+    override_extenders_for_simulator,
+)
+from kube_scheduler_simulator_tpu.server.service import SchedulerService
+
+from helpers import node, pod
+
+
+class _TestExtender(BaseHTTPRequestHandler):
+    """A user extender: filter rejects nodes named in `banned`; prioritize
+    gives `favored` score 10 (max) and everyone else 0."""
+
+    banned: set = set()
+    favored: str = ""
+    calls: list = []
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length") or 0)
+        args = json.loads(self.rfile.read(length)) if length else {}
+        type(self).calls.append((self.path, args))
+        if self.path.endswith("/filter"):
+            names = args.get("NodeNames") or [
+                (n.get("metadata") or {}).get("name")
+                for n in (args.get("Nodes") or {}).get("items", [])
+            ]
+            kept = [n for n in names if n not in self.banned]
+            failed = {n: "banned by test extender" for n in names if n in self.banned}
+            out = {"NodeNames": kept, "FailedNodes": failed}
+        elif self.path.endswith("/prioritize"):
+            names = args.get("NodeNames") or [
+                (n.get("metadata") or {}).get("name")
+                for n in (args.get("Nodes") or {}).get("items", [])
+            ]
+            out = [
+                {"Host": n, "Score": 10 if n == self.favored else 0}
+                for n in names
+            ]
+        elif self.path.endswith("/bind"):
+            out = {}
+        else:
+            out = {}
+        body = json.dumps(out).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture()
+def extender_server():
+    _TestExtender.banned = set()
+    _TestExtender.favored = ""
+    _TestExtender.calls = []
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _TestExtender)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def extender_config(url, *, node_cache=True, weight=1):
+    return SchedulerConfiguration.from_dict(
+        {
+            "profiles": [{"schedulerName": "default-scheduler"}],
+            "extenders": [
+                {
+                    "urlPrefix": url,
+                    "filterVerb": "filter",
+                    "prioritizeVerb": "prioritize",
+                    "weight": weight,
+                    "nodeCacheCapable": node_cache,
+                }
+            ],
+        }
+    )
+
+
+class TestExtenderScheduling:
+    def test_filter_and_prioritize_shape_placement(self, extender_server):
+        _TestExtender.banned = {"n0"}
+        _TestExtender.favored = "n2"
+        store = ResourceStore()
+        for i in range(3):
+            store.apply("nodes", node(f"n{i}"))
+        store.apply("pods", pod("w"))
+        svc = SchedulerService(store, extender_config(extender_server))
+        results = svc.schedule()
+        assert len(results) == 1
+        # n0 banned by extender filter; n2 favored by prioritize
+        assert results[0].selected_node == "n2"
+        # extender results recorded onto the pod annotations
+        got = store.get("pods", "w")
+        fr = json.loads(
+            got["metadata"]["annotations"][
+                "scheduler-simulator/extender-filter-result"
+            ]
+        )
+        assert extender_server in fr
+        assert fr[extender_server]["FailedNodes"] == {
+            "n0": "banned by test extender"
+        }
+        pr = json.loads(
+            got["metadata"]["annotations"][
+                "scheduler-simulator/extender-prioritize-result"
+            ]
+        )
+        # weight 1 x (100/10) rescale: favored host scores 100
+        assert {h["Host"]: h["Score"] for h in pr[extender_server]}["n2"] == 100
+
+    def test_all_nodes_banned_is_unschedulable(self, extender_server):
+        _TestExtender.banned = {"n0", "n1"}
+        store = ResourceStore()
+        store.apply("nodes", node("n0"))
+        store.apply("nodes", node("n1"))
+        store.apply("pods", pod("w"))
+        svc = SchedulerService(store, extender_config(extender_server))
+        results = svc.schedule()
+        assert results[0].status == "Unschedulable"
+        assert "nodeName" not in store.get("pods", "w")["spec"]
+
+    def test_non_cache_capable_gets_full_nodes(self, extender_server):
+        _TestExtender.favored = "n1"
+        store = ResourceStore()
+        store.apply("nodes", node("n0"))
+        store.apply("nodes", node("n1"))
+        store.apply("pods", pod("w"))
+        svc = SchedulerService(
+            store, extender_config(extender_server, node_cache=False)
+        )
+        svc.schedule()
+        filter_calls = [a for p, a in _TestExtender.calls if p.endswith("/filter")]
+        assert filter_calls and "Nodes" in filter_calls[0]
+        items = filter_calls[0]["Nodes"]["items"]
+        assert {n["metadata"]["name"] for n in items} == {"n0", "n1"}
+        assert "status" in items[0]  # full objects, not names
+
+    def test_sequential_state_carries_between_pods(self, extender_server):
+        # two big pods: second must land on the other node (bind_fn state)
+        store = ResourceStore()
+        store.apply("nodes", node("n0", cpu="1"))
+        store.apply("nodes", node("n1", cpu="1"))
+        store.apply("pods", pod("a", cpu="800m"))
+        store.apply("pods", pod("b", cpu="800m"))
+        svc = SchedulerService(store, extender_config(extender_server))
+        results = svc.schedule()
+        sel = {r.pod_name: r.selected_node for r in results}
+        assert sorted(sel.values()) == ["n0", "n1"]
+
+
+class TestExtenderServiceUnit:
+    def test_unknown_verb_and_id(self, extender_server):
+        svc = ExtenderService([{"urlPrefix": extender_server,
+                                "filterVerb": "filter"}])
+        with pytest.raises(Exception):
+            svc.handle("frobnicate", 0, {})
+        with pytest.raises(Exception):
+            svc.handle("filter", 7, {})
+
+    def test_managed_resources_gating(self):
+        from kube_scheduler_simulator_tpu.sched.extender import Extender
+
+        ext = Extender(
+            {"urlPrefix": "http://x", "managedResources": [{"name": "foo.com/gpu"}]}
+        )
+        assert not ext.is_interested(pod("plain"))
+        gpu_pod = pod("gpu")
+        gpu_pod["spec"]["containers"][0]["resources"]["requests"][
+            "foo.com/gpu"
+        ] = "1"
+        assert ext.is_interested(gpu_pod)
+
+    def test_config_rewrite(self):
+        cfg = {
+            "extenders": [
+                {
+                    "urlPrefix": "https://user.example/sched",
+                    "filterVerb": "filter",
+                    "bindVerb": "bind",
+                    "enableHTTPS": True,
+                    "tlsConfig": {"insecure": True},
+                },
+                {"urlPrefix": "http://other/", "prioritizeVerb": "rank"},
+            ]
+        }
+        out = override_extenders_for_simulator(cfg, 1212)
+        e0, e1 = out["extenders"]
+        assert e0["urlPrefix"] == "http://localhost:1212/api/v1/extender/"
+        assert e0["filterVerb"] == "filter/0"
+        assert e0["bindVerb"] == "bind/0"
+        assert e0["enableHTTPS"] is False and "tlsConfig" not in e0
+        assert e1["prioritizeVerb"] == "prioritize/1"
+        assert "filterVerb" not in e1
+
+
+class TestExtenderProxyRoute:
+    def test_proxy_forwards_and_records(self, extender_server):
+        import urllib.request
+
+        from kube_scheduler_simulator_tpu.server import (
+            SimulatorServer,
+            SimulatorService,
+        )
+
+        _TestExtender.banned = {"nope"}
+        sim = SimulatorService(extender_config(extender_server))
+        srv = SimulatorServer(sim, port=0).start()
+        try:
+            args = {
+                "Pod": pod("w"),
+                "NodeNames": ["ok", "nope"],
+            }
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/api/v1/extender/filter/0",
+                data=json.dumps(args).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                out = json.loads(resp.read())
+            assert out["NodeNames"] == ["ok"]
+            ann = sim.scheduler.extender_service.annotations_for("default", "w")
+            assert "scheduler-simulator/extender-filter-result" in ann
+        finally:
+            srv.shutdown()

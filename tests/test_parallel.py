@@ -1,0 +1,135 @@
+"""parallel/: mesh build, node-axis sharding registry, weight sweeps on
+the 8-device virtual CPU mesh (conftest.py forces the platform)."""
+
+import jax
+import numpy as np
+import pytest
+
+from kube_scheduler_simulator_tpu.engine import TPU32, BatchedScheduler, encode_cluster
+from kube_scheduler_simulator_tpu.engine.engine import supported_config
+from kube_scheduler_simulator_tpu.parallel import (
+    NODE_AXIS_FIELDS,
+    WeightSweep,
+    build_mesh,
+    shard_encoded,
+    weights_for,
+)
+from kube_scheduler_simulator_tpu.synth import synthetic_cluster
+
+from helpers import node, pod
+
+
+def _leaf_fields(obj, out):
+    for name in obj.__dataclass_fields__:
+        leaf = getattr(obj, name)
+        if hasattr(leaf, "__dataclass_fields__"):
+            _leaf_fields(leaf, out)
+        else:
+            out[name] = leaf
+    return out
+
+
+class TestNodeAxisRegistry:
+    def test_registry_complete_and_exact(self):
+        """Every array whose axis 0 is the node axis must be registered —
+        and nothing else. Uses a node count (37) no other dimension hits."""
+        N = 37
+        nodes, pods = synthetic_cluster(N, 5, seed=1)
+        enc = encode_cluster(nodes, pods, supported_config(), policy=TPU32)
+        fields = {}
+        _leaf_fields(enc.arrays, fields)
+        _leaf_fields(enc.state0, fields)
+        node_axis = {
+            name
+            for name, leaf in fields.items()
+            if getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] == N
+        }
+        assert node_axis == set(NODE_AXIS_FIELDS) & node_axis
+        missing = node_axis - NODE_AXIS_FIELDS
+        assert not missing, f"unregistered node-axis fields: {missing}"
+        phantom = {
+            f
+            for f in NODE_AXIS_FIELDS
+            if f in fields and fields[f].shape[0] != N
+        }
+        assert not phantom, f"registered non-node-axis fields: {phantom}"
+
+
+class TestMesh:
+    def test_default_factorization(self):
+        mesh = build_mesh(8)
+        assert mesh.shape == {"replicas": 4, "nodes": 2}
+
+    def test_explicit_factors_validated(self):
+        with pytest.raises(ValueError):
+            build_mesh(8, replicas=3)
+        mesh = build_mesh(8, replicas=2, node_shards=4)
+        assert mesh.shape == {"replicas": 2, "nodes": 4}
+
+
+class TestShardEncoded:
+    def test_node_axis_divisibility_enforced(self):
+        mesh = build_mesh(8)
+        nodes, pods = synthetic_cluster(5, 4, seed=2)
+        enc = encode_cluster(nodes, pods, supported_config(), policy=TPU32)
+        with pytest.raises(ValueError):
+            shard_encoded(enc, mesh)
+
+    def test_sharded_run_matches_unsharded(self):
+        mesh = build_mesh(8)
+        nodes, pods = synthetic_cluster(16, 24, seed=3)
+        enc = encode_cluster(
+            nodes, pods, supported_config(), policy=TPU32, node_capacity=16
+        )
+        sched = BatchedScheduler(enc, record=False)
+        want_state, want_sel = jax.jit(sched.run_fn)(
+            enc.arrays, enc.state0, np.asarray(enc.queue), sched.weights
+        )
+        arrays, state0, queue = shard_encoded(enc, mesh)
+        got_state, got_sel = jax.jit(sched.run_fn)(
+            arrays, state0, queue, sched.weights
+        )
+        np.testing.assert_array_equal(np.asarray(want_sel), np.asarray(got_sel))
+        np.testing.assert_array_equal(
+            np.asarray(want_state.assignment), np.asarray(got_state.assignment)
+        )
+
+
+class TestWeightSweep:
+    def test_weights_for(self):
+        nodes, pods = synthetic_cluster(4, 4, seed=4)
+        enc = encode_cluster(nodes, pods, supported_config(), policy=TPU32)
+        w = weights_for(enc, {"TaintToleration": 9})
+        specs = dict(enc.config.score_plugins())
+        assert len(w) == len(specs)
+        with pytest.raises(KeyError):
+            weights_for(enc, {"NotAPlugin": 1})
+
+    def test_sweep_matches_sequential_runs(self):
+        nodes, pods = synthetic_cluster(8, 16, seed=5)
+        enc = encode_cluster(nodes, pods, supported_config(), policy=TPU32)
+        sweep = WeightSweep(enc)
+        base = np.asarray(sweep.sched.weights)
+        variants = np.stack([base + i for i in range(4)])
+        _, sels = sweep.run(variants)
+        assert sels.shape == (4, len(enc.queue))
+        for v in range(4):
+            sched = BatchedScheduler(enc, record=False)
+            _, want = sched.run(weights=variants[v].astype(base.dtype))
+            np.testing.assert_array_equal(np.asarray(want), np.asarray(sels)[v])
+
+    def test_mesh_sweep_all_scheduled_and_decoded(self):
+        mesh = build_mesh(8)
+        nodes, pods = synthetic_cluster(16, 24, seed=6)
+        enc = encode_cluster(
+            nodes, pods, supported_config(), policy=TPU32, node_capacity=16
+        )
+        sweep = WeightSweep(enc, mesh=mesh)
+        base = np.asarray(sweep.sched.weights)
+        variants = np.stack([base + i for i in range(8)])  # 8 % 4 reps == 0
+        _, sels = sweep.run(variants)
+        assert (np.asarray(sels) >= 0).all()
+        pl = sweep.placements(sels)
+        assert len(pl) == 8 and all(len(d) == len(enc.queue) for d in pl)
+        with pytest.raises(ValueError):
+            sweep.run(variants[:3])  # 3 % 4 != 0

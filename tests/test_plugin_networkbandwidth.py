@@ -1,0 +1,110 @@
+"""Out-of-tree plugin demo: NetworkBandwidth registers oracle + kernels +
+preemption row by import, then runs under a config that enables it."""
+
+import kube_scheduler_simulator_tpu.plugins.networkbandwidth  # noqa: F401 — registers
+
+from kube_scheduler_simulator_tpu.engine import EXACT, TPU32
+from kube_scheduler_simulator_tpu.sched.config import SchedulerConfiguration
+
+from helpers import node, pod
+from test_engine_parity import assert_parity, restricted_config
+
+
+def nb_node(name, limit=None, cpu="4"):
+    n = node(name, cpu=cpu)
+    if limit is not None:
+        n["metadata"]["annotations"] = {
+            "node.kubernetes.io/network-limit": limit
+        }
+    return n
+
+
+def nb_pod(name, ingress=None, egress=None, cpu="100m", priority=None,
+           node_name=None):
+    p = pod(name, cpu=cpu, priority=priority, node_name=node_name)
+    ann = {}
+    if ingress:
+        ann["kubernetes.io/ingress-request"] = ingress
+    if egress:
+        ann["kubernetes.io/egress-request"] = egress
+    if ann:
+        p["metadata"]["annotations"] = ann
+    return p
+
+
+def nb_config(postfilters=()):
+    cfg = restricted_config(
+        filters=("NodeUnschedulable", "NodeName", "NodeResourcesFit",
+                 "NetworkBandwidth"),
+        scores=(("NodeResourcesFit", 1), ("NetworkBandwidth", 2)),
+        prefilters=("NodeResourcesFit",),
+        prescores=("NodeResourcesFit",),
+    )
+    if postfilters:
+        d = cfg.to_dict()
+        d["profiles"][0]["plugins"]["postFilter"]["enabled"] = [
+            {"name": n} for n in postfilters
+        ]
+        return SchedulerConfiguration.from_dict(d)
+    return cfg
+
+
+class TestNetworkBandwidthParity:
+    def test_filter_capacity_and_skip(self):
+        nodes = [
+            nb_node("small", limit="100Mi"),
+            nb_node("big", limit="10Gi"),
+            nb_node("unlimited"),  # no annotation: plugin skips the node
+        ]
+        pods = [
+            nb_pod("heavy", ingress="1Gi", egress="1Gi"),
+            nb_pod("light", ingress="50Mi"),
+            nb_pod("none"),  # no request: plugin skips the pod
+        ]
+        for policy in (EXACT, TPU32):
+            got = assert_parity(nodes, pods, nb_config(), policy=policy)
+        by = {r.pod_name: r for r in got}
+        ann = by["heavy"].to_annotations()
+        assert "network bandwidth" in ann["scheduler-simulator/filter-result"]
+
+    def test_allocation_accumulates_across_binds(self):
+        nodes = [nb_node("n0", limit="1Gi"), nb_node("n1", limit="1Gi")]
+        pods = [
+            nb_pod("a", ingress="700Mi", priority=10),
+            nb_pod("b", ingress="700Mi", priority=5),
+            nb_pod("c", ingress="700Mi", priority=1),
+        ]
+        got = assert_parity(nodes, pods, nb_config())
+        by = {r.pod_name: r for r in got}
+        assert by["a"].status == "Scheduled"
+        assert by["b"].status == "Scheduled"
+        assert by["a"].selected_node != by["b"].selected_node
+        assert by["c"].status == "Unschedulable"
+
+    def test_score_prefers_headroom(self):
+        nodes = [nb_node("tight", limit="200Mi"), nb_node("roomy", limit="4Gi")]
+        pods = [nb_pod("w", ingress="100Mi")]
+        got = assert_parity(nodes, pods, nb_config())
+        assert got[0].selected_node == "roomy"
+
+    def test_preemption_over_bandwidth(self):
+        nodes = [nb_node("only", limit="1Gi")]
+        pods = [
+            nb_pod("squatter", ingress="900Mi", priority=1, node_name="only"),
+            nb_pod("urgent", ingress="900Mi", priority=100),
+        ]
+        cfg = nb_config(postfilters=("DefaultPreemption",))
+        got = assert_parity(nodes, pods, cfg)
+        assert any(r.status == "Nominated" for r in got)
+
+    def test_strict_mode_accepts_registered_plugin(self):
+        from kube_scheduler_simulator_tpu.engine import (
+            BatchedScheduler,
+            encode_cluster,
+        )
+
+        enc = encode_cluster(
+            [nb_node("n0", limit="1Gi")], [nb_pod("p", ingress="1Mi")],
+            nb_config(), policy=EXACT,
+        )
+        BatchedScheduler(enc, strict=True)  # must not raise

@@ -1,0 +1,128 @@
+"""KEP-140 scenario VM: operations at MajorStep boundaries, controllers +
+scheduler to fixpoint between them, deterministic Timeline."""
+
+from kube_scheduler_simulator_tpu.scenario import Operation, ScenarioRunner
+
+from helpers import node, pod
+from test_controllers import deployment
+
+
+def make_ops():
+    return [
+        Operation(major_step=1, create={"kind": "nodes", "object": node("n0")}),
+        Operation(major_step=1, create={"kind": "nodes", "object": node("n1")}),
+        Operation(
+            major_step=1,
+            create={"kind": "deployments", "object": deployment("web", 3)},
+        ),
+        Operation(
+            major_step=2,
+            patch={
+                "kind": "deployments",
+                "name": "web",
+                "namespace": "default",
+                "patch": {"spec": {"replicas": 1}},
+            },
+        ),
+        Operation(major_step=3, delete={"kind": "nodes", "name": "n1"}),
+        Operation(major_step=3, done=True),
+    ]
+
+
+class TestScenarioVM:
+    def test_full_lifecycle(self):
+        result = ScenarioRunner(make_ops()).run()
+        assert result.phase == "Succeeded", result.message
+        t = result.timeline
+        # step 1: 3 creates + replicaset expansion + 3 PodScheduled events
+        types1 = [e.type for e in t["1"]]
+        assert types1.count("Create") == 3
+        assert types1.count("PodScheduled") == 3
+        # minor steps strictly increase within the major step
+        minors = [e.step.minor for e in t["1"]]
+        assert minors == sorted(minors) and len(set(minors)) == len(minors)
+        # step 2: scale-down deletes pods, nothing new scheduled
+        assert not any(e.type == "PodScheduled" for e in t["2"])
+        # step 3: node delete cascades; the surviving pod count is 1
+        assert any(e.type == "Done" for e in t["3"])
+
+    def test_determinism_bit_identical(self):
+        a = ScenarioRunner(make_ops()).run().as_dict()
+        b = ScenarioRunner(make_ops()).run().as_dict()
+        # strip resourceVersions/uids? No — identical runs must produce
+        # identical versions too (same op order, same store).
+        assert a == b
+
+    def test_paused_without_done(self):
+        ops = [
+            Operation(major_step=1, create={"kind": "nodes", "object": node("n0")}),
+        ]
+        result = ScenarioRunner(ops).run()
+        assert result.phase == "Paused"
+
+    def test_failed_on_bad_delete(self):
+        ops = [
+            Operation(major_step=1, delete={"kind": "pods", "name": "ghost"}),
+        ]
+        result = ScenarioRunner(ops).run()
+        assert result.phase == "Failed"
+        assert "ghost" in result.message
+
+    def test_invalid_operation_rejected(self):
+        import pytest
+
+        op = Operation(major_step=1)
+        with pytest.raises(ValueError):
+            op.validate()
+        op2 = Operation(
+            major_step=1,
+            create={"kind": "nodes", "object": node("x")},
+            done=True,
+        )
+        with pytest.raises(ValueError):
+            op2.validate()
+
+    def test_scheduler_is_a_simulation_controller(self):
+        # pods created directly (no deployment) are scheduled in step 2
+        ops = [
+            Operation(major_step=1, create={"kind": "nodes", "object": node("n0")}),
+            Operation(major_step=2, create={"kind": "pods", "object": pod("p0")}),
+            Operation(major_step=2, done=True),
+        ]
+        result = ScenarioRunner(ops).run()
+        assert result.phase == "Succeeded"
+        sched_events = [
+            e for e in result.timeline["2"] if e.type == "PodScheduled"
+        ]
+        assert len(sched_events) == 1
+        assert sched_events[0].payload["node"] == "n0"
+
+    def test_preemption_records_delete_event(self):
+        ops = [
+            Operation(
+                major_step=1,
+                create={"kind": "nodes", "object": node("only", cpu="1")},
+            ),
+            Operation(
+                major_step=1,
+                create={
+                    "kind": "pods",
+                    "object": pod("squatter", cpu="800m", priority=1),
+                },
+            ),
+            Operation(
+                major_step=2,
+                create={
+                    "kind": "pods",
+                    "object": pod("urgent", cpu="800m", priority=100),
+                },
+            ),
+            Operation(major_step=2, done=True),
+        ]
+        result = ScenarioRunner(ops).run()
+        assert result.phase == "Succeeded", result.message
+        t2 = result.timeline["2"]
+        deletes = [e for e in t2 if e.type == "Delete"]
+        assert any(e.payload.get("name") == "squatter" for e in deletes)
+        scheduled = [e for e in t2 if e.type == "PodScheduled"]
+        assert any(e.payload["name"] == "urgent" for e in scheduled)

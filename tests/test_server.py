@@ -1,0 +1,392 @@
+"""HTTP-level tests for the serving shell: every reference route
+(simulator/server/server.go:42-57) round-trips against a live server."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from kube_scheduler_simulator_tpu.server import SimulatorServer, SimulatorService
+
+from helpers import node, pod
+
+
+def _req(port, method, path, body=None, timeout=300):
+    # generous timeout: a schedule pass may pay a fresh XLA compile, which
+    # can take minutes on a loaded CPU test machine
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            raw = resp.read()
+            return resp.status, json.loads(raw) if raw else None
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        return e.code, json.loads(raw) if raw else None
+
+
+@pytest.fixture()
+def server():
+    srv = SimulatorServer(SimulatorService(), port=0).start()
+    yield srv
+    srv.shutdown()
+
+
+class TestSchedulerConfigRoutes:
+    def test_get_returns_default(self, server):
+        code, cfg = _req(server.port, "GET", "/api/v1/schedulerconfiguration")
+        assert code == 200
+        assert cfg["profiles"][0]["schedulerName"] == "default-scheduler"
+
+    def test_post_restarts_and_get_roundtrips(self, server):
+        newcfg = {
+            "profiles": [
+                {
+                    "schedulerName": "default-scheduler",
+                    "plugins": {
+                        "score": {
+                            "disabled": [{"name": "*"}],
+                            "enabled": [{"name": "NodeResourcesFit", "weight": 5}],
+                        }
+                    },
+                }
+            ]
+        }
+        code, _ = _req(
+            server.port, "POST", "/api/v1/schedulerconfiguration", newcfg
+        )
+        assert code == 202
+        code, got = _req(server.port, "GET", "/api/v1/schedulerconfiguration")
+        assert code == 200
+        assert got["profiles"][0]["plugins"]["score"]["enabled"] == [
+            {"name": "NodeResourcesFit", "weight": 5}
+        ]
+
+    def test_post_invalid_config_rolls_back(self, server):
+        bad = {
+            "profiles": [
+                {
+                    "schedulerName": "default-scheduler",
+                    "plugins": {
+                        "filter": {
+                            "disabled": [{"name": "*"}],
+                            "enabled": [{"name": "NoSuchPlugin"}],
+                        }
+                    },
+                }
+            ]
+        }
+        code, err = _req(
+            server.port, "POST", "/api/v1/schedulerconfiguration", bad
+        )
+        assert code == 500
+        assert "NoSuchPlugin" in err["message"]
+        # old config still served (rollback, scheduler.go:70-87)
+        code, got = _req(server.port, "GET", "/api/v1/schedulerconfiguration")
+        assert code == 200
+        assert "NoSuchPlugin" not in json.dumps(got)
+
+
+class TestResourceAndScheduleRoutes:
+    def test_crud_schedule_writeback(self, server):
+        p = server.port
+        code, _ = _req(p, "PUT", "/api/v1/resources/nodes", node("n0"))
+        assert code == 201
+        code, _ = _req(p, "PUT", "/api/v1/resources/nodes", node("n1"))
+        assert code == 201
+        code, _ = _req(p, "PUT", "/api/v1/resources/pods", pod("web"))
+        assert code == 201
+
+        code, out = _req(p, "POST", "/api/v1/schedule")
+        assert code == 200
+        assert out["scheduled"] == 1
+        assert out["results"][0]["status"] == "Scheduled"
+
+        # write-back: nodeName + the 13 annotations on the pod object
+        code, got = _req(p, "GET", "/api/v1/resources/pods/default/web")
+        assert code == 200
+        assert got["spec"]["nodeName"] in ("n0", "n1")
+        ann = got["metadata"]["annotations"]
+        assert got["spec"]["nodeName"] == ann["scheduler-simulator/selected-node"]
+        filter_result = json.loads(ann["scheduler-simulator/filter-result"])
+        assert set(filter_result) == {"n0", "n1"}
+
+    def test_delete_node_cascades(self, server):
+        p = server.port
+        _req(p, "PUT", "/api/v1/resources/nodes", node("n0"))
+        _req(p, "PUT", "/api/v1/resources/pods", pod("w", node_name="n0"))
+        code, _ = _req(p, "DELETE", "/api/v1/resources/nodes/n0")
+        assert code == 200
+        code, items = _req(p, "GET", "/api/v1/resources/pods")
+        assert items["items"] == []
+
+    def test_unknown_kind_404(self, server):
+        code, _ = _req(server.port, "GET", "/api/v1/resources/gizmos")
+        assert code == 404
+
+
+class TestExportImportReset:
+    def test_export_import_roundtrip(self, server):
+        p = server.port
+        _req(p, "PUT", "/api/v1/resources/nodes", node("n0"))
+        _req(p, "PUT", "/api/v1/resources/pods", pod("w"))
+        code, snap = _req(p, "GET", "/api/v1/export")
+        assert code == 200
+        assert {n["metadata"]["name"] for n in snap["nodes"]} == {"n0"}
+        assert snap["schedulerConfig"]["profiles"]
+
+        # import into a fresh server
+        srv2 = SimulatorServer(SimulatorService(), port=0).start()
+        try:
+            code, out = _req(srv2.port, "POST", "/api/v1/import", snap)
+            assert code == 200 and out["errors"] == []
+            code, items = _req(srv2.port, "GET", "/api/v1/resources/pods")
+            assert [i["metadata"]["name"] for i in items["items"]] == ["w"]
+        finally:
+            srv2.shutdown()
+
+    def test_import_restarts_scheduler_with_snapshot_config(self, server):
+        p = server.port
+        code, snap = _req(p, "GET", "/api/v1/export")
+        snap["schedulerConfig"] = {
+            "profiles": [
+                {
+                    "schedulerName": "default-scheduler",
+                    "plugins": {
+                        "score": {
+                            "disabled": [{"name": "*"}],
+                            "enabled": [{"name": "ImageLocality", "weight": 3}],
+                        }
+                    },
+                }
+            ]
+        }
+        code, _ = _req(p, "POST", "/api/v1/import", snap)
+        assert code == 200
+        code, got = _req(p, "GET", "/api/v1/schedulerconfiguration")
+        assert got["profiles"][0]["plugins"]["score"]["enabled"] == [
+            {"name": "ImageLocality", "weight": 3}
+        ]
+
+    def test_reset_restores_boot_state_and_config(self, server):
+        p = server.port
+        _req(p, "PUT", "/api/v1/resources/nodes", node("n0"))
+        _req(
+            p,
+            "POST",
+            "/api/v1/schedulerconfiguration",
+            {
+                "profiles": [
+                    {
+                        "schedulerName": "default-scheduler",
+                        "plugins": {
+                            "score": {
+                                "disabled": [{"name": "*"}],
+                                "enabled": [{"name": "ImageLocality"}],
+                            }
+                        },
+                    }
+                ]
+            },
+        )
+        code, _ = _req(p, "PUT", "/api/v1/reset")
+        assert code == 202
+        code, items = _req(p, "GET", "/api/v1/resources/nodes")
+        assert items["items"] == []
+        code, cfg = _req(p, "GET", "/api/v1/schedulerconfiguration")
+        # boot config restored: not the single-plugin score set posted above
+        enabled = cfg["profiles"][0]["plugins"]["score"]["enabled"]
+        assert enabled != [{"name": "ImageLocality"}]
+        assert len(enabled) > 1
+
+
+class TestListWatchStream:
+    def test_list_as_added_then_live_events(self, server):
+        p = server.port
+        _req(p, "PUT", "/api/v1/resources/nodes", node("n0"))
+
+        events = []
+        done = threading.Event()
+
+        def consume():
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{p}/api/v1/listwatchresources"
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                for line in resp:
+                    if not line.strip():
+                        continue  # heartbeat
+                    events.append(json.loads(line))
+                    if len(events) >= 2:
+                        done.set()
+                        return
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        deadline = time.time() + 5
+        while not events and time.time() < deadline:
+            time.sleep(0.05)  # wait for the ADDED replay
+        _req(p, "PUT", "/api/v1/resources/pods", pod("late"))
+        assert done.wait(timeout=10)
+        assert events[0]["Kind"] == "nodes"
+        assert events[0]["EventType"] == "ADDED"
+        live = events[1]
+        assert live["Kind"] == "pods"
+        assert live["Obj"]["metadata"]["name"] == "late"
+
+    def test_last_resource_version_resumes(self, server):
+        p = server.port
+        _req(p, "PUT", "/api/v1/resources/nodes", node("n0"))
+        code, items = _req(p, "GET", "/api/v1/resources/nodes")
+        rv = items["items"][0]["metadata"]["resourceVersion"]
+        _req(p, "PUT", "/api/v1/resources/nodes", node("n1"))
+
+        got = []
+
+        def consume():
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{p}/api/v1/listwatchresources"
+                f"?nodesLastResourceVersion={rv}"
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                for line in resp:
+                    if not line.strip():
+                        continue
+                    got.append(json.loads(line))
+                    return
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        t.join(timeout=10)
+        # only n1 (created after rv) is replayed
+        assert got and got[0]["Obj"]["metadata"]["name"] == "n1"
+
+
+class TestWatchParamValidation:
+    def test_bad_last_resource_version_is_400(self, server):
+        code, err = _req(
+            server.port,
+            "GET",
+            "/api/v1/listwatchresources?podsLastResourceVersion=abc",
+        )
+        assert code == 400
+        assert "podsLastResourceVersion" in err["message"]
+
+
+class TestCORS:
+    def test_allowed_origin_headers(self):
+        srv = SimulatorServer(
+            SimulatorService(),
+            port=0,
+            cors_allowed_origins=["http://localhost:3000"],
+        ).start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/api/v1/schedulerconfiguration",
+                headers={"Origin": "http://localhost:3000"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert (
+                    resp.headers["Access-Control-Allow-Origin"]
+                    == "http://localhost:3000"
+                )
+            # disallowed origin gets no CORS header
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/api/v1/schedulerconfiguration",
+                headers={"Origin": "http://evil.example"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert resp.headers["Access-Control-Allow-Origin"] is None
+        finally:
+            srv.shutdown()
+
+
+class TestCompileReuse:
+    def test_second_pass_reuses_compiled_engine(self, server):
+        p = server.port
+        _req(p, "PUT", "/api/v1/resources/nodes", node("n0"))
+        _req(p, "PUT", "/api/v1/resources/pods", pod("a"))
+        _req(p, "POST", "/api/v1/schedule")
+        svc = server.service.scheduler
+        assert svc._engine_cache is not None
+        first = svc._engine_cache[1]
+        # same padded shapes: the cached engine must be retargeted, not
+        # rebuilt (pow2 padding keeps shapes stable as the cluster grows)
+        _req(p, "PUT", "/api/v1/resources/pods", pod("b"))
+        _req(p, "POST", "/api/v1/schedule")
+        assert svc._engine_cache[1] is first
+        code, got = _req(p, "GET", "/api/v1/resources/pods/default/b")
+        assert got["spec"]["nodeName"] == "n0"
+
+
+class TestAutoSchedule:
+    def test_pod_apply_triggers_pass(self):
+        srv = SimulatorServer(SimulatorService(), port=0, auto_schedule=True)
+        srv.start()
+        try:
+            p = srv.port
+            _req(p, "PUT", "/api/v1/resources/nodes", node("n0"))
+            _req(p, "PUT", "/api/v1/resources/pods", pod("w"))
+            code, got = _req(p, "GET", "/api/v1/resources/pods/default/w")
+            assert got["spec"].get("nodeName") == "n0"
+        finally:
+            srv.shutdown()
+
+
+class TestStoreHygiene:
+    def test_reentrant_subscriber_no_deadlock(self):
+        from kube_scheduler_simulator_tpu.models import ResourceStore
+
+        store = ResourceStore()
+        seen = []
+
+        def reactor(ev):
+            seen.append((ev.event_type, ev.kind, ev.resource_version))
+            # re-entrant mutation from a subscriber must not deadlock
+            if ev.kind == "nodes" and ev.event_type == "ADDED":
+                store.apply(
+                    "pods",
+                    {"metadata": {"name": f"auto-{ev.obj['metadata']['name']}"}},
+                )
+
+        store.subscribe(reactor)
+        store.apply("nodes", {"metadata": {"name": "n0"}})
+        kinds = [k for _, k, _ in seen]
+        assert kinds == ["nodes", "pods"]
+        # delivery order matches log (resourceVersion) order
+        rvs = [rv for _, _, rv in seen]
+        assert rvs == sorted(rvs)
+
+    def test_stale_resource_version_raises(self):
+        from kube_scheduler_simulator_tpu.models import ResourceStore
+        from kube_scheduler_simulator_tpu.models.store import StaleResourceVersion
+
+        store = ResourceStore()
+        store._events = []
+        store._pruned_through = 10  # simulate a pruned log window
+        with pytest.raises(StaleResourceVersion):
+            store.events_since("pods", 5)
+
+    def test_event_log_pruning(self):
+        from kube_scheduler_simulator_tpu.models import ResourceStore
+        from kube_scheduler_simulator_tpu.models.store import (
+            StaleResourceVersion,
+            WatchEvent,
+        )
+
+        store = ResourceStore()
+        with store._lock:
+            for i in range(100_001):
+                store._emit(WatchEvent("ADDED", "pods", {}, i + 1))
+            store._delivery.clear()
+        assert store._pruned_through == 50_000
+        with pytest.raises(StaleResourceVersion):
+            store.events_since("pods", 10_000)
+        # events after the pruned window still replay
+        assert store.events_since("pods", 100_000)[0].resource_version == 100_001
