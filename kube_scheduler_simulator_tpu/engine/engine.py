@@ -94,9 +94,19 @@ def unsupported_plugins(cfg: "SchedulerConfiguration") -> list[str]:
 class BatchedScheduler:
     """Compiled scheduling engine over one `EncodedCluster`."""
 
-    def __init__(self, enc: EncodedCluster, *, record: bool = True, strict: bool = True):
+    def __init__(
+        self,
+        enc: EncodedCluster,
+        *,
+        record: bool = True,
+        strict: bool = True,
+        unroll: int = 1,
+    ):
         self.enc = enc
         self.record = record
+        # lax.scan unroll factor: trades compile time for per-step
+        # overhead; useful at large queue lengths with record=False
+        self.unroll = unroll
         if enc.policy.name == "exact" and not jax.config.jax_enable_x64:
             raise RuntimeError("EXACT dtype policy requires jax_enable_x64")
         cfg = enc.config
@@ -409,7 +419,9 @@ class BatchedScheduler:
             # out of the compiled executable, so equal-shape problems reuse
             # the compilation.
             xs = (queue, jnp.arange(queue.shape[0], dtype=jnp.int32))
-            (state, _, _), out = jax.lax.scan(step, (state0, arrays, weights), xs)
+            (state, _, _), out = jax.lax.scan(
+                step, (state0, arrays, weights), xs, unroll=self.unroll
+            )
             return state, out
 
         return run

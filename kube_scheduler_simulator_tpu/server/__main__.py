@@ -27,6 +27,13 @@ def main(argv: "list[str] | None" = None) -> int:
         action="store_true",
         help="run a scheduling pass automatically after resource changes",
     )
+    parser.add_argument(
+        "--replicate-from",
+        default=None,
+        metavar="URL",
+        help="replicate an existing cluster from a simulator-compatible "
+        "export endpoint at boot (IgnoreErr, keeps own scheduler config)",
+    )
     args = parser.parse_args(argv)
 
     cfg = envconfig.from_env()
@@ -39,6 +46,11 @@ def main(argv: "list[str] | None" = None) -> int:
         )
         for e in errors:
             print(f"import: skipped: {e}")
+    if args.replicate_from:
+        from .replicate import replicate_existing_cluster
+
+        for e in replicate_existing_cluster(service, source_url=args.replicate_from):
+            print(f"replicate: skipped: {e}")
     server = SimulatorServer(
         service,
         host=args.host,

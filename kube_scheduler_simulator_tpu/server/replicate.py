@@ -1,0 +1,65 @@
+"""Replicate an existing cluster into the simulator (reference:
+simulator/replicateexistingcluster/replicateexistingcluster.go:40-53 —
+beta feature: export from a real cluster, import here, ignoring
+per-object errors and the scheduler configuration).
+
+The reference reads a KUBECONFIG and lists resources through client-go.
+This framework's equivalent source is anything that speaks the export
+wire format (`ResourcesForImport` JSON): another simulator instance's
+`GET /api/v1/export`, a kube-apiserver dump converted to the snapshot
+shape, or a snapshot file. Import runs in IgnoreErr mode and drops the
+source's schedulerConfig, exactly like the reference
+(`ImportFromExistingCluster` passes WithIgnoreErr +
+IgnoreSchedulerConfiguration).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+from .service import SimulatorService
+
+
+def fetch_export(source_url: str, timeout: float = 60.0) -> dict:
+    """GET a snapshot from a simulator-compatible export endpoint."""
+    url = source_url.rstrip("/")
+    if not url.endswith("/api/v1/export"):
+        url = url + "/api/v1/export"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        raise RuntimeError(f"export from {url}: HTTP {e.code}") from e
+    except urllib.error.URLError as e:
+        raise RuntimeError(f"export from {url}: {e.reason}") from e
+
+
+def replicate_existing_cluster(
+    service: SimulatorService,
+    *,
+    source_url: "str | None" = None,
+    snapshot: "dict | None" = None,
+    snapshot_path: "str | None" = None,
+) -> list[str]:
+    """Import an existing cluster's state from exactly one source.
+
+    Returns the list of skipped objects (IgnoreErr mode). The source's
+    scheduler configuration is ignored — the simulator keeps its own
+    (replicateexistingcluster.go:47-52).
+    """
+    sources = [s for s in (source_url, snapshot, snapshot_path) if s is not None]
+    if len(sources) != 1:
+        raise ValueError(
+            "exactly one of source_url / snapshot / snapshot_path required"
+        )
+    if source_url is not None:
+        snapshot = fetch_export(source_url)
+    elif snapshot_path is not None:
+        from .config import load_snapshot
+
+        snapshot = load_snapshot(snapshot_path)
+    snapshot = dict(snapshot or {})
+    snapshot.pop("schedulerConfig", None)  # IgnoreSchedulerConfiguration
+    return service.import_(snapshot, ignore_err=True)

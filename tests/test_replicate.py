@@ -1,0 +1,80 @@
+"""Replicate-existing-cluster: snapshot ingestion from a live simulator's
+export endpoint, IgnoreErr + IgnoreSchedulerConfiguration semantics."""
+
+import json
+
+import pytest
+
+from kube_scheduler_simulator_tpu.server import SimulatorServer, SimulatorService
+from kube_scheduler_simulator_tpu.server.replicate import (
+    replicate_existing_cluster,
+)
+from kube_scheduler_simulator_tpu.sched.config import SchedulerConfiguration
+
+from helpers import node, pod
+
+
+def custom_config():
+    return SchedulerConfiguration.from_dict(
+        {
+            "profiles": [
+                {
+                    "schedulerName": "default-scheduler",
+                    "plugins": {
+                        "score": {
+                            "disabled": [{"name": "*"}],
+                            "enabled": [{"name": "ImageLocality", "weight": 7}],
+                        }
+                    },
+                }
+            ]
+        }
+    )
+
+
+class TestReplicate:
+    def test_from_live_simulator_ignores_config(self):
+        src = SimulatorService(custom_config())
+        src.store.apply("nodes", node("n0"))
+        src.store.apply("pods", pod("w"))
+        srv = SimulatorServer(src, port=0).start()
+        try:
+            dst = SimulatorService()
+            errors = replicate_existing_cluster(
+                dst, source_url=f"http://127.0.0.1:{srv.port}"
+            )
+            assert errors == []
+            assert [n["metadata"]["name"] for n in dst.store.list("nodes")] == ["n0"]
+            assert [p["metadata"]["name"] for p in dst.store.list("pods")] == ["w"]
+            # source's custom scheduler config NOT adopted
+            enabled = dst.scheduler.get_config()["profiles"][0]["plugins"][
+                "score"
+            ]["enabled"]
+            assert enabled != [{"name": "ImageLocality", "weight": 7}]
+        finally:
+            srv.shutdown()
+
+    def test_ignore_err_skips_bad_objects(self):
+        dst = SimulatorService()
+        snap = {
+            "nodes": [node("good"), {"metadata": {}}],  # second has no name
+            "pods": [],
+        }
+        errors = replicate_existing_cluster(dst, snapshot=snap)
+        assert len(errors) == 1 and "nodes" in errors[0]
+        assert [n["metadata"]["name"] for n in dst.store.list("nodes")] == ["good"]
+
+    def test_snapshot_path(self, tmp_path):
+        p = tmp_path / "snap.json"
+        p.write_text(json.dumps({"nodes": [node("disk-node")]}))
+        dst = SimulatorService()
+        assert replicate_existing_cluster(dst, snapshot_path=str(p)) == []
+        assert dst.store.get("nodes", "disk-node") is not None
+
+    def test_exactly_one_source(self):
+        with pytest.raises(ValueError):
+            replicate_existing_cluster(SimulatorService())
+        with pytest.raises(ValueError):
+            replicate_existing_cluster(
+                SimulatorService(), snapshot={}, snapshot_path="x"
+            )
