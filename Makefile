@@ -1,0 +1,38 @@
+# Dev workflow targets (reference: Makefile + simulator/Makefile — lint /
+# test / build / start; no etcd or docker needed here: the simulator is a
+# single process over an in-memory store).
+
+PORT ?= 1212
+PY ?= python
+
+.PHONY: test test-fast lint start bench dryrun batch clean
+
+# full suite on the 8-device virtual CPU mesh (tests/conftest.py pins it)
+test:
+	$(PY) -m pytest tests/ -q
+
+# skip the slowest parity suites — the edit-loop target
+test-fast:
+	$(PY) -m pytest tests/ -q -x --ignore=tests/test_engine_parity_preempt.py
+
+lint:
+	$(PY) -m compileall -q kube_scheduler_simulator_tpu tests bench.py __graft_entry__.py
+
+# the HTTP simulator (reference `make start`: PORT=1212 ./bin/simulator)
+start:
+	$(PY) -m kube_scheduler_simulator_tpu.server --port $(PORT)
+
+# one JSON line on the current accelerator (real TPU when available)
+bench:
+	$(PY) bench.py
+
+# multi-chip SPMD dry run on a virtual 8-device CPU mesh
+dryrun:
+	$(PY) -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
+
+# KEP-184 one-shot batch runner: make batch IN=specs/ OUT=results/
+batch:
+	$(PY) -m kube_scheduler_simulator_tpu.scenario.batch --input-dir $(IN) --out-dir $(OUT)
+
+clean:
+	find . -name __pycache__ -type d -prune -exec rm -rf {} +

@@ -10,15 +10,24 @@ scenario / Monte-Carlo engine that shards thousands of cluster replicas and
 policy variants over a TPU mesh.
 
 Layout:
-  models/    typed object model, string vocabularies, in-memory resource
-             store (list/watch), snapshot import/export
-  sched/     scheduler configuration + plugin registry semantics, the pure
-             Python oracle scheduler, per-pod result records
-  engine/    the batched JAX engine: cluster featurizer, per-plugin
-             filter/score kernels, preemption dry-run, lax.scan scheduler
-  server/    REST + watch-stream serving layer with the reference API
-             surface, scheduler lifecycle service, CLI driver
-  utils/     quantities, small helpers
+  models/      typed object model, string vocabularies, in-memory resource
+               store (list/watch), snapshot import/export
+  sched/       scheduler configuration + plugin registry semantics, the pure
+               Python oracle scheduler, per-pod result records, extender
+               HTTP client
+  engine/      the batched JAX engine: cluster featurizer, per-plugin
+               filter/score kernels, preemption dry-run, the sequential
+               lax.scan scheduler (bit-parity mode) and the gang/fixpoint
+               batch scheduler (throughput mode), extender host-callback
+               loop
+  parallel/    device mesh construction, node-axis sharding, Monte-Carlo
+               weight sweeps (vmap over policy variants)
+  controllers/ deterministic deployment/replicaset/PV controller steps
+  scenario/    KEP-140 scenario VM + KEP-159/184 one-shot batch runner
+  server/      REST + watch-stream serving layer with the reference API
+               surface, scheduler lifecycle service, CLI driver
+  plugins/     out-of-tree example plugins (NetworkBandwidth, NodeNumber)
+  utils/       quantities, retry/bounded-map I/O helpers
 """
 
 __version__ = "0.1.0"

@@ -24,6 +24,7 @@ reference's result stores produce.
 
 from .encode import EncodedCluster, ClusterArrays, SchedState, encode_cluster, EXACT, TPU32
 from .engine import BatchedScheduler
+from .gang import GangScheduler
 
 __all__ = [
     "EncodedCluster",
@@ -31,6 +32,7 @@ __all__ = [
     "SchedState",
     "encode_cluster",
     "BatchedScheduler",
+    "GangScheduler",
     "EXACT",
     "TPU32",
 ]

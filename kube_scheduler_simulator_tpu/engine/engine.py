@@ -39,6 +39,42 @@ class UnsupportedPluginError(NotImplementedError):
     pass
 
 
+# Trace slot layout of step()'s record=True output, by tuple position.
+# Single source of truth for run_chunked's chunk handling and results()'s
+# unpacking; step() emits exactly these, in this order.
+TRACE_SLOTS_PREEMPT = (
+    "pf_codes", "codes", "raw", "final", "sel", "did",
+    "pcode", "vmask", "nominated",
+    "codes2", "raw2", "final2", "sel2", "pcode2", "vmask2", "nominated2",
+    "final_sel",
+)
+TRACE_SLOTS_PLAIN = ("pf_codes", "codes", "raw", "final", "sel")
+# Slots run_chunked keeps sparsely (fired rows only): the [N, P] victim
+# masks plus every retry-attempt tensor — results() reads all of them
+# only under did[qi], so event-free pods need no storage or transfer.
+TRACE_SPARSE_SLOTS = frozenset(
+    TRACE_SLOTS_PREEMPT.index(n)
+    for n in ("vmask", "vmask2", "pcode", "codes2", "raw2", "final2", "pcode2")
+)
+TRACE_DID_SLOT = TRACE_SLOTS_PREEMPT.index("did")
+
+
+class _SparseRows:
+    """Row-indexable stand-in for a stacked [P, ...] trace tensor that
+    materializes only the rows recorded by `run_chunked` (pods whose
+    preemption dry-run fired); other rows read as zeros."""
+
+    def __init__(
+        self, rows: "dict[int, np.ndarray]", row_shape: tuple, dtype=bool
+    ):
+        self._rows = rows
+        self._zero = np.zeros(row_shape, dtype)
+        self._zero.setflags(write=False)  # shared across misses
+
+    def __getitem__(self, qi: int) -> np.ndarray:
+        return self._rows.get(int(qi), self._zero)
+
+
 def supported_config() -> "SchedulerConfiguration":
     """The default-plugin-order configuration restricted to extension
     points the engine has kernels for today. Grows automatically as kernel
@@ -168,6 +204,7 @@ class BatchedScheduler:
         # vmap over weight variants (Monte-Carlo), and for mesh-sharded jit.
         self.run_fn = self._build_run()
         self._run = jax.jit(self.run_fn)
+        self._run_segment = jax.jit(self._run_segment_fn)
         # single-pod segments for host-callback (extender) scheduling
         self.attempt_fn = jax.jit(
             lambda arrays, state, weights, p: self._attempt(state, arrays, weights, p)
@@ -413,17 +450,29 @@ class BatchedScheduler:
                 out = final_sel
             return (state, a, weights), out
 
+        def run_segment(arrays, state, queue_seg, qis, weights):
+            # one scan over a queue segment, resuming from `state` with
+            # explicit global step indices — the chunked-trace primitive
+            # (run_chunked) and the building block of the full run
+            (state, _, _), out = jax.lax.scan(
+                step, (state, arrays, weights), (queue_seg, qis), unroll=self.unroll
+            )
+            return state, out
+
         def run(arrays, state0, queue, weights):
             # arrays ride through the scan carry untouched; passing them as
             # an argument (not a closure constant) keeps the cluster data
             # out of the compiled executable, so equal-shape problems reuse
             # the compilation.
-            xs = (queue, jnp.arange(queue.shape[0], dtype=jnp.int32))
-            (state, _, _), out = jax.lax.scan(
-                step, (state0, arrays, weights), xs, unroll=self.unroll
+            return run_segment(
+                arrays,
+                state0,
+                queue,
+                jnp.arange(queue.shape[0], dtype=jnp.int32),
+                weights,
             )
-            return state, out
 
+        self._run_segment_fn = run_segment
         return run
 
     # -- execution ----------------------------------------------------------
@@ -437,6 +486,69 @@ class BatchedScheduler:
         self._final_state = state
         self._trace = out
         return state, out
+
+    def run_chunked(self, chunk: int = 64, weights: "jnp.ndarray | None" = None):
+        """Execute the scan in queue segments, offloading each segment's
+        trace to host memory — the at-scale `record=True` strategy.
+
+        The full-run trace is O(P) stacked per-step tensors; with
+        preemption enabled the dominant term is two [N, P] victim masks
+        per pod (~2e11 bools at 10k pods x 1k nodes), which cannot live
+        on device. Chunking bounds device trace memory to
+        `chunk x per-step-trace`; on the host the victim masks are kept
+        sparsely — only the rows of pods whose preemption dry-run
+        actually fired (`did`) — so host memory scales with the number
+        of preemption events, not P x N x P. `results()` then decodes
+        (optionally a subset of pods; see `results(pods=...)`).
+
+        At most two program compilations occur (full chunk + remainder).
+        """
+        if not self.record:
+            raise RuntimeError("engine built with record=False has no trace")
+        w = self.weights if weights is None else weights
+        enc = self.enc
+        queue = np.asarray(enc.queue)
+        if len(queue) == 0:
+            return self.run(weights)
+        state = enc.state0
+        has_pf = self._preempt is not None
+        sparse_slots = TRACE_SPARSE_SLOTS if has_pf else frozenset()
+        n_slots = len(TRACE_SLOTS_PREEMPT if has_pf else TRACE_SLOTS_PLAIN)
+        dense: list[list[np.ndarray]] = [[] for _ in range(n_slots)]
+        sparse: dict[int, dict[int, np.ndarray]] = {i: {} for i in sparse_slots}
+        zero_spec: dict[int, tuple] = {}  # slot -> (row shape, dtype)
+        for i in range(0, len(queue), chunk):
+            qseg = jnp.asarray(queue[i : i + chunk])
+            qis = jnp.arange(i, i + len(queue[i : i + chunk]), dtype=jnp.int32)
+            state, out = self._run_segment(enc.arrays, state, qseg, qis, w)
+            out = list(out) if isinstance(out, (tuple, list)) else [out]
+            # fired-row indices first: event-free chunks transfer nothing
+            # from the big per-attempt slots, and per-row device gathers
+            # produce owned host copies (no view pinning the whole chunk)
+            fired = (
+                np.nonzero(np.asarray(out[TRACE_DID_SLOT]))[0] if has_pf else ()
+            )
+            for j, x in enumerate(out):
+                if j in sparse_slots:
+                    if j not in zero_spec:
+                        zero_spec[j] = (tuple(x.shape[1:]), np.dtype(str(x.dtype)))
+                    if len(fired):
+                        # one batched gather + transfer per slot per chunk
+                        rows = np.asarray(x[jnp.asarray(fired)])
+                        for r, k in enumerate(fired):
+                            sparse[j][i + int(k)] = rows[r]
+                else:
+                    dense[j].append(np.asarray(x))
+        trace = []
+        for j in range(n_slots):
+            if j in sparse_slots:
+                shape, dtype = zero_spec[j]
+                trace.append(_SparseRows(sparse[j], shape, dtype))
+            else:
+                trace.append(np.concatenate(dense[j], axis=0))
+        self._final_state = state
+        self._trace = tuple(trace)
+        return state, self._trace
 
     def placements(self) -> dict[tuple[str, str], str]:
         """pod (ns, name) → node name ("" = unschedulable). Fast path."""
@@ -506,22 +618,32 @@ class BatchedScheduler:
             ] = K.decode_preemption(code, enc, n, names)
         return victims_by_node
 
-    def results(self) -> list[PodSchedulingResult]:
+    def results(
+        self, pods: "set[tuple[str, str]] | None" = None
+    ) -> list[PodSchedulingResult]:
         """Convert the dense result tensors into the reference's per-pod
-        scheduling records (identical to the oracle's output shape)."""
+        scheduling records (identical to the oracle's output shape).
+
+        `pods`: optional set of (namespace, name) keys — decode only those
+        pods' records. The per-pod record is O(N x plugins) host objects
+        (the reference's annotation maps enumerate every node), so at
+        BASELINE scale full decoding is 1e7+ dict entries; selective
+        decode keeps the cost proportional to the pods asked about.
+        """
         if not self.record:
             raise RuntimeError("engine built with record=False has no trace")
         if self._trace is None:
             self.run()
         enc = self.enc
         has_pf = self._preempt is not None
+        cvt = lambda x: x if isinstance(x, _SparseRows) else np.asarray(x)  # noqa: E731
         if has_pf:
             (pf_codes, codes, raw, final, sel, did, pcode, vmask, nominated,
              codes2, raw2, final2, sel2, pcode2, vmask2, nominated2,
-             final_sel) = (np.asarray(x) for x in self._trace)
+             final_sel) = (cvt(x) for x in self._trace)
         else:
             pf_codes, codes, raw, final, sel = (
-                np.asarray(x) for x in self._trace
+                cvt(x) for x in self._trace
             )
             final_sel = sel
         results = []
@@ -529,6 +651,15 @@ class BatchedScheduler:
         seq = np.asarray(enc.state0.bound_seq).copy()
         for qi, p in enumerate(enc.queue):
             ns, name = enc.pod_keys[p]
+            if pods is not None and (ns, name) not in pods:
+                # bind-chronology bookkeeping must still advance so later
+                # decoded pods order their victim lists correctly
+                if int(final_sel[qi]) >= 0:
+                    seq[p] = enc.P + qi
+                if has_pf and bool(did[qi]) and int(nominated[qi]) >= 0:
+                    for v in np.nonzero(vmask[qi][int(nominated[qi])])[0]:
+                        seq[int(v)] = -1
+                continue
             res = PodSchedulingResult(pod_namespace=ns, pod_name=name)
             pf_failed = False
             for pname in self._prefilter_names:

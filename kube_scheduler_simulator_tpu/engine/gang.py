@@ -1,0 +1,305 @@
+"""Gang (fixpoint) scheduling: all pending pods per round, in parallel.
+
+The sequential engine (engine.py) is bit-identical to the reference's
+one-pod-at-a-time loop but latency-bound: P pods cost P dependent scan
+steps regardless of how small each step's tensors are. Gang mode is the
+batched-queue design from SURVEY.md §7 M4 ("iterate rounds to fixpoint /
+priority-ordered conflict resolution"): per round it
+
+  1. evaluates EVERY pending pod against the round-start state — a
+     `vmap` of the same Filter→Score→Normalize pass the sequential
+     engine runs, chunked through `lax.map` so the [chunk, N, plugins]
+     intermediates stay inside device memory — producing the full
+     [P, N] masked score matrix;
+  2. resolves conflicts by priority with an inner matching loop over
+     that matrix (no kernel re-evaluation): each unmatched pod argmaxes
+     over nodes not yet taken this round, the earliest pod in
+     PrioritySort queue order wins each node (a scatter-min over queue
+     positions — the tensor form of "pod i sees pod i-1's bind"), and
+     losers fall back to their next-best feasible node. Every (pod,
+     node) pair matched this way was evaluated feasible against the
+     round-start state, and one-commit-per-node means same-round
+     commits cannot interact through node-local state — so the fallback
+     mirrors what the sequential loop would do after an earlier bind
+     consumes a node (node-local score deltas move the argmax to the
+     next-best node);
+  3. scatter-binds the whole matching at once and repeats until a round
+     commits nothing (`lax.while_loop`).
+
+Without step 2's fallback, homogeneous pods would all argmax to the
+same node and rounds would commit one pod each — the matching commits
+up to N pods per round, so rounds ≈ max pods per node.
+
+One pod per node commits per round, so within a round committed pods
+cannot interact through node-local state (resources, ports, volumes,
+image locality, balanced allocation — every default plugin's state
+dependence except the global topology-spread / inter-pod-affinity
+counts). Losers re-evaluate next round against the updated state,
+exactly as the sequential loop would have seen it.
+
+Divergence policy (documented, per SURVEY §7 M4):
+
+  * Pods found unschedulable in round r are retried in round r+1 — so a
+    pod whose required inter-pod affinity peer sits LATER in the queue
+    can schedule here but not in the strict sequential pass (upstream
+    would also retry it on the next cluster event; gang mode's rounds
+    play the role of that event-driven re-queue).
+  * Pods committed in the same round read the same global
+    topology-spread / inter-pod-affinity counts; sequential parity for
+    those two plugins holds only across rounds, not within one.
+  * A pod that loses its round re-evaluates against ALL of that round's
+    commits — including pods later in the queue that won other nodes —
+    so under contention placements are a deterministic greedy fixpoint,
+    not the sequential order's. Exact sequential parity is guaranteed
+    precisely when no pod loses a round (no two pending pods select the
+    same node), e.g. spread-out workloads; the contended cases keep the
+    invariants that every commit was feasible when made and node-local
+    constraints are never violated.
+  * PostFilter (DefaultPreemption) is not run — the dry-run is defined
+    against a momentary sequential state. Configs that enable it are
+    accepted; the skipped point is reported in `skipped_postfilter`.
+    Use the sequential engine when preemption semantics matter.
+
+Scale: rounds needed ≈ max pods targeting one node, not P. The per-round
+work is a dense [P, N, plugins] evaluation — the MXU-shaped program the
+north star needs (BASELINE.json: 100k pods x 10k nodes x 1k variants).
+`run_fn` is pure in (arrays, state0, order, weights) so policy sweeps
+vmap over the weight axis and meshes shard the node axis unchanged.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .encode import EncodedCluster
+from .engine import BatchedScheduler
+
+# queue-position value that can never win a scatter-min
+_NO_ORDER = jnp.iinfo(jnp.int32).max
+
+
+class GangScheduler:
+    """Fixpoint batch scheduler over one `EncodedCluster`.
+
+    record mode is not offered: the per-round trace would be [rounds, P,
+    N, plugins] and rounds are data-dependent. For the reference's
+    per-pod annotation records run the sequential `BatchedScheduler`
+    (same placements whenever the divergence conditions above are met).
+    """
+
+    def __init__(
+        self,
+        enc: EncodedCluster,
+        *,
+        strict: bool = True,
+        chunk: int = 256,
+        max_rounds: "int | None" = None,
+        inner_iters: int = 64,
+    ):
+        self.enc = enc
+        self.chunk = int(chunk)
+        # fallback depth of the per-round matching: how many next-best
+        # hops a loser may take before waiting for a fresh evaluation
+        self.inner_iters = int(inner_iters)
+        # Reuse the sequential engine's compiled-kernel construction and
+        # its `attempt` program — gang mode is a different driver around
+        # the identical per-pod evaluation.
+        self._base = BatchedScheduler(enc, record=False, strict=strict)
+        self.skipped_postfilter = list(enc.config.enabled("postFilter"))
+        self.weights = self._base.weights
+        self.max_rounds = max_rounds
+        self.run_fn = self._build_run()
+        self._run = jax.jit(self.run_fn)
+        self._final_state = None
+        self._rounds = None
+
+    # -- host-side queue encoding ------------------------------------------
+
+    def order_arrays(self) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """(order, in_queue): order[p] = position of pod p in the
+        PrioritySort queue (NO_ORDER when not queued), in_queue[p] bool."""
+        P = self.enc.P
+        order = np.full((P,), int(_NO_ORDER), np.int32)
+        in_q = np.zeros((P,), bool)
+        for i, p in enumerate(self.enc.queue):
+            order[p] = i
+            in_q[p] = True
+        return jnp.asarray(order), jnp.asarray(in_q)
+
+    # -- compiled program ---------------------------------------------------
+
+    def _build_run(self):
+        enc = self.enc
+        N = enc.N
+        P = enc.P
+        CH = max(1, min(self.chunk, P))
+        n_chunks = -(-P // CH)
+        P_pad = n_chunks * CH
+        attempt = self._base._attempt
+        max_rounds = self.max_rounds if self.max_rounds is not None else P + 1
+        inner_iters = self.inner_iters
+        # sentinel strictly below any reachable total score (engine.py
+        # uses the same NEG for infeasible nodes); also used to mask
+        # non-pending pods and taken nodes during the inner matching
+        NEG = jnp.iinfo(enc.policy.score).min // 2
+        FLOOR = NEG
+
+        def eval_all(state, a, weights):
+            """[P, N] masked total scores (NEG where infeasible),
+            evaluated against `state`.
+
+            Chunked vmap: `lax.map` over pod chunks keeps peak memory at
+            [CH, N, plugins] instead of [P, N, plugins]; XLA dead-code
+            eliminates the unused attempt outputs (codes/raw/final), so
+            only the masked score row survives per pod.
+            """
+            ps = jnp.arange(P_pad, dtype=jnp.int32) % P
+            ps = ps.reshape(n_chunks, CH)
+
+            def one_pod(state, a, weights, p):
+                _, codes, raw, final, _, pf_ok = attempt(state, a, weights, p)
+                feasible = (codes == 0).all(axis=1) & a.node_mask & pf_ok
+                total = final.sum(axis=1) if final.shape[1] else jnp.zeros(
+                    (N,), enc.policy.score
+                )
+                return jnp.where(feasible, total, NEG)
+
+            def one_chunk(pc):
+                return jax.vmap(
+                    lambda p: one_pod(state, a, weights, p)
+                )(pc)
+
+            return jax.lax.map(one_chunk, ps).reshape(P_pad, N)[:P]
+
+        def bind_all(state, a, mask, sel, order):
+            """Scatter-bind every masked pod to its selected node in one
+            update (the batched form of engine.py's per-pod `bind`;
+            unmasked rows contribute zeros to node row 0)."""
+            tgt = jnp.where(mask, jnp.maximum(sel, 0), 0)
+            mf = mask.astype(a.pod_req.dtype)[:, None]
+            mi = mask.astype(jnp.int32)
+            return state.replace(
+                requested=state.requested.at[tgt].add(a.pod_req * mf),
+                s_requested=state.s_requested.at[tgt].add(a.pod_sreq * mf),
+                n_pods=state.n_pods.at[tgt].add(mi),
+                assignment=jnp.where(mask, sel, state.assignment),
+                used_pair=state.used_pair.at[tgt].add(a.want_pair * mi[:, None]),
+                used_wild=state.used_wild.at[tgt].add(a.want_wild * mi[:, None]),
+                used_trip=state.used_trip.at[tgt].add(a.want_trip * mi[:, None]),
+                used_claims=state.used_claims
+                + mi @ a.pod_claim.astype(jnp.int32),
+                node_disk_any=state.node_disk_any.at[tgt].add(
+                    a.pod_disk_any * mi[:, None]
+                ),
+                node_disk_rw=state.node_disk_rw.at[tgt].add(
+                    a.pod_disk_rw * mi[:, None]
+                ),
+                node_vol3=state.node_vol3.at[tgt].add(a.pod_vol3 * mi[:, None]),
+                bound_seq=jnp.where(mask, jnp.int32(P) + order, state.bound_seq),
+            )
+
+        def run(arrays, state0, order, weights):
+            """(arrays, state0, order, weights) -> (final_state, rounds).
+
+            `order` comes from `order_arrays()`; passing it as an
+            argument (like the sequential engine's queue) keeps the
+            compiled program reusable across retargets and lets sweeps
+            vmap over `weights` alone.
+            """
+            in_queue = order != _NO_ORDER
+
+            def cond(carry):
+                _, progressed, rounds = carry
+                return progressed & (rounds < max_rounds)
+
+            def match(scores):
+                """One-commit-per-node matching over the round's masked
+                score matrix: argmax → earliest-order winner per node →
+                losers retry their next-best untaken node. No kernel
+                re-evaluation — pure selects over [P, N]."""
+
+                def m_cond(c):
+                    _, _, changed, it = c
+                    return changed & (it < inner_iters)
+
+                def m_body(c):
+                    taken, sel_acc, _, it = c
+                    m = jnp.where(taken[None, :], FLOOR, scores)
+                    m = jnp.where((sel_acc >= 0)[:, None], FLOOR, m)
+                    cand = jnp.argmax(m, axis=1).astype(jnp.int32)
+                    has = jnp.take_along_axis(
+                        m, cand[:, None], axis=1
+                    )[:, 0] > NEG
+                    tgt = jnp.where(has, cand, N)
+                    winner = (
+                        jnp.full((N + 1,), _NO_ORDER, jnp.int32)
+                        .at[tgt]
+                        .min(order)
+                    )
+                    commit = has & (winner[jnp.maximum(cand, 0)] == order)
+                    sel_acc = jnp.where(commit, cand, sel_acc)
+                    taken = taken | (
+                        jnp.zeros((N + 1,), bool)
+                        .at[jnp.where(commit, cand, N)]
+                        .set(True)[:N]
+                    )
+                    return taken, sel_acc, commit.any(), it + jnp.int32(1)
+
+                taken0 = jnp.zeros((N,), bool)
+                sel0 = jnp.full((P,), -1, jnp.int32)
+                taken, sel_acc, _, _ = jax.lax.while_loop(
+                    m_cond, m_body, (taken0, sel0, jnp.bool_(True), jnp.int32(0))
+                )
+                return sel_acc
+
+            def body(carry):
+                state, _, rounds = carry
+                scores = eval_all(state, arrays, weights)
+                pending = (state.assignment < 0) & in_queue & arrays.pod_mask
+                scores = jnp.where(pending[:, None], scores, FLOOR)
+                sel = match(scores)
+                commit = sel >= 0
+                state = bind_all(state, arrays, commit, sel, order)
+                return state, commit.any(), rounds + jnp.int32(1)
+
+            state, _, rounds = jax.lax.while_loop(
+                cond, body, (state0, jnp.bool_(True), jnp.int32(0))
+            )
+            return state, rounds
+
+        return run
+
+    # -- execution ----------------------------------------------------------
+
+    def run(self, weights: "jnp.ndarray | None" = None):
+        """Execute to fixpoint; returns (final_state, rounds_used)."""
+        w = self.weights if weights is None else weights
+        order, _ = self.order_arrays()
+        state, rounds = self._run(self.enc.arrays, self.enc.state0, order, w)
+        self._final_state = state
+        self._rounds = rounds
+        return state, rounds
+
+    def placements(self) -> dict[tuple[str, str], str]:
+        """pod (ns, name) → node name ("" = unschedulable)."""
+        if self._final_state is None:
+            self.run()
+        assign = np.asarray(self._final_state.assignment)
+        out = {}
+        for qi in self.enc.queue:
+            sel = int(assign[qi])
+            out[self.enc.pod_keys[qi]] = (
+                self.enc.node_names[sel] if sel >= 0 else ""
+            )
+        return out
+
+    def retarget(self, enc: EncodedCluster) -> "GangScheduler":
+        """Point at a compile-compatible new encoding (see
+        BatchedScheduler.retarget)."""
+        self._base.retarget(enc)  # validates the signature
+        self.enc = enc
+        self._final_state = None
+        self._rounds = None
+        return self

@@ -1,0 +1,100 @@
+"""NodeNumber — the documentation example custom plugin, TPU-native.
+
+Re-derivation of the reference's tutorial plugin
+(simulator/docs/how-to-use-custom-plugins/nodenumber/plugin.go:1-146):
+score 10 for a node whose name's trailing digit equals the pod name's
+trailing digit, else 0; a typed `reverse` arg flips the match. The
+reference uses it to teach out-of-tree plugin registration — here it
+teaches the kernel-registration pattern at its minimum: one score kernel,
+no extra state, featurization done by the builder from the raw manifests
+(see plugins/networkbandwidth.py for the full pattern with filter +
+preemption row).
+
+Used by docs/how-to-use-custom-plugins.md.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..sched import oracle_plugins as op
+
+SCORE_MATCH = 10
+
+
+def _trailing_digit(name: str) -> "int | None":
+    return int(name[-1]) if name and name[-1].isdigit() else None
+
+
+def _reverse_from_config(config) -> bool:
+    """The typed plugin arg (plugin.go NodeNumberArgs.Reverse), read from
+    the profile's pluginConfig like any in-tree args object."""
+    args = config.plugin_args("NodeNumber") if config is not None else None
+    return bool((args or {}).get("reverse", False))
+
+
+# -- oracle (per-pod reference semantics) -----------------------------------
+
+
+def nn_score(ctx, pod, ni) -> int:
+    want = _trailing_digit(pod.obj["metadata"]["name"])
+    have = _trailing_digit(ni.node.obj["metadata"]["name"])
+    if want is None or have is None:
+        return 0
+    matched = want == have
+    if bool((ctx.args("NodeNumber") or {}).get("reverse", False)):
+        matched = not matched
+    return SCORE_MATCH if matched else 0
+
+
+# -- engine kernel ----------------------------------------------------------
+
+
+def build_nn_score(enc):
+    import jax.numpy as jnp
+
+    score_dt = enc.policy.score
+    node_digit = np.full(enc.N, -1, np.int32)
+    for i, name in enumerate(enc.node_names[: enc.n_nodes]):
+        d = _trailing_digit(name)
+        if d is not None:
+            node_digit[i] = d
+    pod_digit = np.full(enc.P, -2, np.int32)
+    for i, p in enumerate(enc.pods):
+        d = _trailing_digit(p["metadata"]["name"])
+        if d is not None:
+            pod_digit[i] = d
+    reverse = _reverse_from_config(enc.config)
+    nd = jnp.asarray(node_digit)
+    pd = jnp.asarray(pod_digit)
+
+    def kernel(a, s, p, feasible=None):
+        both = (nd >= 0) & (pd[p] >= 0)
+        matched = nd == pd[p]
+        if reverse:
+            matched = ~matched
+        return jnp.where(both & matched, SCORE_MATCH, 0).astype(score_dt)
+
+    return kernel
+
+
+def _compile_statics(enc) -> tuple:
+    node_digits = tuple(
+        _trailing_digit(n) for n in enc.node_names[: enc.n_nodes]
+    )
+    pod_digits = tuple(
+        _trailing_digit(p["metadata"]["name"]) for p in enc.pods
+    )
+    return (node_digits, pod_digits, _reverse_from_config(enc.config))
+
+
+def register() -> None:
+    """Idempotently register the oracle fn + score kernel."""
+    from ..engine import kernels as K
+
+    op.SCORE_PLUGINS["NodeNumber"] = (nn_score, None)
+    K.SCORE_KERNELS["NodeNumber"] = (build_nn_score, None)
+    K.COMPILE_STATICS["NodeNumber"] = _compile_statics
+
+
+register()

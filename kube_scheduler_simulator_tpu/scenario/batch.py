@@ -1,0 +1,249 @@
+"""One-shot batch simulation runs (KEP-159 / KEP-184).
+
+The reference designs (never implemented there):
+
+  * KEP-159 `Simulator` CRD — N simulator replicas, each a pod, fanned
+    out over a set of simulation jobs
+    (keps/159-.../README.md:37-120).
+  * KEP-184 `SchedulerSimulation` CRD — a one-shot scenario run through a
+    scenario-runner container with file-based input/output
+    (keps/184-.../README.md:49-150).
+
+TPU-native re-expression: a *batch* is a list of jobs, each either
+
+  * ``scenario`` — a full KEP-140 scenario VM run (scenario/runner.py):
+    operations + optional scheduler config, producing a Timeline; or
+  * ``sweep``    — the Monte-Carlo fast path (BASELINE config #4): a
+    static cluster snapshot + a matrix of score-weight variants, executed
+    as ONE vmapped XLA program over the variant axis (parallel/sweep.py)
+    instead of N replica processes. This is where "1k policy variants"
+    runs at chip speed; an optional mesh shards the variant axis over
+    'replicas' (the KEP-159 replica fan-out collapsed into SPMD).
+
+File-based in/out mirrors KEP-184's runner contract: every ``*.json`` /
+``*.yaml`` spec in an input directory becomes a job; each job writes
+``<name>.result.json`` into the output directory.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..models.snapshot import import_snapshot
+from ..models.store import ResourceStore
+from ..sched.config import SchedulerConfiguration
+from .runner import Operation, ScenarioRunner
+
+
+def _op_from_dict(d: dict, idx: int) -> Operation:
+    return Operation(
+        id=d.get("id", f"op-{idx}"),
+        major_step=int(d.get("majorStep", d.get("major_step", 0))),
+        create=d.get("create"),
+        patch=d.get("patch"),
+        delete=d.get("delete"),
+        done=bool(d.get("done", False)),
+    )
+
+
+@dataclass
+class BatchJob:
+    """One simulation job (the SchedulerSimulation analogue)."""
+
+    name: str
+    kind: str = "scenario"  # "scenario" | "sweep"
+    operations: list[Operation] = field(default_factory=list)
+    snapshot: "dict | None" = None  # sweep: cluster snapshot (import wire shape)
+    scheduler_config: "SchedulerConfiguration | None" = None
+    # sweep: list of {plugin name -> weight} override dicts, one per variant
+    weight_variants: list[dict] = field(default_factory=list)
+    # set when the spec file could not be parsed; the job then fails at
+    # run time like any other job, preserving batch isolation
+    parse_error: str = ""
+
+    @classmethod
+    def from_spec(cls, name: str, spec: dict) -> "BatchJob":
+        cfg = spec.get("schedulerConfig")
+        job = cls(
+            name=name,
+            kind=spec.get("kind", "scenario"),
+            operations=[
+                _op_from_dict(d, i)
+                for i, d in enumerate(spec.get("operations", []))
+            ],
+            snapshot=spec.get("snapshot"),
+            scheduler_config=(
+                SchedulerConfiguration.from_dict(cfg) if cfg else None
+            ),
+            weight_variants=spec.get("weightVariants", []),
+        )
+        if job.kind not in ("scenario", "sweep"):
+            raise ValueError(f"job {name!r}: unknown kind {job.kind!r}")
+        if job.kind == "sweep" and job.snapshot is None:
+            raise ValueError(f"job {name!r}: sweep jobs need a snapshot")
+        return job
+
+
+def _run_sweep_job(job: BatchJob, mesh=None) -> dict:
+    from ..engine import TPU32, encode_cluster
+    from ..parallel.sweep import WeightSweep, weights_for
+
+    store = ResourceStore()
+    import_snapshot(store, job.snapshot)
+    cfg = job.scheduler_config or SchedulerConfiguration.default()
+    enc = encode_cluster(
+        store.list("nodes"),
+        store.list("pods"),
+        cfg,
+        policy=TPU32,
+        priorityclasses=store.list("priorityclasses"),
+        namespaces=store.list("namespaces"),
+        pvcs=store.list("pvcs"),
+        pvs=store.list("pvs"),
+        storageclasses=store.list("storageclasses"),
+    )
+    sweep = WeightSweep(enc, mesh=mesh)
+    variants = job.weight_variants or [{}]
+    w = np.stack([weights_for(enc, ov) for ov in variants])
+    _, sels = sweep.run(w)
+    placements = sweep.placements(sels)
+    return {
+        "phase": "Succeeded",
+        "variants": [
+            {
+                "weights": {
+                    n: int(wv)
+                    for (n, _), wv in zip(enc.config.score_plugins(), w[v])
+                },
+                "scheduled": sum(1 for x in placements[v].values() if x),
+                "unschedulable": sum(
+                    1 for x in placements[v].values() if not x
+                ),
+                "placements": {
+                    f"{ns}/{name}": node_
+                    for (ns, name), node_ in sorted(placements[v].items())
+                },
+            }
+            for v in range(len(variants))
+        ],
+    }
+
+
+def run_job(job: BatchJob, *, mesh=None) -> dict:
+    """Execute one job; returns its result dict (the KEP-184 output file
+    payload)."""
+    if job.parse_error:
+        raise ValueError(job.parse_error)
+    if job.kind == "sweep":
+        return _run_sweep_job(job, mesh=mesh)
+    runner = ScenarioRunner(job.operations, config=job.scheduler_config)
+    return runner.run().as_dict()
+
+
+def run_batch(
+    jobs: list[BatchJob],
+    *,
+    out_dir: "str | None" = None,
+    mesh=None,
+    max_workers: int = 1,
+) -> dict[str, dict]:
+    """Run every job; optionally write ``<name>.result.json`` files.
+
+    By default jobs run sequentially on the host — the chip-level
+    parallel axis is inside each sweep job's vmapped program, not across
+    processes (the KEP-159 replica fan-out done the SPMD way).
+    `max_workers > 1` runs host-bound scenario jobs on a bounded thread
+    pool (utils/tasks.bounded_map, the reference's semaphored-errgroup
+    analogue) — useful when a batch is dominated by small scenario VMs
+    rather than device time. A job that raises is recorded as
+    phase=Failed; remaining jobs still run (the KEP-184 runner's
+    one-shot isolation).
+    """
+
+    def one(job: BatchJob) -> tuple[str, dict]:
+        try:
+            return job.name, run_job(job, mesh=mesh)
+        except Exception as e:  # noqa: BLE001 — job failure is a result
+            return job.name, {
+                "phase": "Failed",
+                "message": f"{type(e).__name__}: {e}",
+            }
+
+    if max_workers > 1:
+        from ..utils.tasks import bounded_map
+
+        results = dict(bounded_map(one, jobs, max_workers=max_workers))
+    else:
+        results = dict(one(job) for job in jobs)
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+        for name, res in results.items():
+            path = os.path.join(out_dir, f"{name}.result.json")
+            with open(path, "w") as f:
+                json.dump(res, f, indent=2, sort_keys=True)
+    return results
+
+
+def load_jobs(input_dir: str) -> list[BatchJob]:
+    """Every *.json / *.yaml / *.yml spec file in `input_dir` → one job,
+    named after its file stem (the KEP-184 file-based input contract).
+    A malformed spec becomes a job that fails at run time — it never
+    aborts the rest of the batch. Files sharing a stem (a.json + a.yaml)
+    are disambiguated by their extension so no result is silently
+    dropped or overwritten."""
+    jobs = []
+    stems: set[str] = set()
+    for fn in sorted(os.listdir(input_dir)):
+        stem, ext = os.path.splitext(fn)
+        path = os.path.join(input_dir, fn)
+        if ext not in (".json", ".yaml", ".yml"):
+            continue
+        if stem in stems:
+            stem = f"{stem}.{ext[1:]}"
+        stems.add(stem)
+        try:
+            if ext == ".json":
+                with open(path) as f:
+                    spec = json.load(f)
+            else:
+                import yaml
+
+                with open(path) as f:
+                    spec = yaml.safe_load(f)
+            if not isinstance(spec, dict):
+                raise ValueError(f"spec must be a mapping, got {type(spec).__name__}")
+            jobs.append(BatchJob.from_spec(stem, spec))
+        except Exception as e:  # noqa: BLE001 — isolate per spec file
+            jobs.append(
+                BatchJob(name=stem, parse_error=f"{type(e).__name__}: {e}")
+            )
+    return jobs
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="kube_scheduler_simulator_tpu.scenario.batch",
+        description="One-shot batch simulation runner (KEP-159/184).",
+    )
+    ap.add_argument("--input-dir", required=True, help="directory of job specs")
+    ap.add_argument("--out-dir", required=True, help="directory for results")
+    args = ap.parse_args(argv)
+    jobs = load_jobs(args.input_dir)
+    results = run_batch(jobs, out_dir=args.out_dir)
+    failed = [n for n, r in results.items() if r.get("phase") == "Failed"]
+    print(
+        f"batch: {len(jobs)} jobs, {len(jobs) - len(failed)} succeeded, "
+        f"{len(failed)} failed"
+        + (f" ({', '.join(failed)})" if failed else "")
+    )
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

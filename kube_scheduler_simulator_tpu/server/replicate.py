@@ -19,21 +19,37 @@ import json
 import urllib.error
 import urllib.request
 
+from ..utils.tasks import RetryError, retry
 from .service import SimulatorService
 
 
-def fetch_export(source_url: str, timeout: float = 60.0) -> dict:
-    """GET a snapshot from a simulator-compatible export endpoint."""
+def fetch_export(
+    source_url: str, timeout: float = 60.0, retry_steps: int = 3
+) -> dict:
+    """GET a snapshot from a simulator-compatible export endpoint.
+
+    Connection-level failures are retried with exponential backoff
+    (utils/tasks.retry — the reference wraps its cluster I/O in backoff
+    retries, util/retry.go); HTTP error statuses are not retried."""
     url = source_url.rstrip("/")
     if not url.endswith("/api/v1/export"):
         url = url + "/api/v1/export"
-    try:
+
+    def get():
         with urllib.request.urlopen(url, timeout=timeout) as resp:
             return json.loads(resp.read())
+
+    def transient(e: BaseException) -> bool:
+        return isinstance(e, urllib.error.URLError) and not isinstance(
+            e, urllib.error.HTTPError
+        )
+
+    try:
+        return retry(get, steps=retry_steps, retryable=transient)
     except urllib.error.HTTPError as e:
         raise RuntimeError(f"export from {url}: HTTP {e.code}") from e
-    except urllib.error.URLError as e:
-        raise RuntimeError(f"export from {url}: {e.reason}") from e
+    except RetryError as e:
+        raise RuntimeError(f"export from {url}: {e.last.reason}") from e.last
 
 
 def replicate_existing_cluster(

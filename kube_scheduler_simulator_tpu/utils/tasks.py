@@ -1,0 +1,77 @@
+"""Host-side task utilities at the framework's I/O boundaries.
+
+The reference's equivalents: a GOMAXPROCS-bounded errgroup used for bulk
+CRUD during export/import (simulator/util/semaphored_errgroup.go:17-40)
+and an exponential-backoff retry helper (simulator/util/retry.go:8-26,
+100ms base, factor 3, 6 steps). The TPU framework is single-process and
+mostly pure, so these apply only at real I/O boundaries — `retry` guards
+the replicate-existing-cluster HTTP fetch (server/replicate.py),
+`bounded_map` fans out host-bound batch jobs (scenario/batch.py
+run_batch(max_workers=...)) — never inside compiled programs.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+
+class RetryError(Exception):
+    """All attempts failed; `.last` is the final exception."""
+
+    def __init__(self, attempts: int, last: BaseException):
+        super().__init__(f"failed after {attempts} attempts: {last}")
+        self.attempts = attempts
+        self.last = last
+
+
+def retry(
+    fn,
+    *,
+    steps: int = 6,
+    base_delay: float = 0.1,
+    factor: float = 3.0,
+    retryable=lambda e: True,
+    sleep=time.sleep,
+):
+    """Call `fn()` with exponential backoff (reference retry.go defaults:
+    100ms x 3^n, 6 steps). Raises RetryError when every attempt fails or
+    immediately re-raises a non-retryable exception."""
+    if steps < 1:
+        raise ValueError(f"steps must be >= 1, got {steps}")
+    delay = base_delay
+    last: "BaseException | None" = None
+    for attempt in range(steps):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — boundary helper
+            if not retryable(e):
+                raise
+            last = e
+            if attempt < steps - 1:
+                sleep(delay)
+                delay *= factor
+    raise RetryError(steps, last)
+
+
+def bounded_map(fn, items, *, max_workers: "int | None" = None) -> list:
+    """Run `fn` over `items` on a bounded thread pool, preserving order —
+    the semaphored-errgroup analogue. The first exception is raised after
+    all tasks finish (errgroup semantics); results of failed items are
+    not returned."""
+    if not items:
+        return []
+    workers = max_workers or min(len(items), os.cpu_count() or 4)
+    with ThreadPoolExecutor(max_workers=workers) as ex:
+        futures = [ex.submit(fn, it) for it in items]
+        results, first_err = [], None
+        for f in futures:
+            try:
+                results.append(f.result())
+            except Exception as e:  # noqa: BLE001
+                if first_err is None:
+                    first_err = e
+        if first_err is not None:
+            raise first_err
+        return results

@@ -1,0 +1,146 @@
+"""Batch runner (KEP-159/184): scenario + sweep jobs, file-based in/out."""
+
+import json
+
+from kube_scheduler_simulator_tpu.scenario.batch import (
+    BatchJob,
+    load_jobs,
+    run_batch,
+)
+
+from helpers import node, pod
+
+
+def _scenario_spec():
+    return {
+        "kind": "scenario",
+        "operations": [
+            {"majorStep": 0, "create": {"kind": "nodes", "object": node("n0")}},
+            {"majorStep": 0, "create": {"kind": "pods", "object": pod("p0")}},
+            {"majorStep": 1, "done": True},
+        ],
+    }
+
+
+def _sweep_spec():
+    return {
+        "kind": "sweep",
+        "snapshot": {
+            "nodes": [node(f"n{i}", cpu=str(2 + i)) for i in range(3)],
+            "pods": [pod(f"p{i}", cpu="500m") for i in range(6)],
+        },
+        "schedulerConfig": {
+            "profiles": [
+                {
+                    "schedulerName": "default-scheduler",
+                    "plugins": {
+                        "preFilter": {"disabled": [{"name": "*"}],
+                                      "enabled": [{"name": "NodeResourcesFit"}]},
+                        "filter": {"disabled": [{"name": "*"}],
+                                   "enabled": [{"name": "NodeResourcesFit"}]},
+                        "postFilter": {"disabled": [{"name": "*"}], "enabled": []},
+                        "preScore": {
+                            "disabled": [{"name": "*"}],
+                            "enabled": [
+                                {"name": "NodeResourcesFit"},
+                                {"name": "NodeResourcesBalancedAllocation"},
+                            ],
+                        },
+                        "score": {
+                            "disabled": [{"name": "*"}],
+                            "enabled": [
+                                {"name": "NodeResourcesFit", "weight": 1},
+                                {"name": "NodeResourcesBalancedAllocation",
+                                 "weight": 1},
+                            ],
+                        },
+                    },
+                }
+            ]
+        },
+        "weightVariants": [
+            {},
+            {"NodeResourcesFit": 10},
+            {"NodeResourcesBalancedAllocation": 10},
+        ],
+    }
+
+
+def test_scenario_job():
+    job = BatchJob.from_spec("demo", _scenario_spec())
+    results = run_batch([job])
+    r = results["demo"]
+    assert r["phase"] == "Succeeded"
+    evs = [e["type"] for e in r["timeline"]["0"]]
+    assert "Create" in evs and "PodScheduled" in evs
+
+
+def test_sweep_job_runs_all_variants():
+    job = BatchJob.from_spec("sweep", _sweep_spec())
+    r = run_batch([job])["sweep"]
+    assert r["phase"] == "Succeeded"
+    assert len(r["variants"]) == 3
+    for v in r["variants"]:
+        assert v["scheduled"] == 6
+        assert set(v["placements"]) == {f"default/p{i}" for i in range(6)}
+    assert r["variants"][1]["weights"]["NodeResourcesFit"] == 10
+
+
+def test_file_based_in_out(tmp_path):
+    indir, outdir = tmp_path / "in", tmp_path / "out"
+    indir.mkdir()
+    (indir / "a.json").write_text(json.dumps(_scenario_spec()))
+    (indir / "b.json").write_text(json.dumps(_sweep_spec()))
+    (indir / "ignored.txt").write_text("not a spec")
+    jobs = load_jobs(str(indir))
+    assert [j.name for j in jobs] == ["a", "b"]
+    results = run_batch(jobs, out_dir=str(outdir))
+    assert (outdir / "a.result.json").exists()
+    assert (outdir / "b.result.json").exists()
+    on_disk = json.loads((outdir / "b.result.json").read_text())
+    assert on_disk == results["b"]
+
+
+def test_malformed_spec_isolated(tmp_path):
+    indir = tmp_path / "in"
+    indir.mkdir()
+    (indir / "good.json").write_text(json.dumps(_scenario_spec()))
+    (indir / "broken.json").write_text("{not json")
+    (indir / "empty.yaml").write_text("")
+    jobs = load_jobs(str(indir))
+    assert [j.name for j in jobs] == ["broken", "empty", "good"]
+    results = run_batch(jobs)
+    assert results["good"]["phase"] == "Succeeded"
+    assert results["broken"]["phase"] == "Failed"
+    assert results["empty"]["phase"] == "Failed"
+
+
+def test_parallel_batch_matches_sequential():
+    jobs = [
+        BatchJob.from_spec(f"j{i}", _scenario_spec()) for i in range(4)
+    ]
+    seq = run_batch(jobs)
+    par = run_batch(
+        [BatchJob.from_spec(f"j{i}", _scenario_spec()) for i in range(4)],
+        max_workers=3,
+    )
+    assert {n: r["phase"] for n, r in par.items()} == {
+        n: r["phase"] for n, r in seq.items()
+    }
+
+
+def test_failed_job_isolated():
+    bad = BatchJob.from_spec(
+        "bad",
+        {
+            "kind": "scenario",
+            "operations": [
+                {"majorStep": 0,
+                 "delete": {"kind": "pods", "name": "ghost"}},
+            ],
+        },
+    )
+    good = BatchJob.from_spec("good", _scenario_spec())
+    results = run_batch([bad, good])
+    assert results["bad"]["phase"] == "Failed"
+    assert results["good"]["phase"] == "Succeeded"

@@ -1,0 +1,195 @@
+"""Gang (fixpoint) scheduler: correctness, determinism, divergence policy.
+
+Gang mode (engine/gang.py) trades the sequential engine's bit-parity for
+round-parallel throughput. Its contract (documented in the module):
+
+  * one pod per node commits per round, earliest queue position wins;
+  * committed placements are always feasible against the state they were
+    evaluated on, and node-local constraints (resources, ports) can never
+    be violated by same-round peers;
+  * unschedulable pods are retried next round (the event-driven re-queue
+    analogue), so affinity chains resolve across rounds;
+  * no-contention workloads place identically to the sequential engine.
+"""
+
+import numpy as np
+import pytest
+
+from kube_scheduler_simulator_tpu.engine import (
+    EXACT,
+    BatchedScheduler,
+    encode_cluster,
+)
+from kube_scheduler_simulator_tpu.engine.gang import GangScheduler
+from kube_scheduler_simulator_tpu.sched.config import SchedulerConfiguration
+
+from helpers import node, pod
+from test_engine_parity import restricted_config
+
+
+def _placements(sched):
+    sched.run()
+    return sched.placements()
+
+
+def test_no_contention_matches_sequential():
+    # each pod nodeSelector-pinned to its own node: one round, and the
+    # placements must equal the sequential engine's exactly
+    nodes = [node(f"n{i}", labels={"k": f"v{i}"}) for i in range(6)]
+    pods = [pod(f"p{i}", node_selector={"k": f"v{i}"}) for i in range(6)]
+    cfg = restricted_config(
+        filters=("NodeUnschedulable", "NodeName", "NodeAffinity", "NodeResourcesFit"),
+    )
+    enc = encode_cluster(nodes, pods, cfg, policy=EXACT)
+    gang = GangScheduler(enc)
+    seq = BatchedScheduler(encode_cluster(nodes, pods, cfg, policy=EXACT), record=False)
+    assert _placements(gang) == _placements(seq)
+    assert int(np.asarray(gang._rounds)) == 2  # 1 committing + 1 empty
+
+
+def test_contended_node_priority_order_and_capacity():
+    # 4 pods all fit only n0 (n1 unschedulable); n0 holds exactly 2.
+    # Queue order (PrioritySort) must win the contention rounds.
+    nodes = [node("n0", cpu="2"), node("n1", cpu="8", unschedulable=True)]
+    pods = [
+        pod("lo1", cpu="1", priority=1),
+        pod("hi", cpu="1", priority=10),
+        pod("lo2", cpu="1", priority=1),
+        pod("lo3", cpu="1", priority=1),
+    ]
+    cfg = restricted_config()
+    enc = encode_cluster(nodes, pods, cfg, policy=EXACT)
+    gang = GangScheduler(enc)
+    got = _placements(gang)
+    assert got[("default", "hi")] == "n0"
+    # exactly one of the priority-1 pods (the earliest in queue order,
+    # which is input order among equals) fits next
+    assert got[("default", "lo1")] == "n0"
+    assert got[("default", "lo2")] == ""
+    assert got[("default", "lo3")] == ""
+    # matches the sequential engine bit-for-bit on this workload
+    seq = BatchedScheduler(encode_cluster(nodes, pods, cfg, policy=EXACT), record=False)
+    assert got == _placements(seq)
+
+
+def test_random_cluster_contended_invariants():
+    # moderately contended random cluster: under contention gang is a
+    # deterministic greedy fixpoint, not sequential-identical (gang.py
+    # divergence policy) — but it must (a) never violate capacity,
+    # (b) schedule at least as many pods as the sequential pass (losers
+    # are retried), (c) be deterministic.
+    rng = np.random.default_rng(3)
+    nodes = [node(f"n{i}", cpu=str(2 + int(rng.integers(3)))) for i in range(8)]
+    pods = [
+        pod(f"p{i}", cpu=f"{int(rng.integers(200, 900))}m",
+            priority=int(rng.integers(3)))
+        for i in range(40)
+    ]
+    cfg = restricted_config()
+    enc = encode_cluster(nodes, pods, cfg, policy=EXACT)
+    gang = GangScheduler(enc, chunk=16)
+    seq = BatchedScheduler(encode_cluster(nodes, pods, cfg, policy=EXACT), record=False)
+    g, s = _placements(gang), _placements(seq)
+    assert sum(1 for v in g.values() if v) >= sum(1 for v in s.values() if v)
+    assert g == _placements(GangScheduler(enc, chunk=16))
+    # capacity safety, independently recomputed
+    used = {}
+    for (ns, name), nn in g.items():
+        if nn:
+            p = next(pp for pp in pods if pp["metadata"]["name"] == name)
+            req = p["spec"]["containers"][0]["resources"]["requests"]["cpu"]
+            used[nn] = used.get(nn, 0) + int(req[:-1])
+    for n_, total in used.items():
+        alloc = next(nn for nn in nodes if nn["metadata"]["name"] == n_)
+        assert total <= int(alloc["status"]["allocatable"]["cpu"]) * 1000
+
+
+def test_determinism():
+    rng = np.random.default_rng(7)
+    nodes = [node(f"n{i}") for i in range(5)]
+    pods = [pod(f"p{i}", cpu=f"{int(rng.integers(100, 500))}m") for i in range(20)]
+    cfg = restricted_config()
+    enc = encode_cluster(nodes, pods, cfg, policy=EXACT)
+    a = _placements(GangScheduler(enc))
+    b = _placements(GangScheduler(enc))
+    assert a == b
+
+
+def test_affinity_chain_resolves_across_rounds():
+    # backend requires affinity to frontend, but frontend sits LATER in
+    # the queue (lower priority listed first in input order? — no:
+    # equal priority, input order backend-first). Sequential: backend
+    # fails (peer not bound yet). Gang: backend schedules in round 2 —
+    # the documented retry divergence.
+    nodes = [node(f"n{i}", labels={"zone": "z"}) for i in range(2)]
+    aff = {
+        "podAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {
+                    "labelSelector": {"matchLabels": {"app": "frontend"}},
+                    "topologyKey": "zone",
+                }
+            ]
+        }
+    }
+    pods = [
+        pod("backend", affinity=aff),
+        pod("frontend", labels={"app": "frontend"}),
+    ]
+    cfg = restricted_config(
+        filters=("NodeUnschedulable", "NodeResourcesFit", "InterPodAffinity"),
+        prefilters=("NodeResourcesFit", "InterPodAffinity"),
+    )
+    enc = encode_cluster(nodes, pods, cfg, policy=EXACT)
+    gang = GangScheduler(enc)
+    got = _placements(gang)
+    assert got[("default", "frontend")] != ""
+    assert got[("default", "backend")] != ""  # retried after peer bound
+    seq = BatchedScheduler(encode_cluster(nodes, pods, cfg, policy=EXACT), record=False)
+    assert _placements(seq)[("default", "backend")] == ""  # sequential can't
+
+
+def test_infeasible_pods_terminate_quickly():
+    nodes = [node("n0", cpu="1")]
+    pods = [pod(f"p{i}", cpu="4") for i in range(10)]  # none fit
+    cfg = restricted_config()
+    enc = encode_cluster(nodes, pods, cfg, policy=EXACT)
+    gang = GangScheduler(enc)
+    state, rounds = gang.run()
+    assert int(np.asarray(rounds)) == 1  # one empty round, then fixpoint
+    assert all(v == "" for v in gang.placements().values())
+
+
+def test_weight_sweep_vmap_matches_per_variant_runs():
+    import jax
+    import jax.numpy as jnp
+
+    nodes = [node(f"n{i}", cpu=str(2 + i % 3)) for i in range(6)]
+    pods = [pod(f"p{i}", cpu=f"{300 + 40 * (i % 5)}m") for i in range(18)]
+    cfg = restricted_config()
+    enc = encode_cluster(nodes, pods, cfg, policy=EXACT)
+    gang = GangScheduler(enc, chunk=8)
+    order, _ = gang.order_arrays()
+    wbase = np.asarray(gang.weights)
+    variants = jnp.asarray(np.stack([wbase, wbase * 3, wbase + 7]), wbase.dtype)
+    vrun = jax.jit(jax.vmap(gang.run_fn, in_axes=(None, None, None, 0)))
+    vstate, vrounds = vrun(enc.arrays, enc.state0, order, variants)
+    for i in range(3):
+        state_i, _ = jax.jit(gang.run_fn)(
+            enc.arrays, enc.state0, order, variants[i]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(vstate.assignment[i]), np.asarray(state_i.assignment)
+        )
+
+
+def test_full_default_config_accepted_postfilter_skipped():
+    from kube_scheduler_simulator_tpu.engine.engine import supported_config
+
+    nodes = [node(f"n{i}") for i in range(3)]
+    pods = [pod(f"p{i}") for i in range(5)]
+    enc = encode_cluster(nodes, pods, supported_config(), policy=EXACT)
+    gang = GangScheduler(enc)
+    assert gang.skipped_postfilter == ["DefaultPreemption"]
+    got = _placements(gang)
+    assert all(v != "" for v in got.values())

@@ -1,0 +1,62 @@
+"""NodeNumber docs-example plugin: oracle/kernel parity + typed args."""
+
+import kube_scheduler_simulator_tpu.plugins.nodenumber  # noqa: F401 — registers
+from kube_scheduler_simulator_tpu.engine import EXACT, BatchedScheduler, encode_cluster
+from kube_scheduler_simulator_tpu.sched.config import SchedulerConfiguration
+from kube_scheduler_simulator_tpu.sched.oracle import Oracle
+
+from helpers import node, pod
+
+
+def _config(reverse=False):
+    star = [{"name": "*"}]
+    plugins = {
+        "preFilter": {"disabled": star, "enabled": [{"name": "NodeResourcesFit"}]},
+        "filter": {"disabled": star, "enabled": [{"name": "NodeResourcesFit"}]},
+        "postFilter": {"disabled": star, "enabled": []},
+        "preScore": {"disabled": star, "enabled": []},
+        "score": {"disabled": star, "enabled": [{"name": "NodeNumber", "weight": 1}]},
+    }
+    profile = {"schedulerName": "default-scheduler", "plugins": plugins}
+    if reverse:
+        profile["pluginConfig"] = [
+            {"name": "NodeNumber", "args": {"reverse": True}}
+        ]
+    return SchedulerConfiguration.from_dict({"profiles": [profile]})
+
+
+def test_suffix_match_drives_placement():
+    nodes = [node("node0"), node("node1"), node("node3")]
+    pods = [pod("web1"), pod("db3")]
+    cfg = _config()
+    enc = encode_cluster(nodes, pods, cfg, policy=EXACT)
+    sched = BatchedScheduler(enc, record=True)
+    sched.run()
+    got = sched.placements()
+    assert got[("default", "web1")] == "node1"
+    assert got[("default", "db3")] == "node3"
+
+
+def test_reverse_arg():
+    nodes = [node("node1"), node("node2")]
+    pods = [pod("app1")]
+    enc = encode_cluster(nodes, pods, _config(reverse=True), policy=EXACT)
+    sched = BatchedScheduler(enc, record=False)
+    sched.run()
+    # reverse: the matching node scores 0, the non-matching scores 10
+    assert sched.placements()[("default", "app1")] == "node2"
+
+
+def test_oracle_kernel_parity():
+    nodes = [node(f"node{i}") for i in range(5)] + [node("master")]
+    pods = [pod(f"p{i}") for i in range(8)] + [pod("nodigit")]
+    cfg = _config()
+    oracle = Oracle([dict(n) for n in nodes], [dict(p) for p in pods], cfg)
+    oracle_res = {
+        (r.pod_namespace, r.pod_name): r.selected_node
+        for r in oracle.schedule_all()
+    }
+    enc = encode_cluster(nodes, pods, cfg, policy=EXACT)
+    sched = BatchedScheduler(enc, record=True)
+    sched.run()
+    assert sched.placements() == oracle_res

@@ -1,0 +1,62 @@
+"""Retry + bounded map (reference util semantics)."""
+
+import pytest
+
+from kube_scheduler_simulator_tpu.utils.tasks import (
+    RetryError,
+    bounded_map,
+    retry,
+)
+
+
+def test_retry_succeeds_after_failures():
+    calls = {"n": 0}
+    delays = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert retry(flaky, sleep=delays.append) == "ok"
+    assert calls["n"] == 3
+    # exponential: 100ms, then 300ms (retry.go 100ms x 3^n)
+    assert delays == pytest.approx([0.1, 0.3])
+
+
+def test_retry_exhausts():
+    delays = []
+    with pytest.raises(RetryError) as ei:
+        retry(lambda: 1 / 0, steps=3, sleep=delays.append)
+    assert ei.value.attempts == 3
+    assert isinstance(ei.value.last, ZeroDivisionError)
+    assert len(delays) == 2  # no sleep after the final attempt
+
+
+def test_retry_non_retryable_raises_immediately():
+    calls = {"n": 0}
+
+    def bad():
+        calls["n"] += 1
+        raise ValueError("fatal")
+
+    with pytest.raises(ValueError):
+        retry(bad, retryable=lambda e: isinstance(e, OSError),
+              sleep=lambda _: None)
+    assert calls["n"] == 1
+
+
+def test_bounded_map_order_and_error():
+    assert bounded_map(lambda x: x * x, list(range(20)), max_workers=4) == [
+        x * x for x in range(20)
+    ]
+
+    def boom(x):
+        if x == 3:
+            raise RuntimeError("x=3")
+        return x
+
+    with pytest.raises(RuntimeError):
+        bounded_map(boom, list(range(6)), max_workers=2)
+    assert bounded_map(lambda x: x, []) == []
