@@ -56,7 +56,47 @@ def _best_of(fn, reps=3):
     return best
 
 
+def _device_watchdog(timeout_s: float = 180.0) -> str:
+    """Return the platform name, or re-exec on the CPU backend when the
+    accelerator tunnel is wedged (observed failure mode: even
+    jax.devices() hangs forever; a hung bench loses the round's artifact
+    entirely, a CPU fallback keeps an honest, labeled number)."""
+    import os
+    import sys
+
+    from kube_scheduler_simulator_tpu.utils.axonenv import (
+        probe_devices,
+        scrubbed_cpu_env,
+    )
+
+    devices, error = probe_devices(timeout_s)
+    if devices:
+        return devices[0].platform
+    why = (
+        f"device init failed: {error!r}"
+        if error is not None
+        else f"device init hung >{timeout_s:.0f}s"
+    )
+    if os.environ.get("_KSS_BENCH_CPU_FALLBACK"):
+        raise RuntimeError(f"CPU fallback backend unusable — {why}")
+    sys.stderr.write(f"bench: {why}; re-exec on CPU backend\n")
+    env = scrubbed_cpu_env()
+    env["_KSS_BENCH_CPU_FALLBACK"] = "1"
+    os.execve(sys.executable, [sys.executable, __file__], env)
+
+
 def main():
+    import os
+
+    platform = _device_watchdog()
+    global N_NODES, N_PODS, N_VARIANTS, SCALE_NODES, SCALE_PODS
+    if os.environ.get("_KSS_BENCH_CPU_FALLBACK"):
+        # degraded-mode shapes: the CPU fallback exists to save the
+        # round's artifact, not to simulate a chip — keep it finishable
+        N_NODES, N_PODS, N_VARIANTS = 128, 512, 8
+        SCALE_NODES, SCALE_PODS = 256, 2048
+        platform = "cpu-fallback(reduced shapes)"
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -135,7 +175,7 @@ def main():
                 "metric": "scheduling decisions/sec/chip",
                 "value": round(sweep_dps, 1),
                 "unit": (
-                    f"decisions/s; sweep {N_VARIANTS}x{N_PODS}pods"
+                    f"decisions/s on {platform}; sweep {N_VARIANTS}x{N_PODS}pods"
                     f"x{N_NODES}nodes={round(sweep_dps, 1)}/s (default set "
                     f"minus postFilter), single full default set="
                     f"{round(single_dps, 1)}/s, {SCALE_PODS}pods"

@@ -222,7 +222,9 @@ class BatchedScheduler:
     # -- compile reuse ------------------------------------------------------
 
     @staticmethod
-    def compile_signature(enc: EncodedCluster, record: bool = True) -> tuple:
+    def compile_signature(
+        enc: EncodedCluster, record: bool = True, include_queue_len: bool = True
+    ) -> tuple:
         """Everything the compiled program bakes in beyond its argument
         shapes: the configuration (kernel selection + static plugin args),
         dtype policy, the resource-vocabulary order (score-resource indices
@@ -256,7 +258,10 @@ class BatchedScheduler:
             tuple(enc.resource_names),
             enc.aux.get("n_node_pairs"),
             victim_bound,
-            len(enc.queue),
+            # the scan length is baked into the sequential program; gang
+            # mode passes the queue as a fixed-[P] order argument and
+            # drops this component (GangScheduler.compile_signature)
+            len(enc.queue) if include_queue_len else None,
             record,
             custom_statics,
             shapes,

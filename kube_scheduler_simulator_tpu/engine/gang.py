@@ -31,11 +31,15 @@ same node and rounds would commit one pod each — the matching commits
 up to N pods per round, so rounds ≈ max pods per node.
 
 One pod per node commits per round, so within a round committed pods
-cannot interact through node-local state (resources, ports, volumes,
-image locality, balanced allocation — every default plugin's state
-dependence except the global topology-spread / inter-pod-affinity
-counts). Losers re-evaluate next round against the updated state,
-exactly as the sequential loop would have seen it.
+cannot interact through node-local state (resources, ports, per-node
+volume counts, image locality, balanced allocation). The two
+cluster-global state dependences are handled separately:
+ReadWriteOncePod claims get their own per-claim conflict resolution in
+the matching (at most one claimant commits per round; see `match`),
+while the global topology-spread / inter-pod-affinity counts remain the
+documented within-round divergence below. Losers re-evaluate next round
+against the updated state, exactly as the sequential loop would have
+seen it.
 
 Divergence policy (documented, per SURVEY §7 M4):
 
@@ -214,20 +218,35 @@ class GangScheduler:
                 _, progressed, rounds = carry
                 return progressed & (rounds < max_rounds)
 
+            C = arrays.pod_claim.shape[1]
+            pod_claim = arrays.pod_claim.astype(bool)
+
             def match(scores):
                 """One-commit-per-node matching over the round's masked
                 score matrix: argmax → earliest-order winner per node →
                 losers retry their next-best untaken node. No kernel
-                re-evaluation — pure selects over [P, N]."""
+                re-evaluation — pure selects over [P, N].
+
+                ReadWriteOncePod claims are cluster-global, so node
+                serialization alone can't protect them: two claimants
+                could win different nodes in one round. The matching
+                therefore also carries per-claim consumption — a pod
+                commits only if it is the earliest-order committer for
+                every claim it uses, and consumed claims knock their
+                other claimants out of the rest of the round (next
+                round's evaluation sees used_claims > 0 and rejects them
+                exactly like the sequential engine)."""
 
                 def m_cond(c):
-                    _, _, changed, it = c
+                    _, _, _, changed, it = c
                     return changed & (it < inner_iters)
 
                 def m_body(c):
-                    taken, sel_acc, _, it = c
+                    taken, claim_taken, sel_acc, _, it = c
                     m = jnp.where(taken[None, :], FLOOR, scores)
                     m = jnp.where((sel_acc >= 0)[:, None], FLOOR, m)
+                    claim_blocked = (pod_claim & claim_taken[None, :]).any(axis=1)
+                    m = jnp.where(claim_blocked[:, None], FLOOR, m)
                     cand = jnp.argmax(m, axis=1).astype(jnp.int32)
                     has = jnp.take_along_axis(
                         m, cand[:, None], axis=1
@@ -239,18 +258,36 @@ class GangScheduler:
                         .min(order)
                     )
                     commit = has & (winner[jnp.maximum(cand, 0)] == order)
+                    # per-claim winner among this iteration's committers
+                    claim_order = jnp.where(
+                        commit[:, None] & pod_claim, order[:, None], _NO_ORDER
+                    )
+                    claim_min = claim_order.min(axis=0)  # [C]
+                    claim_ok = jnp.where(
+                        pod_claim, claim_min[None, :] == order[:, None], True
+                    ).all(axis=1)
+                    commit = commit & claim_ok
                     sel_acc = jnp.where(commit, cand, sel_acc)
                     taken = taken | (
                         jnp.zeros((N + 1,), bool)
                         .at[jnp.where(commit, cand, N)]
                         .set(True)[:N]
                     )
-                    return taken, sel_acc, commit.any(), it + jnp.int32(1)
+                    claim_taken = claim_taken | (
+                        pod_claim & commit[:, None]
+                    ).any(axis=0)
+                    return (
+                        taken, claim_taken, sel_acc,
+                        commit.any(), it + jnp.int32(1),
+                    )
 
                 taken0 = jnp.zeros((N,), bool)
+                claims0 = jnp.zeros((C,), bool)
                 sel0 = jnp.full((P,), -1, jnp.int32)
-                taken, sel_acc, _, _ = jax.lax.while_loop(
-                    m_cond, m_body, (taken0, sel0, jnp.bool_(True), jnp.int32(0))
+                taken, _, sel_acc, _, _ = jax.lax.while_loop(
+                    m_cond,
+                    m_body,
+                    (taken0, claims0, sel0, jnp.bool_(True), jnp.int32(0)),
                 )
                 return sel_acc
 
@@ -295,10 +332,23 @@ class GangScheduler:
             )
         return out
 
+    @staticmethod
+    def compile_signature(enc: EncodedCluster) -> tuple:
+        """Everything the compiled gang program bakes in. Unlike the
+        sequential scan, the queue rides in as a fixed-[P] `order`
+        argument, so two encodings differing only in pending-queue
+        length share one compilation."""
+        return BatchedScheduler.compile_signature(
+            enc, record=False, include_queue_len=False
+        )
+
     def retarget(self, enc: EncodedCluster) -> "GangScheduler":
         """Point at a compile-compatible new encoding (see
         BatchedScheduler.retarget)."""
-        self._base.retarget(enc)  # validates the signature
+        if self.compile_signature(enc) != self.compile_signature(self.enc):
+            raise ValueError("encoding is not compile-compatible; rebuild")
+        # keep the base engine's host-side decode tables in sync
+        self._base.enc = enc
         self.enc = enc
         self._final_state = None
         self._rounds = None

@@ -164,6 +164,13 @@ def run_batch(
     one-shot isolation).
     """
 
+    names = [j.name for j in jobs]
+    dupes = {n for n in names if names.count(n) > 1}
+    if dupes:
+        raise ValueError(
+            f"duplicate job names would silently drop results: {sorted(dupes)}"
+        )
+
     def one(job: BatchJob) -> tuple[str, dict]:
         try:
             return job.name, run_job(job, mesh=mesh)

@@ -17,6 +17,8 @@ typed store — SURVEY.md §2 #3):
     GET/PUT            /api/v1/resources/<kind>
     GET/DELETE         /api/v1/resources/<kind>/<ns>/<name>  (or /<name>)
     POST               /api/v1/schedule      run one batched scheduling pass
+    GET                /api/v1/metrics       scheduling-pass counters
+                                             (decisions/sec, utils/metrics.py)
 
 The watch stream mirrors the reference's wire shape — a sequence of JSON
 objects `{"Kind": ..., "EventType": ..., "Obj": {...}}` flushed per event
@@ -178,7 +180,39 @@ def _make_handler(server: SimulatorServer):
                     return self._json(200, {"errors": errs})
                 if rest == ["listwatchresources"] and method == "GET":
                     return self._list_watch(parse_qs(url.query))
+                if rest == ["metrics"] and method == "GET":
+                    from ..utils import metrics as metrics_mod
+
+                    return self._json(200, metrics_mod.GLOBAL.snapshot())
                 if rest == ["schedule"] and method == "POST":
+                    mode = parse_qs(url.query).get("mode", ["sequential"])[0]
+                    if mode not in ("sequential", "gang"):
+                        return self._error(
+                            400, f"unknown scheduling mode {mode!r}"
+                        )
+                    if mode == "gang":
+                        try:
+                            placements, rounds = (
+                                service.scheduler.schedule_gang()
+                            )
+                        except ValueError as e:
+                            # known-unsupported combination (extenders
+                            # configured) is the client's request, not a
+                            # server fault
+                            return self._error(400, str(e))
+                        return self._json(
+                            200,
+                            {
+                                "mode": "gang",
+                                "rounds": rounds,
+                                "scheduled": sum(
+                                    1 for v in placements.values() if v
+                                ),
+                                "unschedulable": sum(
+                                    1 for v in placements.values() if not v
+                                ),
+                            },
+                        )
                     results = service.scheduler.schedule()
                     return self._json(
                         200,

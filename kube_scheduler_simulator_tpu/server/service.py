@@ -39,6 +39,7 @@ from ..models.store import ResourceStore
 from ..sched.config import SchedulerConfiguration
 from ..sched.extender import ExtenderService
 from ..sched.results import PodSchedulingResult
+from ..utils import metrics as metrics_mod
 
 
 class InvalidSchedulerConfiguration(ValueError):
@@ -71,6 +72,7 @@ class SchedulerService:
         self._schedule_lock = threading.Lock()
         self._engine_cache: "tuple[tuple, BatchedScheduler] | None" = None
         self._extender_engine_cache: "tuple[tuple, object] | None" = None
+        self._gang_engine_cache: "tuple[tuple, object] | None" = None
         self.extender_service = ExtenderService(self._config.extenders)
 
     # -- configuration lifecycle -------------------------------------------
@@ -107,30 +109,100 @@ class SchedulerService:
     # -- scheduling ---------------------------------------------------------
 
     def schedule(self) -> list[PodSchedulingResult]:
-        """One batched scheduling pass over the store's current state.
+        """One batched sequential scheduling pass over the store's state.
 
         Encodes the cluster, runs the engine, writes `spec.nodeName` and
         the 13 result annotations back onto pod objects, and deletes
         preemption victims. Returns the per-pod records. Passes are
         serialized — concurrent HTTP triggers queue up rather than
-        interleaving their write-backs.
+        interleaving their write-backs. For bulk throughput without
+        per-plugin records, see `schedule_gang`.
         """
         with self._schedule_lock:
-            return self._schedule_locked()
+            # one config read per pass: encode, branch, and label must
+            # all see the same configuration even if restart() lands
+            # mid-pass
+            with self._lock:
+                config = self._config
+            with metrics_mod.GLOBAL.time_pass(
+                "extender" if config.extenders else "sequential"
+            ) as ctx:
+                results = self._schedule_locked(config)
+                ctx.done(
+                    pods=len(results),
+                    scheduled=sum(
+                        1 for r in results if r.status == "Scheduled"
+                    ),
+                )
+            return results
 
-    def _schedule_locked(self) -> list[PodSchedulingResult]:
+    def schedule_gang(self) -> tuple[dict, int]:
+        """Gang pass with pass serialization; returns
+        ({(ns, name): node | ""}, rounds)."""
+        with self._schedule_lock:
+            return self._schedule_gang_timed()
+
+    def _schedule_gang_timed(self) -> tuple[dict, int]:
         with self._lock:
             config = self._config
+        if config.extenders:
+            raise ValueError(
+                "gang mode does not support extenders; use sequential mode"
+            )
+        with metrics_mod.GLOBAL.time_pass("gang") as ctx:
+            placements, rounds = self._schedule_gang_locked(config)
+            ctx.done(
+                pods=len(placements),
+                scheduled=sum(1 for v in placements.values() if v),
+                rounds=rounds,
+            )
+        return placements, rounds
+
+    def _schedule_gang_locked(self, config) -> tuple[dict, int]:
+        """Gang pass: encode, run to fixpoint, write nodeName back."""
+        import numpy as np
+
+        from ..engine.gang import GangScheduler
+
+        enc = self._encode_current(config)
+        if enc is None:
+            return {}, 0
+        sig = GangScheduler.compile_signature(enc)
+        cache = self._gang_engine_cache
+        if cache and cache[0] == sig:
+            gang = cache[1].retarget(enc)
+        else:
+            gang = GangScheduler(enc, strict=True)
+            self._gang_engine_cache = (sig, gang)
+        _, rounds = gang.run()
+        placements = gang.placements()
+        for (ns, name), node_name in placements.items():
+            if not node_name:
+                continue
+            if self.store.get("pods", name, ns) is not None:
+                self.store.apply(
+                    "pods",
+                    {
+                        "metadata": {"name": name, "namespace": ns},
+                        "spec": {"nodeName": node_name},
+                    },
+                )
+        return placements, int(np.asarray(rounds))
+
+    def _encode_current(self, config) -> "object | None":
+        """Encode the store's current pending state under the pass's
+        single config read (shared by the sequential and gang passes);
+        None when nothing is schedulable."""
         nodes = self.store.list("nodes")
         pods = self.store.list("pods")
         if not nodes or not pods:
-            return []
+            return None
         pending = [
             p for p in pods if not (p.get("spec", {}) or {}).get("nodeName")
         ]
         if not pending:
-            return []
-        enc = encode_cluster(
+            return None
+        return encode_cluster(
             nodes,
             pods,
             config,
@@ -143,6 +215,11 @@ class SchedulerService:
             node_capacity=_pow2(len(nodes)),
             pod_capacity=_pow2(len(pods)),
         )
+
+    def _schedule_locked(self, config) -> list[PodSchedulingResult]:
+        enc = self._encode_current(config)
+        if enc is None:
+            return []
         if config.extenders:
             # host-callback loop: device segments + extender HTTP calls,
             # with the same compiled-program reuse as the batch path

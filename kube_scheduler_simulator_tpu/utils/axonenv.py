@@ -1,0 +1,73 @@
+"""Subprocess environment for escaping the single-chip TPU shim.
+
+This build image pins every Python process to one real TPU chip through a
+sitecustomize shim (env: AXON*/PALLAS_AXON* + a PYTHONPATH site dir).
+Plain ``JAX_PLATFORMS=cpu`` does NOT escape it — backend init hangs — so
+anything that needs a real CPU backend in a subprocess (the multi-chip
+virtual-mesh dryrun, the benchmark's wedged-tunnel fallback) must scrub
+the shim env first. This is the single definition of that scrub; keep the
+shim's env contract knowledge here only.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+
+def probe_devices(timeout_s: float = 180.0) -> tuple[list, "BaseException | None"]:
+    """Discover jax.devices() under a watchdog (a wedged TPU tunnel hangs
+    even device enumeration — the observed failure mode this guards).
+
+    Returns (devices, error): a non-empty device list on success; an
+    empty list with the probe's exception when backend init *failed*; an
+    empty list and None when it *hung* past the timeout (the daemon
+    thread is abandoned — it must not block process exit)."""
+    out: list = []
+    err: list = []
+
+    def probe():
+        try:
+            import jax
+
+            out.extend(jax.devices())
+        except BaseException as e:  # noqa: BLE001 — reported to caller
+            err.append(e)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    return list(out), (err[0] if err else None)
+
+
+def scrubbed_cpu_env(
+    base: "dict[str, str] | None" = None,
+    *,
+    virtual_devices: "int | None" = None,
+) -> dict[str, str]:
+    """A copy of `base` (default os.environ) with the TPU shim removed and
+    JAX pinned to the CPU backend. `virtual_devices` adds the
+    xla_force_host_platform_device_count flag for an n-device virtual
+    mesh."""
+    env = {
+        k: v
+        for k, v in (os.environ if base is None else base).items()
+        if not k.startswith(("AXON", "PALLAS_AXON", "_AXON"))
+    }
+    env["PYTHONPATH"] = os.pathsep.join(
+        p
+        for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and ".axon_site" not in p
+    )
+    env["JAX_PLATFORMS"] = "cpu"
+    if virtual_devices is not None:
+        flags = [
+            f
+            for f in env.get("XLA_FLAGS", "").split()
+            if not f.startswith("--xla_force_host_platform_device_count")
+        ]
+        flags.append(
+            f"--xla_force_host_platform_device_count={virtual_devices}"
+        )
+        env["XLA_FLAGS"] = " ".join(flags)
+    return env

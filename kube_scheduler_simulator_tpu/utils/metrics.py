@@ -1,0 +1,123 @@
+"""In-framework scheduling metrics + profiler wiring (SURVEY.md §5).
+
+The reference has no metrics beyond echo request logging — its *product*
+is the decision trace. Here the BASELINE metric (scheduling decisions per
+second per chip) is a first-class counter: every scheduling pass reports
+into a process-wide `SchedulingMetrics` registry that the serving layer
+exposes (`GET /api/v1/metrics`, an extension route) and benchmarks read
+directly.
+
+`profile_trace` wraps `jax.profiler.trace` so a pass can be captured for
+TensorBoard/XProf without the caller importing jax.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+
+@dataclass
+class PassRecord:
+    """One scheduling pass (one engine execution over the queue)."""
+
+    mode: str  # "sequential" | "gang" | "extender"
+    pods: int  # queue length scheduled over
+    scheduled: int  # pods that received a node
+    wall_s: float
+    rounds: int = 0  # gang mode only
+
+    @property
+    def decisions_per_s(self) -> float:
+        return self.pods / self.wall_s if self.wall_s > 0 else 0.0
+
+
+@dataclass
+class SchedulingMetrics:
+    """Thread-safe rolling pass statistics (the decisions/sec/chip
+    counter from BASELINE.json, kept in-framework)."""
+
+    keep: int = 256
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+    _passes: list[PassRecord] = field(default_factory=list, repr=False)
+    _pass_count: int = 0  # monotonic; _passes is a bounded window of it
+    _total_pods: int = 0
+    _total_scheduled: int = 0
+    _total_wall_s: float = 0.0
+
+    def record(self, rec: PassRecord) -> None:
+        with self._lock:
+            self._passes.append(rec)
+            if len(self._passes) > self.keep:
+                self._passes = self._passes[-self.keep :]
+            self._pass_count += 1
+            self._total_pods += rec.pods
+            self._total_scheduled += rec.scheduled
+            self._total_wall_s += rec.wall_s
+
+    @contextmanager
+    def time_pass(self, mode: str):
+        """Context manager: `ctx.done(pods, scheduled, rounds=...)` inside
+        the block stamps the pass; wall time is measured around it."""
+        holder = {}
+
+        class _Ctx:
+            @staticmethod
+            def done(pods: int, scheduled: int, rounds: int = 0):
+                holder["args"] = (pods, scheduled, rounds)
+
+        t0 = time.perf_counter()
+        yield _Ctx
+        wall = time.perf_counter() - t0
+        pods, scheduled, rounds = holder.get("args", (0, 0, 0))
+        self.record(PassRecord(mode, pods, scheduled, wall, rounds))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            recent = self._passes[-16:]
+            return {
+                "passes": self._pass_count,
+                "totalPods": self._total_pods,
+                "totalScheduled": self._total_scheduled,
+                "totalWallSeconds": round(self._total_wall_s, 6),
+                "decisionsPerSecond": round(
+                    self._total_pods / self._total_wall_s, 2
+                )
+                if self._total_wall_s > 0
+                else 0.0,
+                "recent": [
+                    {
+                        "mode": r.mode,
+                        "pods": r.pods,
+                        "scheduled": r.scheduled,
+                        "wallSeconds": round(r.wall_s, 6),
+                        "decisionsPerSecond": round(r.decisions_per_s, 2),
+                        "rounds": r.rounds,
+                    }
+                    for r in recent
+                ],
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._passes.clear()
+            self._pass_count = 0
+            self._total_pods = 0
+            self._total_scheduled = 0
+            self._total_wall_s = 0.0
+
+
+# process-wide default registry (the serving layer's instance)
+GLOBAL = SchedulingMetrics()
+
+
+@contextmanager
+def profile_trace(log_dir: str):
+    """Capture a JAX profiler trace (TensorBoard/XProf format) around the
+    block — per-phase device timing for any pass run inside."""
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield

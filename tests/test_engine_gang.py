@@ -183,6 +183,35 @@ def test_weight_sweep_vmap_matches_per_variant_runs():
         )
 
 
+def test_rwop_claim_single_winner_per_round():
+    # cluster-global constraint: two pods share a ReadWriteOncePod claim
+    # and could win DIFFERENT nodes in the same round — the per-claim
+    # conflict resolution must let exactly one commit (the earlier in
+    # queue order), and the next round must reject the other
+    from test_engine_parity_vol import claim_vol, pv, pvc, vol_config
+
+    nodes = [node("n0"), node("n1")]
+    pods = [
+        pod("first", priority=10, volumes=[claim_vol("solo")]),
+        pod("second", priority=1, volumes=[claim_vol("solo")]),
+    ]
+    kw = dict(
+        pvcs=[pvc("solo", modes=("ReadWriteOncePod",), volume_name="pv-s")],
+        pvs=[pv("pv-s")],
+    )
+    cfg = vol_config()
+    enc = encode_cluster(nodes, pods, cfg, policy=EXACT, **kw)
+    gang = GangScheduler(enc)
+    got = _placements(gang)
+    assert got[("default", "first")] != ""
+    assert got[("default", "second")] == ""
+    # matches the sequential engine on this workload
+    seq = BatchedScheduler(
+        encode_cluster(nodes, pods, cfg, policy=EXACT, **kw), record=False
+    )
+    assert got == _placements(seq)
+
+
 def test_full_default_config_accepted_postfilter_skipped():
     from kube_scheduler_simulator_tpu.engine.engine import supported_config
 

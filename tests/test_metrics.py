@@ -1,0 +1,65 @@
+"""Scheduling metrics counters + the /api/v1/metrics route."""
+
+import json
+import urllib.request
+
+from kube_scheduler_simulator_tpu.utils.metrics import (
+    GLOBAL,
+    PassRecord,
+    SchedulingMetrics,
+)
+
+from helpers import node, pod
+
+
+def test_counters_accumulate():
+    m = SchedulingMetrics(keep=3)
+    for i in range(5):
+        m.record(PassRecord("sequential", pods=10, scheduled=9, wall_s=0.5))
+    snap = m.snapshot()
+    assert snap["passes"] == 5  # monotonic count
+    assert len(snap["recent"]) == 3  # rolling window
+    assert snap["totalPods"] == 50  # totals keep accumulating
+    assert snap["totalScheduled"] == 45
+    assert snap["decisionsPerSecond"] == 20.0
+    assert snap["recent"][0]["decisionsPerSecond"] == 20.0
+    m.reset()
+    assert m.snapshot()["passes"] == 0
+
+
+def test_time_pass_context():
+    m = SchedulingMetrics()
+    with m.time_pass("gang") as ctx:
+        ctx.done(pods=7, scheduled=7, rounds=3)
+    snap = m.snapshot()
+    assert snap["recent"][0]["mode"] == "gang"
+    assert snap["recent"][0]["rounds"] == 3
+    assert snap["recent"][0]["wallSeconds"] > 0
+
+
+def test_schedule_pass_records_and_route_serves(tmp_path):
+    from kube_scheduler_simulator_tpu.server.httpserver import SimulatorServer
+    from kube_scheduler_simulator_tpu.server.service import SimulatorService
+
+    GLOBAL.reset()
+    server = SimulatorServer(SimulatorService(), port=0).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}/api/v1"
+        for obj, kind in [(node("n0"), "nodes"), (pod("p0"), "pods")]:
+            req = urllib.request.Request(
+                f"{base}/resources/{kind}",
+                data=json.dumps(obj).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"},
+            )
+            urllib.request.urlopen(req)
+        urllib.request.urlopen(
+            urllib.request.Request(f"{base}/schedule", data=b"", method="POST")
+        )
+        with urllib.request.urlopen(f"{base}/metrics") as resp:
+            snap = json.load(resp)
+        assert snap["passes"] >= 1
+        assert snap["totalScheduled"] >= 1
+        assert snap["recent"][-1]["mode"] == "sequential"
+    finally:
+        server.shutdown()

@@ -1,0 +1,72 @@
+"""Service-level gang scheduling: write-back, cache reuse, HTTP route."""
+
+import json
+import urllib.request
+
+import pytest
+
+from kube_scheduler_simulator_tpu.server.service import SimulatorService
+
+from helpers import node, pod
+
+
+def _fill(svc, n_nodes=3, n_pods=6):
+    for i in range(n_nodes):
+        svc.store.apply("nodes", node(f"n{i}"))
+    for i in range(n_pods):
+        svc.store.apply("pods", pod(f"p{i}"))
+
+
+def test_gang_pass_writes_node_names():
+    svc = SimulatorService()
+    _fill(svc)
+    placements, rounds = svc.scheduler.schedule_gang()
+    assert rounds >= 1
+    assert all(v for v in placements.values())
+    for i in range(6):
+        obj = svc.store.get("pods", f"p{i}", "default")
+        assert obj["spec"]["nodeName"] == placements[("default", f"p{i}")]
+
+
+def test_gang_engine_cache_reused_across_passes():
+    svc = SimulatorService()
+    _fill(svc)
+    svc.scheduler.schedule_gang()
+    cached = svc.scheduler._gang_engine_cache
+    assert cached is not None
+    # same shapes/config: second pass must reuse the compiled engine
+    svc.store.apply("pods", pod("extra"))
+    svc.scheduler.schedule_gang()
+    assert svc.scheduler._gang_engine_cache[1] is cached[1]
+    assert svc.store.get("pods", "extra", "default")["spec"].get("nodeName")
+
+
+def test_gang_rejects_extenders():
+    svc = SimulatorService()
+    _fill(svc)
+    svc.scheduler._config.extenders.append(
+        {"urlPrefix": "http://localhost:9", "filterVerb": "filter"}
+    )
+    with pytest.raises(ValueError, match="extenders"):
+        svc.scheduler.schedule_gang()
+
+
+def test_http_gang_route():
+    from kube_scheduler_simulator_tpu.server.httpserver import SimulatorServer
+
+    svc = SimulatorService()
+    _fill(svc, n_nodes=2, n_pods=4)
+    server = SimulatorServer(svc, port=0).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}/api/v1"
+        with urllib.request.urlopen(
+            urllib.request.Request(
+                f"{base}/schedule?mode=gang", data=b"", method="POST"
+            )
+        ) as resp:
+            out = json.load(resp)
+        assert out["mode"] == "gang"
+        assert out["scheduled"] == 4
+        assert out["rounds"] >= 1
+    finally:
+        server.shutdown()
