@@ -45,6 +45,12 @@ SCALE_NODES = 1024
 SCALE_PODS = 10_000
 UNROLL = 4  # scan unroll: ~13% step-overhead win at moderate compile cost
 BASELINE_PODS = 48  # oracle sample (sequential python, full plugin set)
+# degraded shapes used when the accelerator is wedged and bench re-execs
+# on the CPU backend (single source: main() and _gang_probe must agree)
+CPU_FALLBACK = {
+    "N_NODES": 128, "N_PODS": 512, "N_VARIANTS": 8,
+    "SCALE_NODES": 256, "SCALE_PODS": 2048,
+}
 
 
 def _best_of(fn, reps=3):
@@ -85,6 +91,76 @@ def _device_watchdog(timeout_s: float = 180.0) -> str:
     os.execve(sys.executable, [sys.executable, __file__], env)
 
 
+def _gang_probe():
+    """Subprocess mode (`bench.py --gang-probe`): measure the gang
+    scheduler at the bench shape and print one JSON line. Run isolated
+    because gang's `lax.while_loop` program has never been observed to
+    finish compiling on the experimental axon backend — the parent
+    bench must survive that (subprocess + timeout), and a success here
+    upgrades the headline."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kube_scheduler_simulator_tpu.engine import TPU32, encode_cluster
+    from kube_scheduler_simulator_tpu.engine.engine import supported_config
+    from kube_scheduler_simulator_tpu.engine.gang import GangScheduler
+    from kube_scheduler_simulator_tpu.synth import synthetic_cluster
+
+    n_nodes, n_pods = N_NODES, N_PODS
+    if os.environ.get("_KSS_BENCH_CPU_FALLBACK"):
+        n_nodes, n_pods = CPU_FALLBACK["N_NODES"], CPU_FALLBACK["N_PODS"]
+    nodes, pods = synthetic_cluster(n_nodes, n_pods, seed=42)
+    enc = encode_cluster(nodes, pods, supported_config(), policy=TPU32)
+    gang = GangScheduler(enc, chunk=128)
+    order, _ = gang.order_arrays()
+    run = jax.jit(gang.run_fn)
+    args = (enc.arrays, enc.state0, order, gang.weights)
+    state, rounds = run(*args)
+    np.asarray(state.assignment)  # compile + sync
+    best = _best_of(lambda: np.asarray(run(*args)[0].assignment))
+    # the program is deterministic: reuse the warm-up call's state/rounds
+    print(
+        json.dumps(
+            {
+                "gang_dps": round(n_pods / best, 1),
+                "rounds": int(np.asarray(rounds)),
+                "scheduled": int((np.asarray(state.assignment) >= 0).sum()),
+            }
+        )
+    )
+
+
+def _try_gang_subprocess(timeout_s: float = 900.0) -> "dict | None":
+    """Run the gang probe isolated; None when it can't finish in time."""
+    import os
+    import subprocess
+    import sys
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, __file__, "--gang-probe"],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            env=os.environ,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    if proc.returncode != 0:
+        return None
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            out = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(out, dict) and "gang_dps" in out:
+            return out
+    return None
+
+
 def main():
     import os
 
@@ -93,8 +169,10 @@ def main():
     if os.environ.get("_KSS_BENCH_CPU_FALLBACK"):
         # degraded-mode shapes: the CPU fallback exists to save the
         # round's artifact, not to simulate a chip — keep it finishable
-        N_NODES, N_PODS, N_VARIANTS = 128, 512, 8
-        SCALE_NODES, SCALE_PODS = 256, 2048
+        N_NODES, N_PODS = CPU_FALLBACK["N_NODES"], CPU_FALLBACK["N_PODS"]
+        N_VARIANTS = CPU_FALLBACK["N_VARIANTS"]
+        SCALE_NODES = CPU_FALLBACK["SCALE_NODES"]
+        SCALE_PODS = CPU_FALLBACK["SCALE_PODS"]
         platform = "cpu-fallback(reduced shapes)"
 
     import jax
@@ -169,17 +247,27 @@ def main():
     oracle.schedule_all()
     base_dps = BASELINE_PODS / (time.perf_counter() - t0)
 
+    # gang mode, isolated (see _gang_probe); a stall cannot hang bench
+    gang = _try_gang_subprocess()
+    gang_note = (
+        f", gang fixpoint={gang['gang_dps']}/s in {gang['rounds']} rounds"
+        if gang
+        else ", gang=n/a (did not finish in isolation window)"
+    )
+    headline = max(sweep_dps, gang["gang_dps"] if gang else 0.0)
+
     print(
         json.dumps(
             {
                 "metric": "scheduling decisions/sec/chip",
-                "value": round(sweep_dps, 1),
+                "value": round(headline, 1),
                 "unit": (
                     f"decisions/s on {platform}; sweep {N_VARIANTS}x{N_PODS}pods"
                     f"x{N_NODES}nodes={round(sweep_dps, 1)}/s (default set "
                     f"minus postFilter), single full default set="
                     f"{round(single_dps, 1)}/s, {SCALE_PODS}pods"
-                    f"x{SCALE_NODES}nodes={round(scale_dps, 1)}/s; "
+                    f"x{SCALE_NODES}nodes={round(scale_dps, 1)}/s"
+                    f"{gang_note}; "
                     f"vs_baseline = single vs the repo's python oracle on "
                     f"the same config (Go reference unrunnable here)"
                 ),
@@ -191,4 +279,9 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    if "--gang-probe" in sys.argv:
+        _gang_probe()
+    else:
+        main()

@@ -1,0 +1,12 @@
+"""Package entry point: ``python -m kube_scheduler_simulator_tpu``.
+
+The reference's single binary boots config → state store → controllers →
+scheduler → HTTP server (simulator/simulator.go:23-106); here the same
+boot lives in the server CLI (server/__main__.py) — this alias makes the
+package itself runnable, the `sim.run()` driver from SURVEY.md §2 #1.
+"""
+
+from .server.__main__ import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -239,6 +239,28 @@ class EncodedCluster:
     def R(self) -> int:
         return len(self.resource_names)
 
+    # -- result decoding (single source for every driver) -------------------
+
+    def decode_assignment(self, assignment) -> dict:
+        """[P] pod-indexed node assignments → {(ns, name): node | ""} over
+        the queued pods (BatchedScheduler/GangScheduler final state)."""
+        assignment = np.asarray(assignment)
+        out = {}
+        for p in self.queue:
+            s = int(assignment[p])
+            out[self.pod_keys[p]] = self.node_names[s] if s >= 0 else ""
+        return out
+
+    def decode_selection(self, sels) -> dict:
+        """[Q] queue-position-indexed selections → {(ns, name): node | ""}
+        (the sequential scan's per-step selection trace)."""
+        sels = np.asarray(sels)
+        out = {}
+        for qi, p in enumerate(self.queue):
+            s = int(sels[qi])
+            out[self.pod_keys[p]] = self.node_names[s] if s >= 0 else ""
+        return out
+
 
 def _encode_taints(node_views, pod_views, N, P):
     """TaintToleration encodings (oracle: taint_toleration_filter/score,

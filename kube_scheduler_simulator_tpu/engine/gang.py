@@ -323,14 +323,7 @@ class GangScheduler:
         """pod (ns, name) → node name ("" = unschedulable)."""
         if self._final_state is None:
             self.run()
-        assign = np.asarray(self._final_state.assignment)
-        out = {}
-        for qi in self.enc.queue:
-            sel = int(assign[qi])
-            out[self.enc.pod_keys[qi]] = (
-                self.enc.node_names[sel] if sel >= 0 else ""
-            )
-        return out
+        return self.enc.decode_assignment(self._final_state.assignment)
 
     @staticmethod
     def compile_signature(enc: EncodedCluster) -> tuple:

@@ -55,11 +55,14 @@ class WatchEvent:
 class ResourceStore:
     """Typed collections with list/watch semantics."""
 
-    def __init__(self):
+    def __init__(self, event_log_capacity: int = 100_000):
         self._lock = threading.RLock()
         self._rv = itertools.count(1)
         self._objs: dict[str, dict[str, dict]] = {k: {} for k in KINDS}
         self._events: list[WatchEvent] = []
+        # bounded event log: past capacity, the older half is dropped and
+        # watchers behind it get StaleResourceVersion (410-Gone analogue)
+        self._event_log_capacity = max(2, int(event_log_capacity))
         self._pruned_through = 0  # highest resourceVersion dropped from the log
         self._subscribers: list[Callable[[WatchEvent], None]] = []
         self._initial_snapshot: "dict | None" = None
@@ -196,9 +199,10 @@ class ResourceStore:
         """Append to the event log (under self._lock) and queue for
         subscriber delivery — callbacks run later, outside the lock."""
         self._events.append(ev)
-        if len(self._events) > 100_000:
-            self._pruned_through = self._events[49_999].resource_version
-            del self._events[:50_000]
+        if len(self._events) > self._event_log_capacity:
+            drop = self._event_log_capacity // 2
+            self._pruned_through = self._events[drop - 1].resource_version
+            del self._events[:drop]
         self._delivery.append(ev)
 
     def _dispatch(self):

@@ -3,12 +3,13 @@ and Monte-Carlo weight sweeps (SURVEY.md §2 parallelism table)."""
 
 from .mesh import build_mesh
 from .shard import NODE_AXIS_FIELDS, shard_encoded
-from .sweep import WeightSweep, weights_for
+from .sweep import GangSweep, WeightSweep, weights_for
 
 __all__ = [
     "build_mesh",
     "shard_encoded",
     "NODE_AXIS_FIELDS",
     "WeightSweep",
+    "GangSweep",
     "weights_for",
 ]

@@ -86,13 +86,64 @@ class WeightSweep:
     def placements(self, sels) -> list[dict]:
         """Decode selections into per-variant {(ns, name): node} dicts."""
         sels = np.asarray(sels)
-        out = []
-        for v in range(sels.shape[0]):
-            d = {}
-            for qi, p in enumerate(self.enc.queue):
-                s = int(sels[v, qi])
-                d[self.enc.pod_keys[p]] = (
-                    self.enc.node_names[s] if s >= 0 else ""
+        return [self.enc.decode_selection(sels[v]) for v in range(sels.shape[0])]
+
+
+class GangSweep:
+    """vmapped gang (fixpoint) sweep — the north-star program shape:
+    policy variants (dp over 'replicas') x node-sharded cluster x
+    round-parallel scheduling (engine/gang.py), all in one XLA program.
+
+    Compared to `WeightSweep` (the sequential scan vmapped), each
+    variant's pass is ~max-pods-per-node dense rounds instead of P
+    dependent steps — under vmap the `lax.while_loop` runs until every
+    variant's fixpoint, finished variants riding along unchanged."""
+
+    def __init__(self, enc: EncodedCluster, *, mesh: "Mesh | None" = None,
+                 chunk: int = 256):
+        from ..engine.gang import GangScheduler
+
+        self.enc = enc
+        self.mesh = mesh
+        self.gang = GangScheduler(enc, chunk=chunk)
+        self._vrun = jax.jit(
+            jax.vmap(self.gang.run_fn, in_axes=(None, None, None, 0))
+        )
+        order, _ = self.gang.order_arrays()
+        if mesh is not None:
+            arrays, state0, _ = shard_encoded(enc, mesh)
+            order = jax.device_put(order, NamedSharding(mesh, P()))
+            self._args = (arrays, state0, order)
+        else:
+            self._args = (enc.arrays, enc.state0, order)
+
+    def run(self, weight_matrix) -> tuple:
+        """weight_matrix: [V, S] ints. Returns (assignments[V, P_pad],
+        rounds[V]); V shards over 'replicas' when a mesh is attached."""
+        w = np.asarray(weight_matrix, np.int32)
+        if w.ndim != 2 or w.shape[1] != len(self.gang.weights):
+            raise ValueError(
+                f"weight matrix must be [V, {len(self.gang.weights)}], "
+                f"got {w.shape}"
+            )
+        wj = jnp.asarray(w, self.enc.policy.score)
+        if self.mesh is not None:
+            reps = self.mesh.shape["replicas"]
+            if w.shape[0] % reps != 0:
+                raise ValueError(
+                    f"{w.shape[0]} variants not divisible by the {reps}-way "
+                    "'replicas' mesh axis"
                 )
-            out.append(d)
-        return out
+            wj = jax.device_put(
+                wj, NamedSharding(self.mesh, P("replicas", None))
+            )
+        states, rounds = self._vrun(*self._args, wj)
+        return states.assignment, rounds
+
+    def placements(self, assignments) -> list[dict]:
+        """Per-variant {(ns, name): node} decode of the assignment axis."""
+        assignments = np.asarray(assignments)
+        return [
+            self.enc.decode_assignment(assignments[v])
+            for v in range(assignments.shape[0])
+        ]

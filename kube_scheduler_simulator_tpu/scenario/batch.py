@@ -61,6 +61,9 @@ class BatchJob:
     scheduler_config: "SchedulerConfiguration | None" = None
     # sweep: list of {plugin name -> weight} override dicts, one per variant
     weight_variants: list[dict] = field(default_factory=list)
+    # sweep engine: "sequential" (bit-parity scan, default) | "gang"
+    # (fixpoint rounds — engine/gang.py divergence policy applies)
+    engine: str = "sequential"
     # set when the spec file could not be parsed; the job then fails at
     # run time like any other job, preserving batch isolation
     parse_error: str = ""
@@ -80,17 +83,20 @@ class BatchJob:
                 SchedulerConfiguration.from_dict(cfg) if cfg else None
             ),
             weight_variants=spec.get("weightVariants", []),
+            engine=spec.get("engine", "sequential"),
         )
         if job.kind not in ("scenario", "sweep"):
             raise ValueError(f"job {name!r}: unknown kind {job.kind!r}")
         if job.kind == "sweep" and job.snapshot is None:
             raise ValueError(f"job {name!r}: sweep jobs need a snapshot")
+        if job.engine not in ("sequential", "gang"):
+            raise ValueError(f"job {name!r}: unknown engine {job.engine!r}")
         return job
 
 
 def _run_sweep_job(job: BatchJob, mesh=None) -> dict:
     from ..engine import TPU32, encode_cluster
-    from ..parallel.sweep import WeightSweep, weights_for
+    from ..parallel.sweep import GangSweep, WeightSweep, weights_for
 
     store = ResourceStore()
     import_snapshot(store, job.snapshot)
@@ -106,11 +112,16 @@ def _run_sweep_job(job: BatchJob, mesh=None) -> dict:
         pvs=store.list("pvs"),
         storageclasses=store.list("storageclasses"),
     )
-    sweep = WeightSweep(enc, mesh=mesh)
     variants = job.weight_variants or [{}]
     w = np.stack([weights_for(enc, ov) for ov in variants])
-    _, sels = sweep.run(w)
-    placements = sweep.placements(sels)
+    if job.engine == "gang":
+        sweep = GangSweep(enc, mesh=mesh)
+        assignments, _ = sweep.run(w)
+        placements = sweep.placements(assignments)
+    else:
+        sweep = WeightSweep(enc, mesh=mesh)
+        _, sels = sweep.run(w)
+        placements = sweep.placements(sels)
     return {
         "phase": "Succeeded",
         "variants": [

@@ -86,6 +86,25 @@ def test_sweep_job_runs_all_variants():
     assert r["variants"][1]["weights"]["NodeResourcesFit"] == 10
 
 
+def test_sweep_job_gang_engine():
+    spec = _sweep_spec()
+    spec["engine"] = "gang"
+    r = run_batch([BatchJob.from_spec("gsweep", spec)])["gsweep"]
+    assert r["phase"] == "Succeeded"
+    assert len(r["variants"]) == 3
+    for v in r["variants"]:
+        assert v["scheduled"] == 6
+
+
+def test_bad_engine_rejected():
+    spec = _sweep_spec()
+    spec["engine"] = "warp"
+    import pytest
+
+    with pytest.raises(ValueError, match="unknown engine"):
+        BatchJob.from_spec("bad", spec)
+
+
 def test_file_based_in_out(tmp_path):
     indir, outdir = tmp_path / "in", tmp_path / "out"
     indir.mkdir()

@@ -133,3 +133,48 @@ class TestWeightSweep:
         assert len(pl) == 8 and all(len(d) == len(enc.queue) for d in pl)
         with pytest.raises(ValueError):
             sweep.run(variants[:3])  # 3 % 4 != 0
+
+
+class TestGangSweep:
+    def test_mesh_sharded_gang_sweep_matches_single_variant(self):
+        import jax
+        import numpy as np
+
+        from kube_scheduler_simulator_tpu.engine import TPU32, encode_cluster
+        from kube_scheduler_simulator_tpu.engine.gang import GangScheduler
+        from kube_scheduler_simulator_tpu.parallel import GangSweep, build_mesh
+        from kube_scheduler_simulator_tpu.parallel.sweep import weights_for
+        from kube_scheduler_simulator_tpu.synth import synthetic_cluster
+        from test_engine_parity import restricted_config
+
+        mesh = build_mesh(8)  # 4 replicas x 2 node shards (virtual CPU)
+        n_shards = mesh.shape["nodes"]
+        cfg = restricted_config()
+        nodes, pods = synthetic_cluster(8, 24, seed=5)
+        enc = encode_cluster(
+            nodes, pods, cfg, policy=TPU32, node_capacity=8 * n_shards
+        )
+        sweep = GangSweep(enc, mesh=mesh, chunk=16)
+        variants = [
+            {},
+            {"NodeResourcesFit": 5},
+            {"NodeResourcesBalancedAllocation": 9},
+            {"NodeResourcesFit": 2},
+        ]
+        w = np.stack([weights_for(enc, ov) for ov in variants])
+        assignments, rounds = sweep.run(w)
+        assert assignments.shape[0] == 4
+        assert int(np.asarray(rounds).max()) >= 1
+        placements = sweep.placements(assignments)
+        # every variant schedules the full queue on this easy cluster
+        for d in placements:
+            assert all(v for v in d.values())
+        # variant 0 must equal an unsharded, unvmapped gang run
+        solo = GangScheduler(
+            encode_cluster(
+                nodes, pods, cfg, policy=TPU32, node_capacity=8 * n_shards
+            ),
+            chunk=16,
+        )
+        solo.run()
+        assert placements[0] == solo.placements()

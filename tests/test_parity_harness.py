@@ -1,0 +1,74 @@
+"""The REST parity harness (tools/parity_harness.py) driven against two
+live instances of this framework's own server — proves the harness
+mechanics (reset → import → trigger → poll → extract → diff) end-to-end
+so it is ready to point at the Go reference when one is reachable."""
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+
+from parity_harness import Backend, diff_results, run_backend  # noqa: E402
+
+from kube_scheduler_simulator_tpu.server.httpserver import SimulatorServer
+from kube_scheduler_simulator_tpu.server.service import SimulatorService
+
+from helpers import node, pod
+
+
+def _snapshot():
+    return {
+        "nodes": [node(f"n{i}", cpu=str(2 + i % 2)) for i in range(4)],
+        "pods": [pod(f"p{i}", cpu=f"{300 + 50 * (i % 4)}m") for i in range(10)],
+    }
+
+
+def test_two_identical_backends_reach_parity():
+    srv_a = SimulatorServer(SimulatorService(), port=0).start()
+    srv_b = SimulatorServer(SimulatorService(), port=0).start()
+    try:
+        snap = _snapshot()
+        res_a = run_backend(Backend(f"http://127.0.0.1:{srv_a.port}"), snap)
+        res_b = run_backend(Backend(f"http://127.0.0.1:{srv_b.port}"), snap)
+        assert len(res_a) == 10
+        assert all(r["node"] for r in res_a.values())
+        # scheduler annotations present (the 13-key record)
+        some = next(iter(res_a.values()))
+        assert any(k.endswith("filter-result") for k in some["annotations"])
+        assert diff_results(res_a, res_b, annotations=True) == []
+    finally:
+        srv_a.shutdown()
+        srv_b.shutdown()
+
+
+def test_diff_reports_divergence():
+    a = {"default/p0": {"node": "n1", "annotations": {}}}
+    b = {"default/p0": {"node": "n2", "annotations": {}}}
+    lines = diff_results(a, b)
+    assert lines and "placement" in lines[0]
+    # annotation-level divergence on same placement
+    a2 = {"default/p0": {"node": "n1", "annotations": {"scheduler-simulator/score-result": "{}"}}}
+    b2 = {"default/p0": {"node": "n1", "annotations": {"scheduler-simulator/score-result": "{...}"}}}
+    assert diff_results(a2, b2, annotations=True)
+    assert diff_results(a2, b2) == []  # placements agree
+
+
+def test_cli_roundtrip(tmp_path):
+    from parity_harness import main
+
+    srv_a = SimulatorServer(SimulatorService(), port=0).start()
+    srv_b = SimulatorServer(SimulatorService(), port=0).start()
+    try:
+        snap_path = tmp_path / "w.json"
+        snap_path.write_text(json.dumps(_snapshot()))
+        rc = main([
+            "--a", f"http://127.0.0.1:{srv_a.port}",
+            "--b", f"http://127.0.0.1:{srv_b.port}",
+            "--snapshot", str(snap_path),
+            "--annotations",
+        ])
+        assert rc == 0
+    finally:
+        srv_a.shutdown()
+        srv_b.shutdown()

@@ -1,0 +1,190 @@
+"""Black-box placement-parity harness over the simulator REST API.
+
+SURVEY.md §7 M4: the parity suite must be able to compare this framework
+against the *reference simulator as a black box over its REST API* — not
+only against the in-repo oracle (whose bugs can correlate with kernel
+bugs; see the InterPodAffinity first-pod divergence found in round 1).
+
+The harness drives any endpoint speaking the reference wire protocol
+(`simulator/docs/api.md`): the Go reference (`make start` in the
+reference repo, needs etcd + Go — not available in this build image) or
+this framework's own server. Flow per backend:
+
+  1. `PUT /api/v1/reset`
+  2. `POST /api/v1/import` with the workload snapshot
+  3. trigger scheduling — `POST /api/v1/schedule` when the endpoint has
+     it (this framework's explicit-pass extension); the Go reference
+     schedules continuously, so otherwise just wait
+  4. poll pod state until every pod is bound or terminally pending
+  5. extract placements (`spec.nodeName`) + the per-plugin result
+     annotations
+
+and the report diffs placements and (optionally) the 13 annotation
+payloads between two backends.
+
+Usage:
+    python tools/parity_harness.py --a http://localhost:1212 \
+        --b http://localhost:3131 --snapshot workload.json [--annotations]
+
+Exit code 0 = parity, 1 = divergence (diff printed), 2 = driver error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+SCHED_ANNOTATION_PREFIX = "scheduler-simulator/"
+
+
+class Backend:
+    def __init__(self, base_url: str, timeout: float = 30.0):
+        self.base = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _req(self, method: str, path: str, payload=None):
+        data = None if payload is None else json.dumps(payload).encode()
+        req = urllib.request.Request(
+            f"{self.base}{path}",
+            data=data,
+            method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            body = resp.read()
+            return json.loads(body) if body else None
+
+    def reset(self):
+        self._req("PUT", "/api/v1/reset")
+
+    def import_snapshot(self, snapshot: dict):
+        return self._req("POST", "/api/v1/import", snapshot)
+
+    def try_trigger_schedule(self) -> bool:
+        """Explicit scheduling pass where supported (this framework);
+        the reference schedules continuously and 404s here."""
+        try:
+            self._req("POST", "/api/v1/schedule")
+            return True
+        except urllib.error.HTTPError as e:
+            if e.code in (404, 405):
+                return False
+            raise
+
+    def pods(self) -> list[dict]:
+        # this framework's CRUD route first, then the reference's
+        # kube-apiserver proxy shape
+        for path in ("/api/v1/resources/pods",):
+            try:
+                out = self._req("GET", path)
+                return out["items"] if isinstance(out, dict) else out
+            except urllib.error.HTTPError as e:
+                if e.code != 404:
+                    raise
+        out = self._req("GET", "/api/v1/export")
+        return out.get("pods", [])
+
+    def wait_for_placements(
+        self, expected: int, settle_s: float = 2.0, timeout_s: float = 120.0
+    ) -> dict:
+        """Poll until the bound-pod count is stable (the reference binds
+        asynchronously). Returns {(ns/name): {"node": ..., "annotations":
+        {scheduler annotations only}}}."""
+        deadline = time.monotonic() + timeout_s
+        last_bound, last_change = -1, time.monotonic()
+        while True:
+            pods = self.pods()
+            bound = sum(
+                1 for p in pods if (p.get("spec") or {}).get("nodeName")
+            )
+            now = time.monotonic()
+            if bound != last_bound:
+                last_bound, last_change = bound, now
+            done = bound >= expected or (
+                bound > 0 and now - last_change >= settle_s
+            )
+            if done or now > deadline:
+                return {
+                    f"{(p['metadata'].get('namespace') or 'default')}/"
+                    f"{p['metadata']['name']}": {
+                        "node": (p.get("spec") or {}).get("nodeName", ""),
+                        "annotations": {
+                            k: v
+                            for k, v in (
+                                p["metadata"].get("annotations") or {}
+                            ).items()
+                            if k.startswith(SCHED_ANNOTATION_PREFIX)
+                        },
+                    }
+                    for p in pods
+                }
+            time.sleep(0.25)
+
+
+def run_backend(backend: Backend, snapshot: dict) -> dict:
+    backend.reset()
+    backend.import_snapshot(snapshot)
+    backend.try_trigger_schedule()
+    return backend.wait_for_placements(expected=len(snapshot.get("pods", [])))
+
+
+def diff_results(a: dict, b: dict, annotations: bool = False) -> list[str]:
+    lines = []
+    for key in sorted(set(a) | set(b)):
+        ra, rb = a.get(key), b.get(key)
+        if ra is None or rb is None:
+            lines.append(f"{key}: only in {'A' if rb is None else 'B'}")
+            continue
+        if ra["node"] != rb["node"]:
+            lines.append(
+                f"{key}: placement A={ra['node'] or '<none>'} "
+                f"B={rb['node'] or '<none>'}"
+            )
+        elif annotations and ra["annotations"] != rb["annotations"]:
+            keys = {
+                k
+                for k in set(ra["annotations"]) | set(rb["annotations"])
+                if ra["annotations"].get(k) != rb["annotations"].get(k)
+            }
+            lines.append(f"{key}: annotation mismatch on {sorted(keys)}")
+    return lines
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--a", required=True, help="backend A base URL")
+    ap.add_argument("--b", required=True, help="backend B base URL")
+    ap.add_argument("--snapshot", required=True, help="workload JSON path")
+    ap.add_argument(
+        "--annotations",
+        action="store_true",
+        help="also compare the per-plugin result annotations",
+    )
+    args = ap.parse_args(argv)
+    with open(args.snapshot) as f:
+        snapshot = json.load(f)
+    try:
+        res_a = run_backend(Backend(args.a), snapshot)
+        res_b = run_backend(Backend(args.b), snapshot)
+    except (urllib.error.URLError, OSError) as e:
+        print(f"parity-harness: backend unreachable: {e}", file=sys.stderr)
+        return 2
+    lines = diff_results(res_a, res_b, annotations=args.annotations)
+    if lines:
+        print(f"DIVERGED ({len(lines)} differences):")
+        for ln in lines:
+            print("  " + ln)
+        return 1
+    print(
+        f"PARITY: {len(res_a)} pods, "
+        f"{sum(1 for r in res_a.values() if r['node'])} placed identically"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
