@@ -101,12 +101,41 @@ class GangScheduler:
         chunk: int = 256,
         max_rounds: "int | None" = None,
         inner_iters: int = 64,
+        loop: str = "dynamic",
+        static_rounds: "int | None" = None,
     ):
+        """loop="dynamic" (default) runs rounds under `lax.while_loop`
+        until a round commits nothing. loop="static" runs a FIXED number
+        of rounds (`static_rounds`, default 4*ceil(P/N)+8) as a
+        `lax.scan` — rounds past the fixpoint are no-ops. Static mode
+        trades wasted no-op rounds for counted-loop-only control flow
+        (the same structure as the sequential engine's scan, which is
+        known to compile on backends where dynamic-condition loops have
+        not been observed to). If `static_rounds` is too small for a
+        pathological workload, the leftover pods simply stay pending —
+        check `placements()` / raise `static_rounds`.
+
+        With equal `inner_iters` the two modes place identically (the
+        extra static iterations/rounds are provably no-ops); a SMALLER
+        static `inner_iters` is a different matching depth — losers past
+        it retry in a later round against updated state, which can
+        change placements (still valid, just a different greedy order)."""
         self.enc = enc
         self.chunk = int(chunk)
         # fallback depth of the per-round matching: how many next-best
         # hops a loser may take before waiting for a fresh evaluation
         self.inner_iters = int(inner_iters)
+        if loop not in ("dynamic", "static"):
+            raise ValueError(f"loop must be dynamic|static, got {loop!r}")
+        self.loop = loop
+        if static_rounds is None:
+            # honor an explicit max_rounds as the static budget too
+            static_rounds = (
+                max_rounds
+                if max_rounds is not None
+                else 4 * (-(-enc.P // max(1, enc.N))) + 8
+            )
+        self.static_rounds = int(static_rounds)
         # Reuse the sequential engine's compiled-kernel construction and
         # its `attempt` program — gang mode is a different driver around
         # the identical per-pod evaluation.
@@ -144,6 +173,7 @@ class GangScheduler:
         attempt = self._base._attempt
         max_rounds = self.max_rounds if self.max_rounds is not None else P + 1
         inner_iters = self.inner_iters
+        static = self.loop == "static"
         # sentinel strictly below any reachable total score (engine.py
         # uses the same NEG for infeasible nodes); also used to mask
         # non-pending pods and taken nodes during the inner matching
@@ -221,6 +251,40 @@ class GangScheduler:
             C = arrays.pod_claim.shape[1]
             pod_claim = arrays.pod_claim.astype(bool)
 
+            def match_step(taken, claim_taken, sel_acc, scores):
+                """One matching iteration (shared by both loop modes):
+                argmax over untaken nodes → per-node order winner →
+                per-claim order winner → commit."""
+                m = jnp.where(taken[None, :], FLOOR, scores)
+                m = jnp.where((sel_acc >= 0)[:, None], FLOOR, m)
+                claim_blocked = (pod_claim & claim_taken[None, :]).any(axis=1)
+                m = jnp.where(claim_blocked[:, None], FLOOR, m)
+                cand = jnp.argmax(m, axis=1).astype(jnp.int32)
+                has = jnp.take_along_axis(m, cand[:, None], axis=1)[:, 0] > NEG
+                tgt = jnp.where(has, cand, N)
+                winner = (
+                    jnp.full((N + 1,), _NO_ORDER, jnp.int32).at[tgt].min(order)
+                )
+                commit = has & (winner[jnp.maximum(cand, 0)] == order)
+                claim_order = jnp.where(
+                    commit[:, None] & pod_claim, order[:, None], _NO_ORDER
+                )
+                claim_min = claim_order.min(axis=0)  # [C]
+                claim_ok = jnp.where(
+                    pod_claim, claim_min[None, :] == order[:, None], True
+                ).all(axis=1)
+                commit = commit & claim_ok
+                sel_acc = jnp.where(commit, cand, sel_acc)
+                taken = taken | (
+                    jnp.zeros((N + 1,), bool)
+                    .at[jnp.where(commit, cand, N)]
+                    .set(True)[:N]
+                )
+                claim_taken = claim_taken | (
+                    pod_claim & commit[:, None]
+                ).any(axis=0)
+                return taken, claim_taken, sel_acc, commit.any()
+
             def match(scores):
                 """One-commit-per-node matching over the round's masked
                 score matrix: argmax → earliest-order winner per node →
@@ -236,6 +300,26 @@ class GangScheduler:
                 other claimants out of the rest of the round (next
                 round's evaluation sees used_claims > 0 and rejects them
                 exactly like the sequential engine)."""
+                taken0 = jnp.zeros((N,), bool)
+                claims0 = jnp.zeros((C,), bool)
+                sel0 = jnp.full((P,), -1, jnp.int32)
+                if static:
+                    # counted loop: iterations after the matching settles
+                    # are no-ops (nothing commits twice)
+                    def m_scan(carry, _):
+                        taken, claim_taken, sel_acc = carry
+                        taken, claim_taken, sel_acc, _ = match_step(
+                            taken, claim_taken, sel_acc, scores
+                        )
+                        return (taken, claim_taken, sel_acc), None
+
+                    (_, _, sel_acc), _ = jax.lax.scan(
+                        m_scan,
+                        (taken0, claims0, sel0),
+                        None,
+                        length=inner_iters,
+                    )
+                    return sel_acc
 
                 def m_cond(c):
                     _, _, _, changed, it = c
@@ -243,63 +327,43 @@ class GangScheduler:
 
                 def m_body(c):
                     taken, claim_taken, sel_acc, _, it = c
-                    m = jnp.where(taken[None, :], FLOOR, scores)
-                    m = jnp.where((sel_acc >= 0)[:, None], FLOOR, m)
-                    claim_blocked = (pod_claim & claim_taken[None, :]).any(axis=1)
-                    m = jnp.where(claim_blocked[:, None], FLOOR, m)
-                    cand = jnp.argmax(m, axis=1).astype(jnp.int32)
-                    has = jnp.take_along_axis(
-                        m, cand[:, None], axis=1
-                    )[:, 0] > NEG
-                    tgt = jnp.where(has, cand, N)
-                    winner = (
-                        jnp.full((N + 1,), _NO_ORDER, jnp.int32)
-                        .at[tgt]
-                        .min(order)
+                    taken, claim_taken, sel_acc, changed = match_step(
+                        taken, claim_taken, sel_acc, scores
                     )
-                    commit = has & (winner[jnp.maximum(cand, 0)] == order)
-                    # per-claim winner among this iteration's committers
-                    claim_order = jnp.where(
-                        commit[:, None] & pod_claim, order[:, None], _NO_ORDER
-                    )
-                    claim_min = claim_order.min(axis=0)  # [C]
-                    claim_ok = jnp.where(
-                        pod_claim, claim_min[None, :] == order[:, None], True
-                    ).all(axis=1)
-                    commit = commit & claim_ok
-                    sel_acc = jnp.where(commit, cand, sel_acc)
-                    taken = taken | (
-                        jnp.zeros((N + 1,), bool)
-                        .at[jnp.where(commit, cand, N)]
-                        .set(True)[:N]
-                    )
-                    claim_taken = claim_taken | (
-                        pod_claim & commit[:, None]
-                    ).any(axis=0)
-                    return (
-                        taken, claim_taken, sel_acc,
-                        commit.any(), it + jnp.int32(1),
-                    )
+                    return taken, claim_taken, sel_acc, changed, it + jnp.int32(1)
 
-                taken0 = jnp.zeros((N,), bool)
-                claims0 = jnp.zeros((C,), bool)
-                sel0 = jnp.full((P,), -1, jnp.int32)
-                taken, _, sel_acc, _, _ = jax.lax.while_loop(
+                _, _, sel_acc, _, _ = jax.lax.while_loop(
                     m_cond,
                     m_body,
                     (taken0, claims0, sel0, jnp.bool_(True), jnp.int32(0)),
                 )
                 return sel_acc
 
-            def body(carry):
-                state, _, rounds = carry
+            def round_once(state):
                 scores = eval_all(state, arrays, weights)
                 pending = (state.assignment < 0) & in_queue & arrays.pod_mask
                 scores = jnp.where(pending[:, None], scores, FLOOR)
                 sel = match(scores)
                 commit = sel >= 0
                 state = bind_all(state, arrays, commit, sel, order)
-                return state, commit.any(), rounds + jnp.int32(1)
+                return state, commit.any()
+
+            if static:
+                # counted outer loop too: the whole program is scans, the
+                # same control-flow shape as the sequential engine
+                def r_scan(state, _):
+                    state, progressed = round_once(state)
+                    return state, progressed
+
+                state, progressed = jax.lax.scan(
+                    r_scan, state0, None, length=self.static_rounds
+                )
+                return state, progressed.sum().astype(jnp.int32)
+
+            def body(carry):
+                state, _, rounds = carry
+                state, progressed = round_once(state)
+                return state, progressed, rounds + jnp.int32(1)
 
             state, _, rounds = jax.lax.while_loop(
                 cond, body, (state0, jnp.bool_(True), jnp.int32(0))

@@ -212,6 +212,42 @@ def test_rwop_claim_single_winner_per_round():
     assert got == _placements(seq)
 
 
+def test_static_loop_matches_dynamic():
+    # the counted-loop (scan-only) variant must place identically to the
+    # while_loop variant — no-op rounds past the fixpoint change nothing
+    rng = np.random.default_rng(11)
+    nodes = [node(f"n{i}", cpu=str(2 + int(rng.integers(3)))) for i in range(6)]
+    pods = [
+        pod(f"p{i}", cpu=f"{int(rng.integers(200, 800))}m") for i in range(30)
+    ]
+    cfg = restricted_config()
+    enc = encode_cluster(nodes, pods, cfg, policy=EXACT)
+    # equal inner depth => provably identical placements (gang.py note)
+    dyn = GangScheduler(enc, chunk=16, inner_iters=12)
+    stat = GangScheduler(enc, chunk=16, loop="static", inner_iters=12)
+    assert _placements(dyn) == _placements(stat)
+    # rounds reported = rounds that committed something
+    assert int(np.asarray(stat._rounds)) == int(np.asarray(dyn._rounds)) - 1
+
+
+def test_static_loop_rwop_claims():
+    from test_engine_parity_vol import claim_vol, pv, pvc, vol_config
+
+    nodes = [node("n0"), node("n1")]
+    pods = [
+        pod("first", priority=10, volumes=[claim_vol("solo")]),
+        pod("second", priority=1, volumes=[claim_vol("solo")]),
+    ]
+    kw = dict(
+        pvcs=[pvc("solo", modes=("ReadWriteOncePod",), volume_name="pv-s")],
+        pvs=[pv("pv-s")],
+    )
+    enc = encode_cluster(nodes, pods, vol_config(), policy=EXACT, **kw)
+    got = _placements(GangScheduler(enc, loop="static"))
+    assert got[("default", "first")] != ""
+    assert got[("default", "second")] == ""
+
+
 def test_full_default_config_accepted_postfilter_skipped():
     from kube_scheduler_simulator_tpu.engine.engine import supported_config
 

@@ -104,9 +104,11 @@ class Backend:
             now = time.monotonic()
             if bound != last_bound:
                 last_bound, last_change = bound, now
-            done = bound >= expected or (
-                bound > 0 and now - last_change >= settle_s
-            )
+            # zero binds get a longer grace (a reference scheduler may
+            # take a while to make its first bind) but still terminate:
+            # an all-unschedulable workload must not spin to the deadline
+            settle = settle_s if bound > 0 else settle_s * 5
+            done = bound >= expected or now - last_change >= settle
             if done or now > deadline:
                 return {
                     f"{(p['metadata'].get('namespace') or 'default')}/"
