@@ -17,6 +17,9 @@ Three measurements on the real chip:
      preemption semantics anyway.
   3. `atscale` — BASELINE config #2 shape (10k pods x 1k nodes), single
      pass, full default set incl. preemption, record=False.
+  4. `affinity` — BASELINE config #3 shape (5k pods x 500 nodes of
+     required anti-affinity chains + cross-service zone affinity),
+     single pass, record=False — the InterPodAffinity stress shape.
 
 Primary metric (the one JSON line): sweep decisions/sec/chip, where one
 decision = one pod through Filter→Score→Normalize→select→bind over every
@@ -50,7 +53,10 @@ BASELINE_PODS = 48  # oracle sample (sequential python, full plugin set)
 CPU_FALLBACK = {
     "N_NODES": 128, "N_PODS": 512, "N_VARIANTS": 8,
     "SCALE_NODES": 256, "SCALE_PODS": 2048,
+    "AFF_NODES": 64, "AFF_PODS": 256,
 }
+AFF_NODES = 500
+AFF_PODS = 5000
 
 
 def _best_of(fn, reps=3):
@@ -139,15 +145,22 @@ def _gang_probe(mode: str):
     )
 
 
-def _try_gang_subprocess() -> "dict | None":
-    """Probe gang isolated: the dynamic (while_loop) variant first, the
-    static (scan-only) variant as the compile-compatibility fallback.
-    None when neither finishes in its window."""
+def _try_gang_subprocess(platform: str) -> "dict | None":
+    """Probe gang isolated. On CPU backends: the dynamic (while_loop)
+    variant first, static as fallback. On accelerator backends: STATIC
+    ONLY — killing an in-flight dynamic compile on the experimental TPU
+    backend has been observed to wedge the tunnel for hours (BASELINE.md),
+    so the known-risky program is never started there. None when no
+    variant finishes in its window."""
     import os
     import subprocess
     import sys
 
-    for mode, timeout_s in (("dynamic", 420.0), ("static", 600.0)):
+    if platform.startswith("cpu"):
+        attempts = (("dynamic", 420.0), ("static", 600.0))
+    else:
+        attempts = (("static", 600.0),)
+    for mode, timeout_s in attempts:
         try:
             proc = subprocess.run(
                 [sys.executable, __file__, f"--gang-probe={mode}"],
@@ -175,6 +188,7 @@ def main():
 
     platform = _device_watchdog()
     global N_NODES, N_PODS, N_VARIANTS, SCALE_NODES, SCALE_PODS
+    global AFF_NODES, AFF_PODS
     if os.environ.get("_KSS_BENCH_CPU_FALLBACK"):
         # degraded-mode shapes: the CPU fallback exists to save the
         # round's artifact, not to simulate a chip — keep it finishable
@@ -182,6 +196,8 @@ def main():
         N_VARIANTS = CPU_FALLBACK["N_VARIANTS"]
         SCALE_NODES = CPU_FALLBACK["SCALE_NODES"]
         SCALE_PODS = CPU_FALLBACK["SCALE_PODS"]
+        AFF_NODES = CPU_FALLBACK["AFF_NODES"]
+        AFF_PODS = CPU_FALLBACK["AFF_PODS"]
         platform = "cpu-fallback(reduced shapes)"
 
     import jax
@@ -194,7 +210,10 @@ def main():
         supported_config,
     )
     from kube_scheduler_simulator_tpu.sched.oracle import Oracle
-    from kube_scheduler_simulator_tpu.synth import synthetic_cluster
+    from kube_scheduler_simulator_tpu.synth import (
+        synthetic_affinity_cluster,
+        synthetic_cluster,
+    )
 
     from kube_scheduler_simulator_tpu.sched.config import SchedulerConfiguration
 
@@ -250,6 +269,21 @@ def main():
     t_scale = _best_of(lambda: np.asarray(s_run(*s_args)[1]), reps=2)
     scale_dps = SCALE_PODS / t_scale
 
+    # 4) affinity-heavy pass (BASELINE config #3 shape)
+    a_nodes, a_pods = synthetic_affinity_cluster(AFF_NODES, AFF_PODS, seed=11)
+    a_enc = encode_cluster(a_nodes, a_pods, cfg, policy=TPU32)
+    a_sched = BatchedScheduler(a_enc, record=False, unroll=UNROLL)
+    a_args = (
+        a_enc.arrays,
+        a_enc.state0,
+        jnp.asarray(a_enc.queue),
+        a_sched.weights,
+    )
+    a_run = jax.jit(a_sched.run_fn)
+    np.asarray(a_run(*a_args)[1])  # compile
+    t_aff = _best_of(lambda: np.asarray(a_run(*a_args)[1]), reps=2)
+    aff_dps = AFF_PODS / t_aff
+
     # oracle baseline: sequential python on a sample of the same workload
     oracle = Oracle(nodes, pods[:BASELINE_PODS], cfg)
     t0 = time.perf_counter()
@@ -257,7 +291,7 @@ def main():
     base_dps = BASELINE_PODS / (time.perf_counter() - t0)
 
     # gang mode, isolated (see _gang_probe); a stall cannot hang bench
-    gang = _try_gang_subprocess()
+    gang = _try_gang_subprocess(platform)
     gang_complete = bool(gang) and gang.get("scheduled") == N_PODS
     if gang and not gang_complete:
         # a static-budget shortfall left pods pending: still report it,
@@ -285,8 +319,9 @@ def main():
                     f"x{N_NODES}nodes={round(sweep_dps, 1)}/s (default set "
                     f"minus postFilter), single full default set="
                     f"{round(single_dps, 1)}/s, {SCALE_PODS}pods"
-                    f"x{SCALE_NODES}nodes={round(scale_dps, 1)}/s"
-                    f"{gang_note}; "
+                    f"x{SCALE_NODES}nodes={round(scale_dps, 1)}/s, "
+                    f"affinity {AFF_PODS}podsx{AFF_NODES}nodes="
+                    f"{round(aff_dps, 1)}/s{gang_note}; "
                     f"vs_baseline = single vs the repo's python oracle on "
                     f"the same config (Go reference unrunnable here)"
                 ),
@@ -303,6 +338,9 @@ if __name__ == "__main__":
     probe = [a for a in sys.argv if a.startswith("--gang-probe")]
     if probe:
         _, _, mode = probe[0].partition("=")
-        _gang_probe(mode or "dynamic")
+        mode = mode or "dynamic"
+        if mode not in ("dynamic", "static"):
+            raise SystemExit(f"--gang-probe mode must be dynamic|static, got {mode!r}")
+        _gang_probe(mode)
     else:
         main()

@@ -89,11 +89,19 @@ class Backend:
         return out.get("pods", [])
 
     def wait_for_placements(
-        self, expected: int, settle_s: float = 2.0, timeout_s: float = 120.0
+        self,
+        expected: int,
+        settle_s: float = 2.0,
+        timeout_s: float = 120.0,
+        synchronous: bool = False,
     ) -> dict:
         """Poll until the bound-pod count is stable (the reference binds
-        asynchronously). Returns {(ns/name): {"node": ..., "annotations":
-        {scheduler annotations only}}}."""
+        asynchronously). `synchronous=True` (the endpoint ran an explicit
+        scheduling pass) means state is already final: zero binds settle
+        after `settle_s`. Asynchronous backends get the full deadline
+        before zero binds are read as all-unschedulable — a reference
+        may take a long time to make its first bind. Returns
+        {(ns/name): {"node": ..., "annotations": {scheduler only}}}."""
         deadline = time.monotonic() + timeout_s
         last_bound, last_change = -1, time.monotonic()
         while True:
@@ -104,10 +112,10 @@ class Backend:
             now = time.monotonic()
             if bound != last_bound:
                 last_bound, last_change = bound, now
-            # zero binds get a longer grace (a reference scheduler may
-            # take a while to make its first bind) but still terminate:
-            # an all-unschedulable workload must not spin to the deadline
-            settle = settle_s if bound > 0 else settle_s * 5
+            if bound > 0 or synchronous:
+                settle = settle_s
+            else:
+                settle = timeout_s  # only the deadline ends a zero-bind wait
             done = bound >= expected or now - last_change >= settle
             if done or now > deadline:
                 return {
@@ -130,8 +138,10 @@ class Backend:
 def run_backend(backend: Backend, snapshot: dict) -> dict:
     backend.reset()
     backend.import_snapshot(snapshot)
-    backend.try_trigger_schedule()
-    return backend.wait_for_placements(expected=len(snapshot.get("pods", [])))
+    triggered = backend.try_trigger_schedule()
+    return backend.wait_for_placements(
+        expected=len(snapshot.get("pods", [])), synchronous=triggered
+    )
 
 
 def diff_results(a: dict, b: dict, annotations: bool = False) -> list[str]:
