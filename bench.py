@@ -217,17 +217,22 @@ def main():
 
     from kube_scheduler_simulator_tpu.sched.config import SchedulerConfiguration
 
+    def timed_pass(nodes_, pods_, config, reps=3):
+        """Encode → jit → compile → best-of timing of one sequential pass
+        (the shared idiom for every single-pass measurement; sync via
+        host transfer — see module docstring)."""
+        e = encode_cluster(nodes_, pods_, config, policy=TPU32)
+        sc = BatchedScheduler(e, record=False, unroll=UNROLL)
+        a = (e.arrays, e.state0, jnp.asarray(e.queue), sc.weights)
+        r = jax.jit(sc.run_fn)
+        np.asarray(r(*a)[1])  # compile
+        return _best_of(lambda: np.asarray(r(*a)[1]), reps=reps)
+
     cfg = supported_config()  # == the full default KubeSchedulerConfiguration
     nodes, pods = synthetic_cluster(N_NODES, N_PODS, seed=42)
-    enc = encode_cluster(nodes, pods, cfg, policy=TPU32)
-    sched = BatchedScheduler(enc, record=False, unroll=UNROLL)
-    args = (enc.arrays, enc.state0, jnp.asarray(enc.queue), sched.weights)
 
     # 1) single pass
-    run = jax.jit(sched.run_fn)
-    np.asarray(run(*args)[1])  # compile
-    t_single = _best_of(lambda: np.asarray(run(*args)[1]))
-    single_dps = N_PODS / t_single
+    single_dps = N_PODS / timed_pass(nodes, pods, cfg)
 
     # 2) Monte-Carlo sweep: V variants in one program (preemption off —
     # see module docstring)
@@ -256,33 +261,11 @@ def main():
 
     # 3) at-scale single pass (BASELINE config #2 shape)
     s_nodes, s_pods = synthetic_cluster(SCALE_NODES, SCALE_PODS, seed=7)
-    s_enc = encode_cluster(s_nodes, s_pods, cfg, policy=TPU32)
-    s_sched = BatchedScheduler(s_enc, record=False, unroll=UNROLL)
-    s_args = (
-        s_enc.arrays,
-        s_enc.state0,
-        jnp.asarray(s_enc.queue),
-        s_sched.weights,
-    )
-    s_run = jax.jit(s_sched.run_fn)
-    np.asarray(s_run(*s_args)[1])  # compile
-    t_scale = _best_of(lambda: np.asarray(s_run(*s_args)[1]), reps=2)
-    scale_dps = SCALE_PODS / t_scale
+    scale_dps = SCALE_PODS / timed_pass(s_nodes, s_pods, cfg, reps=2)
 
     # 4) affinity-heavy pass (BASELINE config #3 shape)
     a_nodes, a_pods = synthetic_affinity_cluster(AFF_NODES, AFF_PODS, seed=11)
-    a_enc = encode_cluster(a_nodes, a_pods, cfg, policy=TPU32)
-    a_sched = BatchedScheduler(a_enc, record=False, unroll=UNROLL)
-    a_args = (
-        a_enc.arrays,
-        a_enc.state0,
-        jnp.asarray(a_enc.queue),
-        a_sched.weights,
-    )
-    a_run = jax.jit(a_sched.run_fn)
-    np.asarray(a_run(*a_args)[1])  # compile
-    t_aff = _best_of(lambda: np.asarray(a_run(*a_args)[1]), reps=2)
-    aff_dps = AFF_PODS / t_aff
+    aff_dps = AFF_PODS / timed_pass(a_nodes, a_pods, cfg, reps=2)
 
     # oracle baseline: sequential python on a sample of the same workload
     oracle = Oracle(nodes, pods[:BASELINE_PODS], cfg)

@@ -112,12 +112,23 @@ class ScenarioRunner:
         config: "SchedulerConfiguration | None" = None,
         controllers=CONTROLLERS,
         max_controller_rounds: int = 100,
+        scheduler_mode: str = "sequential",
     ):
+        """scheduler_mode="gang" runs each scheduling controller round as
+        a fixpoint batch pass (engine/gang.py): Timeline PodScheduled
+        events carry placements only (no preemption Delete events — gang
+        skips postFilter, and its divergence policy applies). Sequential
+        mode keeps full reference semantics including preemption."""
+        if scheduler_mode not in ("sequential", "gang"):
+            raise ValueError(
+                f"scheduler_mode must be sequential|gang, got {scheduler_mode!r}"
+            )
         self.operations = operations
         self.store = store or ResourceStore()
         self.scheduler = SchedulerService(self.store, config)
         self.controllers = controllers
         self.max_controller_rounds = max_controller_rounds
+        self.scheduler_mode = scheduler_mode
         self._seq = 0
 
     def _gen_id(self, prefix: str) -> str:
@@ -127,6 +138,17 @@ class ScenarioRunner:
     # -- one scheduler "controller" round ----------------------------------
 
     def _scheduler_step(self, record) -> bool:
+        if self.scheduler_mode == "gang":
+            placements, _ = self.scheduler.schedule_gang()
+            changed = False
+            for (ns, name), node_name in sorted(placements.items()):
+                if node_name:
+                    record(
+                        "PodScheduled",
+                        {"namespace": ns, "name": name, "node": node_name},
+                    )
+                    changed = True
+            return changed
         results = self.scheduler.schedule()
         changed = False
         for res in results:

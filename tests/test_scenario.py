@@ -126,3 +126,33 @@ class TestScenarioVM:
         assert any(e.payload.get("name") == "squatter" for e in deletes)
         scheduled = [e for e in t2 if e.type == "PodScheduled"]
         assert any(e.payload["name"] == "urgent" for e in scheduled)
+
+
+def test_gang_scheduler_mode_timeline():
+    from kube_scheduler_simulator_tpu.scenario.runner import (
+        Operation,
+        ScenarioRunner,
+    )
+
+    ops = [
+        Operation(major_step=0, create={"kind": "nodes", "object": node("n0")}),
+        Operation(major_step=0, create={"kind": "nodes", "object": node("n1")}),
+        Operation(major_step=0, create={"kind": "pods", "object": pod("a")}),
+        Operation(major_step=0, create={"kind": "pods", "object": pod("b")}),
+        Operation(major_step=1, done=True),
+    ]
+    result = ScenarioRunner(ops, scheduler_mode="gang").run()
+    assert result.phase == "Succeeded"
+    scheduled = [
+        e for e in result.timeline["0"] if e.type == "PodScheduled"
+    ]
+    assert {e.payload["name"] for e in scheduled} == {"a", "b"}
+    assert all(e.payload["node"] for e in scheduled)
+    # determinism: a second run produces the identical timeline
+    again = ScenarioRunner(
+        [Operation(**{k: getattr(o, k) for k in
+                      ("id", "major_step", "create", "patch", "delete", "done")})
+         for o in ops],
+        scheduler_mode="gang",
+    ).run()
+    assert again.as_dict() == result.as_dict()
