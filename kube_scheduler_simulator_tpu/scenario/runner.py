@@ -123,6 +123,13 @@ class ScenarioRunner:
             raise ValueError(
                 f"scheduler_mode must be sequential|gang, got {scheduler_mode!r}"
             )
+        if scheduler_mode == "gang" and config is not None and config.extenders:
+            # both inputs are fixed for the runner's lifetime: fail here,
+            # not as a Failed result mid-run after ops already applied
+            raise ValueError(
+                "gang scheduler_mode does not support extenders; use "
+                "sequential mode"
+            )
         self.operations = operations
         self.store = store or ResourceStore()
         self.scheduler = SchedulerService(self.store, config)

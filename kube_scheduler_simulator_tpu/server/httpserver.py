@@ -19,6 +19,7 @@ typed store — SURVEY.md §2 #3):
     POST               /api/v1/schedule      run one batched scheduling pass
     GET                /api/v1/metrics       scheduling-pass counters
                                              (decisions/sec, utils/metrics.py)
+    GET                /  (or /ui)           built-in dashboard (webui.py)
 
 The watch stream mirrors the reference's wire shape — a sequence of JSON
 objects `{"Kind": ..., "EventType": ..., "Obj": {...}}` flushed per event
@@ -164,6 +165,17 @@ def _make_handler(server: SimulatorServer):
             url = urlparse(self.path)
             parts = [p for p in url.path.split("/") if p]
             try:
+                if method == "GET" and parts in ([], ["ui"]):
+                    from .webui import PAGE
+
+                    body = PAGE.encode()
+                    self.send_response(200)
+                    self._cors_headers()
+                    self.send_header("Content-Type", "text/html; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return None
                 if parts[:2] != ["api", "v1"]:
                     return self._error(404, "not found")
                 rest = parts[2:]

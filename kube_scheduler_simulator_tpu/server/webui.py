@@ -1,0 +1,154 @@
+"""Minimal built-in dashboard (L7, reference: web/ — a Nuxt SPA).
+
+The reference ships a full Vue frontend talking to the simulator API and
+the embedded kube-apiserver. Here the same core workflows — watch the
+cluster live, inspect per-pod scheduling results (the per-plugin
+filter/score tables from the result annotations), trigger scheduling,
+edit the scheduler configuration, export/import/reset — are served as a
+single static page straight from the simulator (no build step, no
+dependencies), consuming only the public API:
+
+    GET  /                    this page
+    GET  /api/v1/resources/*  tables
+    GET  /api/v1/listwatchresources   live updates (ND-JSON stream)
+    POST /api/v1/schedule[?mode=gang], PUT /api/v1/reset,
+    GET/POST /api/v1/schedulerconfiguration, GET /api/v1/export
+"""
+
+from __future__ import annotations
+
+PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>kube-scheduler-simulator-tpu</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:1.2rem;background:#fafafa;color:#222}
+ h1{font-size:1.2rem} h2{font-size:1rem;margin:.8rem 0 .3rem}
+ table{border-collapse:collapse;width:100%;background:#fff;font-size:.85rem}
+ th,td{border:1px solid #ddd;padding:.25rem .5rem;text-align:left}
+ th{background:#f0f0f0} tr:hover td{background:#f6f9ff;cursor:pointer}
+ #bar button{margin-right:.4rem} #status{color:#666;font-size:.8rem}
+ #detail{white-space:pre-wrap;background:#fff;border:1px solid #ddd;
+         padding:.6rem;font-family:monospace;font-size:.75rem;max-height:40vh;
+         overflow:auto}
+ #cfg{width:100%;height:10rem;font-family:monospace;font-size:.75rem}
+ .pill{display:inline-block;padding:0 .4rem;border-radius:.6rem;font-size:.75rem}
+ .ok{background:#d9f2dd}.bad{background:#f8d7da}.pend{background:#fff3cd}
+</style></head><body>
+<h1>kube-scheduler-simulator-tpu</h1>
+<div id="bar">
+ <button onclick="act('POST','/api/v1/schedule')">Schedule</button>
+ <button onclick="act('POST','/api/v1/schedule?mode=gang')">Schedule (gang)</button>
+ <button onclick="act('PUT','/api/v1/reset')">Reset</button>
+ <button onclick="exportSnap()">Export</button>
+ <span id="status">connecting…</span>
+</div>
+<h2>Nodes (<span id="nnodes">0</span>)</h2>
+<table id="nodes"><thead><tr><th>name</th><th>cpu</th><th>memory</th>
+<th>pods bound</th></tr></thead><tbody></tbody></table>
+<h2>Pods (<span id="npods">0</span>)</h2>
+<table id="pods"><thead><tr><th>namespace</th><th>name</th><th>node</th>
+<th>result</th></tr></thead><tbody></tbody></table>
+<h2>Pod scheduling detail</h2>
+<div id="detail">click a pod row to inspect its per-plugin results</div>
+<h2>Scheduler configuration</h2>
+<textarea id="cfg"></textarea><br>
+<button onclick="applyCfg()">Apply configuration</button>
+<script>
+const state = {nodes:new Map(), pods:new Map()};
+const key = o => (o.metadata.namespace||'default')+'/'+o.metadata.name;
+const esc = s => String(s??'').replace(/[&<>"']/g,
+  c => ({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
+const MAX_ROWS = 500;  // full rebuild per tick: cap rendered rows so a
+                       // 50k-pod import stays responsive (counts stay exact)
+function render(){
+  const nb = document.querySelector('#nodes tbody'); nb.innerHTML='';
+  const counts = {};
+  for (const p of state.pods.values()){
+    const n = (p.spec||{}).nodeName; if(n) counts[n]=(counts[n]||0)+1;
+  }
+  const nodesSorted=[...state.nodes.values()].sort((a,b)=>key(a)<key(b)?-1:1);
+  for (const n of nodesSorted.slice(0,MAX_ROWS)){
+    const al=(n.status||{}).allocatable||{};
+    nb.insertAdjacentHTML('beforeend',`<tr><td>${esc(n.metadata.name)}</td>
+      <td>${esc(al.cpu||'')}</td><td>${esc(al.memory||'')}</td>
+      <td>${counts[n.metadata.name]||0}</td></tr>`);
+  }
+  document.getElementById('nnodes').textContent=state.nodes.size;
+  const pb = document.querySelector('#pods tbody'); pb.innerHTML='';
+  const podsSorted=[...state.pods.values()].sort((a,b)=>key(a)<key(b)?-1:1);
+  for (const p of podsSorted.slice(0,MAX_ROWS)){
+    const node=(p.spec||{}).nodeName||'';
+    const ann=(p.metadata||{}).annotations||{};
+    const has=Object.keys(ann).some(k=>k.startsWith('scheduler-simulator/'));
+    const pill=node?'<span class="pill ok">scheduled</span>'
+      :(has?'<span class="pill bad">unschedulable</span>'
+            :'<span class="pill pend">pending</span>');
+    const row=document.createElement('tr');
+    row.innerHTML=`<td>${esc(p.metadata.namespace||'default')}</td>
+      <td>${esc(p.metadata.name)}</td><td>${esc(node)}</td><td>${pill}</td>`;
+    row.onclick=()=>showDetail(p);
+    pb.appendChild(row);
+  }
+  const over=state.pods.size>MAX_ROWS?` (showing first ${MAX_ROWS})`:'';
+  document.getElementById('npods').textContent=state.pods.size+over;
+}
+function showDetail(p){
+  const ann=(p.metadata||{}).annotations||{};
+  const out={};
+  for (const [k,v] of Object.entries(ann)){
+    if(!k.startsWith('scheduler-simulator/')) continue;
+    try{out[k]=JSON.parse(v);}catch(e){out[k]=v;}
+  }
+  document.getElementById('detail').textContent=
+    key(p)+'\\n'+JSON.stringify(out,null,2);
+}
+async function act(method,path){
+  const r=await fetch(path,{method});
+  setStatus(`${method} ${path} → ${r.status}`);
+}
+async function exportSnap(){
+  const r=await fetch('/api/v1/export'); const blob=await r.blob();
+  const a=document.createElement('a');
+  a.href=URL.createObjectURL(blob); a.download='snapshot.json'; a.click();
+}
+async function loadCfg(){
+  const r=await fetch('/api/v1/schedulerconfiguration');
+  document.getElementById('cfg').value=JSON.stringify(await r.json(),null,2);
+}
+async function applyCfg(){
+  const r=await fetch('/api/v1/schedulerconfiguration',
+    {method:'POST',body:document.getElementById('cfg').value});
+  setStatus('apply config → '+r.status+(r.ok?'':' '+await r.text()));
+  if(r.ok) loadCfg();
+}
+function setStatus(s){document.getElementById('status').textContent=s;}
+async function watch(){
+  while(true){
+    try{
+      const r=await fetch('/api/v1/listwatchresources');
+      const reader=r.body.getReader(); const dec=new TextDecoder();
+      let buf=''; setStatus('live');
+      state.nodes.clear(); state.pods.clear();
+      let pending=null;
+      for(;;){
+        const {done,value}=await reader.read(); if(done) break;
+        buf+=dec.decode(value,{stream:true});
+        let i;
+        while((i=buf.indexOf('\\n'))>=0){
+          const line=buf.slice(0,i).trim(); buf=buf.slice(i+1);
+          if(!line) continue;
+          const ev=JSON.parse(line);
+          const m=ev.Kind==='nodes'?state.nodes:
+                  ev.Kind==='pods'?state.pods:null;
+          if(!m) continue;
+          if(ev.EventType==='DELETED') m.delete(key(ev.Obj));
+          else m.set(key(ev.Obj),ev.Obj);
+        }
+        if(!pending){pending=setTimeout(()=>{pending=null;render();},100);}
+      }
+    }catch(e){setStatus('stream lost, reconnecting… '+e);}
+    await new Promise(res=>setTimeout(res,2000));
+  }
+}
+loadCfg(); watch();
+</script></body></html>
+"""
