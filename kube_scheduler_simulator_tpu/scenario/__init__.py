@@ -1,5 +1,6 @@
 """KEP-140 scenario engine: a deterministic discrete-event scenario VM."""
 
+from .results import summarize
 from .runner import (
     Operation,
     ScenarioResult,
@@ -9,6 +10,7 @@ from .runner import (
 )
 
 __all__ = [
+    "summarize",
     "Operation",
     "ScenarioResult",
     "ScenarioRunner",

@@ -152,7 +152,14 @@ def run_job(job: BatchJob, *, mesh=None) -> dict:
     if job.kind == "sweep":
         return _run_sweep_job(job, mesh=mesh)
     runner = ScenarioRunner(job.operations, config=job.scheduler_config)
-    return runner.run().as_dict()
+    result = runner.run()
+    out = result.as_dict()
+    # KEP-140 result calculation: quantitative summary alongside the
+    # Timeline so batch variants can be compared numerically
+    from .results import summarize
+
+    out["summary"] = summarize(result, runner.store)
+    return out
 
 
 def run_batch(

@@ -1,0 +1,127 @@
+"""Scenario result calculation (KEP-140 README.md:554-568).
+
+The KEP sketches a "result calculation" package deriving quantitative
+summaries from a Scenario's Timeline so policy variants can be compared
+numerically instead of by eyeballing event lists. This module computes
+those summaries from a finished `ScenarioResult` plus the end-state
+store:
+
+  * scheduling outcomes — pods scheduled / preempted / still pending,
+    and bind latency measured in MajorSteps (create step → bind step;
+    the KEP's virtual-clock notion of latency);
+  * cluster shape — per-node bound-pod counts and requested-CPU/memory
+    utilization against allocatable (end state);
+  * per-step activity — operations and binds per MajorStep.
+
+Pure host-side arithmetic over the Timeline and store; nothing here
+touches the engine, so summaries are identical across reruns of a
+deterministic scenario.
+"""
+
+from __future__ import annotations
+
+from fractions import Fraction
+
+from ..models.objects import NodeView, PodView
+from ..models.store import ResourceStore
+from .runner import ScenarioResult
+
+
+def summarize(result: ScenarioResult, store: ResourceStore) -> dict:
+    """Compute the KEP-style result summary for one finished scenario."""
+    created_step: dict[tuple[str, str], int] = {}
+    bound_step: dict[tuple[str, str], int] = {}
+    deleted: set[tuple[str, str]] = set()
+    preempted: set[tuple[str, str]] = set()
+    per_step: dict[str, dict] = {}
+    for major, events in result.timeline.items():
+        ops = binds = 0
+        for ev in events:
+            if ev.type == "Create":
+                ops += 1
+                obj = ev.payload.get("result") or {}
+                if ev.payload.get("kind") == "pods":
+                    k = (
+                        (obj.get("metadata") or {}).get("namespace", "default"),
+                        (obj.get("metadata") or {}).get("name", ""),
+                    )
+                    created_step.setdefault(k, int(major))
+            elif ev.type in ("Patch", "Delete", "Done"):
+                ops += 1
+                if ev.type == "Delete" and ev.payload.get("kind") == "pods":
+                    k = (
+                        ev.payload.get("namespace", "default"),
+                        ev.payload.get("name", ""),
+                    )
+                    deleted.add(k)
+                    if ev.payload.get("reason") == "preempted":
+                        preempted.add(k)
+            elif ev.type == "PodScheduled":
+                binds += 1
+                k = (ev.payload["namespace"], ev.payload["name"])
+                bound_step.setdefault(k, int(major))
+        per_step[major] = {"operations": ops, "binds": binds}
+
+    latencies = [
+        bound_step[k] - created_step[k]
+        for k in bound_step
+        if k in created_step
+    ]
+    # end-state accounting: a pod bound and later deleted (preemption
+    # victims, scenario Delete ops) is not scheduled in the final state
+    bound_then_deleted = set(bound_step) & deleted
+    pods = store.list("pods")
+    pending = sum(
+        1 for p in pods if not (p.get("spec") or {}).get("nodeName")
+    )
+
+    # end-state utilization per node (exact Fractions, like the oracle)
+    alloc: dict[str, dict] = {}
+    for n in store.list("nodes"):
+        a = NodeView(n).allocatable
+        alloc[n["metadata"]["name"]] = {
+            "cpu": a.get("cpu", Fraction(0)),
+            "memory": a.get("memory", Fraction(0)),
+            "pods": 0,
+            "cpu_used": Fraction(0),
+            "memory_used": Fraction(0),
+        }
+    for p in pods:
+        node = (p.get("spec") or {}).get("nodeName")
+        if not node or node not in alloc:
+            continue
+        req = PodView(p).requests
+        alloc[node]["pods"] += 1
+        alloc[node]["cpu_used"] += req.get("cpu", Fraction(0))
+        alloc[node]["memory_used"] += req.get("memory", Fraction(0))
+
+    nodes_summary = {
+        name: {
+            "pods": a["pods"],
+            "cpuUtilization": round(float(a["cpu_used"] / a["cpu"]), 4)
+            if a["cpu"]
+            else 0.0,
+            "memoryUtilization": round(
+                float(a["memory_used"] / a["memory"]), 4
+            )
+            if a["memory"]
+            else 0.0,
+        }
+        for name, a in alloc.items()
+    }
+    return {
+        "phase": result.phase,
+        "pods": {
+            "scheduled": len(bound_step) - len(bound_then_deleted),
+            "preempted": len(preempted),
+            "pending": pending,
+        },
+        "bindLatencySteps": {
+            "max": max(latencies) if latencies else 0,
+            "mean": round(sum(latencies) / len(latencies), 3)
+            if latencies
+            else 0.0,
+        },
+        "perStep": per_step,
+        "nodes": nodes_summary,
+    }

@@ -113,12 +113,20 @@ class ScenarioRunner:
         controllers=CONTROLLERS,
         max_controller_rounds: int = 100,
         scheduler_mode: str = "sequential",
+        pre_simulation: bool = False,
     ):
         """scheduler_mode="gang" runs each scheduling controller round as
         a fixpoint batch pass (engine/gang.py): Timeline PodScheduled
         events carry placements only (no preemption Delete events — gang
         skips postFilter, and its divergence policy applies). Sequential
-        mode keeps full reference semantics including preemption."""
+        mode keeps full reference semantics including preemption.
+
+        pre_simulation=True runs the non-scheduler controllers to a
+        fixpoint over the provided store BEFORE MajorStep 0, without
+        Timeline events — the KEP's PreSimulationControllers
+        (README.md:366-391): reconcile imported state (expand
+        deployments, bind PVs) so the scenario starts from a settled
+        cluster."""
         if scheduler_mode not in ("sequential", "gang"):
             raise ValueError(
                 f"scheduler_mode must be sequential|gang, got {scheduler_mode!r}"
@@ -136,6 +144,7 @@ class ScenarioRunner:
         self.controllers = controllers
         self.max_controller_rounds = max_controller_rounds
         self.scheduler_mode = scheduler_mode
+        self.pre_simulation = pre_simulation
         self._seq = 0
 
     def _gen_id(self, prefix: str) -> str:
@@ -182,6 +191,19 @@ class ScenarioRunner:
     # -- the VM -------------------------------------------------------------
 
     def run(self) -> ScenarioResult:
+        if self.pre_simulation:
+            # PreSimulationControllers: settle the provided store first,
+            # outside the virtual clock (no Timeline events)
+            from ..controllers.steps import run_to_fixpoint
+
+            try:
+                run_to_fixpoint(
+                    self.store, self.controllers, self.max_controller_rounds
+                )
+            except RuntimeError as e:
+                return ScenarioResult(
+                    phase="Failed", message=f"pre-simulation: {e}"
+                )
         for op in self.operations:
             op.validate()
         by_major: dict[int, list[Operation]] = {}

@@ -102,17 +102,23 @@ function showDetail(p){
     key(p)+'\\n'+JSON.stringify(out,null,2);
 }
 async function act(method,path){
-  const r=await fetch(path,{method});
-  setStatus(`${method} ${path} → ${r.status}`);
+  try{
+    const r=await fetch(path,{method});
+    setStatus(`${method} ${path} → ${r.status}`);
+  }catch(e){setStatus(`${method} ${path} failed: ${e}`);}
 }
 async function exportSnap(){
-  const r=await fetch('/api/v1/export'); const blob=await r.blob();
-  const a=document.createElement('a');
-  a.href=URL.createObjectURL(blob); a.download='snapshot.json'; a.click();
+  try{
+    const r=await fetch('/api/v1/export'); const blob=await r.blob();
+    const a=document.createElement('a');
+    a.href=URL.createObjectURL(blob); a.download='snapshot.json'; a.click();
+  }catch(e){setStatus('export failed: '+e);}
 }
 async function loadCfg(){
-  const r=await fetch('/api/v1/schedulerconfiguration');
-  document.getElementById('cfg').value=JSON.stringify(await r.json(),null,2);
+  try{
+    const r=await fetch('/api/v1/schedulerconfiguration');
+    document.getElementById('cfg').value=JSON.stringify(await r.json(),null,2);
+  }catch(e){setStatus('config load failed: '+e);}
 }
 async function applyCfg(){
   const r=await fetch('/api/v1/schedulerconfiguration',
@@ -128,6 +134,7 @@ async function watch(){
       const reader=r.body.getReader(); const dec=new TextDecoder();
       let buf=''; setStatus('live');
       state.nodes.clear(); state.pods.clear();
+      render();  // an empty cluster sends no replay events
       let pending=null;
       for(;;){
         const {done,value}=await reader.read(); if(done) break;

@@ -156,3 +156,68 @@ def test_gang_scheduler_mode_timeline():
         scheduler_mode="gang",
     ).run()
     assert again.as_dict() == result.as_dict()
+
+
+def test_summarize_result_calculation():
+    from kube_scheduler_simulator_tpu.scenario import summarize
+    from kube_scheduler_simulator_tpu.scenario.runner import (
+        Operation,
+        ScenarioRunner,
+    )
+
+    ops = [
+        Operation(major_step=0, create={"kind": "nodes", "object": node("n0", cpu="2")}),
+        Operation(major_step=0, create={"kind": "pods",
+                                        "object": pod("early", cpu="500m")}),
+        Operation(major_step=2, create={"kind": "pods",
+                                        "object": pod("late", cpu="500m")}),
+        Operation(major_step=2, create={"kind": "pods",
+                                        "object": pod("toobig", cpu="8")}),
+        Operation(major_step=3, done=True),
+    ]
+    runner = ScenarioRunner(ops)
+    result = runner.run()
+    s = summarize(result, runner.store)
+    assert s["phase"] == "Succeeded"
+    assert s["pods"] == {"scheduled": 2, "preempted": 0, "pending": 1}
+    assert s["bindLatencySteps"] == {"max": 0, "mean": 0.0}  # bound same step
+    assert s["perStep"]["0"]["binds"] == 1
+    assert s["perStep"]["2"]["binds"] == 1
+    n0 = s["nodes"]["n0"]
+    assert n0["pods"] == 2 and abs(n0["cpuUtilization"] - 0.5) < 1e-9
+
+
+def test_pre_simulation_controllers_settle_imported_state():
+    from kube_scheduler_simulator_tpu.models.store import ResourceStore
+    from kube_scheduler_simulator_tpu.scenario.runner import (
+        Operation,
+        ScenarioRunner,
+    )
+
+    store = ResourceStore()
+    store.apply("nodes", node("n0"))
+    store.apply(
+        "deployments",
+        {
+            "metadata": {"name": "web", "namespace": "default"},
+            "spec": {
+                "replicas": 3,
+                "selector": {"matchLabels": {"app": "web"}},
+                "template": {
+                    "metadata": {"labels": {"app": "web"}},
+                    "spec": {"containers": [{"name": "c", "resources":
+                             {"requests": {"cpu": "100m", "memory": "64Mi"}}}]},
+                },
+            },
+        },
+    )
+    ops = [Operation(major_step=0, done=True)]
+    result = ScenarioRunner(ops, store=store, pre_simulation=True).run()
+    assert result.phase == "Succeeded"
+    # deployment expanded BEFORE step 0 (no Create events in the timeline
+    # for the replicas), then the step-0 controller round scheduled them
+    assert len(store.list("pods")) == 3
+    creates = [e for e in result.timeline["0"] if e.type == "Create"]
+    assert not creates
+    scheduled = [e for e in result.timeline["0"] if e.type == "PodScheduled"]
+    assert len(scheduled) == 3
