@@ -123,7 +123,7 @@ def _gang_probe(mode: str):
     nodes, pods = synthetic_cluster(n_nodes, n_pods, seed=42)
     enc = encode_cluster(nodes, pods, supported_config(), policy=TPU32)
     if mode == "static":
-        gang = GangScheduler(enc, chunk=128, loop="static", inner_iters=32)
+        gang = GangScheduler(enc, chunk=128, loop="static", inner_iters=64)
     else:
         gang = GangScheduler(enc, chunk=128)
     order, _ = gang.order_arrays()
