@@ -265,8 +265,15 @@ class ScenarioRunner:
                                 f"operation {op.id}: delete target "
                                 f"{d['kind']}/{d['name']} not found"
                             )
-                        record("Delete", {"kind": d["kind"], "name": d["name"]},
-                               op.id)
+                        record(
+                            "Delete",
+                            {
+                                "kind": d["kind"],
+                                "name": d["name"],
+                                "namespace": d.get("namespace", "default"),
+                            },
+                            op.id,
+                        )
 
                 # 2) SimulationControllers to fixpoint (controllers + the
                 # scheduler are each one "controller"; a round in which any

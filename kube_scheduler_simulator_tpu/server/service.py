@@ -128,8 +128,10 @@ class SchedulerService:
                 "extender" if config.extenders else "sequential"
             ) as ctx:
                 results = self._schedule_locked(config)
+                # a preempting pod yields two records (Nominated + retry):
+                # count distinct pods so decisions/sec isn't inflated
                 ctx.done(
-                    pods=len(results),
+                    pods=len({(r.pod_namespace, r.pod_name) for r in results}),
                     scheduled=sum(
                         1 for r in results if r.status == "Scheduled"
                     ),

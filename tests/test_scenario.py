@@ -221,3 +221,26 @@ def test_pre_simulation_controllers_settle_imported_state():
     assert not creates
     scheduled = [e for e in result.timeline["0"] if e.type == "PodScheduled"]
     assert len(scheduled) == 3
+
+
+def test_summarize_counts_deleted_nondefault_namespace_pod():
+    from kube_scheduler_simulator_tpu.scenario import summarize
+    from kube_scheduler_simulator_tpu.scenario.runner import (
+        Operation,
+        ScenarioRunner,
+    )
+
+    p = pod("web-1", ns="team-a")
+    ops = [
+        Operation(major_step=0, create={"kind": "nodes", "object": node("n0")}),
+        Operation(major_step=0, create={"kind": "pods", "object": p}),
+        Operation(major_step=1, delete={"kind": "pods", "name": "web-1",
+                                        "namespace": "team-a"}),
+        Operation(major_step=2, done=True),
+    ]
+    runner = ScenarioRunner(ops)
+    result = runner.run()
+    s = summarize(result, runner.store)
+    # bound at step 0, deleted at step 1: not scheduled in the end state
+    assert s["pods"]["scheduled"] == 0
+    assert s["pods"]["pending"] == 0
