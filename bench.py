@@ -68,7 +68,7 @@ def _best_of(fn, reps=3):
     return best
 
 
-def _device_watchdog(timeout_s: float = 180.0) -> str:
+def _device_watchdog(timeout_s: "float | None" = None) -> str:
     """Return the platform name, or re-exec on the CPU backend when the
     accelerator tunnel is wedged (observed failure mode: even
     jax.devices() hangs forever; a hung bench loses the round's artifact
@@ -77,10 +77,13 @@ def _device_watchdog(timeout_s: float = 180.0) -> str:
     import sys
 
     from kube_scheduler_simulator_tpu.utils.axonenv import (
+        PROBE_TIMEOUT_S,
         probe_devices,
         scrubbed_cpu_env,
     )
 
+    if timeout_s is None:
+        timeout_s = PROBE_TIMEOUT_S
     devices, error = probe_devices(timeout_s)
     if devices:
         return devices[0].platform

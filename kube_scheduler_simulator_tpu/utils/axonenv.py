@@ -14,8 +14,14 @@ from __future__ import annotations
 import os
 import threading
 
+# default device-init watchdog window, shared by every entry point
+# (bench, __graft_entry__, library callers)
+PROBE_TIMEOUT_S = 180.0
 
-def probe_devices(timeout_s: float = 180.0) -> tuple[list, "BaseException | None"]:
+
+def probe_devices(
+    timeout_s: float = PROBE_TIMEOUT_S,
+) -> tuple[list, "BaseException | None"]:
     """Discover jax.devices() under a watchdog (a wedged TPU tunnel hangs
     even device enumeration — the observed failure mode this guards).
 

@@ -23,3 +23,20 @@ jax.config.update("jax_platforms", "cpu")
 # The EXACT dtype policy (engine/encode.py) needs 64-bit ints/floats for
 # bit-parity with the pure-Python oracle on arbitrary quantities.
 jax.config.update("jax_enable_x64", True)
+# Persistent compilation cache: many tests build fresh engines whose
+# programs are HLO-identical (different BatchedScheduler instances can't
+# share the in-process jit cache) — dedupe them across tests AND runs.
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.environ.get(
+        "KSS_JAX_CACHE_DIR",
+        # per-user path: a world-shared /tmp dir would break on multi-user
+        # hosts and let another local user plant crafted cache entries
+        # that deserialize into in-process executables
+        os.path.join(
+            os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+            "kss_jax_test_cache",
+        ),
+    ),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
