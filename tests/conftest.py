@@ -30,13 +30,11 @@ jax.config.update(
     "jax_compilation_cache_dir",
     os.environ.get(
         "KSS_JAX_CACHE_DIR",
-        # per-user path: a world-shared /tmp dir would break on multi-user
-        # hosts and let another local user plant crafted cache entries
-        # that deserialize into in-process executables
-        os.path.join(
-            os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
-            "kss_jax_test_cache",
-        ),
+        # inside the repo (gitignored): per-checkout isolation — a
+        # world-shared /tmp dir would break on multi-user hosts and let
+        # another local user plant crafted cache entries that deserialize
+        # into in-process executables
+        os.path.join(os.path.dirname(os.path.dirname(__file__)), ".jax_cache"),
     ),
 )
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
