@@ -137,12 +137,28 @@ class BatchedScheduler:
         record: bool = True,
         strict: bool = True,
         unroll: int = 1,
+        preempt_mode: str = "cond",
     ):
         self.enc = enc
         self.record = record
         # lax.scan unroll factor: trades compile time for per-step
         # overhead; useful at large queue lengths with record=False
         self.unroll = unroll
+        # preempt_mode: how the PostFilter dry-run is gated per step.
+        #   "cond"   — `lax.cond`: the dry-run only executes for pods the
+        #              main attempt left unschedulable (the common case
+        #              skips it entirely — right for the sequential path).
+        #   "masked" — always-run with the outputs select-gated on the
+        #              same predicate. Identical placements and trace;
+        #              required under `vmap` (sweeps), where batching
+        #              would lower the cond to both-branches-run anyway —
+        #              making the masking explicit keeps the semantics
+        #              defined instead of relying on that lowering.
+        if preempt_mode not in ("cond", "masked"):
+            raise ValueError(
+                f"preempt_mode must be cond|masked, got {preempt_mode!r}"
+            )
+        self.preempt_mode = preempt_mode
         if enc.policy.name == "exact" and not jax.config.jax_enable_x64:
             raise RuntimeError("EXACT dtype policy requires jax_enable_x64")
         cfg = enc.config
@@ -191,6 +207,13 @@ class BatchedScheduler:
         self._postfilter_names = [
             n for n in cfg.enabled("postFilter") if n in K.POSTFILTER_KERNELS
         ]
+        # custom permit kernels (K.PERMIT_PLUGINS): record-only handlers
+        # invoked at trace-decode time for scheduled pods
+        self._permit_handlers = {
+            n: K.PERMIT_PLUGINS[n](enc)
+            for n in cfg.enabled("permit")
+            if n in K.PERMIT_PLUGINS
+        }
         self._preempt = (
             K.POSTFILTER_KERNELS["DefaultPreemption"](enc, self._filter_names)
             if "DefaultPreemption" in self._postfilter_names
@@ -397,9 +420,11 @@ class BatchedScheduler:
 
         # Exposed segment programs: the extender loop (extender_loop.py)
         # schedules pod-by-pod with HTTP callbacks between these device
-        # segments (SURVEY.md §7 hard part #6).
+        # segments (SURVEY.md §7 hard part #6); the gang scheduler's
+        # preempt phase (gang.py) reuses attempt/evict with its own bind.
         self._attempt = attempt
         self._bind = bind
+        self._evict_all = evict_all
 
         def step(carry, x):
             state, a, weights = carry
@@ -415,6 +440,33 @@ class BatchedScheduler:
             # full cycle within the same step (oracle schedule_all re-queues
             # the pod at the queue head — nothing schedules in between).
             do = (sel < 0) & pf_ok & a.pod_mask[p]
+
+            def masked_preempt(st):
+                # Always-run form of `with_preempt` below: gate the victim
+                # nomination on `do` instead of branching. With nothing
+                # nominated, `evict` is all-False, `evict_all` is an exact
+                # no-op, and the retry attempt reproduces the main attempt
+                # — so binding proceeds from `sel` exactly as the skipped
+                # branch would. Retry outputs are zero-gated to match the
+                # cond mode's `without` trace bit-for-bit.
+                pcode, vmask, nominated = preempt_fn(a, st, p)
+                nominated = jnp.where(do, nominated, jnp.int32(-1))
+                vmask = vmask & do
+                pcode = jnp.where(do, pcode, 0)
+                evict = vmask[jnp.maximum(nominated, 0)] & (nominated >= 0)
+                st2 = evict_all(st, a, evict)
+                _, codes2, raw2, final2, sel2, _ = attempt(st2, a, weights, p)
+                pcode2, vmask2, nominated2 = preempt_fn(a, st2, p)
+                return st2, (
+                    pcode, vmask, nominated, evict,
+                    jnp.where(do, codes2, 0),
+                    jnp.where(do, raw2, 0),
+                    jnp.where(do, final2, 0),
+                    jnp.where(do, sel2, jnp.int32(-1)),
+                    jnp.where(do, pcode2, 0),
+                    vmask2 & do,
+                    jnp.where(do, nominated2, jnp.int32(-1)),
+                )
 
             def with_preempt(st):
                 pcode, vmask, nominated = preempt_fn(a, st, p)
@@ -439,7 +491,10 @@ class BatchedScheduler:
                     jnp.int32(-1),
                 )
 
-            state, extra = jax.lax.cond(do, with_preempt, without, state)
+            if self.preempt_mode == "masked":
+                state, extra = masked_preempt(state)
+            else:
+                state, extra = jax.lax.cond(do, with_preempt, without, state)
             (pcode, vmask, nominated, evict,
              codes2, raw2, final2, sel2, pcode2, vmask2, nominated2) = extra
             final_sel = jnp.where(do & (nominated >= 0), sel2, sel)
@@ -563,9 +618,11 @@ class BatchedScheduler:
 
     # -- trace → reference annotation records -------------------------------
 
-    def _fill_attempt(self, res, codes_row, raw_row, final_row, sel_val):
+    def _fill_attempt(self, res, codes_row, raw_row, final_row, sel_val, p=None):
         """Fill one Filter→Score attempt into a result record. Returns True
-        when the attempt scheduled the pod."""
+        when the attempt scheduled the pod. `p`: the pod's index, forwarded
+        to custom permit handlers (both the first and the post-preemption
+        retry attempt pass it; None suppresses permit records)."""
         enc = self.enc
         feasible = []
         for n in range(enc.n_nodes):
@@ -595,7 +652,12 @@ class BatchedScheduler:
         s = int(sel_val)
         res.selected_node = enc.node_names[s]
         res.status = "Scheduled"
-        record_bind_points(enc.config, res)
+        permit = (
+            {n: h(p, s) for n, h in self._permit_handlers.items()}
+            if self._permit_handlers and p is not None
+            else None
+        )
+        record_bind_points(enc.config, res, permit=permit)
         return True
 
     def _fill_postfilter(self, res, pcode_row, vmask_row, seq):
@@ -676,7 +738,7 @@ class BatchedScheduler:
                 res.status = "Unschedulable"
                 results.append(res)
                 continue
-            self._fill_attempt(res, codes[qi], raw[qi], final[qi], sel[qi])
+            self._fill_attempt(res, codes[qi], raw[qi], final[qi], sel[qi], p)
             if has_pf and bool(did[qi]):
                 victims_by_node = self._fill_postfilter(
                     res, pcode[qi], vmask[qi], seq
@@ -692,7 +754,7 @@ class BatchedScheduler:
                     res2 = PodSchedulingResult(pod_namespace=ns, pod_name=name)
                     res2.pre_filter_status = dict(res.pre_filter_status)
                     ok = self._fill_attempt(
-                        res2, codes2[qi], raw2[qi], final2[qi], sel2[qi]
+                        res2, codes2[qi], raw2[qi], final2[qi], sel2[qi], p
                     )
                     if not ok:
                         self._fill_postfilter(res2, pcode2[qi], vmask2[qi], seq)

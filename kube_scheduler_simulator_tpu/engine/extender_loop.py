@@ -226,7 +226,17 @@ class ExtenderScheduler:
             best = min(feasible, key=lambda n: (-totals[n], n))
             res.selected_node = enc.node_names[best]
             res.status = "Scheduled"
-            record_bind_points(enc.config, res)
+            # custom permit kernels record the same wait/timeout verdicts
+            # here as on the batch path (engine._fill_attempt)
+            permit = (
+                {
+                    n_: h(p, best)
+                    for n_, h in self.sched._permit_handlers.items()
+                }
+                if self.sched._permit_handlers
+                else None
+            )
+            record_bind_points(enc.config, res, permit=permit)
             try:
                 delegated = self._delegated_bind(pod, enc.node_names[best])
             except ExtenderError as e:

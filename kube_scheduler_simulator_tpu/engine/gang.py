@@ -59,10 +59,17 @@ Divergence policy (documented, per SURVEY §7 M4):
     same node), e.g. spread-out workloads; the contended cases keep the
     invariants that every commit was feasible when made and node-local
     constraints are never violated.
-  * PostFilter (DefaultPreemption) is not run — the dry-run is defined
-    against a momentary sequential state. Configs that enable it are
-    accepted; the skipped point is reported in `skipped_postfilter`.
-    Use the sequential engine when preemption semantics matter.
+  * PostFilter (DefaultPreemption) runs as a *phase*, not inline: when
+    the round loop settles with pods still pending, those pods (few by
+    construction — everything schedulable without eviction has already
+    placed) go through a compiled sequential preempt pass (dry-run →
+    evict → retry → bind, the same kernels as the sequential engine),
+    after which rounds resume; phases repeat until neither makes
+    progress. Against a workload where every preemption-needing pod is
+    unschedulable without eviction this matches the sequential engine
+    exactly; in mixed workloads the phase ordering (all non-evicting
+    binds first) is the documented divergence. Non-DefaultPreemption
+    postFilter plugins remain unsupported (`skipped_postfilter`).
 
 Scale: rounds needed ≈ max pods targeting one node, not P. The per-round
 work is a dense [P, N, plugins] evaluation — the MXU-shaped program the
@@ -77,6 +84,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import kernels as K
 from .encode import EncodedCluster
 from .engine import BatchedScheduler
 
@@ -140,13 +148,29 @@ class GangScheduler:
         # its `attempt` program — gang mode is a different driver around
         # the identical per-pod evaluation.
         self._base = BatchedScheduler(enc, record=False, strict=strict)
-        self.skipped_postfilter = list(enc.config.enabled("postFilter"))
+        # DefaultPreemption runs as the fixpoint preempt phase (see module
+        # docstring); only postFilter plugins without a kernel are skipped.
+        self.skipped_postfilter = [
+            n
+            for n in enc.config.enabled("postFilter")
+            if n not in K.POSTFILTER_KERNELS
+        ]
         self.weights = self._base.weights
         self.max_rounds = max_rounds
         self.run_fn = self._build_run()
         self._run = jax.jit(self.run_fn)
+        self._preempt_phase = (
+            jax.jit(self.preempt_phase_fn)
+            if self.preempt_phase_fn is not None
+            else None
+        )
         self._final_state = None
         self._rounds = None
+        # static-loop exhaustion signal (see run()): True when a static
+        # pass used its entire round budget with the last round still
+        # committing — leftover pending pods may be budget, not
+        # infeasibility. Callers reading placements() should check this.
+        self.exhausted = False
 
     # -- host-side queue encoding ------------------------------------------
 
@@ -233,6 +257,50 @@ class GangScheduler:
                 node_vol3=state.node_vol3.at[tgt].add(a.pod_vol3 * mi[:, None]),
                 bound_seq=jnp.where(mask, jnp.int32(P) + order, state.bound_seq),
             )
+
+        preempt_fn = self._base._preempt
+        evict_all = self._base._evict_all
+
+        def preempt_phase(arrays, state, seg, order, weights):
+            """Sequential preempt pass over the pods the round loop left
+            pending. `seg`: [K] pod indices in queue (PrioritySort) order,
+            -1-padded. Per pod: full attempt → masked preemption dry-run →
+            evict victims → retry → bind (the sequential engine's step
+            semantics, reference wrappedplugin.go:518-546), expressed with
+            the gang module's mask-vector bind so padded rows are exact
+            no-ops. Returns (state, pods bound this phase)."""
+            a = arrays
+
+            def pstep(state, p_raw):
+                valid = p_raw >= 0
+                p = jnp.maximum(p_raw, 0)
+                _, _, _, _, sel, pf_ok = attempt(state, a, weights, p)
+                pending = valid & (state.assignment[p] < 0) & a.pod_mask[p]
+                do = pending & (sel < 0) & pf_ok
+                pcode, vmask, nominated = preempt_fn(a, state, p)
+                nominated = jnp.where(do, nominated, jnp.int32(-1))
+                vmask = vmask & do
+                evict = vmask[jnp.maximum(nominated, 0)] & (nominated >= 0)
+                state = evict_all(state, a, evict)
+                _, _, _, _, sel2, _ = attempt(state, a, weights, p)
+                # an earlier eviction in this phase may have made the pod
+                # plainly feasible (sel >= 0): bind it exactly as the
+                # sequential loop would
+                final_sel = jnp.where(
+                    do & (nominated >= 0),
+                    sel2,
+                    jnp.where(pending, sel, jnp.int32(-1)),
+                )
+                commit = pending & (final_sel >= 0)
+                mask_vec = jnp.zeros((P,), bool).at[p].set(commit)
+                sel_vec = jnp.full((P,), -1, jnp.int32).at[p].set(final_sel)
+                state = bind_all(state, a, mask_vec, sel_vec, order)
+                return state, commit
+
+            state, commits = jax.lax.scan(pstep, state, seg)
+            return state, commits.sum().astype(jnp.int32)
+
+        self.preempt_phase_fn = preempt_phase if preempt_fn is not None else None
 
         def run(arrays, state0, order, weights):
             """(arrays, state0, order, weights) -> (final_state, rounds).
@@ -375,10 +443,69 @@ class GangScheduler:
     # -- execution ----------------------------------------------------------
 
     def run(self, weights: "jnp.ndarray | None" = None):
-        """Execute to fixpoint; returns (final_state, rounds_used)."""
+        """Execute to fixpoint; returns (final_state, rounds_used).
+
+        With DefaultPreemption enabled the fixpoint alternates with
+        preempt phases: rounds settle → the (few) still-pending pods go
+        through the compiled sequential preempt pass → rounds resume;
+        the host loop stops when a phase binds nothing. Sets
+        `self.exhausted` when a static-loop pass spent its whole round
+        budget with the final round still committing (leftover pending
+        pods may then be under-budgeting, not infeasibility)."""
         w = self.weights if weights is None else weights
-        order, _ = self.order_arrays()
-        state, rounds = self._run(self.enc.arrays, self.enc.state0, order, w)
+        order, in_q = self.order_arrays()
+        arrays = self.enc.arrays
+        eligible = np.asarray(in_q) & np.asarray(arrays.pod_mask)
+        last_exhausted = False
+
+        def gang_pass(state):
+            nonlocal last_exhausted
+            state, rounds = self._run(arrays, state, order, w)
+            # no-op rounds form a suffix, so sum == budget means the
+            # last budgeted round still committed (ADVICE r3)
+            last_exhausted = self.loop == "static" and int(
+                np.asarray(rounds)
+            ) >= self.static_rounds
+            return state, rounds
+
+        state, rounds = gang_pass(self.enc.state0)
+        if self._preempt_phase is not None:
+            order_np = np.asarray(order)
+            while True:
+                pending = np.nonzero(
+                    (np.asarray(state.assignment) < 0) & eligible
+                )[0]
+                if pending.size == 0:
+                    break
+                pending = pending[np.argsort(order_np[pending])]
+                # pow2 padding bounds distinct compilations to log2(P)
+                pad = 1 << int(pending.size - 1).bit_length()
+                seg = np.full((max(pad, 1),), -1, np.int32)
+                seg[: pending.size] = pending
+                state, n_bound = self._preempt_phase(
+                    arrays, state, jnp.asarray(seg), order, w
+                )
+                if int(np.asarray(n_bound)) == 0:
+                    break
+                state, r2 = gang_pass(state)
+                rounds = rounds + r2
+        # the flag describes the FINAL state: only the last pass's budget
+        # matters, and only while pods actually remain pending — a budget
+        # spent on the way to a complete schedule is not exhaustion
+        still_pending = bool(
+            ((np.asarray(state.assignment) < 0) & eligible).any()
+        )
+        self.exhausted = last_exhausted and still_pending
+        if self.exhausted:
+            import warnings
+
+            warnings.warn(
+                "gang static round budget exhausted with the last round "
+                "still committing; leftover pending pods may need a larger "
+                "static_rounds",
+                RuntimeWarning,
+                stacklevel=2,
+            )
         self._final_state = state
         self._rounds = rounds
         return state, rounds

@@ -32,7 +32,8 @@ from .encode import EncodedCluster, PODS_RES, ClusterArrays, SchedState
 
 # ---------------------------------------------------------------------------
 # NodeResourcesFit  (oracle: sched/oracle_plugins.py fit_filter/fit_score;
-# upstream NodeResourcesFit with the LeastAllocated default strategy)
+# upstream NodeResourcesFit with all three scoringStrategies —
+# LeastAllocated (default), MostAllocated, RequestedToCapacityRatio)
 # ---------------------------------------------------------------------------
 
 
@@ -81,16 +82,44 @@ def build_fit_score(enc: EncodedCluster):
     )
     wsum = sum(w for _, w in specs) + zero_weight
 
+    if stype == "RequestedToCapacityRatio":
+        from ..sched.oracle_plugins import rtcr_shape
+
+        shape = rtcr_shape(strategy)
+
+        def broken_linear_vec(u: jnp.ndarray) -> jnp.ndarray:
+            """helper.BuildBrokenLinearFunction over a [N] utilization
+            vector: ascending segments overwrite where u >= x1, ends
+            clamp — integer math with Go's trunc-toward-zero division
+            (jnp // floors, so negative slopes need the sign fixup)."""
+            y = jnp.full_like(u, shape[0][1])
+            for (x1, y1), (x2, y2) in zip(shape, shape[1:]):
+                prod = (u - x1) * (y2 - y1)
+                dx = max(x2 - x1, 1)
+                seg = jnp.sign(prod) * (jnp.abs(prod) // dx) + y1
+                y = jnp.where(u >= x1, seg.astype(y.dtype), y)
+            return jnp.where(u >= shape[-1][0], shape[-1][1], y)
+
     def kernel(a: ClusterArrays, s: SchedState, p, feasible=None) -> jnp.ndarray:
         total = jnp.zeros(a.node_mask.shape[0], enc.policy.score)
         for r_idx, w in specs:
             cap = a.node_alloc[:, r_idx]
             req = s.s_requested[:, r_idx] + a.pod_sreq[p, r_idx]
-            if stype == "MostAllocated":
+            if stype == "RequestedToCapacityRatio":
+                # over-capacity / zero-capacity evaluates the shape at
+                # max utilization (upstream resourceScoringFunction)
+                u = jnp.where(
+                    (cap == 0) | (req > cap),
+                    100,
+                    req * 100 // jnp.maximum(cap, 1),
+                ).astype(enc.policy.score)
+                r_score = broken_linear_vec(u)
+            elif stype == "MostAllocated":
                 r_score = req * MAX_NODE_SCORE // jnp.maximum(cap, 1)
+                r_score = jnp.where((cap == 0) | (req > cap), 0, r_score)
             else:  # LeastAllocated
                 r_score = (cap - req) * MAX_NODE_SCORE // jnp.maximum(cap, 1)
-            r_score = jnp.where((cap == 0) | (req > cap), 0, r_score)
+                r_score = jnp.where((cap == 0) | (req > cap), 0, r_score)
             total = total + r_score.astype(enc.policy.score) * w
         if wsum == 0:
             return total
@@ -285,6 +314,16 @@ POSTFILTER_KERNELS: dict[str, Callable] = {}
 # except the preemption victim bound, which compile_signature already
 # includes directly.
 COMPILE_STATICS: dict[str, Callable] = {}
+
+# Permit plugins: name -> builder(enc) -> fn(pod_idx, node_idx) ->
+# (message, timeout_seconds). Permit runs AFTER node selection and only
+# produces the recorded status + wait timeout (the reference records Wait
+# statuses and the timeout duration, wrappedplugin.go:549-575 /
+# store.go:544-555); it is host-side by design — no in-tree plugin uses
+# it, the simulator never actually parks a binding, and keeping it off
+# the compiled path means custom permits can use arbitrary Python.
+# Enabled permit plugins WITHOUT a registration record plain "success".
+PERMIT_PLUGINS: dict[str, Callable] = {}
 
 
 # ---------------------------------------------------------------------------

@@ -50,7 +50,14 @@ class WeightSweep:
     ):
         self.enc = enc
         self.mesh = mesh
-        self.sched = BatchedScheduler(enc, record=record, strict=True)
+        # masked preemption: under vmap a lax.cond would lower to
+        # both-branches-run with a select anyway; building the engine in
+        # masked mode makes that the defined semantics, so sweeps may
+        # enable DefaultPreemption and still match per-variant sequential
+        # placements (each variant sees its own dry-run/evict/retry).
+        self.sched = BatchedScheduler(
+            enc, record=record, strict=True, preempt_mode="masked"
+        )
         self._vrun = jax.jit(
             jax.vmap(self.sched.run_fn, in_axes=(None, None, None, 0))
         )
@@ -97,7 +104,13 @@ class GangSweep:
     Compared to `WeightSweep` (the sequential scan vmapped), each
     variant's pass is ~max-pods-per-node dense rounds instead of P
     dependent steps — under vmap the `lax.while_loop` runs until every
-    variant's fixpoint, finished variants riding along unchanged."""
+    variant's fixpoint, finished variants riding along unchanged.
+
+    DefaultPreemption runs exactly as in the single-variant
+    GangScheduler: when variants settle with pods pending, the compiled
+    preempt phase runs VMAPPED over per-variant pending segments (each
+    variant nominates and evicts its own victims), then rounds resume —
+    the host loop continues until no variant makes progress."""
 
     def __init__(self, enc: EncodedCluster, *, mesh: "Mesh | None" = None,
                  chunk: int = 256):
@@ -109,7 +122,22 @@ class GangSweep:
         self._vrun = jax.jit(
             jax.vmap(self.gang.run_fn, in_axes=(None, None, None, 0))
         )
-        order, _ = self.gang.order_arrays()
+        # resume + phase programs carry per-variant state ([V, ...])
+        self._vrun_resume = jax.jit(
+            jax.vmap(self.gang.run_fn, in_axes=(None, 0, None, 0))
+        )
+        self._vphase = (
+            jax.jit(
+                jax.vmap(
+                    self.gang.preempt_phase_fn, in_axes=(None, 0, 0, None, 0)
+                )
+            )
+            if self.gang.preempt_phase_fn is not None
+            else None
+        )
+        order, in_q = self.gang.order_arrays()
+        self._eligible = np.asarray(in_q) & np.asarray(enc.arrays.pod_mask)
+        self._order_np = np.asarray(order)
         if mesh is not None:
             arrays, state0, _ = shard_encoded(enc, mesh)
             order = jax.device_put(order, NamedSharding(mesh, P()))
@@ -137,7 +165,33 @@ class GangSweep:
             wj = jax.device_put(
                 wj, NamedSharding(self.mesh, P("replicas", None))
             )
+        arrays, _, order = self._args
         states, rounds = self._vrun(*self._args, wj)
+        while self._vphase is not None:
+            assigns = np.asarray(states.assignment)  # [V, P]
+            pend = [
+                np.nonzero((assigns[v] < 0) & self._eligible)[0]
+                for v in range(assigns.shape[0])
+            ]
+            longest = max(len(x) for x in pend)
+            if longest == 0:
+                break
+            # shared pow2 width bounds distinct phase compilations
+            K = 1 << int(longest - 1).bit_length()
+            segs = np.full((assigns.shape[0], max(K, 1)), -1, np.int32)
+            for v, x in enumerate(pend):
+                x = x[np.argsort(self._order_np[x])]
+                segs[v, : len(x)] = x
+            segs_j = jnp.asarray(segs)
+            if self.mesh is not None:
+                segs_j = jax.device_put(
+                    segs_j, NamedSharding(self.mesh, P("replicas", None))
+                )
+            states, n_bound = self._vphase(arrays, states, segs_j, order, wj)
+            if int(np.asarray(n_bound).sum()) == 0:
+                break
+            states, r2 = self._vrun_resume(arrays, states, order, wj)
+            rounds = rounds + r2
         return states.assignment, rounds
 
     def placements(self, assignments) -> list[dict]:

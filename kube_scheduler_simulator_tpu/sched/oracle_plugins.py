@@ -117,10 +117,46 @@ def fit_filter(ctx: "CycleContext", pod: PodView, ni: "NodeInfo") -> "str | None
     return None
 
 
+def _trunc_div(a: int, b: int) -> int:
+    """Go's int64 division truncates toward zero; Python's // floors —
+    they differ on negative dividends (downward shape slopes)."""
+    q = abs(a) // b
+    return -q if a < 0 else q
+
+
+def rtcr_shape(strategy: dict) -> list[tuple[int, int]]:
+    """The RequestedToCapacityRatio shape points, scaled the upstream way:
+    user scores are 0..10 (MaxCustomPriorityScore) and are multiplied by
+    MaxNodeScore/10 when the scorer is built; sorted by utilization."""
+    pts = (strategy.get("requestedToCapacityRatio") or {}).get("shape") or [
+        {"utilization": 0, "score": 0},
+        {"utilization": 100, "score": 10},
+    ]
+    return sorted(
+        (int(p.get("utilization", 0)), int(p.get("score", 0)) * (MAX_NODE_SCORE // 10))
+        for p in pts
+    )
+
+
+def broken_linear(shape: list[tuple[int, int]], u: int) -> int:
+    """Upstream helper.BuildBrokenLinearFunction: clamp outside the shape,
+    integer linear interpolation (trunc division) between points."""
+    if u < shape[0][0]:
+        return shape[0][1]
+    for (x1, y1), (x2, y2) in zip(shape, shape[1:]):
+        if u < x2:
+            return _trunc_div((u - x1) * (y2 - y1), max(x2 - x1, 1)) + y1
+    return shape[-1][1]
+
+
 def fit_score(ctx: "CycleContext", pod: PodView, ni: "NodeInfo") -> int:
-    """ScoringStrategy LeastAllocated (the default): per configured resource,
-    ((allocatable - requested) * 100) / allocatable, weight-averaged.
-    Requested includes existing pods' non-zero requests plus this pod's."""
+    """ScoringStrategy LeastAllocated (the default) / MostAllocated /
+    RequestedToCapacityRatio: per configured resource a 0..100 score,
+    weight-averaged. Requested includes existing pods' non-zero requests
+    plus this pod's. RequestedToCapacityRatio evaluates the broken-linear
+    shape at utilization = requested*100/capacity (over-capacity and
+    zero-capacity nodes evaluate the shape at 100, upstream
+    resourceScoringFunction)."""
     args = ctx.args("NodeResourcesFit")
     strategy = (args.get("scoringStrategy") or {})
     resources = strategy.get("resources") or [
@@ -128,6 +164,7 @@ def fit_score(ctx: "CycleContext", pod: PodView, ni: "NodeInfo") -> int:
         {"name": "memory", "weight": 1},
     ]
     stype = strategy.get("type", "LeastAllocated")
+    shape = rtcr_shape(strategy) if stype == "RequestedToCapacityRatio" else None
     pod_req = to_int_resources(pod_scoring_requests(pod.obj))
     score_sum = 0
     weight_sum = 0
@@ -135,7 +172,13 @@ def fit_score(ctx: "CycleContext", pod: PodView, ni: "NodeInfo") -> int:
         rname, weight = spec["name"], int(spec.get("weight", 1))
         requested = ni.nonzero_requested.get(rname, 0) + pod_req.get(rname, 0)
         capacity = ni.allocatable.get(rname, 0)
-        if capacity == 0 or requested > capacity:
+        if stype == "RequestedToCapacityRatio":
+            if capacity == 0 or requested > capacity:
+                u = 100
+            else:
+                u = requested * 100 // capacity
+            r_score = broken_linear(shape, u)
+        elif capacity == 0 or requested > capacity:
             r_score = 0
         elif stype == "MostAllocated":
             r_score = requested * MAX_NODE_SCORE // capacity

@@ -20,18 +20,62 @@ SUCCESS_MESSAGE = "success"
 WAIT_MESSAGE = "wait"
 
 
-def record_bind_points(config, res: "PodSchedulingResult") -> None:
+def go_duration(seconds: float) -> str:
+    """`time.Duration.String()` for the permit-timeout annotation
+    (resultstore store.go:544-555 records `timeout.String()`): "0s",
+    sub-second values in ns/µs/ms, otherwise "[Xh][Ym]Zs" with the
+    fraction's trailing zeros trimmed."""
+    ns = round(seconds * 1e9)
+    if ns == 0:
+        return "0s"
+
+    def frac(value: float) -> str:
+        s = f"{value:.9f}".rstrip("0").rstrip(".")
+        return s
+
+    if ns < 1_000:
+        return f"{ns}ns"
+    if ns < 1_000_000:
+        return f"{frac(ns / 1_000)}µs"
+    if ns < 1_000_000_000:
+        return f"{frac(ns / 1_000_000)}ms"
+    total_s = ns / 1e9
+    h = int(total_s // 3600)
+    m = int((total_s - h * 3600) // 60)
+    s = total_s - h * 3600 - m * 60
+    out = ""
+    if h:
+        out += f"{h}h"
+    if m or h:
+        out += f"{m}m"
+    out += f"{frac(s)}s"
+    return out
+
+
+def record_bind_points(
+    config,
+    res: "PodSchedulingResult",
+    permit: "dict[str, tuple[str, float]] | None" = None,
+) -> None:
     """Record the post-selection extension points for a scheduled pod —
     one status per *enabled* plugin at each point, as the reference's
     wrapped plugins do (wrappedplugin.go:549-695: Reserve/Permit/PreBind/
     Bind/PostBind each record per registered plugin). None of the
     simulator-supported plugins can fail these points in-process (no real
-    volume provisioning, no wait-permits), so every recorded status is
-    "success" — but the *set* of records follows the configuration."""
+    volume provisioning), so statuses default to "success".
+
+    `permit`: optional {plugin name: (message, timeout_seconds)} from
+    custom permit kernels (kernels.PERMIT_PLUGINS) — the reference's
+    AddPermitResult records BOTH the status ("success" / "wait" / error
+    message) and the timeout as a Go duration string
+    (wrappedplugin.go:549-575, store.go:544-555); plugins without an
+    entry record success with timeout 0."""
     for name in config.enabled("reserve"):
         res.reserve[name] = SUCCESS_MESSAGE
     for name in config.enabled("permit"):
-        res.permit[name] = SUCCESS_MESSAGE
+        msg, timeout_s = (permit or {}).get(name, (SUCCESS_MESSAGE, 0.0))
+        res.permit[name] = msg
+        res.permit_timeout[name] = go_duration(timeout_s)
     for name in config.enabled("preBind"):
         res.prebind[name] = SUCCESS_MESSAGE
     for name in config.enabled("bind"):

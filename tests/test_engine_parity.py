@@ -167,6 +167,76 @@ class TestM2Parity:
         pods = [pod(f"p{i}", cpu="1", mem="1Gi") for i in range(5)]
         assert_parity(nodes, pods, cfg)
 
+    def test_requested_to_capacity_ratio_strategy(self):
+        """RequestedToCapacityRatio (the third upstream scoringStrategy):
+        broken-linear shape over utilization, integer Go semantics — incl.
+        a DOWNWARD segment, whose negative interpolation product is where
+        trunc-toward-zero (Go) and floor (python/jnp //) differ."""
+        cfg = restricted_config()
+        cfg.profiles[0]["pluginConfig"] = [
+            {
+                "name": "NodeResourcesFit",
+                "args": {
+                    "scoringStrategy": {
+                        "type": "RequestedToCapacityRatio",
+                        "resources": [
+                            {"name": "cpu", "weight": 2},
+                            {"name": "memory", "weight": 1},
+                        ],
+                        "requestedToCapacityRatio": {
+                            "shape": [
+                                {"utilization": 0, "score": 10},
+                                {"utilization": 70, "score": 7},
+                                {"utilization": 100, "score": 0},
+                            ]
+                        },
+                    }
+                },
+            }
+        ]
+        nodes = [
+            node("n0", cpu="4", mem="8Gi"),
+            node("n1", cpu="8", mem="16Gi"),
+            node("n2", cpu="2", mem="4Gi"),
+        ]
+        pods = [pod(f"p{i}", cpu="700m", mem="1.5Gi") for i in range(6)]
+        results = assert_parity(nodes, pods, cfg)
+        # the shape actually drove scores: a scheduled pod has a non-flat
+        # NodeResourcesFit score column
+        scored = [
+            {n: int(v["NodeResourcesFit"]) for n, v in r.score.items()}
+            for r in results
+            if r.status == "Scheduled"
+        ]
+        assert any(len(set(s.values())) > 1 for s in scored)
+
+    def test_rtcr_shape_helpers_match_go_semantics(self):
+        from kube_scheduler_simulator_tpu.sched.oracle_plugins import (
+            broken_linear,
+            rtcr_shape,
+        )
+
+        shape = rtcr_shape(
+            {
+                "requestedToCapacityRatio": {
+                    "shape": [
+                        {"utilization": 0, "score": 10},
+                        {"utilization": 100, "score": 0},
+                    ]
+                }
+            }
+        )
+        assert shape == [(0, 100), (100, 0)]
+        # descending segment: Go computes (u-0)*(0-100)/100 + 100 with
+        # trunc division: u=33 → (33*-100)/100 = -33 → 67
+        assert broken_linear(shape, 33) == 67
+        assert broken_linear(shape, 0) == 100
+        assert broken_linear(shape, 100) == 0
+        assert broken_linear(shape, 150) == 0  # clamp right
+        assert broken_linear([(20, 0), (80, 100)], 10) == 0  # clamp left
+        # default shape when unspecified: 0→0, 100→100 (score 10 scaled)
+        assert rtcr_shape({}) == [(0, 0), (100, 100)]
+
     @pytest.mark.parametrize("seed", range(6))
     def test_randomized_clusters(self, seed):
         rng = random.Random(seed)

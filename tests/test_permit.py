@@ -1,0 +1,94 @@
+"""Permit point: wait/timeout recording via custom permit kernels
+(reference wrappedplugin.go:549-575 + resultstore store.go:544-555 —
+status AND `timeout.String()` are recorded per permit plugin)."""
+
+import json
+
+import pytest
+
+from kube_scheduler_simulator_tpu.engine import EXACT, BatchedScheduler, encode_cluster
+from kube_scheduler_simulator_tpu.engine import kernels as K
+from kube_scheduler_simulator_tpu.sched.results import go_duration
+
+from helpers import node, pod
+from test_engine_parity import restricted_config
+
+
+class TestGoDuration:
+    def test_formats(self):
+        assert go_duration(0) == "0s"
+        assert go_duration(10) == "10s"
+        assert go_duration(90) == "1m30s"
+        assert go_duration(3723) == "1h2m3s"
+        assert go_duration(0.5) == "500ms"
+        assert go_duration(0.0005) == "500µs"
+        assert go_duration(1.5) == "1.5s"
+        assert go_duration(3600) == "1h0m0s"
+
+
+class TestPermitRecording:
+    def _config(self, permit_names):
+        cfg = restricted_config(
+            filters=("NodeUnschedulable", "NodeName", "NodeResourcesFit"),
+        )
+        cfg.profile()["plugins"]["permit"] = {
+            "disabled": [{"name": "*"}],
+            "enabled": [{"name": n} for n in permit_names],
+        }
+        return cfg
+
+    def test_unregistered_permit_records_success_with_zero_timeout(self):
+        nodes = [node("n0")]
+        pods = [pod("p0")]
+        enc = encode_cluster(nodes, pods, self._config(["SomePermit"]), policy=EXACT)
+        sched = BatchedScheduler(enc)
+        sched.run()
+        res = sched.results()[0]
+        assert res.status == "Scheduled"
+        assert res.permit == {"SomePermit": "success"}
+        assert res.permit_timeout == {"SomePermit": "0s"}
+        ann = res.to_annotations()
+        assert json.loads(ann["scheduler-simulator/permit-result"]) == {
+            "SomePermit": "success"
+        }
+        assert json.loads(
+            ann["scheduler-simulator/permit-result-timeout"]
+        ) == {"SomePermit": "0s"}
+
+    def test_custom_permit_kernel_wait_and_timeout(self):
+        def build_gate(enc):
+            def permit(pod_idx, node_idx):
+                ns, name = enc.pod_keys[pod_idx]
+                if name.startswith("slow"):
+                    return "wait", 12.5
+                return "success", 0.0
+
+            return permit
+
+        K.PERMIT_PLUGINS["GatePermit"] = build_gate
+        try:
+            nodes = [node("n0", cpu="8")]
+            pods = [pod("slow-a"), pod("fast-b")]
+            enc = encode_cluster(
+                nodes, pods, self._config(["GatePermit"]), policy=EXACT
+            )
+            sched = BatchedScheduler(enc)
+            sched.run()
+            by_name = {r.pod_name: r for r in sched.results()}
+            assert by_name["slow-a"].permit == {"GatePermit": "wait"}
+            assert by_name["slow-a"].permit_timeout == {"GatePermit": "12.5s"}
+            assert by_name["fast-b"].permit == {"GatePermit": "success"}
+            assert by_name["fast-b"].permit_timeout == {"GatePermit": "0s"}
+        finally:
+            del K.PERMIT_PLUGINS["GatePermit"]
+
+    def test_unschedulable_pod_records_no_permit(self):
+        nodes = [node("n0", cpu="100m")]
+        pods = [pod("too-big", cpu="4")]
+        enc = encode_cluster(nodes, pods, self._config(["SomePermit"]), policy=EXACT)
+        sched = BatchedScheduler(enc)
+        sched.run()
+        res = sched.results()[0]
+        assert res.status == "Unschedulable"
+        assert res.permit == {}
+        assert res.permit_timeout == {}
