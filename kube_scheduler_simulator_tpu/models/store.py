@@ -98,8 +98,63 @@ class ResourceStore:
         self._dispatch()
         return out
 
+    def replace(self, kind: str, obj: dict) -> dict:
+        """Wholesale replacement (the kubectl-replace / PUT-to-item
+        analogue): the provided manifest becomes the stored object —
+        fields absent from it are REMOVED, unlike `apply`'s structural
+        merge. The dashboard's YAML editor saves through this so
+        deleting a field in the editor actually deletes it."""
+        if kind not in KINDS:
+            raise KeyError(f"unknown kind {kind}")
+        with self._lock:
+            obj = copy.deepcopy(obj)
+            if not (obj.get("metadata", {}) or {}).get("name"):
+                raise ValueError("object has no metadata.name")
+            k = self.key(kind, obj)
+            existing = self._objs[kind].get(k)
+            event_type = "MODIFIED" if existing is not None else "ADDED"
+            rv = next(self._rv)
+            meta = obj.setdefault("metadata", {})
+            meta["resourceVersion"] = str(rv)
+            if existing is not None:
+                meta.setdefault("uid", existing.get("metadata", {}).get("uid"))
+            meta.setdefault("uid", f"uid-{kind}-{k}-{rv}")
+            if NAMESPACED.get(kind):
+                meta.setdefault("namespace", "default")
+            self._objs[kind][k] = obj
+            self._emit(WatchEvent(event_type, kind, copy.deepcopy(obj), rv))
+            out = copy.deepcopy(obj)
+        self._dispatch()
+        return out
+
     def _apply_locked(self, kind: str, obj: dict) -> dict:
         obj = copy.deepcopy(obj)
+        meta0 = obj.get("metadata", {}) or {}
+        if not meta0.get("name") and meta0.get("generateName"):
+            # the apiserver's generateName contract (the reference's web
+            # UI creation templates rely on it): server appends a random
+            # 5-char lowercase-alnum suffix. Collisions with existing
+            # names must NOT fall into the merge path (the apiserver
+            # retries/409s); redraw until the key is free.
+            import random
+            import string
+
+            alphabet = string.ascii_lowercase + string.digits
+            prefix = meta0.pop("generateName")
+            ns = meta0.get("namespace", "default")
+            for _ in range(100):
+                name = prefix + "".join(random.choices(alphabet, k=5))
+                probe_key = (
+                    f"{ns}/{name}" if NAMESPACED.get(kind) else name
+                )
+                if probe_key not in self._objs[kind]:
+                    break
+            else:
+                raise ValueError(
+                    f"generateName {prefix!r}: no free name after 100 draws"
+                )
+            meta0["name"] = name
+            obj["metadata"] = meta0
         if not (obj.get("metadata", {}) or {}).get("name"):
             raise ValueError("object has no metadata.name")
         k = self.key(kind, obj)

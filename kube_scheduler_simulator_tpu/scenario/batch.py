@@ -177,7 +177,10 @@ def run_batch(
     `max_workers > 1` runs host-bound scenario jobs on a bounded thread
     pool (utils/tasks.bounded_map, the reference's semaphored-errgroup
     analogue) — useful when a batch is dominated by small scenario VMs
-    rather than device time. A job that raises is recorded as
+    rather than device time. Sweep jobs are device-bound, so they always
+    run serially regardless of `max_workers`: concurrent sweeps would
+    contend for the single device and stack their [chunk, N, plugins]
+    intermediates in device memory. A job that raises is recorded as
     phase=Failed; remaining jobs still run (the KEP-184 runner's
     one-shot isolation).
     """
@@ -201,7 +204,10 @@ def run_batch(
     if max_workers > 1:
         from ..utils.tasks import bounded_map
 
-        results = dict(bounded_map(one, jobs, max_workers=max_workers))
+        pooled = [j for j in jobs if j.kind != "sweep"]
+        serial = [j for j in jobs if j.kind == "sweep"]
+        results = dict(bounded_map(one, pooled, max_workers=max_workers))
+        results.update(one(job) for job in serial)
     else:
         results = dict(one(job) for job in jobs)
     if out_dir:

@@ -117,9 +117,10 @@ class ScenarioRunner:
     ):
         """scheduler_mode="gang" runs each scheduling controller round as
         a fixpoint batch pass (engine/gang.py): Timeline PodScheduled
-        events carry placements only (no preemption Delete events — gang
-        skips postFilter, and its divergence policy applies). Sequential
-        mode keeps full reference semantics including preemption.
+        events carry placements, and pods evicted by gang's preemption
+        phase are recorded as Delete events (reason=preempted), matching
+        the sequential branch; gang's divergence policy applies.
+        Sequential mode keeps full reference semantics.
 
         pre_simulation=True runs the non-scheduler controllers to a
         fixpoint over the provided store BEFORE MajorStep 0, without
@@ -155,8 +156,29 @@ class ScenarioRunner:
 
     def _scheduler_step(self, record) -> bool:
         if self.scheduler_mode == "gang":
+            # the gang pass reports placements only; evicted preemption
+            # victims surface as store deletions — diff the pod set so
+            # the Timeline carries the same Delete events the sequential
+            # branch records from per-pod results
+            def pod_keys():
+                return {
+                    (
+                        p["metadata"].get("namespace", "default"),
+                        p["metadata"]["name"],
+                    )
+                    for p in self.store.list("pods")
+                }
+
+            before = pod_keys()
             placements, _ = self.scheduler.schedule_gang()
             changed = False
+            for ns, name in sorted(before - pod_keys()):
+                record(
+                    "Delete",
+                    {"kind": "pods", "namespace": ns, "name": name,
+                     "reason": "preempted"},
+                )
+                changed = True
             for (ns, name), node_name in sorted(placements.items()):
                 if node_name:
                     record(

@@ -34,12 +34,30 @@ def main(argv: "list[str] | None" = None) -> int:
         help="replicate an existing cluster from a simulator-compatible "
         "export endpoint at boot (IgnoreErr, keeps own scheduler config)",
     )
+    parser.add_argument(
+        "--replicate-from-cluster",
+        default=None,
+        metavar="URL",
+        help="replicate from a REAL kube-apiserver at boot (lists "
+        "pods/nodes/PVs/PVCs/storageclasses/priorityclasses/namespaces "
+        "via the Kubernetes REST API; reference "
+        "replicateexistingcluster.go:40-53)",
+    )
+    parser.add_argument(
+        "--bearer-token-file",
+        default=None,
+        metavar="PATH",
+        help="file holding a bearer token for --replicate-from-cluster",
+    )
     args = parser.parse_args(argv)
 
     cfg = envconfig.from_env()
     if args.port is not None:
         cfg.port = args.port
-    service = SimulatorService(initial_config=cfg.initial_scheduler_config)
+    service = SimulatorService(
+        initial_config=cfg.initial_scheduler_config,
+        external_scheduler_enabled=cfg.external_scheduler_enabled,
+    )
     if cfg.external_import_enabled and cfg.snapshot_path:
         errors = service.import_(
             envconfig.load_snapshot(cfg.snapshot_path), ignore_err=True
@@ -50,6 +68,19 @@ def main(argv: "list[str] | None" = None) -> int:
         from .replicate import replicate_existing_cluster
 
         for e in replicate_existing_cluster(service, source_url=args.replicate_from):
+            print(f"replicate: skipped: {e}")
+    if args.replicate_from_cluster:
+        from .replicate import replicate_existing_cluster
+
+        token = ""
+        if args.bearer_token_file:
+            with open(args.bearer_token_file) as f:
+                token = f.read().strip()
+        for e in replicate_existing_cluster(
+            service,
+            kube_apiserver=args.replicate_from_cluster,
+            bearer_token=token,
+        ):
             print(f"replicate: skipped: {e}")
     server = SimulatorServer(
         service,

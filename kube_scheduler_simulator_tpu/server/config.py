@@ -9,6 +9,10 @@ Honored variables — the reference's names where the concept carries over:
     KUBE_SCHEDULER_CONFIG_PATH  initial KubeSchedulerConfiguration YAML
     EXTERNAL_IMPORT_ENABLED     import a snapshot at boot (see SNAPSHOT_PATH)
     SNAPSHOT_PATH               snapshot JSON for the boot import
+    EXTERNAL_SCHEDULER_ENABLED  serve without the internal engine; an
+                                external scheduler binds pods through the
+                                resource CRUD surface
+                                (config.go:34-35, :115-121)
 
 etcd/kube-apiserver variables have no analogue: the typed in-process store
 replaces both (SURVEY.md §2 #3).
@@ -30,6 +34,19 @@ class Config:
     initial_scheduler_config: "SchedulerConfiguration | None" = None
     external_import_enabled: bool = False
     snapshot_path: str = ""
+    external_scheduler_enabled: bool = False
+
+
+def _parse_bool(name: str, raw: str) -> bool:
+    """strconv.ParseBool semantics (reference
+    config.go getExternalSchedulerEnabled: non-bool values are an error,
+    not silently false)."""
+    low = raw.strip().lower()
+    if low in ("1", "t", "true"):
+        return True
+    if low in ("0", "f", "false"):
+        return False
+    raise ValueError(f"{name} is specified, but it's not bool: {raw}")
 
 
 def from_env(env: "dict | None" = None) -> Config:
@@ -51,6 +68,10 @@ def from_env(env: "dict | None" = None) -> Config:
             )
     cfg.external_import_enabled = env.get("EXTERNAL_IMPORT_ENABLED") == "true"
     cfg.snapshot_path = env.get("SNAPSHOT_PATH", "")
+    if env.get("EXTERNAL_SCHEDULER_ENABLED"):
+        cfg.external_scheduler_enabled = _parse_bool(
+            "EXTERNAL_SCHEDULER_ENABLED", env["EXTERNAL_SCHEDULER_ENABLED"]
+        )
     return cfg
 
 

@@ -40,8 +40,12 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
-from ..models.store import KINDS, StaleResourceVersion
-from .service import InvalidSchedulerConfiguration, SimulatorService
+from ..models.store import KINDS, NAMESPACED, StaleResourceVersion
+from .service import (
+    InvalidSchedulerConfiguration,
+    SchedulerServiceDisabled,
+    SimulatorService,
+)
 
 # kind → (watch wire name, lastResourceVersion query param); reference
 # resourcewatcher.go:22-30 + handler/watcher.go:27-34 (note the singular
@@ -96,7 +100,7 @@ class SimulatorServer:
             self._thread.join(timeout=5)
 
     def maybe_schedule(self):
-        if self.auto_schedule:
+        if self.auto_schedule and not self.service.scheduler.disabled:
             self.service.scheduler.schedule()
 
 
@@ -133,9 +137,19 @@ def _make_handler(server: SimulatorServer):
             self._json(code, {"message": msg})
 
         def _body(self):
+            """Parse the request body: JSON first, YAML fallback — the
+            dashboard's editor submits the same YAML a kubectl user would
+            paste (reference web: Monaco YAML editor)."""
             length = int(self.headers.get("Content-Length") or 0)
             raw = self.rfile.read(length) if length else b""
-            return json.loads(raw) if raw else None
+            if not raw:
+                return None
+            try:
+                return json.loads(raw)
+            except json.JSONDecodeError:
+                import yaml
+
+                return yaml.safe_load(raw)
 
         # -- dispatch -------------------------------------------------------
 
@@ -193,9 +207,9 @@ def _make_handler(server: SimulatorServer):
                 if rest == ["listwatchresources"] and method == "GET":
                     return self._list_watch(parse_qs(url.query))
                 if rest == ["metrics"] and method == "GET":
-                    from ..utils import metrics as metrics_mod
-
-                    return self._json(200, metrics_mod.GLOBAL.snapshot())
+                    return self._json(
+                        200, service.scheduler.metrics.snapshot()
+                    )
                 if rest == ["schedule"] and method == "POST":
                     mode = parse_qs(url.query).get("mode", ["sequential"])[0]
                     if mode not in ("sequential", "gang"):
@@ -250,6 +264,10 @@ def _make_handler(server: SimulatorServer):
                 return self._error(404, "not found")
             except BrokenPipeError:
                 raise
+            except SchedulerServiceDisabled as e:
+                # reference schedulerconfig.go:32-34: external-scheduler
+                # mode answers config/scheduling calls with 400
+                return self._error(400, str(e))
             except InvalidSchedulerConfiguration as e:
                 return self._error(500, str(e))
             except Exception as e:  # noqa: BLE001 — boundary
@@ -290,7 +308,50 @@ def _make_handler(server: SimulatorServer):
                     obj = service.store.get(kind, name, namespace)
                     if obj is None:
                         return self._error(404, "not found")
+                    if q.get("format", [None])[0] == "yaml":
+                        import yaml
+
+                        body = yaml.safe_dump(
+                            obj, sort_keys=False, default_flow_style=False
+                        ).encode()
+                        self.send_response(200)
+                        self._cors_headers()
+                        self.send_header(
+                            "Content-Type", "application/yaml; charset=utf-8"
+                        )
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return None
                     return self._json(200, obj)
+                if method == "PUT":
+                    # item-path PUT = wholesale replace (kubectl replace):
+                    # fields absent from the body are removed — the YAML
+                    # editor's save semantics. Collection POST/PUT keeps
+                    # the SSA-style merge.
+                    obj = self._body() or {}
+                    meta = obj.get("metadata", {}) or {}
+                    if meta.get("name") and meta["name"] != name:
+                        return self._error(
+                            400,
+                            f"body names {meta['name']!r}, path names {name!r}",
+                        )
+                    meta["name"] = name
+                    if NAMESPACED.get(kind):
+                        # a body namespace differing from the path would
+                        # silently replace a DIFFERENT object; reject it
+                        # like the name mismatch above
+                        if meta.get("namespace") and meta["namespace"] != namespace:
+                            return self._error(
+                                400,
+                                f"body namespace {meta['namespace']!r} does "
+                                f"not match path namespace {namespace!r}",
+                            )
+                        meta["namespace"] = namespace
+                    obj["metadata"] = meta
+                    out = service.store.replace(kind, obj)
+                    server.maybe_schedule()
+                    return self._json(200, out)
                 if method == "DELETE":
                     ok = service.store.delete(kind, name, namespace)
                     if not ok:

@@ -4,13 +4,20 @@ beta feature: export from a real cluster, import here, ignoring
 per-object errors and the scheduler configuration).
 
 The reference reads a KUBECONFIG and lists resources through client-go.
-This framework's equivalent source is anything that speaks the export
-wire format (`ResourcesForImport` JSON): another simulator instance's
-`GET /api/v1/export`, a kube-apiserver dump converted to the snapshot
-shape, or a snapshot file. Import runs in IgnoreErr mode and drops the
-source's schedulerConfig, exactly like the reference
-(`ImportFromExistingCluster` passes WithIgnoreErr +
-IgnoreSchedulerConfiguration).
+Two equivalent sources here:
+
+  * anything that speaks the export wire format (`ResourcesForImport`
+    JSON): another simulator instance's `GET /api/v1/export`, or a
+    snapshot file (`fetch_export` / `replicate_existing_cluster`);
+  * a REAL kube-apiserver: `list_cluster` speaks the Kubernetes REST
+    list API directly (`GET /api/v1/{pods,nodes,...}`,
+    `/apis/{storage,scheduling}.k8s.io/v1/...`, optional bearer token)
+    and converts the typed Lists into the snapshot shape — the client-go
+    listing of replicateexistingcluster.go:40-53 without client-go.
+
+Import always runs in IgnoreErr mode and drops the source's
+schedulerConfig, exactly like the reference (`ImportFromExistingCluster`
+passes WithIgnoreErr + IgnoreSchedulerConfiguration).
 """
 
 from __future__ import annotations
@@ -21,6 +28,68 @@ import urllib.request
 
 from ..utils.tasks import RetryError, retry
 from .service import SimulatorService
+
+# snapshot key → kube-apiserver list path (group/version fixed at the
+# reference's supported versions: core/v1, storage.k8s.io/v1,
+# scheduling.k8s.io/v1)
+_CLUSTER_LIST_PATHS = {
+    "pods": "/api/v1/pods",
+    "nodes": "/api/v1/nodes",
+    "pvs": "/api/v1/persistentvolumes",
+    "pvcs": "/api/v1/persistentvolumeclaims",
+    "storageClasses": "/apis/storage.k8s.io/v1/storageclasses",
+    "priorityClasses": "/apis/scheduling.k8s.io/v1/priorityclasses",
+    "namespaces": "/api/v1/namespaces",
+}
+
+
+def list_cluster(
+    server: str,
+    *,
+    bearer_token: str = "",
+    timeout: float = 60.0,
+    retry_steps: int = 3,
+) -> dict:
+    """List every replicated kind from a kube-apiserver and return the
+    snapshot wire shape (`ResourcesForImport` minus schedulerConfig).
+
+    `server`: the apiserver base URL (e.g. ``https://10.0.0.1:6443`` or a
+    ``kubectl proxy`` address). `bearer_token` is sent as
+    ``Authorization: Bearer ...`` when non-empty, covering the
+    serviceaccount/token flows a KUBECONFIG usually encodes; cert-based
+    auth is out of scope (run ``kubectl proxy`` for those clusters).
+    Connection-level failures retry with backoff; HTTP errors don't.
+    """
+    base = server.rstrip("/")
+    out: dict = {}
+
+    def get(url):
+        def go():
+            req = urllib.request.Request(url)
+            if bearer_token:
+                req.add_header("Authorization", f"Bearer {bearer_token}")
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return json.loads(resp.read())
+
+        def transient(e: BaseException) -> bool:
+            return isinstance(e, urllib.error.URLError) and not isinstance(
+                e, urllib.error.HTTPError
+            )
+
+        try:
+            return retry(go, steps=retry_steps, retryable=transient)
+        except urllib.error.HTTPError as e:
+            raise RuntimeError(f"list {url}: HTTP {e.code}") from e
+        except RetryError as e:
+            raise RuntimeError(f"list {url}: {e.last.reason}") from e.last
+
+    for jkey, path in _CLUSTER_LIST_PATHS.items():
+        body = get(base + path)
+        items = body.get("items") or []
+        # apiserver Lists omit each item's kind/apiVersion; the snapshot
+        # shape doesn't need them, only metadata/spec/status
+        out[jkey] = items
+    return out
 
 
 def fetch_export(
@@ -58,17 +127,27 @@ def replicate_existing_cluster(
     source_url: "str | None" = None,
     snapshot: "dict | None" = None,
     snapshot_path: "str | None" = None,
+    kube_apiserver: "str | None" = None,
+    bearer_token: str = "",
 ) -> list[str]:
     """Import an existing cluster's state from exactly one source.
 
-    Returns the list of skipped objects (IgnoreErr mode). The source's
-    scheduler configuration is ignored — the simulator keeps its own
-    (replicateexistingcluster.go:47-52).
+    Sources: a simulator export endpoint (`source_url`), an in-memory
+    snapshot, a snapshot file, or a real kube-apiserver
+    (`kube_apiserver`, optionally with `bearer_token` — see
+    `list_cluster`). Returns the list of skipped objects (IgnoreErr
+    mode). The source's scheduler configuration is ignored — the
+    simulator keeps its own (replicateexistingcluster.go:47-52).
     """
-    sources = [s for s in (source_url, snapshot, snapshot_path) if s is not None]
+    sources = [
+        s
+        for s in (source_url, snapshot, snapshot_path, kube_apiserver)
+        if s is not None
+    ]
     if len(sources) != 1:
         raise ValueError(
-            "exactly one of source_url / snapshot / snapshot_path required"
+            "exactly one of source_url / snapshot / snapshot_path / "
+            "kube_apiserver required"
         )
     if source_url is not None:
         snapshot = fetch_export(source_url)
@@ -76,6 +155,8 @@ def replicate_existing_cluster(
         from .config import load_snapshot
 
         snapshot = load_snapshot(snapshot_path)
+    elif kube_apiserver is not None:
+        snapshot = list_cluster(kube_apiserver, bearer_token=bearer_token)
     snapshot = dict(snapshot or {})
     snapshot.pop("schedulerConfig", None)  # IgnoreSchedulerConfiguration
     return service.import_(snapshot, ignore_err=True)

@@ -46,6 +46,18 @@ class InvalidSchedulerConfiguration(ValueError):
     pass
 
 
+class SchedulerServiceDisabled(RuntimeError):
+    """An external scheduler is enabled, so the internal scheduler
+    service refuses config/scheduling calls (reference
+    scheduler.go:55 ErrServiceDisabled; the disabled service is built
+    when ExternalSchedulerEnabled, scheduler.go:58-61)."""
+
+    def __init__(self):
+        super().__init__(
+            "an external scheduler is enabled: scheduler service is disabled"
+        )
+
+
 def _pow2(n: int, lo: int = 8) -> int:
     """Pad capacities to powers of two so repeated passes over a growing
     cluster reuse XLA compilations instead of recompiling per size."""
@@ -62,8 +74,22 @@ class SchedulerService:
         self,
         store: ResourceStore,
         initial_config: "SchedulerConfiguration | None" = None,
+        metrics: "metrics_mod.SchedulingMetrics | None" = None,
+        disabled: bool = False,
     ):
         self.store = store
+        # external-scheduler mode: the service exists (the HTTP layer
+        # still routes to it) but refuses config and scheduling calls
+        self.disabled = disabled
+        # per-service pass counters: embedded/test use may run several
+        # services in one process, and a process-wide registry would
+        # interleave their numbers (ADVICE r3). Each service defaults to
+        # its own instance; the serving shell reads it through
+        # GET /api/v1/metrics. Pass `metrics=metrics_mod.GLOBAL` to opt
+        # into the shared process registry.
+        self.metrics = (
+            metrics if metrics is not None else metrics_mod.SchedulingMetrics()
+        )
         self._initial = initial_config or SchedulerConfiguration.default()
         self._config = self._initial
         self._lock = threading.Lock()
@@ -82,12 +108,16 @@ class SchedulerService:
         return self._config
 
     def get_config(self) -> dict:
+        if self.disabled:
+            raise SchedulerServiceDisabled()
         return self._config.to_dict()
 
     def restart(self, new_config: "dict | SchedulerConfiguration") -> None:
         """Swap in a new configuration; on an unusable one, keep the old
         (reference RestartScheduler rolls back to oldSchedulerCfg,
         scheduler.go:70-87)."""
+        if self.disabled:
+            raise SchedulerServiceDisabled()
         if not isinstance(new_config, SchedulerConfiguration):
             new_config = SchedulerConfiguration.from_dict(new_config)
         missing = unsupported_plugins(new_config)
@@ -101,7 +131,10 @@ class SchedulerService:
 
     def reset(self) -> None:
         """Restore the boot-time configuration (reference
-        ResetScheduler, scheduler.go:89-91)."""
+        ResetScheduler, scheduler.go:89-91 — which goes through
+        RestartScheduler and hence errors when disabled)."""
+        if self.disabled:
+            raise SchedulerServiceDisabled()
         with self._lock:
             self._config = self._initial
             self.extender_service = ExtenderService(self._initial.extenders)
@@ -118,13 +151,15 @@ class SchedulerService:
         interleaving their write-backs. For bulk throughput without
         per-plugin records, see `schedule_gang`.
         """
+        if self.disabled:
+            raise SchedulerServiceDisabled()
         with self._schedule_lock:
             # one config read per pass: encode, branch, and label must
             # all see the same configuration even if restart() lands
             # mid-pass
             with self._lock:
                 config = self._config
-            with metrics_mod.GLOBAL.time_pass(
+            with self.metrics.time_pass(
                 "extender" if config.extenders else "sequential"
             ) as ctx:
                 results = self._schedule_locked(config)
@@ -141,6 +176,8 @@ class SchedulerService:
     def schedule_gang(self) -> tuple[dict, int]:
         """Gang pass with pass serialization; returns
         ({(ns, name): node | ""}, rounds)."""
+        if self.disabled:
+            raise SchedulerServiceDisabled()
         with self._schedule_lock:
             return self._schedule_gang_timed()
 
@@ -151,7 +188,7 @@ class SchedulerService:
             raise ValueError(
                 "gang mode does not support extenders; use sequential mode"
             )
-        with metrics_mod.GLOBAL.time_pass("gang") as ctx:
+        with self.metrics.time_pass("gang") as ctx:
             placements, rounds = self._schedule_gang_locked(config)
             ctx.done(
                 pods=len(placements),
@@ -178,6 +215,15 @@ class SchedulerService:
             self._gang_engine_cache = (sig, gang)
         _, rounds = gang.run()
         placements = gang.placements()
+        # preemption victims: pre-bound pods the preempt phase evicted.
+        # They are NOT in placements (decode covers queued pods only), so
+        # diff the full [P] assignment exactly like the sequential path —
+        # upstream preemption deletes victims through the API.
+        before = np.asarray(enc.state0.assignment)
+        after = np.asarray(gang._final_state.assignment)
+        for p_idx in np.nonzero((before >= 0) & (after < 0))[0]:
+            ns, name = enc.pod_keys[int(p_idx)]
+            self.store.delete("pods", name, ns)
         for (ns, name), node_name in placements.items():
             if not node_name:
                 continue
@@ -290,30 +336,93 @@ class SchedulerService:
 
 
 class SimulatorService:
-    """Store + scheduler + snapshot composites (the DI container analogue)."""
+    """Store + scheduler + snapshot composites (the DI container analogue).
+
+    `external_scheduler_enabled` mirrors the reference's
+    EXTERNAL_SCHEDULER_ENABLED (simulator.go:75-80: the internal
+    scheduler is never started): the scheduler service is built disabled,
+    and pod binds arriving through the resource CRUD surface (an external
+    scheduler setting `spec.nodeName`) are recorded into the service's
+    metrics as mode="external" passes."""
 
     def __init__(
-        self, initial_config: "SchedulerConfiguration | None" = None
+        self,
+        initial_config: "SchedulerConfiguration | None" = None,
+        external_scheduler_enabled: bool = False,
     ):
         self.store = ResourceStore()
-        self.scheduler = SchedulerService(self.store, initial_config)
+        self.external_scheduler_enabled = external_scheduler_enabled
+        self.scheduler = SchedulerService(
+            self.store, initial_config, disabled=external_scheduler_enabled
+        )
+        if external_scheduler_enabled:
+            # key -> last-seen bound state; a recorded external bind is
+            # specifically the pending→bound TRANSITION, so pods imported
+            # or replicated already-bound never count as scheduler
+            # activity (they enter the map as bound on their ADDED event)
+            self._ext_seen: dict[tuple[str, str], bool] = {}
+            self._ext_lock = threading.Lock()
+            self.store.subscribe(self._record_external_bind)
         self.store.snapshot_initial()
+
+    def _record_external_bind(self, ev) -> None:
+        """Store subscriber (external mode only): a pod the simulator has
+        seen pending that now carries a nodeName is an external
+        scheduler's bind — count it. All such transitions are external
+        here by construction (the internal engine is disabled)."""
+        if ev.kind != "pods":
+            return
+        meta = (ev.obj or {}).get("metadata", {})
+        key = (meta.get("namespace", "default"), meta.get("name", ""))
+        with self._ext_lock:
+            if ev.event_type == "DELETED":
+                self._ext_seen.pop(key, None)
+                return
+            bound = bool(((ev.obj or {}).get("spec", {}) or {}).get("nodeName"))
+            if bound and self._ext_seen.get(key) is False:
+                self.scheduler.metrics.record(
+                    metrics_mod.PassRecord(
+                        mode="external", pods=1, scheduled=1, wall_s=0.0
+                    )
+                )
+            self._ext_seen[key] = bound
 
     # -- export / import / reset -------------------------------------------
 
     def export(self) -> dict:
-        return export_snapshot(self.store, self.scheduler.get_config())
+        """Export resources + config. In external mode the config is not
+        exported (reference export.go:400-412 tolerates
+        ErrServiceDisabled and omits it)."""
+        try:
+            cfg = self.scheduler.get_config()
+        except SchedulerServiceDisabled:
+            cfg = None
+        return export_snapshot(self.store, cfg)
 
     def import_(self, snapshot: dict, ignore_err: bool = False) -> list[str]:
         """Restart the scheduler with the imported config (unless absent),
         then apply resources in dependency order (reference
-        export.go:246-263 Import)."""
+        export.go:246-263 Import). In external mode the config restart is
+        skipped, resources still apply (export.go:251-257)."""
         cfg = snapshot.get("schedulerConfig")
         if cfg:
-            self.scheduler.restart(cfg)
+            try:
+                self.scheduler.restart(cfg)
+            except SchedulerServiceDisabled:
+                pass
         _, errors = import_snapshot(self.store, snapshot, ignore_err=ignore_err)
         return errors
 
     def reset(self) -> None:
+        """Reset resources, and the scheduler config unless disabled
+        (reference reset.go:80 tolerates ErrServiceDisabled)."""
+        # note: no _ext_seen maintenance needed here — store.reset()
+        # dispatches DELETED + re-ADDED events through the subscriber,
+        # which rebuilds the map (clearing afterwards would wipe the
+        # pending-state of boot-snapshot pods and undercount their
+        # later external binds)
         self.store.reset()
-        self.scheduler.reset()
+        try:
+            self.scheduler.reset()
+        except SchedulerServiceDisabled:
+            pass

@@ -1,21 +1,119 @@
-"""Minimal built-in dashboard (L7, reference: web/ — a Nuxt SPA).
+"""Built-in dashboard (L7, reference: web/ — a Nuxt SPA).
 
-The reference ships a full Vue frontend talking to the simulator API and
-the embedded kube-apiserver. Here the same core workflows — watch the
-cluster live, inspect per-pod scheduling results (the per-plugin
-filter/score tables from the result annotations), trigger scheduling,
-edit the scheduler configuration, export/import/reset — are served as a
-single static page straight from the simulator (no build step, no
+The reference ships a full Vue frontend; the same workflows are served
+as a single static page straight from the simulator (no build step, no
 dependencies), consuming only the public API:
 
+  * watch the cluster live (`/api/v1/listwatchresources` ND-JSON),
+  * browse ALL seven resource kinds in tabs (reference
+    web/components/ResourceViews/ResourcesViewPanel.vue),
+  * author resources: create from the reference's creation templates
+    (web/components/lib/templates/*.yaml, embedded below), edit any
+    object as YAML, delete — the Monaco-editor workflow collapsed to a
+    textarea + the server's YAML body support,
+  * pods bucketed per node in the node detail (web/store/pod.ts:12-57),
+  * inspect per-pod scheduling results (the per-plugin filter/score
+    tables from the result annotations),
+  * trigger scheduling, edit the scheduler configuration,
+    export / import / reset.
+
+Routes consumed:
+
     GET  /                    this page
-    GET  /api/v1/resources/*  tables
-    GET  /api/v1/listwatchresources   live updates (ND-JSON stream)
+    GET  /api/v1/resources/<kind>[/<ns>/<name>[?format=yaml]]
+    POST /api/v1/resources/<kind>          (JSON or YAML body)
+    DELETE /api/v1/resources/<kind>/...
+    GET  /api/v1/listwatchresources        live updates (ND-JSON stream)
     POST /api/v1/schedule[?mode=gang], PUT /api/v1/reset,
-    GET/POST /api/v1/schedulerconfiguration, GET /api/v1/export
+    GET/POST /api/v1/schedulerconfiguration, GET /api/v1/export,
+    POST /api/v1/import
 """
 
 from __future__ import annotations
+
+# Creation templates — the reference's web/components/lib/templates/*.yaml
+# verbatim in spirit (generateName + a schedulable default shape); the
+# store implements the apiserver's generateName suffixing.
+TEMPLATES = {
+    "nodes": """\
+metadata:
+  generateName: node-
+  labels: {}
+spec: {}
+status:
+  capacity:
+    cpu: "4"
+    memory: 32Gi
+    pods: "110"
+  allocatable:
+    cpu: "4"
+    memory: 32Gi
+    pods: "110"
+""",
+    "pods": """\
+metadata:
+  generateName: pod-
+  namespace: default
+  labels: {}
+spec:
+  containers:
+    - name: pause
+      image: registry.k8s.io/pause:3.5
+      resources:
+        requests:
+          cpu: 100m
+          memory: 128Mi
+  restartPolicy: Always
+""",
+    "pvs": """\
+metadata:
+  generateName: pv-
+  labels: {}
+spec:
+  capacity:
+    storage: 1Gi
+  volumeMode: Filesystem
+  accessModes:
+    - ReadWriteOnce
+  persistentVolumeReclaimPolicy: Delete
+  hostPath:
+    path: /tmp/data
+    type: DirectoryOrCreate
+""",
+    "pvcs": """\
+metadata:
+  generateName: pvc-
+  namespace: default
+spec:
+  accessModes:
+    - ReadWriteOnce
+  volumeMode: Filesystem
+  resources:
+    requests:
+      storage: 1Gi
+""",
+    "storageclasses": """\
+metadata:
+  generateName: local-storageclass-
+provisioner: kubernetes.io/no-provisioner
+""",
+    "priorityclasses": """\
+metadata:
+  generateName: priority-class-
+value: 1000
+globalDefault: false
+description: "This is a template priority class for all pods"
+""",
+    "namespaces": """\
+metadata:
+  generateName: namespace-
+  labels: {}
+""",
+}
+
+import json as _json
+
+_TEMPLATES_JS = _json.dumps(TEMPLATES)
 
 PAGE = """<!doctype html>
 <html><head><meta charset="utf-8"><title>kube-scheduler-simulator-tpu</title>
@@ -25,13 +123,23 @@ PAGE = """<!doctype html>
  table{border-collapse:collapse;width:100%;background:#fff;font-size:.85rem}
  th,td{border:1px solid #ddd;padding:.25rem .5rem;text-align:left}
  th{background:#f0f0f0} tr:hover td{background:#f6f9ff;cursor:pointer}
- #bar button{margin-right:.4rem} #status{color:#666;font-size:.8rem}
+ #bar button,#tabs button,#editorpane button{margin-right:.4rem}
+ #status{color:#666;font-size:.8rem}
+ #tabs{margin:.8rem 0 .4rem}
+ #tabs button{background:#eee;border:1px solid #ccc;padding:.25rem .6rem;
+   border-radius:.3rem;cursor:pointer}
+ #tabs button.active{background:#dce8ff;border-color:#88a}
  #detail{white-space:pre-wrap;background:#fff;border:1px solid #ddd;
          padding:.6rem;font-family:monospace;font-size:.75rem;max-height:40vh;
          overflow:auto}
+ #editorpane{display:none;border:1px solid #bbb;background:#fff;
+   padding:.6rem;margin:.6rem 0}
+ #editor{width:100%;height:16rem;font-family:monospace;font-size:.78rem}
+ #editerr{color:#b00;font-size:.8rem;white-space:pre-wrap}
  #cfg{width:100%;height:10rem;font-family:monospace;font-size:.75rem}
  .pill{display:inline-block;padding:0 .4rem;border-radius:.6rem;font-size:.75rem}
  .ok{background:#d9f2dd}.bad{background:#f8d7da}.pend{background:#fff3cd}
+ .del{color:#b00;cursor:pointer}
 </style></head><body>
 <h1>kube-scheduler-simulator-tpu</h1>
 <div id="bar">
@@ -39,59 +147,127 @@ PAGE = """<!doctype html>
  <button onclick="act('POST','/api/v1/schedule?mode=gang')">Schedule (gang)</button>
  <button onclick="act('PUT','/api/v1/reset')">Reset</button>
  <button onclick="exportSnap()">Export</button>
+ <button onclick="document.getElementById('importfile').click()">Import</button>
+ <input type="file" id="importfile" style="display:none"
+        onchange="importSnap(this.files[0])">
  <span id="status">connecting…</span>
 </div>
-<h2>Nodes (<span id="nnodes">0</span>)</h2>
-<table id="nodes"><thead><tr><th>name</th><th>cpu</th><th>memory</th>
-<th>pods bound</th></tr></thead><tbody></tbody></table>
-<h2>Pods (<span id="npods">0</span>)</h2>
-<table id="pods"><thead><tr><th>namespace</th><th>name</th><th>node</th>
-<th>result</th></tr></thead><tbody></tbody></table>
-<h2>Pod scheduling detail</h2>
-<div id="detail">click a pod row to inspect its per-plugin results</div>
+<div id="tabs"></div>
+<div>
+ <button id="newbtn" onclick="newResource()">New</button>
+ <span id="count"></span>
+</div>
+<table id="grid"><thead></thead><tbody></tbody></table>
+<div id="editorpane">
+ <b id="edtitle"></b><br>
+ <textarea id="editor" spellcheck="false"></textarea><br>
+ <button onclick="saveResource()">Save</button>
+ <button id="delbtn" onclick="deleteResource()">Delete</button>
+ <button onclick="closeEditor()">Cancel</button>
+ <div id="editerr"></div>
+</div>
+<h2>Detail</h2>
+<div id="detail">click a pod row to inspect its per-plugin results; click a
+node row for its pods</div>
 <h2>Scheduler configuration</h2>
 <textarea id="cfg"></textarea><br>
 <button onclick="applyCfg()">Apply configuration</button>
 <script>
-const state = {nodes:new Map(), pods:new Map()};
+const TEMPLATES = __TEMPLATES__;
+// kind key -> watch wire name + table spec (reference
+// ResourceViews/ResourcesViewPanel.vue covers the same seven kinds)
+const KINDS = {
+  nodes:{wire:'nodes',title:'Nodes',ns:false,
+    cols:['name','cpu','memory','pods bound'],
+    row:n=>{const al=(n.status||{}).allocatable||{};
+      return [n.metadata.name,al.cpu||'',al.memory||'',
+              podsByNode().get(n.metadata.name)?.length||0];}},
+  pods:{wire:'pods',title:'Pods',ns:true,
+    cols:['namespace','name','node','result'],
+    row:p=>{const node=(p.spec||{}).nodeName||'';
+      const ann=(p.metadata||{}).annotations||{};
+      const has=Object.keys(ann).some(k=>k.startsWith('scheduler-simulator/'));
+      const pill=node?'<span class="pill ok">scheduled</span>'
+        :(has?'<span class="pill bad">unschedulable</span>'
+              :'<span class="pill pend">pending</span>');
+      return [p.metadata.namespace||'default',p.metadata.name,node,
+              {html:pill}];}},
+  pvs:{wire:'persistentvolumes',title:'PVs',ns:false,
+    cols:['name','capacity','phase','claim'],
+    row:v=>{const sp=v.spec||{};const cr=sp.claimRef||{};
+      return [v.metadata.name,(sp.capacity||{}).storage||'',
+              (v.status||{}).phase||'',
+              cr.name?((cr.namespace||'default')+'/'+cr.name):''];}},
+  pvcs:{wire:'persistentvolumeclaims',title:'PVCs',ns:true,
+    cols:['namespace','name','volume','phase'],
+    row:c=>[c.metadata.namespace||'default',c.metadata.name,
+            (c.spec||{}).volumeName||'',(c.status||{}).phase||'']},
+  storageclasses:{wire:'storageclasses',title:'StorageClasses',ns:false,
+    cols:['name','provisioner','bindingMode'],
+    row:s=>[s.metadata.name,s.provisioner||'',s.volumeBindingMode||'']},
+  priorityclasses:{wire:'priorityclasses',title:'PriorityClasses',ns:false,
+    cols:['name','value','globalDefault'],
+    row:p=>[p.metadata.name,String(p.value??''),String(p.globalDefault??'')]},
+  namespaces:{wire:'namespaces',title:'Namespaces',ns:false,
+    cols:['name'],row:n=>[n.metadata.name]},
+};
+const state = {}; for (const k in KINDS) state[k]=new Map();
+const wireToKind = {}; for (const k in KINDS) wireToKind[KINDS[k].wire]=k;
+let activeKind='nodes';
+let editing=null;   // {kind, ns, name} | {kind} for new
 const key = o => (o.metadata.namespace||'default')+'/'+o.metadata.name;
 const esc = s => String(s??'').replace(/[&<>"']/g,
   c => ({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));
 const MAX_ROWS = 500;  // full rebuild per tick: cap rendered rows so a
                        // 50k-pod import stays responsive (counts stay exact)
-function render(){
-  const nb = document.querySelector('#nodes tbody'); nb.innerHTML='';
-  const counts = {};
+let bucketCache=null;
+function podsByNode(){
+  if(bucketCache) return bucketCache;
+  const m=new Map();  // reference web/store/pod.ts:12-57 bucketing
   for (const p of state.pods.values()){
-    const n = (p.spec||{}).nodeName; if(n) counts[n]=(counts[n]||0)+1;
+    const n=(p.spec||{}).nodeName; if(!n) continue;
+    if(!m.has(n)) m.set(n,[]); m.get(n).push(p);
   }
-  const nodesSorted=[...state.nodes.values()].sort((a,b)=>key(a)<key(b)?-1:1);
-  for (const n of nodesSorted.slice(0,MAX_ROWS)){
-    const al=(n.status||{}).allocatable||{};
-    nb.insertAdjacentHTML('beforeend',`<tr><td>${esc(n.metadata.name)}</td>
-      <td>${esc(al.cpu||'')}</td><td>${esc(al.memory||'')}</td>
-      <td>${counts[n.metadata.name]||0}</td></tr>`);
-  }
-  document.getElementById('nnodes').textContent=state.nodes.size;
-  const pb = document.querySelector('#pods tbody'); pb.innerHTML='';
-  const podsSorted=[...state.pods.values()].sort((a,b)=>key(a)<key(b)?-1:1);
-  for (const p of podsSorted.slice(0,MAX_ROWS)){
-    const node=(p.spec||{}).nodeName||'';
-    const ann=(p.metadata||{}).annotations||{};
-    const has=Object.keys(ann).some(k=>k.startsWith('scheduler-simulator/'));
-    const pill=node?'<span class="pill ok">scheduled</span>'
-      :(has?'<span class="pill bad">unschedulable</span>'
-            :'<span class="pill pend">pending</span>');
-    const row=document.createElement('tr');
-    row.innerHTML=`<td>${esc(p.metadata.namespace||'default')}</td>
-      <td>${esc(p.metadata.name)}</td><td>${esc(node)}</td><td>${pill}</td>`;
-    row.onclick=()=>showDetail(p);
-    pb.appendChild(row);
-  }
-  const over=state.pods.size>MAX_ROWS?` (showing first ${MAX_ROWS})`:'';
-  document.getElementById('npods').textContent=state.pods.size+over;
+  bucketCache=m; return m;
 }
-function showDetail(p){
+function renderTabs(){
+  const t=document.getElementById('tabs'); t.innerHTML='';
+  for (const k in KINDS){
+    const b=document.createElement('button');
+    b.textContent=`${KINDS[k].title} (${state[k].size})`;
+    if(k===activeKind) b.className='active';
+    b.onclick=()=>{activeKind=k; render();};
+    t.appendChild(b);
+  }
+}
+function render(){
+  bucketCache=null;
+  renderTabs();
+  const spec=KINDS[activeKind];
+  document.querySelector('#grid thead').innerHTML=
+    '<tr>'+spec.cols.map(c=>`<th>${esc(c)}</th>`).join('')+'<th></th></tr>';
+  const tb=document.querySelector('#grid tbody'); tb.innerHTML='';
+  const objs=[...state[activeKind].values()].sort((a,b)=>key(a)<key(b)?-1:1);
+  for (const o of objs.slice(0,MAX_ROWS)){
+    const tr=document.createElement('tr');
+    tr.innerHTML=spec.row(o).map(c=>
+      c&&c.html!==undefined?`<td>${c.html}</td>`:`<td>${esc(c)}</td>`
+    ).join('')+'<td><span class="del">delete</span></td>';
+    tr.onclick=(ev)=>{
+      if(ev.target.classList.contains('del')){deleteRow(activeKind,o);return;}
+      if(activeKind==='pods') showPodDetail(o);
+      else if(activeKind==='nodes') showNodeDetail(o);
+      editResource(activeKind,o);
+    };
+    tb.appendChild(tr);
+  }
+  const over=state[activeKind].size>MAX_ROWS?` (showing first ${MAX_ROWS})`:'';
+  document.getElementById('count').textContent=
+    `${state[activeKind].size} ${spec.title}${over}`;
+  document.getElementById('newbtn').textContent=
+    `New ${spec.title.replace(/s$/,'')}`;
+}
+function showPodDetail(p){
   const ann=(p.metadata||{}).annotations||{};
   const out={};
   for (const [k,v] of Object.entries(ann)){
@@ -100,6 +276,72 @@ function showDetail(p){
   }
   document.getElementById('detail').textContent=
     key(p)+'\\n'+JSON.stringify(out,null,2);
+}
+function showNodeDetail(n){
+  const pods=podsByNode().get(n.metadata.name)||[];
+  document.getElementById('detail').textContent=
+    `node ${n.metadata.name}: ${pods.length} pod(s)\\n`+
+    pods.map(p=>'  '+key(p)).join('\\n');
+}
+function resourcePath(kind,o){
+  const ns=(o.metadata.namespace||'default');
+  return KINDS[kind].ns
+    ?`/api/v1/resources/${kind}/${ns}/${o.metadata.name}`
+    :`/api/v1/resources/${kind}/${o.metadata.name}`;
+}
+function newResource(){
+  editing={kind:activeKind};
+  document.getElementById('edtitle').textContent=
+    `New ${KINDS[activeKind].title.replace(/s$/,'')} (YAML)`;
+  document.getElementById('editor').value=TEMPLATES[activeKind]||'metadata:\\n  name: \\n';
+  document.getElementById('delbtn').style.display='none';
+  document.getElementById('editerr').textContent='';
+  document.getElementById('editorpane').style.display='block';
+}
+async function editResource(kind,o){
+  editing={kind, ns:o.metadata.namespace||'default', name:o.metadata.name};
+  document.getElementById('edtitle').textContent=
+    `${kind}/${o.metadata.name} (YAML)`;
+  document.getElementById('editerr').textContent='';
+  try{
+    const r=await fetch(resourcePath(kind,o)+'?format=yaml');
+    document.getElementById('editor').value=await r.text();
+  }catch(e){
+    document.getElementById('editor').value='';
+    document.getElementById('editerr').textContent='load failed: '+e;
+  }
+  document.getElementById('delbtn').style.display='';
+  document.getElementById('editorpane').style.display='block';
+}
+async function saveResource(){
+  if(!editing) return;
+  const body=document.getElementById('editor').value;
+  // edits of an existing object REPLACE it (item-path PUT: fields
+  // removed in the editor are removed from the object); creation goes
+  // through the collection's apply
+  const r=editing.name
+    ?await fetch(resourcePath(editing.kind,
+        {metadata:{namespace:editing.ns,name:editing.name}}),
+        {method:'PUT',headers:{'Content-Type':'application/yaml'},body})
+    :await fetch(`/api/v1/resources/${editing.kind}`,
+        {method:'POST',headers:{'Content-Type':'application/yaml'},body});
+  if(r.ok){closeEditor(); setStatus('saved');}
+  else{document.getElementById('editerr').textContent=
+    `save → ${r.status} `+await r.text();}
+}
+async function deleteResource(){
+  if(!editing||!editing.name) return;
+  await deleteRow(editing.kind,
+    {metadata:{namespace:editing.ns,name:editing.name}});
+  closeEditor();
+}
+async function deleteRow(kind,o){
+  const r=await fetch(resourcePath(kind,o),{method:'DELETE'});
+  setStatus(`delete ${kind}/${o.metadata.name} → ${r.status}`);
+}
+function closeEditor(){
+  editing=null;
+  document.getElementById('editorpane').style.display='none';
 }
 async function act(method,path){
   try{
@@ -113,6 +355,11 @@ async function exportSnap(){
     const a=document.createElement('a');
     a.href=URL.createObjectURL(blob); a.download='snapshot.json'; a.click();
   }catch(e){setStatus('export failed: '+e);}
+}
+async function importSnap(file){
+  if(!file) return;
+  const r=await fetch('/api/v1/import',{method:'POST',body:await file.text()});
+  setStatus('import → '+r.status+(r.ok?'':' '+await r.text()));
 }
 async function loadCfg(){
   try{
@@ -133,7 +380,7 @@ async function watch(){
       const r=await fetch('/api/v1/listwatchresources');
       const reader=r.body.getReader(); const dec=new TextDecoder();
       let buf=''; setStatus('live');
-      state.nodes.clear(); state.pods.clear();
+      for (const k in KINDS) state[k].clear();
       render();  // an empty cluster sends no replay events
       let pending=null;
       for(;;){
@@ -144,9 +391,8 @@ async function watch(){
           const line=buf.slice(0,i).trim(); buf=buf.slice(i+1);
           if(!line) continue;
           const ev=JSON.parse(line);
-          const m=ev.Kind==='nodes'?state.nodes:
-                  ev.Kind==='pods'?state.pods:null;
-          if(!m) continue;
+          const kind=wireToKind[ev.Kind]; if(!kind) continue;
+          const m=state[kind];
           if(ev.EventType==='DELETED') m.delete(key(ev.Obj));
           else m.set(key(ev.Obj),ev.Obj);
         }
@@ -158,4 +404,4 @@ async function watch(){
 }
 loadCfg(); watch();
 </script></body></html>
-"""
+""".replace("__TEMPLATES__", _TEMPLATES_JS)

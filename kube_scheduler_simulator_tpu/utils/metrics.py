@@ -109,7 +109,10 @@ class SchedulingMetrics:
             self._total_wall_s = 0.0
 
 
-# process-wide default registry (the serving layer's instance)
+# process-wide shared registry for ad-hoc callers (benchmarks, scripts).
+# Serving-layer services each own a SchedulingMetrics instance instead
+# (server/service.py) so per-server numbers stay attributable when
+# several services share a process.
 GLOBAL = SchedulingMetrics()
 
 

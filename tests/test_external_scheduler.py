@@ -1,0 +1,169 @@
+"""External-scheduler mode (EXTERNAL_SCHEDULER_ENABLED): the simulator
+serves store/CRUD/watch/export with the internal engine disabled, and an
+external scheduler binds pods through the CRUD surface (reference
+config.go:34-35 + :115-121, simulator.go:75-80, scheduler.go:55-61)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kube_scheduler_simulator_tpu.server import config as envconfig
+from kube_scheduler_simulator_tpu.server.httpserver import SimulatorServer
+from kube_scheduler_simulator_tpu.server.service import (
+    SchedulerServiceDisabled,
+    SimulatorService,
+)
+
+from helpers import node, pod
+
+
+def _req(url, data=None, method="GET"):
+    req = urllib.request.Request(
+        url,
+        data=None if data is None else json.dumps(data).encode(),
+        method=method,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req) as resp:
+        body = resp.read()
+        return resp.status, json.loads(body) if body else None
+
+
+def _status_of(err_call):
+    try:
+        err_call()
+    except urllib.error.HTTPError as e:
+        return e.code
+    return None
+
+
+class TestEnvFlag:
+    def test_parse_bool_semantics(self):
+        for raw, want in [("true", True), ("1", True), ("T", True),
+                          ("false", False), ("0", False), ("F", False)]:
+            cfg = envconfig.from_env({"EXTERNAL_SCHEDULER_ENABLED": raw})
+            assert cfg.external_scheduler_enabled is want
+        assert not envconfig.from_env({}).external_scheduler_enabled
+        with pytest.raises(ValueError):
+            envconfig.from_env({"EXTERNAL_SCHEDULER_ENABLED": "yes-please"})
+
+
+class TestDisabledService:
+    def test_scheduler_calls_refused(self):
+        svc = SimulatorService(external_scheduler_enabled=True)
+        with pytest.raises(SchedulerServiceDisabled):
+            svc.scheduler.get_config()
+        with pytest.raises(SchedulerServiceDisabled):
+            svc.scheduler.restart({"profiles": []})
+        with pytest.raises(SchedulerServiceDisabled):
+            svc.scheduler.schedule()
+        with pytest.raises(SchedulerServiceDisabled):
+            svc.scheduler.schedule_gang()
+
+    def test_export_omits_config_and_import_skips_restart(self):
+        svc = SimulatorService(external_scheduler_enabled=True)
+        snap = svc.export()
+        assert snap["schedulerConfig"] is None
+        # importing a snapshot that carries a config must not blow up —
+        # the restart is skipped, resources still land (export.go:251-257)
+        snap2 = {
+            "pods": [],
+            "nodes": [node("n-ext")],
+            "schedulerConfig": {"profiles": []},
+        }
+        errs = svc.import_(snap2, ignore_err=True)
+        assert errs == []
+        assert svc.store.get("nodes", "n-ext") is not None
+
+    def test_reset_tolerated(self):
+        svc = SimulatorService(external_scheduler_enabled=True)
+        svc.reset()  # must not raise
+
+    def test_imported_bound_pods_not_counted_as_external_passes(self):
+        """Replicating a cluster whose pods are already bound must not
+        masquerade as external scheduler activity — only the
+        pending→bound transition counts."""
+        svc = SimulatorService(external_scheduler_enabled=True)
+        svc.import_(
+            {
+                "nodes": [node("n0")],
+                "pods": [pod("prebound", node_name="n0"), pod("waiting")],
+            },
+            ignore_err=True,
+        )
+        assert svc.scheduler.metrics.snapshot()["passes"] == 0
+        # a real external bind of the pending pod DOES count
+        bound = svc.store.get("pods", "waiting")
+        bound["spec"]["nodeName"] = "n0"
+        svc.store.apply("pods", bound)
+        assert svc.scheduler.metrics.snapshot()["passes"] == 1
+
+
+class TestExternalSchedulerOverHTTP:
+    """Drive a fake external scheduler against the serving surface."""
+
+    def setup_method(self):
+        self.server = SimulatorServer(
+            SimulatorService(external_scheduler_enabled=True), port=0
+        ).start()
+        self.base = f"http://127.0.0.1:{self.server.port}/api/v1"
+
+    def teardown_method(self):
+        self.server.shutdown()
+
+    def test_full_external_flow(self):
+        base = self.base
+        # config and scheduling surfaces answer 400 (schedulerconfig.go:32)
+        assert _status_of(lambda: _req(f"{base}/schedulerconfiguration")) == 400
+        assert (
+            _status_of(
+                lambda: _req(f"{base}/schedule", data={}, method="POST")
+            )
+            == 400
+        )
+        assert (
+            _status_of(
+                lambda: _req(
+                    f"{base}/schedulerconfiguration",
+                    data={"profiles": []},
+                    method="POST",
+                )
+            )
+            == 400
+        )
+        # the cluster surface still works: seed a node + a pending pod
+        _req(f"{base}/resources/nodes", data=node("n0"), method="POST")
+        _req(f"{base}/resources/pods", data=pod("p0"), method="POST")
+        st, listing = _req(f"{base}/resources/pods")
+        pending = [
+            o
+            for o in listing["items"]
+            if not (o.get("spec", {}) or {}).get("nodeName")
+        ]
+        assert [o["metadata"]["name"] for o in pending] == ["p0"]
+        # the external scheduler binds through CRUD: set spec.nodeName
+        bound = pending[0]
+        bound["spec"]["nodeName"] = "n0"
+        st, _ = _req(f"{base}/resources/pods", data=bound, method="PUT")
+        assert st == 201
+        st, got = _req(f"{base}/resources/pods/default/p0")
+        assert got["spec"]["nodeName"] == "n0"
+        # ... and the bind was recorded as an external pass
+        st, snap = _req(f"{base}/metrics")
+        assert snap["passes"] == 1
+        assert snap["recent"][0]["mode"] == "external"
+        assert snap["totalScheduled"] == 1
+        # re-applying the bound pod must not double-count
+        _req(f"{base}/resources/pods", data=bound, method="PUT")
+        st, snap = _req(f"{base}/metrics")
+        assert snap["passes"] == 1
+        # export serves resources without a schedulerConfig
+        st, exported = _req(f"{base}/export")
+        assert exported["schedulerConfig"] is None
+        assert len(exported["nodes"]) == 1
+        # reset still answers 202 (reset.go:80 tolerates disabled)
+        req = urllib.request.Request(f"{base}/reset", data=b"", method="PUT")
+        with urllib.request.urlopen(req) as resp:
+            assert resp.status == 202

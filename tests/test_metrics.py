@@ -37,6 +37,40 @@ def test_time_pass_context():
     assert snap["recent"][0]["wallSeconds"] > 0
 
 
+def test_profile_trace_writes_artifact(tmp_path):
+    """profile_trace captures a TensorBoard/XProf trace directory —
+    the SURVEY §5 tracing artifact (bench.py --profile wraps the warm
+    pass in this)."""
+    import jax
+    import jax.numpy as jnp
+
+    from kube_scheduler_simulator_tpu.utils.metrics import profile_trace
+
+    d = str(tmp_path / "trace")
+    with profile_trace(d):
+        jax.jit(lambda x: x * 2)(jnp.ones((8,))).block_until_ready()
+    import os
+
+    found = []
+    for root, _, files in os.walk(d):
+        found += files
+    assert found, "profiler trace directory is empty"
+
+
+def test_per_service_metrics_attributable():
+    """Two services in one process must not interleave their pass
+    counters (ADVICE r3): each SchedulerService owns its registry."""
+    from kube_scheduler_simulator_tpu.server.service import SimulatorService
+
+    a, b = SimulatorService(), SimulatorService()
+    assert a.scheduler.metrics is not b.scheduler.metrics
+    for obj, kind in [(node("n0"), "nodes"), (pod("p0"), "pods")]:
+        a.store.apply(kind, obj)
+    a.scheduler.schedule()
+    assert a.scheduler.metrics.snapshot()["passes"] == 1
+    assert b.scheduler.metrics.snapshot()["passes"] == 0
+
+
 def test_schedule_pass_records_and_route_serves(tmp_path):
     from kube_scheduler_simulator_tpu.server.httpserver import SimulatorServer
     from kube_scheduler_simulator_tpu.server.service import SimulatorService

@@ -78,3 +78,142 @@ class TestReplicate:
             replicate_existing_cluster(
                 SimulatorService(), snapshot={}, snapshot_path="x"
             )
+        with pytest.raises(ValueError):
+            replicate_existing_cluster(
+                SimulatorService(), snapshot={}, kube_apiserver="http://x"
+            )
+
+
+class _FakeApiserver:
+    """Canned kube-apiserver: serves the typed List endpoints with the
+    real wire shapes (PodList/NodeList/...; kind/apiVersion on the List,
+    not on items), optionally requiring a bearer token."""
+
+    def __init__(self, token=""):
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        fixtures = {
+            "/api/v1/pods": (
+                "PodList",
+                [
+                    pod("bound", node_name="real-n0"),
+                    pod("pending"),
+                    {  # system pod in kube-system stays importable
+                        "metadata": {"name": "kube-proxy-x", "namespace": "kube-system"},
+                        "spec": {"containers": [{"name": "c"}], "nodeName": "real-n0"},
+                    },
+                ],
+            ),
+            "/api/v1/nodes": ("NodeList", [node("real-n0"), node("real-n1")]),
+            "/api/v1/persistentvolumes": ("PersistentVolumeList", []),
+            "/api/v1/persistentvolumeclaims": ("PersistentVolumeClaimList", []),
+            "/apis/storage.k8s.io/v1/storageclasses": ("StorageClassList", []),
+            "/apis/scheduling.k8s.io/v1/priorityclasses": (
+                "PriorityClassList",
+                [
+                    {
+                        "metadata": {"name": "workload-high"},
+                        "value": 10000,
+                    }
+                ],
+            ),
+            "/api/v1/namespaces": (
+                "NamespaceList",
+                [{"metadata": {"name": "prod"}}],
+            ),
+        }
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):  # noqa: N802
+                if token and self.headers.get("Authorization") != f"Bearer {token}":
+                    self.send_response(401)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                fx = fixtures.get(self.path)
+                if fx is None:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                kind, items = fx
+                body = json.dumps(
+                    {"kind": kind, "apiVersion": "v1", "items": items}
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self.thread.start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def shutdown(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class TestReplicateFromRealCluster:
+    """kube-apiserver REST listing → snapshot shape → IgnoreErr import
+    (reference replicateexistingcluster.go:40-53 without client-go)."""
+
+    def test_list_cluster_shape(self):
+        from kube_scheduler_simulator_tpu.server.replicate import list_cluster
+
+        api = _FakeApiserver()
+        try:
+            snap = list_cluster(api.url)
+        finally:
+            api.shutdown()
+        assert {
+            "pods", "nodes", "pvs", "pvcs",
+            "storageClasses", "priorityClasses", "namespaces",
+        } <= set(snap)
+        assert len(snap["pods"]) == 3
+        assert len(snap["nodes"]) == 2
+        assert snap["priorityClasses"][0]["value"] == 10000
+
+    def test_replicate_imports_cluster(self):
+        api = _FakeApiserver()
+        dst = SimulatorService(custom_config())
+        try:
+            errors = replicate_existing_cluster(dst, kube_apiserver=api.url)
+        finally:
+            api.shutdown()
+        assert errors == []
+        assert {n["metadata"]["name"] for n in dst.store.list("nodes")} == {
+            "real-n0",
+            "real-n1",
+        }
+        got = dst.store.get("pods", "bound")
+        assert got["spec"]["nodeName"] == "real-n0"
+        assert dst.store.get("pods", "pending")["spec"].get("nodeName") is None
+        assert dst.store.get("namespaces", "prod") is not None
+        # config untouched (IgnoreSchedulerConfiguration — the apiserver
+        # has none to offer anyway)
+        enabled = dst.scheduler.get_config()["profiles"][0]["plugins"][
+            "score"
+        ]["enabled"]
+        assert enabled == [{"name": "ImageLocality", "weight": 7}]
+
+    def test_bearer_token_required_and_sent(self):
+        from kube_scheduler_simulator_tpu.server.replicate import list_cluster
+
+        api = _FakeApiserver(token="sekret")
+        try:
+            with pytest.raises(RuntimeError, match="HTTP 401"):
+                list_cluster(api.url)
+            snap = list_cluster(api.url, bearer_token="sekret")
+        finally:
+            api.shutdown()
+        assert len(snap["nodes"]) == 2

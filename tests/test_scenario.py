@@ -158,6 +158,53 @@ def test_gang_scheduler_mode_timeline():
     assert again.as_dict() == result.as_dict()
 
 
+def test_gang_scheduler_mode_records_preemption_deletes():
+    """Gang mode's preempt phase evicts pre-bound victims; the Timeline
+    must carry the same Delete(reason=preempted) events the sequential
+    branch records, so the Timeline reconciles with the final store."""
+    from kube_scheduler_simulator_tpu.scenario.runner import (
+        Operation,
+        ScenarioRunner,
+    )
+
+    ops = [
+        Operation(
+            major_step=1,
+            create={"kind": "nodes", "object": node("only", cpu="1")},
+        ),
+        Operation(
+            major_step=1,
+            create={
+                "kind": "pods",
+                "object": pod("squatter", cpu="800m", priority=1),
+            },
+        ),
+        Operation(
+            major_step=2,
+            create={
+                "kind": "pods",
+                "object": pod("urgent", cpu="800m", priority=100),
+            },
+        ),
+        Operation(major_step=2, done=True),
+    ]
+    runner = ScenarioRunner(ops, scheduler_mode="gang")
+    result = runner.run()
+    assert result.phase == "Succeeded", result.message
+    t2 = result.timeline["2"]
+    deletes = [e for e in t2 if e.type == "Delete"]
+    assert any(
+        e.payload.get("name") == "squatter"
+        and e.payload.get("reason") == "preempted"
+        for e in deletes
+    )
+    scheduled = [e for e in t2 if e.type == "PodScheduled"]
+    assert any(e.payload["name"] == "urgent" for e in scheduled)
+    # the store agrees with the Timeline
+    assert runner.store.get("pods", "squatter") is None
+    assert runner.store.get("pods", "urgent")["spec"]["nodeName"] == "only"
+
+
 def test_summarize_result_calculation():
     from kube_scheduler_simulator_tpu.scenario import summarize
     from kube_scheduler_simulator_tpu.scenario.runner import (

@@ -28,6 +28,32 @@ def test_gang_pass_writes_node_names():
         assert obj["spec"]["nodeName"] == placements[("default", f"p{i}")]
 
 
+def test_gang_pass_deletes_preemption_victims():
+    """The gang preempt phase evicts pre-bound victims; the write-back
+    must delete them from the store exactly like the sequential path
+    (upstream preemption deletes victims through the API) — otherwise
+    the next pass encodes a double-booked node."""
+    svc = SimulatorService()
+    for i in range(2):
+        svc.store.apply("nodes", node(f"n{i}", cpu="2", pods="8"))
+        svc.store.apply(
+            "pods",
+            pod(f"low-{i}", cpu="1800m", priority=1, node_name=f"n{i}"),
+        )
+    for i in range(2):
+        svc.store.apply("pods", pod(f"high-{i}", cpu="1500m", priority=100))
+    placements, _ = svc.scheduler.schedule_gang()
+    assert placements[("default", "high-0")] != ""
+    assert placements[("default", "high-1")] != ""
+    # the victims are gone from the store
+    for i in range(2):
+        assert svc.store.get("pods", f"low-{i}", "default") is None
+    # and a follow-up pass over the SAME store doesn't see phantom load:
+    # both nodes hold exactly one (high) pod
+    for i in range(2):
+        assert len(svc.store.pods_on_node(f"n{i}")) == 1
+
+
 def test_gang_engine_cache_reused_across_passes():
     svc = SimulatorService()
     _fill(svc)
