@@ -1,0 +1,28 @@
+# Containerized simulator (reference: simulator/Dockerfile — a two-stage
+# Go build; here the server is pure Python + JAX, so one stage suffices).
+# The reference's three-service docker-compose (server + frontend + etcd,
+# root docker-compose.yml) collapses to this single service: the typed
+# in-process store replaces etcd + the embedded kube-apiserver, and the
+# dashboard (server/webui.py) is served by the same process at /.
+#
+# Build:  docker build -t kube-scheduler-simulator-tpu .
+# Run:    docker run -p 1212:1212 kube-scheduler-simulator-tpu
+#
+# For TPU hosts, swap the base image for one with libtpu and run with the
+# TPU runtime mounted; the CPU jax wheel here keeps the container
+# self-contained for development (the serving semantics are identical —
+# the chip only changes pass latency).
+FROM python:3.11-slim
+
+WORKDIR /app
+
+COPY pyproject.toml ./
+COPY kube_scheduler_simulator_tpu ./kube_scheduler_simulator_tpu
+
+RUN pip install --no-cache-dir "jax[cpu]" pyyaml && \
+    pip install --no-cache-dir --no-deps .
+
+ENV PORT=1212
+EXPOSE 1212
+
+CMD ["python", "-m", "kube_scheduler_simulator_tpu", "--host", "0.0.0.0"]

@@ -97,18 +97,21 @@ def _device_watchdog(timeout_s: "float | None" = None) -> str:
     sys.stderr.write(f"bench: {why}; re-exec on CPU backend\n")
     env = scrubbed_cpu_env()
     env["_KSS_BENCH_CPU_FALLBACK"] = "1"
-    os.execve(sys.executable, [sys.executable, __file__], env)
+    os.execve(sys.executable, [sys.executable, __file__, *sys.argv[1:]], env)
 
 
-def _gang_probe(mode: str):
-    """Subprocess mode (`bench.py --gang-probe=<dynamic|static>`):
-    measure the gang scheduler at the bench shape and print one JSON
-    line. Run isolated because gang's dynamic `lax.while_loop` program
-    has never been observed to finish compiling on the experimental
-    axon backend — the parent bench must survive that (subprocess +
-    timeout). "static" is the scan-only counted-loop variant (the same
-    control-flow shape as the sequential engine, which does compile
-    there) at the cost of no-op rounds past the fixpoint."""
+def _gang_probe(mode: str, shape: str = "bench"):
+    """Subprocess mode (`bench.py --gang-probe=<dynamic|static>
+    [--gang-shape=bench|atscale]`): measure the gang scheduler and print
+    one JSON line. Run isolated because gang's dynamic `lax.while_loop`
+    program has never been observed to finish compiling on the
+    experimental axon backend — the parent bench must survive that
+    (subprocess + timeout). "static" is the scan-only counted-loop
+    variant (the same control-flow shape as the sequential engine, which
+    does compile there) at the cost of no-op rounds past the fixpoint.
+    shape=atscale probes the BASELINE #2 shape (10k pods x 1k nodes) —
+    the step-count-reduction claim: ~a-dozen dense rounds instead of 10k
+    dependent scan steps."""
     import os
 
     import jax
@@ -120,35 +123,43 @@ def _gang_probe(mode: str):
     from kube_scheduler_simulator_tpu.engine.gang import GangScheduler
     from kube_scheduler_simulator_tpu.synth import synthetic_cluster
 
-    n_nodes, n_pods = N_NODES, N_PODS
-    if os.environ.get("_KSS_BENCH_CPU_FALLBACK"):
-        n_nodes, n_pods = CPU_FALLBACK["N_NODES"], CPU_FALLBACK["N_PODS"]
-    nodes, pods = synthetic_cluster(n_nodes, n_pods, seed=42)
+    fallback = bool(os.environ.get("_KSS_BENCH_CPU_FALLBACK"))
+    if shape == "atscale":
+        n_nodes = CPU_FALLBACK["SCALE_NODES"] if fallback else SCALE_NODES
+        n_pods = CPU_FALLBACK["SCALE_PODS"] if fallback else SCALE_PODS
+        seed, chunk, reps = 7, 256, 1
+    else:
+        n_nodes = CPU_FALLBACK["N_NODES"] if fallback else N_NODES
+        n_pods = CPU_FALLBACK["N_PODS"] if fallback else N_PODS
+        seed, chunk, reps = 42, 128, 3
+    nodes, pods = synthetic_cluster(n_nodes, n_pods, seed=seed)
     enc = encode_cluster(nodes, pods, supported_config(), policy=TPU32)
     if mode == "static":
-        gang = GangScheduler(enc, chunk=128, loop="static", inner_iters=64)
+        gang = GangScheduler(enc, chunk=chunk, loop="static", inner_iters=64)
     else:
-        gang = GangScheduler(enc, chunk=128)
+        gang = GangScheduler(enc, chunk=chunk)
     order, _ = gang.order_arrays()
     run = jax.jit(gang.run_fn)
     args = (enc.arrays, enc.state0, order, gang.weights)
     state, rounds = run(*args)
     np.asarray(state.assignment)  # compile + sync
-    best = _best_of(lambda: np.asarray(run(*args)[0].assignment))
+    best = _best_of(lambda: np.asarray(run(*args)[0].assignment), reps=reps)
     # the program is deterministic: reuse the warm-up call's state/rounds
     print(
         json.dumps(
             {
                 "gang_dps": round(n_pods / best, 1),
                 "mode": mode,
+                "shape": f"{n_pods}x{n_nodes}",
                 "rounds": int(np.asarray(rounds)),
                 "scheduled": int((np.asarray(state.assignment) >= 0).sum()),
+                "pods": n_pods,
             }
         )
     )
 
 
-def _try_gang_subprocess(platform: str) -> "dict | None":
+def _try_gang_subprocess(platform: str, shape: str = "bench") -> "dict | None":
     """Probe gang isolated. On CPU backends: the dynamic (while_loop)
     variant first, static as fallback. On accelerator backends: STATIC
     ONLY — killing an in-flight dynamic compile on the experimental TPU
@@ -166,7 +177,12 @@ def _try_gang_subprocess(platform: str) -> "dict | None":
     for mode, timeout_s in attempts:
         try:
             proc = subprocess.run(
-                [sys.executable, __file__, f"--gang-probe={mode}"],
+                [
+                    sys.executable,
+                    __file__,
+                    f"--gang-probe={mode}",
+                    f"--gang-shape={shape}",
+                ],
                 capture_output=True,
                 text=True,
                 timeout=timeout_s,
@@ -186,8 +202,14 @@ def _try_gang_subprocess(platform: str) -> "dict | None":
     return None
 
 
-def main():
+def main(profile_dir: "str | None" = None):
+    """`profile_dir` (from --profile=DIR): capture a JAX profiler trace
+    (TensorBoard/XProf format) of one warm pass per measured program into
+    DIR, and print per-phase host timings (encode / compile / best run)
+    to stderr as JSON — the SURVEY §5 tracing artifact. Off by default:
+    the driver contract is ONE stdout JSON line, unchanged either way."""
     import os
+    import sys
 
     platform = _device_watchdog()
     global N_NODES, N_PODS, N_VARIANTS, SCALE_NODES, SCALE_PODS
@@ -220,22 +242,42 @@ def main():
 
     from kube_scheduler_simulator_tpu.sched.config import SchedulerConfiguration
 
-    def timed_pass(nodes_, pods_, config, reps=3):
+    phases: dict[str, dict] = {}
+
+    def timed_pass(nodes_, pods_, config, reps=3, label=None):
         """Encode → jit → compile → best-of timing of one sequential pass
         (the shared idiom for every single-pass measurement; sync via
-        host transfer — see module docstring)."""
+        host transfer — see module docstring). Per-phase host timings
+        land in `phases[label]`; under --profile the warm pass also runs
+        inside a jax.profiler trace."""
+        t0 = time.perf_counter()
         e = encode_cluster(nodes_, pods_, config, policy=TPU32)
         sc = BatchedScheduler(e, record=False, unroll=UNROLL)
+        t_encode = time.perf_counter() - t0
         a = (e.arrays, e.state0, jnp.asarray(e.queue), sc.weights)
         r = jax.jit(sc.run_fn)
+        t0 = time.perf_counter()
         np.asarray(r(*a)[1])  # compile
-        return _best_of(lambda: np.asarray(r(*a)[1]), reps=reps)
+        t_compile = time.perf_counter() - t0
+        best = _best_of(lambda: np.asarray(r(*a)[1]), reps=reps)
+        if label:
+            phases[label] = {
+                "encode_s": round(t_encode, 4),
+                "compile_s": round(t_compile, 4),
+                "best_run_s": round(best, 4),
+            }
+        if profile_dir:
+            from kube_scheduler_simulator_tpu.utils.metrics import profile_trace
+
+            with profile_trace(profile_dir):
+                np.asarray(r(*a)[1])
+        return best
 
     cfg = supported_config()  # == the full default KubeSchedulerConfiguration
     nodes, pods = synthetic_cluster(N_NODES, N_PODS, seed=42)
 
     # 1) single pass
-    single_dps = N_PODS / timed_pass(nodes, pods, cfg)
+    single_dps = N_PODS / timed_pass(nodes, pods, cfg, label="single")
 
     # 2) Monte-Carlo sweep: V variants in one program (preemption off —
     # see module docstring)
@@ -262,13 +304,40 @@ def main():
     t_sweep = _best_of(lambda: np.asarray(vrun(*vargs)[1]))
     sweep_dps = N_VARIANTS * N_PODS / t_sweep
 
+    # 2b) sweep WITH preemption (masked dry-run mode — the vmap-safe
+    # always-run gating; see engine.py preempt_mode). Every pod in every
+    # variant pays the full dry-run, so fewer variants: this measures the
+    # semantics-complete sweep, not the headline.
+    PRE_VARIANTS = max(2, N_VARIANTS // 4)
+    pre_enc = encode_cluster(nodes, pods, cfg, policy=TPU32)
+    pre_sched = BatchedScheduler(
+        pre_enc, record=False, preempt_mode="masked"
+    )
+    prun = jax.jit(jax.vmap(pre_sched.run_fn, in_axes=(None, None, None, 0)))
+    pvariants = jnp.asarray(
+        np.stack([wbase + i for i in range(PRE_VARIANTS)]), wbase.dtype
+    )
+    pargs = (
+        pre_enc.arrays,
+        pre_enc.state0,
+        jnp.asarray(pre_enc.queue),
+        pvariants,
+    )
+    np.asarray(prun(*pargs)[1])  # compile
+    t_pre = _best_of(lambda: np.asarray(prun(*pargs)[1]), reps=2)
+    sweep_pre_dps = PRE_VARIANTS * N_PODS / t_pre
+
     # 3) at-scale single pass (BASELINE config #2 shape)
     s_nodes, s_pods = synthetic_cluster(SCALE_NODES, SCALE_PODS, seed=7)
-    scale_dps = SCALE_PODS / timed_pass(s_nodes, s_pods, cfg, reps=2)
+    scale_dps = SCALE_PODS / timed_pass(
+        s_nodes, s_pods, cfg, reps=2, label="atscale"
+    )
 
     # 4) affinity-heavy pass (BASELINE config #3 shape)
     a_nodes, a_pods = synthetic_affinity_cluster(AFF_NODES, AFF_PODS, seed=11)
-    aff_dps = AFF_PODS / timed_pass(a_nodes, a_pods, cfg, reps=2)
+    aff_dps = AFF_PODS / timed_pass(
+        a_nodes, a_pods, cfg, reps=2, label="affinity"
+    )
 
     # oracle baseline: sequential python on a sample of the same workload
     oracle = Oracle(nodes, pods[:BASELINE_PODS], cfg)
@@ -293,6 +362,21 @@ def main():
         )
     else:
         gang_note = ", gang=n/a (did not finish in isolation window)"
+    # gang at the BASELINE #2 shape — the dense-rounds-vs-10k-steps
+    # claim; only probed when the bench shape finished (no point burning
+    # the window on a backend that can't run the small one)
+    if gang:
+        gang_sc = _try_gang_subprocess(platform, shape="atscale")
+        if gang_sc and gang_sc.get("scheduled") == gang_sc.get("pods"):
+            gang_note += (
+                f", gang atscale({gang_sc['mode']},{gang_sc['shape']})="
+                f"{gang_sc['gang_dps']}/s in {gang_sc['rounds']} rounds"
+            )
+        elif gang_sc:
+            gang_note += (
+                f", gang atscale({gang_sc['shape']})={gang_sc['gang_dps']}/s "
+                f"INCOMPLETE ({gang_sc['scheduled']}/{gang_sc['pods']})"
+            )
     headline = max(sweep_dps, gang["gang_dps"] if gang_complete else 0.0)
 
     print(
@@ -303,7 +387,9 @@ def main():
                 "unit": (
                     f"decisions/s on {platform}; sweep {N_VARIANTS}x{N_PODS}pods"
                     f"x{N_NODES}nodes={round(sweep_dps, 1)}/s (default set "
-                    f"minus postFilter), single full default set="
+                    f"minus postFilter), sweep+preemption {PRE_VARIANTS}x="
+                    f"{round(sweep_pre_dps, 1)}/s (full default set, masked "
+                    f"dry-run), single full default set="
                     f"{round(single_dps, 1)}/s, {SCALE_PODS}pods"
                     f"x{SCALE_NODES}nodes={round(scale_dps, 1)}/s, "
                     f"affinity {AFF_PODS}podsx{AFF_NODES}nodes="
@@ -316,6 +402,14 @@ def main():
             }
         )
     )
+    if profile_dir:
+        # per-phase host timings + the trace artifact location, on
+        # stderr so the stdout driver contract stays one JSON line
+        sys.stderr.write(
+            "bench phases: "
+            + json.dumps({"profile_dir": profile_dir, "passes": phases})
+            + "\n"
+        )
 
 
 if __name__ == "__main__":
@@ -327,6 +421,19 @@ if __name__ == "__main__":
         mode = mode or "dynamic"
         if mode not in ("dynamic", "static"):
             raise SystemExit(f"--gang-probe mode must be dynamic|static, got {mode!r}")
-        _gang_probe(mode)
+        shape = "bench"
+        gs = [a for a in sys.argv if a.startswith("--gang-shape")]
+        if gs:
+            _, _, shape = gs[0].partition("=")
+            if shape not in ("bench", "atscale"):
+                raise SystemExit(
+                    f"--gang-shape must be bench|atscale, got {shape!r}"
+                )
+        _gang_probe(mode, shape)
     else:
-        main()
+        prof = [a for a in sys.argv if a.startswith("--profile")]
+        profile_dir = None
+        if prof:
+            _, _, profile_dir = prof[0].partition("=")
+            profile_dir = profile_dir or "bench_profile"
+        main(profile_dir)
