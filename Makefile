@@ -5,7 +5,7 @@
 PORT ?= 1212
 PY ?= python
 
-.PHONY: test test-fast lint start bench dryrun batch clean
+.PHONY: test test-fast lint start bench dryrun batch docker docker-up clean
 
 # full suite on the 8-device virtual CPU mesh (tests/conftest.py pins it)
 test:
@@ -33,6 +33,13 @@ dryrun:
 # KEP-184 one-shot batch runner: make batch IN=specs/ OUT=results/
 batch:
 	$(PY) -m kube_scheduler_simulator_tpu.scenario.batch --input-dir $(IN) --out-dir $(OUT)
+
+# containerized dev flow (reference `make docker_build_and_up`, one service)
+docker:
+	docker build -t kube-scheduler-simulator-tpu .
+
+docker-up: docker
+	docker compose up
 
 clean:
 	find . -name __pycache__ -type d -prune -exec rm -rf {} +

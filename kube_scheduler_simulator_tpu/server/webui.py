@@ -177,12 +177,12 @@ const TEMPLATES = __TEMPLATES__;
 // kind key -> watch wire name + table spec (reference
 // ResourceViews/ResourcesViewPanel.vue covers the same seven kinds)
 const KINDS = {
-  nodes:{wire:'nodes',title:'Nodes',ns:false,
+  nodes:{wire:'nodes',title:'Nodes',one:'Node',ns:false,
     cols:['name','cpu','memory','pods bound'],
     row:n=>{const al=(n.status||{}).allocatable||{};
       return [n.metadata.name,al.cpu||'',al.memory||'',
               podsByNode().get(n.metadata.name)?.length||0];}},
-  pods:{wire:'pods',title:'Pods',ns:true,
+  pods:{wire:'pods',title:'Pods',one:'Pod',ns:true,
     cols:['namespace','name','node','result'],
     row:p=>{const node=(p.spec||{}).nodeName||'';
       const ann=(p.metadata||{}).annotations||{};
@@ -192,23 +192,23 @@ const KINDS = {
               :'<span class="pill pend">pending</span>');
       return [p.metadata.namespace||'default',p.metadata.name,node,
               {html:pill}];}},
-  pvs:{wire:'persistentvolumes',title:'PVs',ns:false,
+  pvs:{wire:'persistentvolumes',title:'PVs',one:'PV',ns:false,
     cols:['name','capacity','phase','claim'],
     row:v=>{const sp=v.spec||{};const cr=sp.claimRef||{};
       return [v.metadata.name,(sp.capacity||{}).storage||'',
               (v.status||{}).phase||'',
               cr.name?((cr.namespace||'default')+'/'+cr.name):''];}},
-  pvcs:{wire:'persistentvolumeclaims',title:'PVCs',ns:true,
+  pvcs:{wire:'persistentvolumeclaims',title:'PVCs',one:'PVC',ns:true,
     cols:['namespace','name','volume','phase'],
     row:c=>[c.metadata.namespace||'default',c.metadata.name,
             (c.spec||{}).volumeName||'',(c.status||{}).phase||'']},
-  storageclasses:{wire:'storageclasses',title:'StorageClasses',ns:false,
+  storageclasses:{wire:'storageclasses',title:'StorageClasses',one:'StorageClass',ns:false,
     cols:['name','provisioner','bindingMode'],
     row:s=>[s.metadata.name,s.provisioner||'',s.volumeBindingMode||'']},
-  priorityclasses:{wire:'priorityclasses',title:'PriorityClasses',ns:false,
+  priorityclasses:{wire:'priorityclasses',title:'PriorityClasses',one:'PriorityClass',ns:false,
     cols:['name','value','globalDefault'],
     row:p=>[p.metadata.name,String(p.value??''),String(p.globalDefault??'')]},
-  namespaces:{wire:'namespaces',title:'Namespaces',ns:false,
+  namespaces:{wire:'namespaces',title:'Namespaces',one:'Namespace',ns:false,
     cols:['name'],row:n=>[n.metadata.name]},
 };
 const state = {}; for (const k in KINDS) state[k]=new Map();
@@ -264,8 +264,7 @@ function render(){
   const over=state[activeKind].size>MAX_ROWS?` (showing first ${MAX_ROWS})`:'';
   document.getElementById('count').textContent=
     `${state[activeKind].size} ${spec.title}${over}`;
-  document.getElementById('newbtn').textContent=
-    `New ${spec.title.replace(/s$/,'')}`;
+  document.getElementById('newbtn').textContent=`New ${spec.one}`;
 }
 function showPodDetail(p){
   const ann=(p.metadata||{}).annotations||{};
@@ -292,7 +291,7 @@ function resourcePath(kind,o){
 function newResource(){
   editing={kind:activeKind};
   document.getElementById('edtitle').textContent=
-    `New ${KINDS[activeKind].title.replace(/s$/,'')} (YAML)`;
+    `New ${KINDS[activeKind].one} (YAML)`;
   document.getElementById('editor').value=TEMPLATES[activeKind]||'metadata:\\n  name: \\n';
   document.getElementById('delbtn').style.display='none';
   document.getElementById('editerr').textContent='';

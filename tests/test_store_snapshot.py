@@ -38,6 +38,51 @@ def test_apply_get_list_delete():
     assert s.get("pods", "p1") is None
 
 
+def test_replace_removes_omitted_fields():
+    """store.replace is kubectl-replace: the manifest becomes the object
+    wholesale (apply's SSA merge would keep removed fields)."""
+    s = ResourceStore()
+    p = make_pod("p1")
+    p["metadata"]["labels"] = {"keep": "no"}
+    s.apply("pods", p)
+    uid = s.get("pods", "p1")["metadata"]["uid"]
+    replacement = make_pod("p1")  # no labels
+    out = s.replace("pods", replacement)
+    got = s.get("pods", "p1")
+    assert "labels" not in got["metadata"]
+    # identity is preserved across replaces; RV advances
+    assert got["metadata"]["uid"] == uid
+    assert int(got["metadata"]["resourceVersion"]) > 1
+    assert out["metadata"]["name"] == "p1"
+    # replace of a missing object creates it (PUT upsert)
+    s.replace("pods", make_pod("fresh"))
+    assert s.get("pods", "fresh") is not None
+
+
+def test_generate_name_suffix_and_collision_redraw(monkeypatch):
+    """metadata.generateName gets a random 5-char suffix; a suffix
+    collision redraws instead of merging into the existing object
+    (the apiserver 409/retry contract)."""
+    import random as random_mod
+
+    s = ResourceStore()
+    obj = {"metadata": {"generateName": "pod-"}, "spec": {}}
+    out = s.apply("pods", dict(obj))
+    name1 = out["metadata"]["name"]
+    assert name1.startswith("pod-") and len(name1) == len("pod-") + 5
+    # force the next draw to collide with name1 first, then yield a
+    # fresh suffix — the colliding draw must be skipped
+    suffixes = [name1[len("pod-"):], "zzz99"]
+    monkeypatch.setattr(
+        random_mod, "choices", lambda *a, **k: list(suffixes.pop(0))
+    )
+    out2 = s.apply("pods", dict(obj))
+    assert out2["metadata"]["name"] == "pod-zzz99"
+    # the original object was not touched (no MODIFIED merge)
+    assert s.get("pods", name1)["metadata"]["name"] == name1
+    assert len(s.list("pods")) == 2
+
+
 def test_node_delete_cascades_pods():
     s = ResourceStore()
     s.apply("nodes", make_node("n1"))
