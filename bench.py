@@ -79,7 +79,8 @@ def _device_watchdog(timeout_s: "float | None" = None) -> str:
     from kube_scheduler_simulator_tpu.utils.axonenv import (
         PROBE_TIMEOUT_S,
         probe_devices,
-        scrubbed_cpu_env,
+        probe_why,
+        reexec_on_cpu,
     )
 
     if timeout_s is None:
@@ -87,17 +88,15 @@ def _device_watchdog(timeout_s: "float | None" = None) -> str:
     devices, error = probe_devices(timeout_s)
     if devices:
         return devices[0].platform
-    why = (
-        f"device init failed: {error!r}"
-        if error is not None
-        else f"device init hung >{timeout_s:.0f}s"
-    )
+    why = probe_why(error, timeout_s)
     if os.environ.get("_KSS_BENCH_CPU_FALLBACK"):
         raise RuntimeError(f"CPU fallback backend unusable — {why}")
-    sys.stderr.write(f"bench: {why}; re-exec on CPU backend\n")
-    env = scrubbed_cpu_env()
-    env["_KSS_BENCH_CPU_FALLBACK"] = "1"
-    os.execve(sys.executable, [sys.executable, __file__, *sys.argv[1:]], env)
+    reexec_on_cpu(
+        "bench",
+        "_KSS_BENCH_CPU_FALLBACK",
+        [sys.executable, __file__, *sys.argv[1:]],
+        why,
+    )
 
 
 def _gang_probe(mode: str, shape: str = "bench"):
@@ -285,12 +284,12 @@ def _try_gang_subprocess(
 def main(profile_dir: "str | None" = None):
     """`profile_dir` (from --profile=DIR): capture a JAX profiler trace
     (TensorBoard/XProf format) of one warm pass per in-process measured
-    program — single, both sweeps (incl. the headline), atscale,
-    affinity — into DIR, and print per-phase host timings to stderr as
-    JSON: the SURVEY §5 tracing artifact. Gang probes run in isolated
-    subprocesses (wedge containment) and are NOT traced; their JSON
-    lines carry rounds/throughput instead. Off by default: the driver
-    contract is ONE stdout JSON line, unchanged either way."""
+    program — single, the headline sweep, atscale, affinity — into DIR,
+    and print per-phase host timings to stderr as JSON: the SURVEY §5
+    tracing artifact. The gang probes AND the preemption sweep run in
+    isolated subprocesses (wedge/crash containment) and are NOT traced;
+    their JSON lines carry the throughput numbers instead. Off by
+    default: the driver contract is ONE stdout JSON line either way."""
     import os
     import sys
 
@@ -456,7 +455,9 @@ def main(profile_dir: "str | None" = None):
     # claim; only probed when the bench shape finished (no point burning
     # the window on a backend that can't run the small one), and without
     # re-running the tiny ladder rung that probe already proved
-    if gang:
+    if gang and not gang.get("fallback_from"):
+        # a tiny-rung fallback means the full bench shape did not finish
+        # — the 10k-pod shape has no chance there; keep the window
         gang_sc = _try_gang_subprocess(
             platform, shape="atscale", ladder_proved=True
         )

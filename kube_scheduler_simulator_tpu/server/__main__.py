@@ -49,7 +49,42 @@ def main(argv: "list[str] | None" = None) -> int:
         metavar="PATH",
         help="file holding a bearer token for --replicate-from-cluster",
     )
+    parser.add_argument(
+        "--no-device-probe",
+        action="store_true",
+        help="skip the boot-time accelerator watchdog (the probe guards "
+        "against a wedged backend hanging the first scheduling pass "
+        "forever; skipping is for environments where device init is "
+        "known-good but slower than the probe window)",
+    )
     args = parser.parse_args(argv)
+
+    if not args.no_device_probe:
+        # A wedged accelerator tunnel hangs even jax.devices(), which
+        # would turn the FIRST /api/v1/schedule into an unbounded stall
+        # (observed failure mode). Probe under a watchdog at boot and
+        # re-exec on the scrubbed CPU backend when the accelerator is
+        # unusable — a slower, labeled server beats a hung one.
+        import os
+        import sys
+
+        from ..utils.axonenv import (
+            PROBE_TIMEOUT_S,
+            probe_devices,
+            probe_why,
+            reexec_on_cpu,
+        )
+
+        if not os.environ.get("_KSS_SERVER_CPU_FALLBACK"):
+            devices, error = probe_devices()
+            if not devices:
+                reexec_on_cpu(
+                    "server",
+                    "_KSS_SERVER_CPU_FALLBACK",
+                    [sys.executable, "-m", "kube_scheduler_simulator_tpu.server"]
+                    + list(argv if argv is not None else sys.argv[1:]),
+                    probe_why(error, PROBE_TIMEOUT_S),
+                )
 
     cfg = envconfig.from_env()
     if args.port is not None:

@@ -46,6 +46,31 @@ def probe_devices(
     return list(out), (err[0] if err else None)
 
 
+def probe_why(error: "BaseException | None", timeout_s: float) -> str:
+    """The shared wording for an unusable accelerator backend."""
+    if error is not None:
+        return f"device init failed: {error!r}"
+    return f"device init hung >{timeout_s:.0f}s"
+
+
+def reexec_on_cpu(label: str, marker_env: str, argv: list[str], why: str):
+    """Replace this process with the same program on the scrubbed CPU
+    backend (the shared probe-failed response of bench and the serving
+    shell). The notice goes to stderr AND is flushed first — execve
+    replaces the image without flushing stdio, so a block-buffered
+    stdout (docker/systemd pipes) would silently eat the only signal
+    that the process degraded to the CPU backend. `marker_env` guards
+    against re-exec loops (the callee raises instead of re-execing when
+    it sees it)."""
+    import sys
+
+    sys.stderr.write(f"{label}: {why}; re-exec on CPU backend\n")
+    sys.stderr.flush()
+    env = scrubbed_cpu_env()
+    env[marker_env] = "1"
+    os.execve(sys.executable, argv, env)
+
+
 def scrubbed_cpu_env(
     base: "dict[str, str] | None" = None,
     *,
