@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -144,13 +145,21 @@ def _run_sweep_job(job: BatchJob, mesh=None) -> dict:
     }
 
 
+# Sweep jobs are device-bound: one vmapped XLA program at a time per
+# process, whoever the caller is (the batch runner's serial loop, the
+# HTTP /api/v1/scenario route's request threads). This lock is the
+# single enforcement point.
+_DEVICE_JOB_LOCK = threading.Lock()
+
+
 def run_job(job: BatchJob, *, mesh=None) -> dict:
     """Execute one job; returns its result dict (the KEP-184 output file
-    payload)."""
+    payload). Device-bound sweep jobs serialize process-wide."""
     if job.parse_error:
         raise ValueError(job.parse_error)
     if job.kind == "sweep":
-        return _run_sweep_job(job, mesh=mesh)
+        with _DEVICE_JOB_LOCK:
+            return _run_sweep_job(job, mesh=mesh)
     runner = ScenarioRunner(job.operations, config=job.scheduler_config)
     result = runner.run()
     out = result.as_dict()

@@ -81,6 +81,10 @@ class SimulatorServer:
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.httpd.daemon_threads = True
         self._thread: "threading.Thread | None" = None
+        # one scenario/sweep run at a time over this server (KEP-140's
+        # one-scenario-at-a-time; each request thread would otherwise
+        # drive the device concurrently)
+        self._scenario_lock = threading.Lock()
 
     @property
     def port(self) -> int:
@@ -257,6 +261,30 @@ def _make_handler(server: SimulatorServer):
                             ],
                         },
                     )
+                if rest == ["scenario"] and method == "POST":
+                    # one-shot KEP-140 scenario / KEP-159 sweep run over
+                    # the serving shell: the body is a batch-job spec
+                    # (scenario/batch.py — operations + schedulerConfig,
+                    # or a sweep snapshot + weightVariants). Runs against
+                    # its OWN isolated store (KEP-140's one-scenario-at-
+                    # a-time pre-cleaned cluster, README.md:600-610), not
+                    # the server's; synchronous, returns the result doc.
+                    # Concurrent scenario requests serialize (KEP: one
+                    # scenario at a time; run_job additionally holds the
+                    # process-wide device lock for sweep jobs).
+                    from ..scenario.batch import BatchJob, run_job
+
+                    try:
+                        spec = self._body() or {}
+                        if not isinstance(spec, dict):
+                            return self._error(400, "spec must be a mapping")
+                        job = BatchJob.from_spec(
+                            spec.get("name", "http-scenario"), spec
+                        )
+                    except (ValueError, KeyError, AttributeError, TypeError) as e:
+                        return self._error(400, f"{type(e).__name__}: {e}")
+                    with server._scenario_lock:
+                        return self._json(200, run_job(job))
                 if rest and rest[0] == "extender":
                     return self._extender(method, rest[1:])
                 if rest and rest[0] == "resources":

@@ -1,0 +1,73 @@
+"""POST /api/v1/scenario — the KEP-140 scenario VM / KEP-159 sweep
+runner exposed through the serving shell (isolated store per run)."""
+
+import json
+import urllib.error
+import urllib.request
+
+from kube_scheduler_simulator_tpu.server.httpserver import SimulatorServer
+from kube_scheduler_simulator_tpu.server.service import SimulatorService
+
+from helpers import node, pod
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+class TestScenarioRoute:
+    def setup_method(self):
+        self.server = SimulatorServer(SimulatorService(), port=0).start()
+        self.base = f"http://127.0.0.1:{self.server.port}/api/v1"
+
+    def teardown_method(self):
+        self.server.shutdown()
+
+    def test_scenario_run_returns_timeline_and_summary(self):
+        spec = {
+            "kind": "scenario",
+            "operations": [
+                {"majorStep": 0, "create": {"kind": "nodes", "object": node("n0")}},
+                {"majorStep": 0, "create": {"kind": "pods", "object": pod("p0")}},
+                {"majorStep": 1, "done": True},
+            ],
+        }
+        st, out = _post(f"{self.base}/scenario", spec)
+        assert st == 200
+        assert out["phase"] == "Succeeded"
+        events = out["timeline"]["0"]
+        assert any(e["type"] == "PodScheduled" for e in events)
+        assert out["summary"]["pods"]["scheduled"] == 1
+        # isolation: the server's own store saw nothing
+        with urllib.request.urlopen(f"{self.base}/resources/pods") as resp:
+            assert json.load(resp)["items"] == []
+
+    def test_sweep_run_over_http(self):
+        spec = {
+            "kind": "sweep",
+            "snapshot": {
+                "nodes": [node("n0"), node("n1")],
+                "pods": [pod("a"), pod("b")],
+            },
+            "weightVariants": [{}, {"NodeResourcesFit": 5}],
+        }
+        st, out = _post(f"{self.base}/scenario", spec)
+        assert st == 200
+        assert out["phase"] == "Succeeded"
+        assert len(out["variants"]) == 2
+        for v in out["variants"]:
+            assert v["scheduled"] == 2
+
+    def test_bad_spec_is_400(self):
+        try:
+            _post(f"{self.base}/scenario", {"kind": "nope"})
+            raise AssertionError("accepted bad kind")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400

@@ -80,6 +80,74 @@ def test_cross_thread_delivery_order_matches_log():
     assert len(seen) == 80
 
 
+def test_concurrent_writers_and_watcher_stress():
+    """Race stress (SURVEY §5 thread-safety claim): many writer threads
+    applying/deleting while a subscriber consumes. Invariants: every
+    subscriber-delivered resourceVersion is unique and monotone per
+    delivery order gaps are allowed (writers interleave) but the final
+    store state must equal the last write per key, and the event log
+    must replay to the same set of live objects."""
+    import queue
+
+    store = ResourceStore()
+    seen: "queue.Queue" = queue.Queue()
+    store.subscribe(seen.put)
+    N_THREADS, N_OPS = 8, 60
+    errs = []
+
+    def writer(t):
+        try:
+            for i in range(N_OPS):
+                name = f"p-{t}-{i % 10}"
+                if i % 7 == 3:
+                    store.delete("pods", name, "default")
+                else:
+                    store.apply(
+                        "pods",
+                        {
+                            "metadata": {"name": name, "namespace": "default"},
+                            "spec": {"x": f"{t}-{i}"},
+                        },
+                    )
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errs.append(e)
+
+    threads = [threading.Thread(target=writer, args=(t,)) for t in range(N_THREADS)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errs, errs
+    store.unsubscribe(seen.put)
+    # drain: RVs unique and strictly increasing in delivery order
+    rvs = []
+    while not seen.empty():
+        rvs.append(seen.get().resource_version)
+    assert len(rvs) == len(set(rvs)), "duplicate resourceVersion delivered"
+    assert rvs == sorted(rvs), "subscriber saw events out of order"
+    # replaying the retained event log over an empty dict yields exactly
+    # the live set (delete events included)
+    replayed = {}
+    for ev in store.events_since("pods", 0):
+        key = (
+            ev.obj["metadata"].get("namespace", "default"),
+            ev.obj["metadata"]["name"],
+        )
+        if ev.event_type == "DELETED":
+            replayed.pop(key, None)
+        else:
+            replayed[key] = ev.obj
+    live = {
+        (p["metadata"]["namespace"], p["metadata"]["name"]): p
+        for p in store.list("pods")
+    }
+    assert set(replayed) == set(live)
+    for k in live:
+        assert replayed[k]["metadata"]["resourceVersion"] == live[k][
+            "metadata"
+        ]["resourceVersion"]
+
+
 def test_unsubscribe_stops_delivery():
     s = ResourceStore()
     seen = []
