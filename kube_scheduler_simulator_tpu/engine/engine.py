@@ -660,18 +660,34 @@ class BatchedScheduler:
         record_bind_points(enc.config, res, permit=permit)
         return True
 
-    def _fill_postfilter(self, res, pcode_row, vmask_row, seq):
-        """Attach DefaultPreemption messages (oracle default_preemption's
-        per-node messages dict). Returns (nominated victims by node)."""
+    def _ordered_victims(self, vmask_row, seq) -> "dict[int, list[int]]":
+        """Per candidate node, the victim pod INDICES in reprieve
+        processing order: priority desc, bind order asc (oracle
+        NodeInfo.pods insertion order for ties). Shared by the trace
+        decode below and the extender loop's preemption path — one
+        definition of the order the records promise."""
         enc = self.enc
         prio = np.asarray(enc.arrays.pod_priority)
+        out = {}
+        for n in range(enc.n_nodes):
+            vs = [int(v) for v in np.nonzero(vmask_row[n])[0]]
+            vs.sort(key=lambda v: (-int(prio[v]), int(seq[v])))
+            out[n] = vs
+        return out
+
+    def _fill_postfilter(self, res, pcode_row, vmask_row, seq, victims=None):
+        """Attach DefaultPreemption messages (oracle default_preemption's
+        per-node messages dict). Returns (nominated victims by node).
+        `victims`: optional precomputed `_ordered_victims` output."""
+        enc = self.enc
+        if victims is None:
+            victims = self._ordered_victims(vmask_row, seq)
         victims_by_node = {}
         for n in range(enc.n_nodes):
             code = int(pcode_row[n])
-            vs = [int(v) for v in np.nonzero(vmask_row[n])[0]]
-            # reprieve processing order: priority desc, bind order asc
-            vs.sort(key=lambda v: (-int(prio[v]), int(seq[v])))
-            names = [f"{enc.pod_keys[v][0]}/{enc.pod_keys[v][1]}" for v in vs]
+            names = [
+                f"{enc.pod_keys[v][0]}/{enc.pod_keys[v][1]}" for v in victims[n]
+            ]
             victims_by_node[n] = names
             if code == K.PREEMPT_SILENT:
                 continue

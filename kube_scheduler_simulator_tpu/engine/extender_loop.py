@@ -16,10 +16,16 @@ extender calls host-side:
                               manages the pod (upstream binder delegation)
       bind_fn     (device)  → state update
 
-Documented divergence: preemption is not attempted in extender mode — a
-pod that fails all filters is recorded Unschedulable without the dry-run
-(upstream would also invoke the extender preempt verb). The preempt verb
-is still proxied and recorded for external schedulers that call it.
+Preemption (DefaultPreemption enabled): when a pod fails all filters —
+framework or extender — the dry-run kernel nominates candidates, then
+every preempt-verb extender gets the candidate victim map and may trim
+or veto it (upstream processPreemptionWithExtenders; wire shapes
+ExtenderPreemptionArgs / ExtenderPreemptionResult with meta-victim UID
+mapping). The best surviving candidate is re-ranked host-side with the
+same rule as the kernel (min highest-victim priority, min priority sum,
+fewest victims, lowest index), its victims evicted on device, and the
+pod retried through the full framework+extender cycle — two records
+(Nominated + retry), exactly like the batch engine's trace.
 """
 
 from __future__ import annotations
@@ -49,9 +55,26 @@ class ExtenderScheduler:
         *,
         strict: bool = True,
     ):
+        import jax
+
         self.enc = enc
         self.service = service
         self.sched = BatchedScheduler(enc, record=True, strict=strict)
+        # preemption segments (DefaultPreemption enabled): the dry-run
+        # kernel and the batched eviction, jitted once like
+        # attempt_fn/bind_fn
+        if self.sched._preempt is not None:
+            self.preempt_fn = jax.jit(
+                lambda arrays, state, p: self.sched._preempt(arrays, state, p)
+            )
+            self.evict_fn = jax.jit(
+                lambda arrays, state, mask: self.sched._evict_all(
+                    state, arrays, mask
+                )
+            )
+        else:
+            self.preempt_fn = None
+            self.evict_fn = None
         self._results: "list[PodSchedulingResult] | None" = None
         self.final_state = None
 
@@ -156,7 +179,231 @@ class ExtenderScheduler:
                 return True
         return False
 
+    # -- preemption interplay ----------------------------------------------
+
+    def _dry_run(self, res, state, p):
+        """Run the dry-run kernel for pod p, record the per-node
+        DefaultPreemption messages into `res` via the engine's shared
+        trace-decode helpers (one definition of message format and
+        reprieve order), and return (nominated node idx,
+        {candidate node idx: ordered victim pod indices}, per-node
+        codes)."""
+        import jax.numpy as jnp
+
+        pcode, vmask, nominated = self.preempt_fn(
+            self.enc.arrays, state, jnp.int32(p)
+        )
+        pcode = np.asarray(pcode)
+        vmask = np.asarray(vmask)
+        seq = np.asarray(state.bound_seq)
+        victims = self.sched._ordered_victims(vmask, seq)
+        self.sched._fill_postfilter(res, pcode, vmask, seq, victims=victims)
+        return int(np.asarray(nominated)), victims, pcode
+
+    def _victim_uid(self, v: int) -> str:
+        """The meta-victim identifier: the pod's UID, or ns/name for
+        manifests without one (mapped back symmetrically)."""
+        meta = (self.enc.pods[v].get("metadata", {}) or {})
+        return meta.get("uid") or f"{self.enc.pod_keys[v][0]}/{self.enc.pod_keys[v][1]}"
+
+    def _process_preemption_with_extenders(
+        self, pod: dict, candidates: "dict[int, list[int]]"
+    ) -> "dict[int, list[int]] | None":
+        """upstream processPreemptionWithExtenders: every preempt-verb,
+        interested extender sees the candidate victim map
+        (ExtenderPreemptionArgs) and returns the trimmed surviving map
+        (ExtenderPreemptionResult.NodeNameToMetaVictims, victims keyed by
+        UID). Extenders chain — each sees the previous one's survivors.
+        Returns None when an ignorable extender failed (skip) collapses
+        to nothing or a veto empties the map."""
+        enc = self.enc
+        surviving = dict(candidates)
+        uid_to_idx = {
+            self._victim_uid(v): v for vs in candidates.values() for v in vs
+        }
+        for i, ext in enumerate(self.service.extenders):
+            if not ext.preempt_verb or not ext.is_interested(pod):
+                continue
+            if ext.node_cache_capable:
+                wire = {
+                    "Pod": pod,
+                    "NodeNameToMetaVictims": {
+                        enc.node_names[n]: {
+                            "Pods": [{"UID": self._victim_uid(v)} for v in vs],
+                            "NumPDBViolations": 0,
+                        }
+                        for n, vs in surviving.items()
+                    },
+                }
+            else:
+                wire = {
+                    "Pod": pod,
+                    "NodeNameToVictims": {
+                        enc.node_names[n]: {
+                            "Pods": [enc.pods[v] for v in vs],
+                            "NumPDBViolations": 0,
+                        }
+                        for n, vs in surviving.items()
+                    },
+                }
+            try:
+                out = self.service.handle("preempt", i, wire)
+            except ExtenderError:
+                if ext.ignorable:
+                    continue
+                raise
+            name_to_idx = {enc.node_names[n]: n for n in surviving}
+            meta = (out or {}).get("NodeNameToMetaVictims")
+            if meta is None:
+                continue  # extender expressed no opinion
+            trimmed: dict[int, list[int]] = {}
+            for node_name, vict in meta.items():
+                n = name_to_idx.get(node_name)
+                if n is None:
+                    continue
+                vs = [
+                    uid_to_idx[m.get("UID")]
+                    for m in (vict or {}).get("Pods") or []
+                    if m.get("UID") in uid_to_idx
+                ]
+                if vs:
+                    trimmed[n] = vs
+            surviving = trimmed
+            if not surviving:
+                return None
+        return surviving
+
+    def _try_preemption(self, pod, p, qi, res, state, results):
+        """PostFilter for one unschedulable pod. Appends the Nominated and
+        retry records on success and returns the post-bind state; returns
+        None when preemption cannot help (res carries the dry-run
+        messages; caller records Unschedulable)."""
+        import jax.numpy as jnp
+
+        enc = self.enc
+        nom, victims_by_node, pcode = self._dry_run(res, state, p)
+        if nom < 0:
+            return None
+        candidates = {
+            n: victims_by_node[n]
+            for n in range(enc.n_nodes)
+            if int(pcode[n]) in (K.PREEMPT_CANDIDATE, K.PREEMPT_SELECTED)
+            and victims_by_node[n]
+        }
+        try:
+            surviving = self._process_preemption_with_extenders(pod, candidates)
+        except ExtenderError:
+            return None  # non-ignorable extender failure aborts preemption
+        if not surviving:
+            return None
+        prio = np.asarray(enc.arrays.pod_priority)
+
+        def rank(n):
+            ps = [int(prio[v]) for v in surviving[n]]
+            return (max(ps), sum(ps), len(ps), n)
+
+        best = min(surviving, key=rank)
+        victims = surviving[best]
+        res.status = "Nominated"
+        res.nominated_node = enc.node_names[best]
+        res.preemption_victims = [
+            f"{enc.pod_keys[v][0]}/{enc.pod_keys[v][1]}" for v in victims
+        ]
+        results.append(res)
+        mask = np.zeros(enc.P, bool)
+        mask[victims] = True
+        state = self.evict_fn(enc.arrays, state, jnp.asarray(mask))
+        # the retry cycle (oracle re-queues at the head; a second failure
+        # is terminally Unschedulable, with its own dry-run messages)
+        res2 = PodSchedulingResult(
+            pod_namespace=res.pod_namespace, pod_name=res.pod_name
+        )
+        res2.pre_filter_status = dict(res.pre_filter_status)
+        state, placed = self._attempt_once(pod, p, qi, res2, state)
+        if not placed:
+            nom2, _, _ = self._dry_run(res2, state, p)
+            if nom2 >= 0:
+                res2.nominated_node = enc.node_names[nom2]
+            res2.status = "Unschedulable"
+        results.append(res2)
+        return state
+
     # -- the loop -----------------------------------------------------------
+
+    def _attempt_once(self, pod, p, qi, res, state, attempt_out=None):
+        """One full framework+extender cycle for pod p against `state`:
+        attempt segment → decode filters/scores into `res` → extender
+        filter/prioritize → select → permit/bind records → (delegated)
+        bind. Returns (state, placed). `attempt_out`: the caller's
+        already-computed `attempt_fn` output for (state, p) — the main
+        loop runs the segment once for the prefilter decode and hands it
+        down; the preemption retry recomputes against the evicted state."""
+        import jax.numpy as jnp
+
+        enc = self.enc
+        sched = self.sched
+        arrays = enc.arrays
+        weights = sched.weights
+        if attempt_out is None:
+            attempt_out = sched.attempt_fn(arrays, state, weights, jnp.int32(p))
+        _, codes, raw, final, sel, _ = attempt_out
+        codes = np.asarray(codes)
+        raw = np.asarray(raw)
+        final = np.asarray(final)
+        feasible = []
+        for n in range(enc.n_nodes):
+            ok = True
+            for j, fname in enumerate(sched._filter_names):
+                c = int(codes[n, j])
+                if c:
+                    res.add_filter(
+                        enc.node_names[n], fname,
+                        K.FILTER_KERNELS[fname][1](c, enc, n),
+                    )
+                    ok = False
+                    break
+                res.add_filter(enc.node_names[n], fname, PASSED_FILTER_MESSAGE)
+            if ok:
+                feasible.append(n)
+        if feasible:
+            for pname in sched._prescore_names:
+                res.pre_score[pname] = SUCCESS_MESSAGE
+            for j, sname in enumerate(sched._score_specs_names):
+                for n in feasible:
+                    res.add_score(enc.node_names[n], sname, int(raw[n, j]))
+                    res.add_final_score(
+                        enc.node_names[n], sname, int(final[n, j])
+                    )
+        totals = {n: int(final[n].sum()) for n in feasible}
+        feasible, totals = self._apply_extenders(pod, feasible, totals)
+        if not feasible:
+            return state, False
+        best = min(feasible, key=lambda n: (-totals[n], n))
+        res.selected_node = enc.node_names[best]
+        res.status = "Scheduled"
+        # custom permit kernels record the same wait/timeout verdicts
+        # here as on the batch path (engine._fill_attempt)
+        permit = (
+            {
+                n_: h(p, best)
+                for n_, h in self.sched._permit_handlers.items()
+            }
+            if self.sched._permit_handlers
+            else None
+        )
+        record_bind_points(enc.config, res, permit=permit)
+        try:
+            delegated = self._delegated_bind(pod, enc.node_names[best])
+        except ExtenderError as e:
+            res.status = "Unschedulable"
+            res.bind["ExtenderBinder"] = str(e)
+            return state, False
+        if delegated:
+            res.bind["ExtenderBinder"] = SUCCESS_MESSAGE
+        state = sched.bind_fn(
+            arrays, state, jnp.int32(p), jnp.int32(best), jnp.int32(qi)
+        )
+        return state, True
 
     def run(self) -> list[PodSchedulingResult]:
         enc = self.enc
@@ -168,14 +415,14 @@ class ExtenderScheduler:
         weights = sched.weights
         results = []
         for qi, p in enumerate(np.asarray(enc.queue)):  # PrioritySort order
-            pod = enc.pods[int(p)]
-            ns, name = enc.pod_keys[int(p)]
+            p = int(p)
+            pod = enc.pods[p]
+            ns, name = enc.pod_keys[p]
             res = PodSchedulingResult(pod_namespace=ns, pod_name=name)
-            pf_codes, codes, raw, final, sel, pf_ok = sched.attempt_fn(
-                arrays, state, weights, jnp.int32(p)
-            )
+            attempt_out = sched.attempt_fn(arrays, state, weights, jnp.int32(p))
+            pf_codes = attempt_out[0]
             pf_failed = False
-            for j, pname in enumerate(sched._prefilter_names):
+            for pname in sched._prefilter_names:
                 if pname in K.PREFILTER_KERNELS:
                     k = sched._prefilter_kernel_names.index(pname)
                     c = int(np.asarray(pf_codes)[k])
@@ -189,66 +436,20 @@ class ExtenderScheduler:
                 res.status = "Unschedulable"
                 results.append(res)
                 continue
-
-            codes = np.asarray(codes)
-            raw = np.asarray(raw)
-            final = np.asarray(final)
-            feasible = []
-            for n in range(enc.n_nodes):
-                ok = True
-                for j, fname in enumerate(sched._filter_names):
-                    c = int(codes[n, j])
-                    if c:
-                        res.add_filter(
-                            enc.node_names[n], fname,
-                            K.FILTER_KERNELS[fname][1](c, enc, n),
-                        )
-                        ok = False
-                        break
-                    res.add_filter(enc.node_names[n], fname, PASSED_FILTER_MESSAGE)
-                if ok:
-                    feasible.append(n)
-            if feasible:
-                for pname in sched._prescore_names:
-                    res.pre_score[pname] = SUCCESS_MESSAGE
-                for j, sname in enumerate(sched._score_specs_names):
-                    for n in feasible:
-                        res.add_score(enc.node_names[n], sname, int(raw[n, j]))
-                        res.add_final_score(
-                            enc.node_names[n], sname, int(final[n, j])
-                        )
-            totals = {n: int(final[n].sum()) for n in feasible}
-            feasible, totals = self._apply_extenders(pod, feasible, totals)
-            if not feasible:
-                res.status = "Unschedulable"
+            state, placed = self._attempt_once(
+                pod, p, qi, res, state, attempt_out=attempt_out
+            )
+            if placed or res.bind.get("ExtenderBinder"):
+                # scheduled, or a delegated bind failed terminally (the
+                # bind error is this pod's record; no preemption retry)
                 results.append(res)
                 continue
-            best = min(feasible, key=lambda n: (-totals[n], n))
-            res.selected_node = enc.node_names[best]
-            res.status = "Scheduled"
-            # custom permit kernels record the same wait/timeout verdicts
-            # here as on the batch path (engine._fill_attempt)
-            permit = (
-                {
-                    n_: h(p, best)
-                    for n_, h in self.sched._permit_handlers.items()
-                }
-                if self.sched._permit_handlers
-                else None
-            )
-            record_bind_points(enc.config, res, permit=permit)
-            try:
-                delegated = self._delegated_bind(pod, enc.node_names[best])
-            except ExtenderError as e:
-                res.status = "Unschedulable"
-                res.bind["ExtenderBinder"] = str(e)
-                results.append(res)
-                continue
-            if delegated:
-                res.bind["ExtenderBinder"] = SUCCESS_MESSAGE
-            state = sched.bind_fn(
-                arrays, state, jnp.int32(p), jnp.int32(best), jnp.int32(qi)
-            )
+            if self.preempt_fn is not None:
+                new_state = self._try_preemption(pod, p, qi, res, state, results)
+                if new_state is not None:
+                    state = new_state
+                    continue
+            res.status = "Unschedulable"
             results.append(res)
         self.final_state = state
         self._results = results
