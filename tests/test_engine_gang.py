@@ -409,32 +409,36 @@ def test_divergence_rate_quantified_on_contended_hotspot():
     assert moved_m < 1.0 and moved_h < 1.0
 
 
-def test_gang_sweep_runs_preemption_per_variant():
-    """GangSweep must not silently drop the preempt phase: every variant
-    of a preemption-requiring workload must match a single-variant
-    GangScheduler run with those weights (which itself matches the
-    sequential engine on this all-pods-need-eviction shape)."""
-    from kube_scheduler_simulator_tpu.parallel import GangSweep
+@pytest.mark.parametrize("use_mesh", [False, True], ids=["unsharded", "mesh"])
+def test_gang_sweep_runs_preemption_per_variant(use_mesh):
+    """GangSweep must not silently drop the preempt phase — unsharded AND
+    mesh-sharded (dp over 'replicas' x node shards, the vmapped phase):
+    every variant of a preemption-requiring workload must match a
+    single-variant GangScheduler run with those weights (which itself
+    matches the sequential engine on this all-pods-need-eviction
+    shape)."""
+    from kube_scheduler_simulator_tpu.parallel import GangSweep, build_mesh
     from kube_scheduler_simulator_tpu.parallel.sweep import weights_for
 
+    mesh = build_mesh(8) if use_mesh else None  # 4 replicas x 2 node shards
+    cap = 4 * mesh.shape["nodes"] if mesh else None
     nodes = [node(f"n{i}", cpu="2", pods="8") for i in range(4)]
     pods = [
         pod(f"low-{i}", cpu="1500m", priority=1, node_name=f"n{i}")
         for i in range(4)
     ] + [pod(f"high-{i}", cpu="1200m", priority=100) for i in range(3)]
     cfg = _preempt_cfg()
-    enc = encode_cluster(nodes, pods, cfg, policy=EXACT)
-    sweep = GangSweep(enc, chunk=16)
-    variants = [{}, {"NodeResourcesFit": 5}]
+    enc = encode_cluster(nodes, pods, cfg, policy=EXACT, node_capacity=cap)
+    sweep = GangSweep(enc, mesh=mesh, chunk=16)
+    variants = [{}, {"NodeResourcesFit": 5}, {}, {"NodeResourcesFit": 7}]
     w = np.stack([weights_for(enc, ov) for ov in variants])
     assignments, _ = sweep.run(w)
+    solo = GangScheduler(
+        encode_cluster(nodes, pods, cfg, policy=EXACT, node_capacity=cap),
+        chunk=16,
+    )
     for v, ov in enumerate(variants):
-        solo = GangScheduler(
-            encode_cluster(nodes, pods, cfg, policy=EXACT), chunk=16
-        )
-        solo.run(
-            weights=np.asarray(weights_for(enc, ov), dtype=np.int32)
-        )
+        solo.run(weights=np.asarray(weights_for(enc, ov), dtype=np.int32))
         np.testing.assert_array_equal(
             np.asarray(assignments)[v],
             np.asarray(solo._final_state.assignment),
