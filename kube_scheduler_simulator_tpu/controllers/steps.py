@@ -39,10 +39,32 @@ def _template_hash(template: dict) -> str:
     return hashlib.sha256(blob.encode()).hexdigest()[:10]
 
 
+def _replicas(spec: dict) -> "int | None":
+    """spec.replicas as an int, or None when malformed. The store has no
+    admission validation (a real apiserver would reject non-integer
+    replicas), so the controllers must tolerate garbage: a malformed
+    object is SKIPPED, never allowed to wedge the reconcile loop — one
+    bad deployment posted through the CRUD surface must not turn every
+    subsequent mutation into a 500."""
+    v = (spec or {}).get("replicas", 1)
+    if isinstance(v, bool):
+        return None
+    if isinstance(v, int):
+        return v if v >= 0 else None
+    if isinstance(v, str) and v.isdigit():
+        return int(v)
+    return None
+
+
 def deployment_controller_step(store: ResourceStore) -> bool:
     """One reconcile round: every deployment owns exactly one ReplicaSet
-    per current template; old-template ReplicaSets are deleted (recreate-
-    style rollout — deterministic, no rolling-update surge modeling)."""
+    per current template. Old-template ReplicaSets retire in TWO phases —
+    scale to 0 (the replicaset controller then removes their pods), and
+    delete once drained (recreate-style rollout, deterministic). The
+    two-phase order means no step ever deletes pods it cannot see — there
+    is no ambient owner-reference GC (the reference's controller subset
+    runs no garbage collector either, controller.go:77-86), so imported
+    pods carrying ownerReferences to absent ReplicaSets are left alone."""
     changed = False
     # list once, index by owner (store.list deep-copies; per-object
     # re-listing would make a round O(objects^2) in copies)
@@ -62,7 +84,9 @@ def deployment_controller_step(store: ResourceStore) -> bool:
         name = meta.get("name", "")
         spec = deploy.get("spec", {}) or {}
         template = spec.get("template", {}) or {}
-        replicas = spec.get("replicas", 1)
+        replicas = _replicas(spec)
+        if replicas is None:
+            continue  # malformed spec: skip, never wedge the loop
         want_rs = f"{name}-{_template_hash(template)}"
         have = owned_by.get((ns, name), {})
         if want_rs not in have:
@@ -98,9 +122,24 @@ def deployment_controller_step(store: ResourceStore) -> bool:
             )
             changed = True
         for rs_name in sorted(have):
-            if rs_name != want_rs:
+            if rs_name == want_rs:
+                continue
+            stale = have[rs_name]
+            if (_replicas(stale.get("spec", {}) or {}) or 0) != 0:
+                # phase 1: drain — the replicaset controller deletes the
+                # pods this round
+                store.apply(
+                    "replicasets",
+                    {
+                        "metadata": {"name": rs_name, "namespace": ns},
+                        "spec": {"replicas": 0},
+                    },
+                )
+            else:
+                # phase 2: drained last round — remove (the store cascade
+                # catches any pod a name conflict left behind)
                 store.delete("replicasets", rs_name, ns)
-                changed = True
+            changed = True
     return changed
 
 
@@ -109,42 +148,35 @@ def replicaset_controller_step(store: ResourceStore) -> bool:
     scale up fills the lowest free ordinals, scale down deletes the
     highest ones (deterministic victim choice)."""
     changed = False
-    # list once; index pods by (ns, name) and by owning ReplicaSet
+    # list once; index pods by (ns, name) and by owning ReplicaSet.
+    # Pods whose owner ReplicaSet no longer exists are LEFT ALONE: the
+    # reference's controller subset runs no garbage collector
+    # (controller.go:77-86), and ambient GC here silently destroyed
+    # imported snapshots whose pods carried ownerReferences. Rollout
+    # cleanup is the deployment step's two-phase drain; terminal cleanup
+    # is the store's delete cascade.
     rs_list = sorted(
         store.list("replicasets"), key=lambda r: ResourceStore.key("replicasets", r)
     )
-    live_rs = {
-        (_meta(rs).get("namespace", "default"), _meta(rs).get("name", ""))
-        for rs in rs_list
-    }
     pods_by_key: dict[tuple[str, str], dict] = {}
     pods_by_owner: dict[tuple[str, str], dict[str, dict]] = {}
     for p in store.list("pods"):
         pmeta = _meta(p)
         ns = pmeta.get("namespace", "default")
         pods_by_key[(ns, pmeta["name"])] = p
-        owners = [
-            ref
-            for ref in pmeta.get("ownerReferences") or []
-            if ref.get("kind") == "ReplicaSet"
-        ]
-        # owner-reference GC (upstream garbage collector): pods whose
-        # owning ReplicaSet is gone are deleted before reconciling counts
-        if owners and all((ns, ref.get("name")) not in live_rs for ref in owners):
-            store.delete("pods", pmeta["name"], ns)
-            del pods_by_key[(ns, pmeta["name"])]
-            changed = True
-            continue
-        for ref in owners:
-            pods_by_owner.setdefault((ns, ref.get("name")), {})[
-                pmeta["name"]
-            ] = p
+        for ref in pmeta.get("ownerReferences") or []:
+            if ref.get("kind") == "ReplicaSet":
+                pods_by_owner.setdefault((ns, ref.get("name")), {})[
+                    pmeta["name"]
+                ] = p
     for rs in rs_list:
         meta = _meta(rs)
         ns = meta.get("namespace", "default")
         name = meta.get("name", "")
         spec = rs.get("spec", {}) or {}
-        want = int(spec.get("replicas", 1))
+        want = _replicas(spec)
+        if want is None:
+            continue  # malformed spec: skip, never wedge the loop
         template = spec.get("template", {}) or {}
         owned = pods_by_owner.get((ns, name), {})
         if len(owned) == want:

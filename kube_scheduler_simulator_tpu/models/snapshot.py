@@ -25,6 +25,12 @@ _KIND_TO_JSON = {
     "storageclasses": "storageClasses",
     "priorityclasses": "priorityClasses",
     "namespaces": "namespaces",
+    # extension keys beyond the reference wire (its snapshot has only the
+    # seven above): the workload kinds the controller subset manages.
+    # Extra top-level keys are ignored by consumers that don't know them,
+    # so reference-shaped snapshots stay importable both ways.
+    "deployments": "deployments",
+    "replicasets": "replicasets",
 }
 
 _STRIP_META = ("resourceVersion", "uid", "creationTimestamp", "managedFields", "generation")
@@ -84,6 +90,10 @@ def import_snapshot(
     _apply("storageclasses", snapshot.get("storageClasses"))
     _apply("pvcs", snapshot.get("pvcs"))
     _apply("nodes", snapshot.get("nodes"))
+    # workload owners before their pods (extension keys; absent in
+    # reference-shaped snapshots)
+    _apply("deployments", snapshot.get("deployments"))
+    _apply("replicasets", snapshot.get("replicasets"))
     _apply("pods", snapshot.get("pods"))
 
     # PVs last: re-link claimRef to the (re-created) PVC's new uid

@@ -210,9 +210,46 @@ class ResourceStore:
                 self._delete_locked(
                     "pods", meta.get("name", ""), meta.get("namespace", "default")
                 )
+        elif kind in ("deployments", "replicasets"):
+            # Owner cascade: deleting a workload object deletes what it
+            # owns (deployment → its ReplicaSets → their pods). In a real
+            # cluster the GC controller does this through ownerReferences;
+            # the reference's controller subset doesn't run it, so the
+            # cascade lives at the delete itself — deterministic, one
+            # shot, and never ambient (imported orphans are untouched).
+            child_kind = "replicasets" if kind == "deployments" else "pods"
+            owner_kind = "Deployment" if kind == "deployments" else "ReplicaSet"
+            doomed = [
+                c
+                for c in self._objs[child_kind].values()
+                if any(
+                    ref.get("kind") == owner_kind and ref.get("name") == name
+                    for ref in (c.get("metadata", {}) or {}).get(
+                        "ownerReferences"
+                    )
+                    or []
+                )
+                and (c.get("metadata", {}) or {}).get("namespace", "default")
+                == namespace
+            ]
+            for c in doomed:
+                meta = c.get("metadata", {})
+                self._delete_locked(
+                    child_kind,
+                    meta.get("name", ""),
+                    meta.get("namespace", "default"),
+                )
         return True
 
     # -- watch --------------------------------------------------------------
+
+    def count(self, kind: str) -> int:
+        """Object count without the deep copy `list` pays — the cheap
+        existence probe for controller early-exits."""
+        if kind not in KINDS:
+            raise KeyError(f"unknown kind {kind}")
+        with self._lock:
+            return len(self._objs[kind])
 
     def subscribe(self, fn: Callable[[WatchEvent], None]):
         with self._lock:

@@ -104,6 +104,11 @@ class SimulatorServer:
             self._thread.join(timeout=5)
 
     def maybe_schedule(self):
+        """Post-mutation convergence: the controller subset always runs
+        to fixpoint (the reference's continuously-running controllers —
+        POST a Deployment, GET its Pods), then a scheduling pass follows
+        when --auto-schedule is on."""
+        self.service.run_controllers()
         if self.auto_schedule and not self.service.scheduler.disabled:
             self.service.scheduler.schedule()
 

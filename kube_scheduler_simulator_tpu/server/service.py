@@ -351,6 +351,7 @@ class SimulatorService:
         external_scheduler_enabled: bool = False,
     ):
         self.store = ResourceStore()
+        self._controllers_lock = threading.Lock()
         self.external_scheduler_enabled = external_scheduler_enabled
         self.scheduler = SchedulerService(
             self.store, initial_config, disabled=external_scheduler_enabled
@@ -386,6 +387,31 @@ class SimulatorService:
                     )
                 )
             self._ext_seen[key] = bound
+
+    def run_controllers(self) -> int:
+        """Run the deterministic controller subset (deployment →
+        replicaset expansion, PV binding; controllers/steps.py) to a
+        fixpoint over the store. The reference's controller subset runs
+        CONTINUOUSLY against its apiserver (simulator/controller/
+        controller.go:31-46 — create a Deployment, get Pods); here the
+        serving shell invokes this after every resource mutation, which
+        is the same convergence expressed deterministically. Returns the
+        rounds executed (0 when nothing the controllers read exists —
+        the cheap early-exit that keeps bulk pod/node loads O(N)).
+        Fixpoints are serialized: concurrent request threads must not
+        interleave partial reconciles (one thread's freshly created pods
+        racing another's round)."""
+        store = self.store
+        if (
+            store.count("deployments") == 0
+            and store.count("replicasets") == 0
+            and (store.count("pvcs") == 0 or store.count("pvs") == 0)
+        ):
+            return 0
+        from ..controllers.steps import run_to_fixpoint
+
+        with self._controllers_lock:
+            return run_to_fixpoint(store)
 
     # -- export / import / reset -------------------------------------------
 

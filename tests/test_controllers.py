@@ -97,6 +97,51 @@ class TestDeploymentReplicaSet:
             req = p["spec"]["containers"][0]["resources"]["requests"]
             assert req["cpu"] == "200m"
 
+    def test_orphaned_pods_left_alone_no_ambient_gc(self):
+        """Pods carrying ownerReferences to an absent ReplicaSet must
+        survive reconciles (the reference's controller subset runs no
+        garbage collector; ambient GC destroyed imported snapshots)."""
+        store = ResourceStore()
+        store.apply(
+            "pods",
+            {
+                "metadata": {
+                    "name": "adopted",
+                    "namespace": "default",
+                    "ownerReferences": [
+                        {"kind": "ReplicaSet", "name": "long-gone"}
+                    ],
+                },
+                "spec": {"containers": [{"name": "c"}]},
+            },
+        )
+        run_to_fixpoint(store)
+        assert store.get("pods", "adopted") is not None
+
+    def test_delete_deployment_cascades_via_store(self):
+        store = ResourceStore()
+        store.apply("deployments", deployment("web", 2))
+        run_to_fixpoint(store)
+        assert len(store.list("pods")) == 2
+        store.delete("deployments", "web", "default")
+        assert store.list("replicasets") == []
+        assert store.list("pods") == []
+
+    def test_malformed_replicas_skipped(self):
+        store = ResourceStore()
+        d = deployment("bad", 2)
+        d["spec"]["replicas"] = None
+        store.apply("deployments", d)
+        rounds = run_to_fixpoint(store)  # must not raise
+        assert rounds >= 1
+        assert store.list("replicasets") == []
+        # string digits are tolerated (YAML hand-edits)
+        d2 = deployment("ok", 2)
+        d2["spec"]["replicas"] = "2"
+        store.apply("deployments", d2)
+        run_to_fixpoint(store)
+        assert len(store.list("pods")) == 2
+
     def test_determinism_two_runs_identical(self):
         def run():
             store = ResourceStore()
