@@ -1,0 +1,61 @@
+"""The examples/ specs must stay runnable: snapshot imports cleanly and
+schedules, the scenario and sweep specs run to Succeeded through the
+batch runner (the same path the HTTP /api/v1/scenario route uses)."""
+
+import json
+import os
+
+from kube_scheduler_simulator_tpu.scenario.batch import load_jobs, run_batch
+from kube_scheduler_simulator_tpu.server.service import SimulatorService
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+def test_snapshot_imports_and_schedules():
+    with open(os.path.join(EXAMPLES, "snapshot.json")) as f:
+        snap = json.load(f)
+    svc = SimulatorService()
+    errors = svc.import_(snap, ignore_err=True)
+    assert errors == []
+    # the deployment extension key expands through the controllers
+    svc.run_controllers()
+    pods = svc.store.list("pods")
+    names = {p["metadata"]["name"] for p in pods}
+    assert {"web-a", "web-b", "batch-1"} <= names
+    assert sum(1 for n in names if n.startswith("workers-")) == 3
+    results = svc.scheduler.schedule()
+    by_name = {r.pod_name: r for r in results}
+    # the nodeSelector-pinned critical pod lands on the big node
+    assert by_name["batch-1"].selected_node == "big-0"
+    assert all(
+        r.status == "Scheduled" for r in results
+    ), [(r.pod_name, r.status) for r in results]
+    # the PV controller bound the claim
+    assert svc.store.get("pvs", "vol-0")["spec"]["claimRef"]["name"] == "data"
+
+
+def test_scenario_and_sweep_examples_run(tmp_path):
+    jobs = load_jobs(os.path.join(EXAMPLES, "jobs"))
+    by_name = {j.name: j for j in jobs}
+    assert {"scenario", "sweep"} <= set(by_name)
+    assert len(jobs) == 2  # snapshot.json must NOT be picked up as a job
+    results = run_batch(jobs, out_dir=str(tmp_path))
+    assert results["scenario"]["phase"] == "Succeeded", results["scenario"]
+    # the scenario really exercised preemption + the deployment
+    t = results["scenario"]["timeline"]
+    assert any(
+        e["type"] == "Delete" and e["payload"].get("reason") == "preempted"
+        for e in t["1"]
+    )
+    summary = results["scenario"]["summary"]
+    assert summary["pods"]["preempted"] == 1
+    assert summary["pods"]["pending"] == 0
+    # sweep: four variants, everything placed in each
+    sweep = results["sweep"]
+    assert sweep["phase"] == "Succeeded"
+    assert len(sweep["variants"]) == 4
+    for v in sweep["variants"]:
+        assert v["scheduled"] == 4 and v["unschedulable"] == 0
+    # result files landed (KEP-184 file contract)
+    assert (tmp_path / "scenario.result.json").exists()
+    assert (tmp_path / "sweep.result.json").exists()
