@@ -114,14 +114,17 @@ class GangScheduler:
     ):
         """loop="dynamic" (default) runs rounds under `lax.while_loop`
         until a round commits nothing. loop="static" runs a FIXED number
-        of rounds (`static_rounds`, default 4*ceil(P/N)+8) as a
-        `lax.scan` — rounds past the fixpoint are no-ops. Static mode
-        trades wasted no-op rounds for counted-loop-only control flow
-        (the same structure as the sequential engine's scan, which is
-        known to compile on backends where dynamic-condition loops have
-        not been observed to). If `static_rounds` is too small for a
-        pathological workload, the leftover pods simply stay pending —
-        check `placements()` / raise `static_rounds`.
+        of rounds (`static_rounds`, default ceil(P/N)+4) as a `lax.scan`
+        — counted-loop-only control flow, the same structure as the
+        sequential engine's scan, which is known to compile on backends
+        where dynamic-condition loops have not been observed to. A
+        static pass that spends its whole budget with the last round
+        still committing AUTO-RESUMES: `run()` executes another pass of
+        the same compiled program from the reached state, so the
+        per-pass budget bounds wasted no-op rounds (at most ~budget
+        past the fixpoint) without ever starving a workload — the
+        budget is a quantum, not a cap. An explicit `max_rounds` caps
+        the per-pass budget too.
 
         With equal `inner_iters` the two modes place identically (the
         extra static iterations/rounds are provably no-ops); a SMALLER
@@ -137,11 +140,14 @@ class GangScheduler:
             raise ValueError(f"loop must be dynamic|static, got {loop!r}")
         self.loop = loop
         if static_rounds is None:
-            # honor an explicit max_rounds as the static budget too
+            # honor an explicit max_rounds as the static budget too.
+            # Default per-pass quantum: ~max-pods-per-node rounds plus
+            # slack — enough for typical fixpoints in ONE pass; heavy
+            # skew just triggers auto-resume passes of the same program.
             static_rounds = (
                 max_rounds
                 if max_rounds is not None
-                else 4 * (-(-enc.P // max(1, enc.N))) + 8
+                else (-(-enc.P // max(1, enc.N))) + 4
             )
         self.static_rounds = int(static_rounds)
         # Reuse the sequential engine's compiled-kernel construction and
@@ -166,11 +172,6 @@ class GangScheduler:
         )
         self._final_state = None
         self._rounds = None
-        # static-loop exhaustion signal (see run()): True when a static
-        # pass used its entire round budget with the last round still
-        # committing — leftover pending pods may be budget, not
-        # infeasibility. Callers reading placements() should check this.
-        self.exhausted = False
 
     # -- host-side queue encoding ------------------------------------------
 
@@ -445,28 +446,65 @@ class GangScheduler:
     def run(self, weights: "jnp.ndarray | None" = None):
         """Execute to fixpoint; returns (final_state, rounds_used).
 
+        Static loop mode auto-resumes: a pass whose whole round budget
+        committed (no-op rounds form a suffix, so a pass's rounds ==
+        budget means its final budgeted round still made progress) runs
+        another pass of the same compiled program from the reached
+        state, until a pass reaches its fixpoint mid-budget — the old
+        under-budget starvation trap (ADVICE r3) is structurally
+        impossible, so there is no `exhausted` flag anymore. An
+        infeasible remainder that coincides with an exactly-full budget
+        costs at most one extra (no-commit) pass, the same price
+        dynamic mode pays for its final empty round.
+
         With DefaultPreemption enabled the fixpoint alternates with
         preempt phases: rounds settle → the (few) still-pending pods go
         through the compiled sequential preempt pass → rounds resume;
-        the host loop stops when a phase binds nothing. Sets
-        `self.exhausted` when a static-loop pass spent its whole round
-        budget with the final round still committing (leftover pending
-        pods may then be under-budgeting, not infeasibility)."""
+        the host loop stops when a phase binds nothing."""
         w = self.weights if weights is None else weights
         order, in_q = self.order_arrays()
         arrays = self.enc.arrays
-        eligible = np.asarray(in_q) & np.asarray(arrays.pod_mask)
-        last_exhausted = False
+        # the eligibility mask feeds host-side pending counts, which only
+        # the static auto-resume and the preempt-phase loop read — the
+        # plain dynamic path must not pay the two [P] host transfers
+        need_pending = self.loop == "static" or self._preempt_phase is not None
+        eligible = (
+            np.asarray(in_q) & np.asarray(arrays.pod_mask)
+            if need_pending
+            else None
+        )
+
+        def pending_count(state) -> int:
+            return int(((np.asarray(state.assignment) < 0) & eligible).sum())
 
         def gang_pass(state):
-            nonlocal last_exhausted
             state, rounds = self._run(arrays, state, order, w)
-            # no-op rounds form a suffix, so sum == budget means the
-            # last budgeted round still committed (ADVICE r3)
-            last_exhausted = self.loop == "static" and int(
-                np.asarray(rounds)
-            ) >= self.static_rounds
-            return state, rounds
+            if self.loop != "static":
+                return state, rounds
+            # static auto-resume: continue while the LAST pass used its
+            # whole budget (fixpoint not provably reached) and pods are
+            # still pending; a pass without progress means the remainder
+            # is infeasible, not under-budgeted. An EXPLICIT max_rounds
+            # stays a TOTAL cap across passes, matching its hard-cap role
+            # in the dynamic loop — never an unbounded-latency trap.
+            total = rounds
+            committed = last = int(np.asarray(rounds))
+            pend = pending_count(state)
+            while (
+                pend > 0
+                and last >= self.static_rounds
+                and (self.max_rounds is None or committed < self.max_rounds)
+            ):
+                state2, r2 = self._run(arrays, state, order, w)
+                total = total + r2
+                last = int(np.asarray(r2))
+                committed += last
+                pend2 = pending_count(state2)
+                state = state2
+                if pend2 >= pend:
+                    break
+                pend = pend2
+            return state, total
 
         state, rounds = gang_pass(self.enc.state0)
         if self._preempt_phase is not None:
@@ -489,23 +527,6 @@ class GangScheduler:
                     break
                 state, r2 = gang_pass(state)
                 rounds = rounds + r2
-        # the flag describes the FINAL state: only the last pass's budget
-        # matters, and only while pods actually remain pending — a budget
-        # spent on the way to a complete schedule is not exhaustion
-        still_pending = bool(
-            ((np.asarray(state.assignment) < 0) & eligible).any()
-        )
-        self.exhausted = last_exhausted and still_pending
-        if self.exhausted:
-            import warnings
-
-            warnings.warn(
-                "gang static round budget exhausted with the last round "
-                "still committing; leftover pending pods may need a larger "
-                "static_rounds",
-                RuntimeWarning,
-                stacklevel=2,
-            )
         self._final_state = state
         self._rounds = rounds
         return state, rounds
