@@ -111,6 +111,8 @@ class GangScheduler:
         inner_iters: int = 64,
         loop: str = "dynamic",
         static_rounds: "int | None" = None,
+        match_width: "int | None" = None,
+        compact: bool = True,
     ):
         """loop="dynamic" (default) runs rounds under `lax.while_loop`
         until a round commits nothing. loop="static" runs a FIXED number
@@ -130,12 +132,47 @@ class GangScheduler:
         extra static iterations/rounds are provably no-ops); a SMALLER
         static `inner_iters` is a different matching depth — losers past
         it retry in a later round against updated state, which can
-        change placements (still valid, just a different greedy order)."""
+        change placements (still valid, just a different greedy order).
+
+        `match_width` bounds each pod's per-round candidate list: the
+        matching runs over the pod's top-`match_width` scoring feasible
+        nodes (one `lax.top_k` per round) instead of the full [P, N]
+        matrix. This is the same kind of depth bound as `inner_iters` —
+        a pod whose whole candidate list is consumed by earlier-order
+        winners waits for the next round's fresh evaluation instead of
+        falling back to its (k+1)-th choice — and placements are
+        identical to full-width matching whenever every pod commits
+        within its k candidates (always true when k == N; `lax.top_k`
+        breaks score ties toward lower node indices, matching argmax).
+        It exists because the full-width matching program is what the
+        experimental axon TPU backend could not compile at the 10k x 1k
+        BASELINE shape (the [P, N] select/argmax chain per inner
+        iteration); top-k keeps the inner loop at [P, k]. Default: full
+        width for N <= 512, else 128.
+
+        `compact` (default True) makes each round evaluate only chunks
+        that contain still-pending pods: pods are permuted pending-first
+        (stable argsort of the pending mask) and settled chunks return
+        floor rows through a `lax.cond` — placements are bit-identical
+        (settled pods' scores are masked out either way), but total
+        evaluation work drops from rounds x P to ~sum of per-round
+        pending counts (~P^2/2N on uniform workloads). Turn it off under
+        `vmap` (GangSweep does): vmapped `cond` lowers to both-branches
+        select, so there is nothing to skip."""
         self.enc = enc
         self.chunk = int(chunk)
         # fallback depth of the per-round matching: how many next-best
         # hops a loser may take before waiting for a fresh evaluation
         self.inner_iters = int(inner_iters)
+        if match_width is None:
+            # scalable-by-default on EVERY backend (not an axon gate):
+            # a uniform default keeps placements backend-independent,
+            # and the depth bound is the same sanctioned semantics as
+            # inner_iters — a pod that exhausts 128 candidates in one
+            # round waits for the next round's fresh evaluation
+            match_width = enc.N if enc.N <= 512 else 128
+        self.match_width = max(1, min(int(match_width), enc.N))
+        self.compact = bool(compact)
         if loop not in ("dynamic", "static"):
             raise ValueError(f"loop must be dynamic|static, got {loop!r}")
         self.loop = loop
@@ -198,6 +235,7 @@ class GangScheduler:
         attempt = self._base._attempt
         max_rounds = self.max_rounds if self.max_rounds is not None else P + 1
         inner_iters = self.inner_iters
+        MW = self.match_width
         static = self.loop == "static"
         # sentinel strictly below any reachable total score (engine.py
         # uses the same NEG for infeasible nodes); also used to mask
@@ -205,7 +243,9 @@ class GangScheduler:
         NEG = jnp.iinfo(enc.policy.score).min // 2
         FLOOR = NEG
 
-        def eval_all(state, a, weights):
+        compact = self.compact
+
+        def eval_all(state, a, weights, pending):
             """[P, N] masked total scores (NEG where infeasible),
             evaluated against `state`.
 
@@ -213,9 +253,14 @@ class GangScheduler:
             [CH, N, plugins] instead of [P, N, plugins]; XLA dead-code
             eliminates the unused attempt outputs (codes/raw/final), so
             only the masked score row survives per pod.
+
+            Compaction (`compact`): pods ride through the chunks in
+            pending-first order (stable argsort), and a chunk whose
+            pods are all settled short-circuits to floor rows via
+            `lax.cond` — later rounds pay for their pending count, not
+            for P. Settled pods' rows are floor either way (the caller
+            masks on `pending`), so placements cannot depend on it.
             """
-            ps = jnp.arange(P_pad, dtype=jnp.int32) % P
-            ps = ps.reshape(n_chunks, CH)
 
             def one_pod(state, a, weights, p):
                 _, codes, raw, final, _, pf_ok = attempt(state, a, weights, p)
@@ -225,12 +270,58 @@ class GangScheduler:
                 )
                 return jnp.where(feasible, total, NEG)
 
-            def one_chunk(pc):
-                return jax.vmap(
-                    lambda p: one_pod(state, a, weights, p)
-                )(pc)
+            if not compact:
+                ps = jnp.arange(P_pad, dtype=jnp.int32) % P
+                ps = ps.reshape(n_chunks, CH)
 
-            return jax.lax.map(one_chunk, ps).reshape(P_pad, N)[:P]
+                def one_chunk(pc):
+                    return jax.vmap(
+                        lambda p: one_pod(state, a, weights, p)
+                    )(pc)
+
+                return jax.lax.map(one_chunk, ps).reshape(P_pad, N)[:P]
+
+            # pending-first permutation; padding rows scatter to row P
+            # of a [P+1]-row buffer so they can never clobber a pod row
+            row_dt = jax.eval_shape(
+                lambda s, aa, w: one_pod(s, aa, w, jnp.int32(0)),
+                state, a, weights,
+            ).dtype
+            perm = jnp.argsort(~pending).astype(jnp.int32)
+            n_pending = pending.sum()
+            if P_pad > P:
+                rows = jnp.concatenate(
+                    [perm, jnp.full((P_pad - P,), jnp.int32(P))]
+                )
+                pods_in = jnp.concatenate([perm, perm[: P_pad - P]])
+            else:
+                rows = perm
+                pods_in = perm
+            ps = pods_in.reshape(n_chunks, CH)
+
+            def one_chunk(args):
+                i, pc = args
+
+                def live(_):
+                    return jax.vmap(
+                        lambda p: one_pod(state, a, weights, p)
+                    )(pc)
+
+                def settled(_):
+                    return jnp.full((CH, N), NEG, row_dt)
+
+                return jax.lax.cond(
+                    i * CH < n_pending, live, settled, None
+                )
+
+            flat = jax.lax.map(
+                one_chunk, (jnp.arange(n_chunks, dtype=jnp.int32), ps)
+            ).reshape(P_pad, N)
+            return (
+                jnp.full((P + 1, N), NEG, row_dt)
+                .at[rows]
+                .set(flat)[:P]
+            )
 
         def bind_all(state, a, mask, sel, order):
             """Scatter-bind every masked pod to its selected node in one
@@ -320,16 +411,24 @@ class GangScheduler:
             C = arrays.pod_claim.shape[1]
             pod_claim = arrays.pod_claim.astype(bool)
 
-            def match_step(taken, claim_taken, sel_acc, scores):
+            def match_step(taken, claim_taken, sel_acc, vals, idx):
                 """One matching iteration (shared by both loop modes):
-                argmax over untaken nodes → per-node order winner →
-                per-claim order winner → commit."""
-                m = jnp.where(taken[None, :], FLOOR, scores)
+                argmax over untaken candidates → per-node order winner →
+                per-claim order winner → commit. `vals`/`idx` are the
+                [P, K] top-k candidate scores/node-indices (idx is None
+                in full-width mode, where column position == node)."""
+                node_taken = taken[idx] if idx is not None else taken[None, :]
+                m = jnp.where(node_taken, FLOOR, vals)
                 m = jnp.where((sel_acc >= 0)[:, None], FLOOR, m)
                 claim_blocked = (pod_claim & claim_taken[None, :]).any(axis=1)
                 m = jnp.where(claim_blocked[:, None], FLOOR, m)
-                cand = jnp.argmax(m, axis=1).astype(jnp.int32)
-                has = jnp.take_along_axis(m, cand[:, None], axis=1)[:, 0] > NEG
+                col = jnp.argmax(m, axis=1).astype(jnp.int32)
+                has = jnp.take_along_axis(m, col[:, None], axis=1)[:, 0] > NEG
+                cand = (
+                    jnp.take_along_axis(idx, col[:, None], axis=1)[:, 0]
+                    if idx is not None
+                    else col
+                )
                 tgt = jnp.where(has, cand, N)
                 winner = (
                     jnp.full((N + 1,), _NO_ORDER, jnp.int32).at[tgt].min(order)
@@ -368,7 +467,16 @@ class GangScheduler:
                 every claim it uses, and consumed claims knock their
                 other claimants out of the rest of the round (next
                 round's evaluation sees used_claims > 0 and rejects them
-                exactly like the sequential engine)."""
+                exactly like the sequential engine).
+
+                With `match_width` < N the iteration runs over each
+                pod's top-k candidate columns instead of all N nodes
+                (see __init__ docstring)."""
+                if MW < N:
+                    vals, idx = jax.lax.top_k(scores, MW)
+                    idx = idx.astype(jnp.int32)
+                else:
+                    vals, idx = scores, None
                 taken0 = jnp.zeros((N,), bool)
                 claims0 = jnp.zeros((C,), bool)
                 sel0 = jnp.full((P,), -1, jnp.int32)
@@ -378,7 +486,7 @@ class GangScheduler:
                     def m_scan(carry, _):
                         taken, claim_taken, sel_acc = carry
                         taken, claim_taken, sel_acc, _ = match_step(
-                            taken, claim_taken, sel_acc, scores
+                            taken, claim_taken, sel_acc, vals, idx
                         )
                         return (taken, claim_taken, sel_acc), None
 
@@ -397,7 +505,7 @@ class GangScheduler:
                 def m_body(c):
                     taken, claim_taken, sel_acc, _, it = c
                     taken, claim_taken, sel_acc, changed = match_step(
-                        taken, claim_taken, sel_acc, scores
+                        taken, claim_taken, sel_acc, vals, idx
                     )
                     return taken, claim_taken, sel_acc, changed, it + jnp.int32(1)
 
@@ -409,8 +517,8 @@ class GangScheduler:
                 return sel_acc
 
             def round_once(state):
-                scores = eval_all(state, arrays, weights)
                 pending = (state.assignment < 0) & in_queue & arrays.pod_mask
+                scores = eval_all(state, arrays, weights, pending)
                 scores = jnp.where(pending[:, None], scores, FLOOR)
                 sel = match(scores)
                 commit = sel >= 0

@@ -118,7 +118,10 @@ class GangSweep:
 
         self.enc = enc
         self.mesh = mesh
-        self.gang = GangScheduler(enc, chunk=chunk)
+        # compact=False: the per-round pending-compaction rides on
+        # lax.cond, which vmap lowers to both-branches select — under a
+        # variant vmap there is nothing to skip, so don't carry the cond
+        self.gang = GangScheduler(enc, chunk=chunk, compact=False)
         self._vrun = jax.jit(
             jax.vmap(self.gang.run_fn, in_axes=(None, None, None, 0))
         )

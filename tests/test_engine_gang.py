@@ -450,6 +450,106 @@ def test_gang_sweep_runs_preemption_per_variant(use_mesh):
         assert all(d[("default", f"high-{i}")] != "" for i in range(3))
 
 
+def test_match_width_topk_uncontended_equals_full():
+    # pinned pods: every pod commits on its single feasible node, so even
+    # the narrowest candidate list (k=1) must reproduce full-width
+    # matching (and therefore the sequential engine) exactly
+    nodes = [node(f"n{i}", labels={"k": f"v{i}"}) for i in range(6)]
+    pods = [pod(f"p{i}", node_selector={"k": f"v{i}"}) for i in range(6)]
+    cfg = restricted_config(
+        filters=("NodeUnschedulable", "NodeName", "NodeAffinity", "NodeResourcesFit"),
+    )
+    enc = encode_cluster(nodes, pods, cfg, policy=EXACT)
+    narrow = GangScheduler(enc, match_width=1)
+    full = GangScheduler(enc, match_width=len(nodes))
+    assert narrow.match_width == 1 and full.match_width == 6
+    assert _placements(narrow) == _placements(full)
+
+
+def test_match_width_topk_contended_invariants():
+    # contended random cluster with a narrow candidate list: losers whose
+    # whole list is consumed wait a round (documented depth semantics) —
+    # the fixpoint must still fill the cluster exactly as deep as
+    # full-width matching does (feasibility at fixpoint is depth-
+    # independent on a resources config), deterministically
+    rng = np.random.default_rng(9)
+    nodes = [node(f"n{i}", cpu=str(2 + int(rng.integers(3)))) for i in range(8)]
+    pods = [
+        pod(f"p{i}", cpu=f"{int(rng.integers(200, 900))}m") for i in range(40)
+    ]
+    cfg = restricted_config()
+    enc = encode_cluster(nodes, pods, cfg, policy=EXACT)
+    topk = GangScheduler(enc, chunk=16, match_width=2)
+    full = GangScheduler(enc, chunk=16)
+    g, f = _placements(topk), _placements(full)
+    assert sum(1 for v in g.values() if v) == sum(1 for v in f.values() if v)
+    assert g == _placements(GangScheduler(enc, chunk=16, match_width=2))
+    # static loop with the same width places like its own dynamic loop
+    stat = GangScheduler(
+        enc, chunk=16, match_width=2, loop="static", inner_iters=64
+    )
+    assert _placements(stat) == g
+
+
+def test_match_width_rwop_claims():
+    # the per-claim conflict resolution must survive the top-k rewrite
+    from test_engine_parity_vol import claim_vol, pv, pvc, vol_config
+
+    nodes = [node("n0"), node("n1")]
+    pods = [
+        pod("first", priority=10, volumes=[claim_vol("solo")]),
+        pod("second", priority=1, volumes=[claim_vol("solo")]),
+    ]
+    kw = dict(
+        pvcs=[pvc("solo", modes=("ReadWriteOncePod",), volume_name="pv-s")],
+        pvs=[pv("pv-s")],
+    )
+    enc = encode_cluster(nodes, pods, vol_config(), policy=EXACT, **kw)
+    got = _placements(GangScheduler(enc, match_width=1))
+    assert got[("default", "first")] != ""
+    assert got[("default", "second")] == ""
+
+
+def test_compact_eval_is_bit_identical():
+    # pending-compaction is a pure execution-cost optimization: the same
+    # cluster through compact and non-compact programs (both loop modes)
+    # must produce identical assignments
+    rng = np.random.default_rng(17)
+    nodes = [node(f"n{i}", cpu=str(2 + int(rng.integers(3)))) for i in range(6)]
+    pods = [
+        pod(f"p{i}", cpu=f"{int(rng.integers(200, 800))}m") for i in range(30)
+    ]
+    cfg = restricted_config()
+    enc = encode_cluster(nodes, pods, cfg, policy=EXACT)
+    for loop in ("dynamic", "static"):
+        on = GangScheduler(enc, chunk=8, loop=loop, compact=True)
+        off = GangScheduler(enc, chunk=8, loop=loop, compact=False)
+        assert _placements(on) == _placements(off), loop
+        np.testing.assert_array_equal(
+            np.asarray(on._final_state.assignment),
+            np.asarray(off._final_state.assignment),
+        )
+
+
+def test_compact_eval_with_preemption_phase():
+    # compaction + the preempt phase: the phase hands back a state whose
+    # pending set shrank mid-pass — placements must match the sequential
+    # engine on the all-pods-need-eviction shape regardless of compact
+    nodes = [node(f"n{i}", cpu="2", pods="8") for i in range(4)]
+    pods = [
+        pod(f"low-{i}", cpu="1500m", priority=1, node_name=f"n{i}")
+        for i in range(4)
+    ] + [pod(f"high-{i}", cpu="1200m", priority=100) for i in range(3)]
+    cfg = _preempt_cfg()
+    gang = GangScheduler(
+        encode_cluster(nodes, pods, cfg, policy=EXACT), compact=True
+    )
+    seq = BatchedScheduler(
+        encode_cluster(nodes, pods, cfg, policy=EXACT), record=False
+    )
+    assert _placements(gang) == _placements(seq)
+
+
 def test_static_budget_auto_resumes():
     """A small static budget is a per-pass quantum, not a cap: run()
     auto-resumes exhausted passes of the same compiled program until the
