@@ -113,6 +113,7 @@ class GangScheduler:
         static_rounds: "int | None" = None,
         match_width: "int | None" = None,
         compact: bool = True,
+        inner_loop: "str | None" = None,
     ):
         """loop="dynamic" (default) runs rounds under `lax.while_loop`
         until a round commits nothing. loop="static" runs a FIXED number
@@ -150,6 +151,20 @@ class GangScheduler:
         iteration); top-k keeps the inner loop at [P, k]. Default: full
         width for N <= 512, else 128.
 
+        `inner_loop` picks the matching iteration's control flow
+        independently of the outer loop: None (default) follows `loop`;
+        "dynamic" runs the matching as a `lax.while_loop` that exits as
+        soon as an iteration commits nothing — with equal `inner_iters`
+        placements are identical to the scan form (post-settle
+        iterations are provably no-ops), but the round stops paying for
+        them. The split exists because the matching scan is the round's
+        LATENCY floor on real TPU hardware (64 dependent iterations of
+        small selects, ~whole-round wall time at the bench shape), while
+        the outer static scan is what makes the program compile on the
+        experimental axon backend at all — `loop="static",
+        inner_loop="dynamic"` keeps the outer program counted and lets
+        each round's matching quit early.
+
         `compact` (default True) makes each round evaluate only chunks
         that contain still-pending pods: pods are permuted pending-first
         (stable argsort of the pending mask) and settled chunks return
@@ -176,6 +191,13 @@ class GangScheduler:
         if loop not in ("dynamic", "static"):
             raise ValueError(f"loop must be dynamic|static, got {loop!r}")
         self.loop = loop
+        if inner_loop is None:
+            inner_loop = loop
+        if inner_loop not in ("dynamic", "static"):
+            raise ValueError(
+                f"inner_loop must be dynamic|static|None, got {inner_loop!r}"
+            )
+        self.inner_loop = inner_loop
         if static_rounds is None:
             # honor an explicit max_rounds as the static budget too.
             # Default per-pass quantum: ~max-pods-per-node rounds plus
@@ -237,6 +259,7 @@ class GangScheduler:
         inner_iters = self.inner_iters
         MW = self.match_width
         static = self.loop == "static"
+        inner_static = self.inner_loop == "static"
         # sentinel strictly below any reachable total score (engine.py
         # uses the same NEG for infeasible nodes); also used to mask
         # non-pending pods and taken nodes during the inner matching
@@ -480,7 +503,7 @@ class GangScheduler:
                 taken0 = jnp.zeros((N,), bool)
                 claims0 = jnp.zeros((C,), bool)
                 sel0 = jnp.full((P,), -1, jnp.int32)
-                if static:
+                if inner_static:
                     # counted loop: iterations after the matching settles
                     # are no-ops (nothing commits twice)
                     def m_scan(carry, _):

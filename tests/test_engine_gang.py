@@ -510,6 +510,39 @@ def test_match_width_rwop_claims():
     assert got[("default", "second")] == ""
 
 
+def test_hybrid_inner_loop_matches_pure_static():
+    # static outer scan + while-loop matching (the chip-latency hybrid):
+    # equal inner depth => identical placements to the all-scan program
+    rng = np.random.default_rng(23)
+    nodes = [node(f"n{i}", cpu=str(2 + int(rng.integers(3)))) for i in range(6)]
+    pods = [
+        pod(f"p{i}", cpu=f"{int(rng.integers(200, 800))}m") for i in range(30)
+    ]
+    cfg = restricted_config()
+    enc = encode_cluster(nodes, pods, cfg, policy=EXACT)
+    pure = GangScheduler(enc, chunk=8, loop="static", inner_iters=12)
+    hybrid = GangScheduler(
+        enc, chunk=8, loop="static", inner_iters=12, inner_loop="dynamic"
+    )
+    assert hybrid.inner_loop == "dynamic" and hybrid.loop == "static"
+    assert _placements(pure) == _placements(hybrid)
+    # and the preemption phase still composes
+    nodes2 = [node(f"m{i}", cpu="2", pods="8") for i in range(4)]
+    pods2 = [
+        pod(f"low-{i}", cpu="1500m", priority=1, node_name=f"m{i}")
+        for i in range(4)
+    ] + [pod(f"high-{i}", cpu="1200m", priority=100) for i in range(3)]
+    cfg2 = _preempt_cfg()
+    hyb2 = GangScheduler(
+        encode_cluster(nodes2, pods2, cfg2, policy=EXACT),
+        loop="static", inner_loop="dynamic",
+    )
+    seq2 = BatchedScheduler(
+        encode_cluster(nodes2, pods2, cfg2, policy=EXACT), record=False
+    )
+    assert _placements(hyb2) == _placements(seq2)
+
+
 def test_compact_eval_is_bit_identical():
     # pending-compaction is a pure execution-cost optimization: the same
     # cluster through compact and non-compact programs (both loop modes)
