@@ -618,6 +618,8 @@ class GangScheduler:
             # is infeasible, not under-budgeted. An EXPLICIT max_rounds
             # stays a TOTAL cap across passes, matching its hard-cap role
             # in the dynamic loop — never an unbounded-latency trap.
+            # (parallel/sweep.py gang_pass carries the per-variant-array
+            # form of this rule — keep the two in step.)
             total = rounds
             committed = last = int(np.asarray(rounds))
             pend = pending_count(state)

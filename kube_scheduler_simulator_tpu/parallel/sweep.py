@@ -113,15 +113,22 @@ class GangSweep:
     the host loop continues until no variant makes progress."""
 
     def __init__(self, enc: EncodedCluster, *, mesh: "Mesh | None" = None,
-                 chunk: int = 256):
+                 chunk: int = 256, loop: str = "dynamic"):
         from ..engine.gang import GangScheduler
 
         self.enc = enc
         self.mesh = mesh
         # compact=False: the per-round pending-compaction rides on
         # lax.cond, which vmap lowers to both-branches select — under a
-        # variant vmap there is nothing to skip, so don't carry the cond
-        self.gang = GangScheduler(enc, chunk=chunk, compact=False)
+        # variant vmap there is nothing to skip, so don't carry the cond.
+        # loop="static" vmaps the counted-loop variant (scans only — the
+        # control-flow class that compiles on the experimental axon TPU
+        # backend); run() re-invokes the pass while any variant spent its
+        # whole round budget still committing, the vmapped form of the
+        # single-variant auto-resume (finished variants ride along as
+        # no-ops), so the budget stays a quantum, not a cap.
+        self.loop = loop
+        self.gang = GangScheduler(enc, chunk=chunk, compact=False, loop=loop)
         self._vrun = jax.jit(
             jax.vmap(self.gang.run_fn, in_axes=(None, None, None, 0))
         )
@@ -168,8 +175,47 @@ class GangSweep:
             wj = jax.device_put(
                 wj, NamedSharding(self.mesh, P("replicas", None))
             )
-        arrays, _, order = self._args
-        states, rounds = self._vrun(*self._args, wj)
+        arrays, state0, order = self._args
+
+        def pending_counts(st) -> np.ndarray:
+            assigns = np.asarray(st.assignment)  # [V, P]
+            return ((assigns < 0) & self._eligible[None, :]).sum(axis=1)
+
+        def gang_pass(st, *, initial: bool):
+            """One vmapped gang invocation; in static mode, auto-resume
+            passes while any variant spent its whole budget still
+            committing (the vmapped single-variant resume rule) and the
+            total pending count still shrinks.
+
+            This is the per-variant-array form of GangScheduler.run's
+            scalar resume loop (engine/gang.py) — keep the two rules in
+            step when changing either; the correctness argument (no-op
+            rounds form a suffix, pending is monotone under bind-only
+            rounds) lives there. GangSweep offers no max_rounds, so the
+            scalar loop's explicit total-cap clause has no counterpart
+            here."""
+            if initial:
+                st, r = self._vrun(arrays, state0, order, wj)
+            else:
+                st, r = self._vrun_resume(arrays, st, order, wj)
+            if self.loop != "static":
+                return st, r
+            budget = self.gang.static_rounds
+            total = r
+            last = np.asarray(r)
+            pend = pending_counts(st)
+            while (last >= budget).any() and pend.sum() > 0:
+                st2, r2 = self._vrun_resume(arrays, st, order, wj)
+                total = total + r2
+                last = np.asarray(r2)
+                pend2 = pending_counts(st2)
+                st = st2
+                if pend2.sum() >= pend.sum():
+                    break
+                pend = pend2
+            return st, total
+
+        states, rounds = gang_pass(None, initial=True)
         while self._vphase is not None:
             assigns = np.asarray(states.assignment)  # [V, P]
             pend = [
@@ -193,7 +239,7 @@ class GangSweep:
             states, n_bound = self._vphase(arrays, states, segs_j, order, wj)
             if int(np.asarray(n_bound).sum()) == 0:
                 break
-            states, r2 = self._vrun_resume(arrays, states, order, wj)
+            states, r2 = gang_pass(states, initial=False)
             rounds = rounds + r2
         return states.assignment, rounds
 
