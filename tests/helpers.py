@@ -26,6 +26,8 @@ def pod(name, cpu="100m", mem="128Mi", ns="default", labels=None, node_name=None
             containers.append({"name": f"c{i}", "image": img})
         if cpu or mem:
             containers[0]["resources"] = {"requests": {"cpu": cpu, "memory": mem}}
+        if ports:
+            containers[0]["ports"] = ports
     else:
         c = {"name": "c", "resources": {"requests": {"cpu": cpu, "memory": mem}}}
         if ports:

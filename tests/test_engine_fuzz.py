@@ -1,0 +1,141 @@
+"""Randomized cross-feature parity fuzz: oracle vs engine, full default set.
+
+Each seed generates a cluster mixing resources, zone/disk labels, node
+selectors, pod affinity/anti-affinity, taints + tolerations, priorities
+(preemption pressure included — the full default set enables
+DefaultPreemption), topology spread, host ports, and image locality, then
+asserts the vectorized engine reproduces the sequential oracle's complete
+13-annotation wire record for every pod (`assert_parity`).
+
+Random workloads are the cheap defense against correlated misreadings
+between the oracle and the kernels (VERDICT r3 weak #4): both sides share
+one author's reading of upstream, and hand-written cases only pin the
+interactions that author thought of. Seeds are fixed so failures
+reproduce; when one fails, minimize it into a named case in the relevant
+test_engine_parity_* file.
+"""
+
+import random
+
+import pytest
+
+from kube_scheduler_simulator_tpu.engine.engine import supported_config
+
+from helpers import node, pod
+from test_engine_parity import assert_parity
+
+ZONES = ("z0", "z1")
+DISKS = ("ssd", "hdd")
+APPS = ("a0", "a1", "a2")
+IMAGES = ("img0", "img1", "img2", "img3")
+
+
+def _rand_cluster(rng: random.Random):
+    nodes = []
+    for i in range(rng.randint(4, 10)):
+        labels = {"zone": rng.choice(ZONES), "disk": rng.choice(DISKS)}
+        kw = {}
+        if rng.random() < 0.2:
+            kw["taints"] = [
+                {"key": "dedicated", "value": "infra", "effect": "NoSchedule"}
+            ]
+        if rng.random() < 0.1:
+            kw["unschedulable"] = True
+        if rng.random() < 0.5:
+            kw["images"] = [
+                {
+                    "names": [rng.choice(IMAGES)],
+                    "sizeBytes": rng.randint(10**6, 10**9),
+                }
+            ]
+        nodes.append(
+            node(
+                f"n{i}",
+                cpu=str(rng.randint(2, 8)),
+                mem=f"{rng.randint(4, 16)}Gi",
+                pods=str(rng.randint(8, 32)),
+                labels=labels,
+                **kw,
+            )
+        )
+    pods_ = []
+    for j in range(rng.randint(20, 40)):
+        kw = {}
+        if rng.random() < 0.3:
+            kw["node_selector"] = {"disk": rng.choice(DISKS)}
+        r = rng.random()
+        if r < 0.2:
+            kw["affinity"] = {
+                "podAntiAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [
+                        {
+                            "labelSelector": {
+                                "matchLabels": {"app": rng.choice(APPS)}
+                            },
+                            "topologyKey": "zone",
+                        }
+                    ]
+                }
+            }
+        elif r < 0.35:
+            kw["affinity"] = {
+                "podAffinity": {
+                    "preferredDuringSchedulingIgnoredDuringExecution": [
+                        {
+                            "weight": rng.randint(1, 100),
+                            "podAffinityTerm": {
+                                "labelSelector": {
+                                    "matchLabels": {"app": rng.choice(APPS)}
+                                },
+                                "topologyKey": "zone",
+                            },
+                        }
+                    ]
+                }
+            }
+        if rng.random() < 0.3:
+            kw["tolerations"] = [
+                {
+                    "key": "dedicated",
+                    "operator": "Exists",
+                    "effect": "NoSchedule",
+                }
+            ]
+        if rng.random() < 0.5:
+            kw["priority"] = rng.choice((0, 10, 100))
+        if rng.random() < 0.25:
+            kw["spread"] = [
+                {
+                    "maxSkew": 1,
+                    "topologyKey": "zone",
+                    "whenUnsatisfiable": rng.choice(
+                        ("DoNotSchedule", "ScheduleAnyway")
+                    ),
+                    "labelSelector": {"matchLabels": {"app": rng.choice(APPS)}},
+                }
+            ]
+        if rng.random() < 0.25:
+            # hostPort is what NodePorts conflicts key on (containerPort
+            # alone can never conflict)
+            kw["ports"] = [
+                {"hostPort": rng.choice((80, 443, 8080)), "protocol": "TCP"}
+            ]
+        if rng.random() < 0.4:
+            kw["images"] = [rng.choice(IMAGES)]
+        pods_.append(
+            pod(
+                f"p{j}",
+                cpu=f"{rng.randint(100, 1500)}m",
+                mem=f"{rng.randint(64, 2048)}Mi",
+                labels={"app": rng.choice(APPS)},
+                **kw,
+            )
+        )
+    return nodes, pods_
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4, 5])
+def test_fuzz_full_default_set_parity(seed):
+    rng = random.Random(seed)
+    nodes, pods_ = _rand_cluster(rng)
+    assert_parity(nodes, pods_, supported_config())
