@@ -139,3 +139,72 @@ def test_fuzz_full_default_set_parity(seed):
     rng = random.Random(seed)
     nodes, pods_ = _rand_cluster(rng)
     assert_parity(nodes, pods_, supported_config())
+
+
+@pytest.mark.parametrize("seed", [11, 12, 13])
+def test_fuzz_volume_stack_parity(seed):
+    """The volume kernel family under random pressure: bound and unbound
+    PVCs across Immediate/WaitForFirstConsumer storage classes, PV node
+    affinity pinning volumes to zones, shared access modes (incl.
+    ReadWriteOncePod single-winner claims), and more claimants than
+    volumes — against the full default set so VolumeBinding/Zone/
+    Restrictions/limits all run."""
+    from test_engine_parity_vol import claim_vol, pv, pvc, storageclass
+
+    rng = random.Random(seed)
+    nodes = [
+        node(f"n{i}", cpu="8", labels={"zone": rng.choice(ZONES)})
+        for i in range(rng.randint(3, 6))
+    ]
+    scs = [storageclass("fast"), storageclass("lazy", mode="WaitForFirstConsumer")]
+    pvs, pvcs, pods_ = [], [], []
+    for k in range(rng.randint(4, 8)):
+        sc = rng.choice(("fast", "lazy"))
+        zone = rng.choice(ZONES)
+        aff = (
+            {
+                "required": {
+                    "nodeSelectorTerms": [
+                        {
+                            "matchExpressions": [
+                                {
+                                    "key": "zone",
+                                    "operator": "In",
+                                    "values": [zone],
+                                }
+                            ]
+                        }
+                    ]
+                }
+            }
+            if rng.random() < 0.5
+            else None
+        )
+        modes = rng.choice(
+            (("ReadWriteOnce",), ("ReadWriteMany",), ("ReadWriteOncePod",))
+        )
+        pvs.append(pv(f"pv{k}", sc=sc, modes=modes, node_affinity=aff))
+        pvcs.append(
+            pvc(
+                f"c{k}",
+                sc=sc,
+                modes=modes,
+                volume_name=f"pv{k}" if rng.random() < 0.6 else None,
+            )
+        )
+    for j in range(rng.randint(8, 16)):
+        kw = {}
+        if rng.random() < 0.7:
+            claims = rng.sample(range(len(pvcs)), k=rng.choice((1, 1, 2)))
+            kw["volumes"] = [claim_vol(f"c{k}") for k in claims]
+        if rng.random() < 0.4:
+            kw["priority"] = rng.choice((0, 50))
+        pods_.append(pod(f"p{j}", cpu=f"{rng.randint(100, 900)}m", **kw))
+    assert_parity(
+        nodes,
+        pods_,
+        supported_config(),
+        pvcs=pvcs,
+        pvs=pvs,
+        storageclasses=scs,
+    )
