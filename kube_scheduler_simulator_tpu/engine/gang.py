@@ -51,7 +51,7 @@ Divergence policy (documented, per SURVEY §7 M4):
   * Pods committed in the same round read the same global
     topology-spread / inter-pod-affinity counts; sequential parity for
     those two plugins holds only across rounds, not within one. Pods
-    carrying REQUIRED InterPodAffinity terms are exempted by default:
+    carrying REQUIRED anti-affinity terms are exempted by default:
     `rel_serialize` batches only up to the first placeable carrier in
     queue order and gives the carrier an EXCLUSIVE round (see
     __init__), so required-term coupling is always evaluated against
@@ -175,8 +175,11 @@ class GangScheduler:
         `rel_serialize` (default True, effective only when the
         InterPodAffinity filter is enabled) — queue-prefix batching:
         each batched round commits only pods strictly BEFORE the first
-        placeable pod carrying REQUIRED InterPodAffinity/anti-affinity
-        terms in queue order; once that prefix is exhausted, the
+        placeable pod carrying REQUIRED anti-affinity terms in queue
+        order (positive required affinity is monotone — same-round
+        peers can only satisfy it — and bound pods' positive terms
+        never block incoming pods, so affinity-only pods stay
+        batched); once that prefix is exhausted, the
         carrier takes an EXCLUSIVE round at its argmax node (the
         sequential engine's choice against this state), then batching
         resumes up to the next carrier. Two properties follow:
@@ -477,11 +480,15 @@ class GangScheduler:
 
             C = arrays.pod_claim.shape[1]
             pod_claim = arrays.pod_claim.astype(bool)
-            # [P] pods carrying required InterPodAffinity terms — the
-            # cluster-global coupling the one-per-round rule serializes
+            # [P] pods carrying required ANTI-affinity terms — the only
+            # cluster-global coupling that needs serialization: positive
+            # required affinity is monotone (same-round peers can only
+            # SATISFY it, never violate it) and bound pods' positive
+            # terms never block incoming pods (upstream's symmetric
+            # check exists for anti-affinity only), so affinity-only
+            # pods batch freely
             rel_carrier = (
-                (arrays.rel.ia_key >= 0).any(axis=1)
-                | (arrays.rel.ian_key >= 0).any(axis=1)
+                (arrays.rel.ian_key >= 0).any(axis=1)
                 if rel_serialize
                 else None
             )

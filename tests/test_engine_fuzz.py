@@ -64,7 +64,7 @@ def _rand_cluster(rng: random.Random):
         if rng.random() < 0.3:
             kw["node_selector"] = {"disk": rng.choice(DISKS)}
         r = rng.random()
-        if r < 0.2:
+        if r < 0.15:
             kw["affinity"] = {
                 "podAntiAffinity": {
                     "requiredDuringSchedulingIgnoredDuringExecution": [
@@ -77,7 +77,24 @@ def _rand_cluster(rng: random.Random):
                     ]
                 }
             }
-        elif r < 0.35:
+        elif r < 0.27:
+            # required POSITIVE affinity — the class rel_serialize keeps
+            # batched (monotone); sometimes self-matching (the
+            # first-pod-in-series special case)
+            want = rng.choice(APPS)
+            kw["affinity"] = {
+                "podAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [
+                        {
+                            "labelSelector": {"matchLabels": {"app": want}},
+                            "topologyKey": "zone",
+                        }
+                    ]
+                }
+            }
+            if rng.random() < 0.5:
+                kw.setdefault("force_app", want)
+        elif r < 0.4:
             kw["affinity"] = {
                 "podAffinity": {
                     "preferredDuringSchedulingIgnoredDuringExecution": [
@@ -122,12 +139,13 @@ def _rand_cluster(rng: random.Random):
             ]
         if rng.random() < 0.4:
             kw["images"] = [rng.choice(IMAGES)]
+        app = kw.pop("force_app", None) or rng.choice(APPS)
         pods_.append(
             pod(
                 f"p{j}",
                 cpu=f"{rng.randint(100, 1500)}m",
                 mem=f"{rng.randint(64, 2048)}Mi",
-                labels={"app": rng.choice(APPS)},
+                labels={"app": app},
                 **kw,
             )
         )
@@ -193,38 +211,47 @@ def test_fuzz_gang_invariants(seed):
     if n_seq > 0:
         assert n_gang > 0, (n_gang, n_seq)
 
-    # soundness (see docstring): recheck required anti-affinity over the
-    # final placements by hand — generator terms are all
-    # {matchLabels: {app: X}, topologyKey: zone}
+    # soundness (see docstring): recheck REQUIRED terms over the final
+    # placements by hand — generator terms are all
+    # {matchLabels: {app: X}, topologyKey: zone}. Anti-affinity: no
+    # matching peer may share the pod's zone. Positive affinity: some
+    # matching pod (self included — the bound pod itself satisfies a
+    # self-matching series) must share it.
     def violations(placed: dict) -> list:
         zone = {
             n["metadata"]["name"]: n["metadata"]["labels"]["zone"]
             for n in nodes
         }
+        by_name = {p["metadata"]["name"]: p for p in pods_}
+
+        def matching_in_zone(want_app, z, exclude=None):
+            return [
+                name2
+                for (ns2, name2), nn2 in placed.items()
+                if nn2
+                and name2 != exclude
+                and by_name[name2]["metadata"]["labels"].get("app") == want_app
+                and zone[nn2] == z
+            ]
+
         out = []
         for (ns, name), nn in placed.items():
             if not nn:
                 continue
-            p = next(q for q in pods_ if q["metadata"]["name"] == name)
-            terms = (
-                p["spec"]
-                .get("affinity", {})
-                .get("podAntiAffinity", {})
-                .get("requiredDuringSchedulingIgnoredDuringExecution", [])
-            )
-            for t in terms:
-                want_app = t["labelSelector"]["matchLabels"]["app"]
-                for (ns2, name2), nn2 in placed.items():
-                    if name2 == name or not nn2:
-                        continue
-                    q = next(
-                        r for r in pods_ if r["metadata"]["name"] == name2
-                    )
-                    if (
-                        q["metadata"]["labels"].get("app") == want_app
-                        and zone[nn2] == zone[nn]
-                    ):
-                        out.append((name, name2, want_app, zone[nn]))
+            aff = by_name[name]["spec"].get("affinity", {})
+            for t in aff.get("podAntiAffinity", {}).get(
+                "requiredDuringSchedulingIgnoredDuringExecution", []
+            ):
+                want = t["labelSelector"]["matchLabels"]["app"]
+                hits = matching_in_zone(want, zone[nn], exclude=name)
+                if hits:
+                    out.append(("anti", name, hits[0], want, zone[nn]))
+            for t in aff.get("podAffinity", {}).get(
+                "requiredDuringSchedulingIgnoredDuringExecution", []
+            ):
+                want = t["labelSelector"]["matchLabels"]["app"]
+                if not matching_in_zone(want, zone[nn]):
+                    out.append(("affinity", name, None, want, zone[nn]))
         return out
 
     assert violations(got) == [], violations(got)[:5]
