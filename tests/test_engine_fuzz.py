@@ -211,6 +211,11 @@ def test_fuzz_gang_invariants(seed):
     if n_seq > 0:
         assert n_gang > 0, (n_gang, n_seq)
 
+    zone = {
+        n["metadata"]["name"]: n["metadata"]["labels"]["zone"] for n in nodes
+    }
+    by_name = {p["metadata"]["name"]: p for p in pods_}
+
     # soundness (see docstring): recheck REQUIRED terms over the final
     # placements by hand — generator terms are all
     # {matchLabels: {app: X}, topologyKey: zone}. Anti-affinity: no
@@ -218,12 +223,6 @@ def test_fuzz_gang_invariants(seed):
     # matching pod (self included — the bound pod itself satisfies a
     # self-matching series) must share it.
     def violations(placed: dict) -> list:
-        zone = {
-            n["metadata"]["name"]: n["metadata"]["labels"]["zone"]
-            for n in nodes
-        }
-        by_name = {p["metadata"]["name"]: p for p in pods_}
-
         def matching_in_zone(want_app, z, exclude=None):
             return [
                 name2
@@ -259,6 +258,45 @@ def test_fuzz_gang_invariants(seed):
     sp = enc.decode_assignment(seq._final_state.assignment)
     in_q = {k for k in got}
     assert violations({k: v for k, v in sp.items() if k in in_q}) == []
+
+    # the REMAINING same-round divergence class, measured (not
+    # asserted): hard topology-spread constraints evaluated against
+    # round-start counts can exceed maxSkew once same-round peers land.
+    # Even the sequential engine shows nonzero final-state excess
+    # (upstream's check is at-schedule-time only; later selector-
+    # matching pods shift counts unchecked), so this is a report, not an
+    # invariant. Measured on these seeds: gang 0/0, sequential 1/0.
+    # Caveat: min is taken over all ZONES, not k8s's eligible-domain
+    # set, so a matching app pinned off one zone reads as excess here
+    # that real DoNotSchedule semantics would not count — fine for a
+    # conservative report.
+    def spread_violations(placed: dict) -> int:
+        n_viol = 0
+        for (ns, name), nn in placed.items():
+            if not nn:
+                continue
+            for c in by_name[name]["spec"].get(
+                "topologySpreadConstraints", []
+            ):
+                if c["whenUnsatisfiable"] != "DoNotSchedule":
+                    continue
+                want = c["labelSelector"]["matchLabels"]["app"]
+                counts = {z: 0 for z in ZONES}
+                for (ns2, name2), nn2 in placed.items():
+                    if nn2 and by_name[name2]["metadata"]["labels"].get(
+                        "app"
+                    ) == want:
+                        counts[zone[nn2]] += 1
+                skew = counts[zone[nn]] - min(counts.values())
+                if skew > c["maxSkew"]:
+                    n_viol += 1
+        return n_viol
+
+    print(
+        f"seed {seed}: final-state hard-spread skew excess — gang "
+        f"{spread_violations(got)}, sequential "
+        f"{spread_violations({k: v for k, v in sp.items() if k in in_q})}"
+    )
 
     per_node = Counter(v for v in got.values() if v)
     caps = {
