@@ -39,7 +39,67 @@ jax.block_until_ready is a no-op on the experimental axon TPU backend.
 from __future__ import annotations
 
 import json
+import os as _os
 import time
+
+# Persistent tunnel-state marker: written when a device probe exceeds its
+# window (meaning an axon compile may still be in flight in an abandoned
+# subprocess), read by every later device probe, by bench start, and by
+# the round-end driver. The round-4 postmortem is the reason this exists:
+# killing one in-flight axon compile at 04:40 wedged the tunnel for the
+# remaining ~7 h of the session (even jax.devices() hung) and cost the
+# round its TPU artifact (BASELINE.md round-4 session log).
+TUNNEL_MARKER = _os.path.join(
+    _os.path.dirname(_os.path.abspath(__file__)), ".tunnel_wedged.json"
+)
+# Wedges outlast sessions but not days; a marker older than this is stale.
+TUNNEL_MARKER_TTL_S = 6 * 3600.0
+
+
+def _tunnel_wedged_since() -> "float | None":
+    """Timestamp of an active wedge marker, or None (absent/stale/bad).
+
+    Staleness gates on `last` — the most recent wedge EVIDENCE — not on
+    `since` (the first): a fresh timeout near an old marker's TTL edge
+    must renew the skip protection, or the next long-window probe pokes
+    a tunnel that wedged minutes ago. `since` is only the honest
+    "wedged since T" answer."""
+    try:
+        with open(TUNNEL_MARKER) as f:
+            data = json.load(f)
+        since = float(data["since"])
+        last = float(data.get("last", since))
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+    if time.time() - last > TUNNEL_MARKER_TTL_S:
+        return None
+    return since
+
+
+def _mark_tunnel_wedged(program_class: str) -> None:
+    """Flip the wedge marker: `since` keeps the oldest active wedge time
+    (so "wedged since T" stays honest across probes), `last` records
+    this newest evidence (the staleness clock)."""
+    since = _tunnel_wedged_since()
+    now = time.time()
+    payload = {
+        "since": since if since is not None else now,
+        "last": now,
+        "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "class": program_class,
+    }
+    try:
+        with open(TUNNEL_MARKER, "w") as f:
+            json.dump(payload, f)
+    except OSError:
+        pass  # a read-only checkout must not turn a timeout into a crash
+
+
+def _clear_tunnel_marker() -> None:
+    try:
+        _os.unlink(TUNNEL_MARKER)
+    except OSError:
+        pass
 
 N_NODES = 256
 N_PODS = 2048
@@ -57,6 +117,26 @@ CPU_FALLBACK = {
 }
 AFF_NODES = 500
 AFF_PODS = 5000
+
+
+def _enable_compile_cache() -> None:
+    """Point JAX at the repo-local persistent compilation cache (what
+    tests/conftest.py uses — the judge's warm re-runs rely on it). Every
+    bench entry point calls this so repeat compiles of an identical
+    program (including the AOT lower().compile() the cost telemetry
+    takes) are disk hits, not fresh XLA compiles."""
+    try:
+        import jax
+
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            _os.path.join(
+                _os.path.dirname(_os.path.abspath(__file__)), ".jax_cache"
+            ),
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+    except Exception:  # noqa: BLE001 — cache is an optimization only
+        pass
 
 
 def _best_of(fn, reps=3):
@@ -85,10 +165,26 @@ def _device_watchdog(timeout_s: "float | None" = None) -> str:
 
     if timeout_s is None:
         timeout_s = PROBE_TIMEOUT_S
+    wedged_since = _tunnel_wedged_since()
+    if wedged_since is not None:
+        # an earlier probe abandoned a possibly-in-flight axon compile;
+        # spend only a short re-probe on the chance the tunnel recovered
+        # (clearing the marker when it did)
+        timeout_s = min(timeout_s, 60.0)
     devices, error = probe_devices(timeout_s)
     if devices:
+        _clear_tunnel_marker()
         return devices[0].platform
+    if error is None:
+        # device init HUNG (the wedge signature, not a clean failure):
+        # record it for later processes and the round-end driver
+        _mark_tunnel_wedged("device-init")
     why = probe_why(error, timeout_s)
+    if wedged_since is not None:
+        iso = time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime(wedged_since)
+        )
+        why += f"; wedge marker active since {iso}"
     if os.environ.get("_KSS_BENCH_CPU_FALLBACK"):
         raise RuntimeError(f"CPU fallback backend unusable — {why}")
     reexec_on_cpu(
@@ -99,7 +195,7 @@ def _device_watchdog(timeout_s: "float | None" = None) -> str:
     )
 
 
-def _gang_probe(mode: str, shape: str = "bench"):
+def _gang_probe(mode: str, shape: str = "bench", plain: bool = False):
     """Subprocess mode (`bench.py --gang-probe=<dynamic|static>
     [--gang-shape=bench|atscale]`): measure the gang scheduler and print
     one JSON line. Run isolated because gang's dynamic `lax.while_loop`
@@ -110,7 +206,16 @@ def _gang_probe(mode: str, shape: str = "bench"):
     does compile there) at the cost of no-op rounds past the fixpoint.
     shape=atscale probes the BASELINE #2 shape (10k pods x 1k nodes) —
     the step-count-reduction claim: ~a-dozen dense rounds instead of 10k
-    dependent scan steps."""
+    dependent scan steps.
+
+    `plain` (--gang-plain) builds the scheduler with compact=False and
+    rel_serialize=False: the EXACT program class that compiled and ran
+    on the axon backend in round 4 (scans-only, no per-chunk lax.cond
+    from compaction, no carrier cond from rel_serialize — both were
+    added AFTER that compile was proven). Chip ladders start here so the
+    first rung is never an unproven class; placements are unchanged on
+    the bench synthetic workloads (carrier-free, and compaction is
+    bit-identical by construction) — only the work-skipping differs."""
     import os
 
     import jax
@@ -139,18 +244,21 @@ def _gang_probe(mode: str, shape: str = "bench"):
         seed, chunk, reps = 42, 128, 3
     nodes, pods = synthetic_cluster(n_nodes, n_pods, seed=seed)
     enc = encode_cluster(nodes, pods, supported_config(), policy=TPU32)
+    variant_kw = dict(compact=not plain, rel_serialize=not plain)
     if mode == "static":
-        gang = GangScheduler(enc, chunk=chunk, loop="static", inner_iters=64)
+        gang = GangScheduler(
+            enc, chunk=chunk, loop="static", inner_iters=64, **variant_kw
+        )
     elif mode == "hybrid":
         # static outer scan (the axon-compilable shape) + while-loop
         # matching that exits when the round settles — the matching scan
         # is the round's latency floor on the chip (BASELINE.md)
         gang = GangScheduler(
             enc, chunk=chunk, loop="static", inner_iters=64,
-            inner_loop="dynamic",
+            inner_loop="dynamic", **variant_kw,
         )
     else:
-        gang = GangScheduler(enc, chunk=chunk)
+        gang = GangScheduler(enc, chunk=chunk, **variant_kw)
     # measure through run(): it owns the static auto-resume passes and
     # the preemption phases — the number must price the whole schedule,
     # not one budget quantum. run() syncs per pass via host transfers
@@ -162,30 +270,54 @@ def _gang_probe(mode: str, shape: str = "bench"):
 
     state, rounds = once()  # compile + warm; deterministic → reuse below
     best = _best_of(once, reps=reps)
-    print(
-        json.dumps(
-            {
-                "gang_dps": round(n_pods / best, 1),
-                "mode": mode,
-                "shape": f"{n_pods}x{n_nodes}",
-                "rounds": int(np.asarray(rounds)),
-                "scheduled": int((np.asarray(state.assignment) >= 0).sum()),
-                "pods": n_pods,
-            }
+    result = {
+        "gang_dps": round(n_pods / best, 1),
+        "mode": mode,
+        "variant": "plain" if plain else "default",
+        "shape": f"{n_pods}x{n_nodes}",
+        "rounds": int(np.asarray(rounds)),
+        "scheduled": int((np.asarray(state.assignment) >= 0).sum()),
+        "pods": n_pods,
+    }
+    # the measurement line is banked BEFORE any telemetry compile: the
+    # parent reads it out of the probe's temp file even if what follows
+    # hangs (round-5 review finding — cost_analysis's AOT path may
+    # recompile, and a post-measurement hang must not cost the number)
+    print(json.dumps(result), flush=True)
+    import jax
+
+    platform = jax.devices()[0].platform
+    if platform.startswith("cpu") or mode == "static":
+        # XLA cost model of ONE compiled gang pass (run() may chain
+        # several under auto-resume/preempt phases — per-pass work, not
+        # per-schedule). Skipped for dynamic-control-flow classes on the
+        # accelerator: their compile has never been observed to finish
+        # there, and the cost path must not restart it.
+        from kube_scheduler_simulator_tpu.utils.metrics import cost_fields
+
+        order, _ = gang.order_arrays()
+        extra = cost_fields(
+            gang._run,
+            (enc.arrays, enc.state0, order, gang.weights),
+            per="pass",
         )
-    )
+        if extra:
+            print(json.dumps({**result, **extra}), flush=True)
 
 
-def _gang_sweep_probe():
-    """Subprocess mode (`bench.py --gang-sweep-probe`): V policy-weight
-    variants x the gang fixpoint, vmapped into ONE scans-only XLA
-    program (`GangSweep(loop="static")`) at the bench shape — the
-    north-star program shape (variants x dense rounds x nodes), and the
+def _gang_sweep_probe(shape: str = "bench"):
+    """Subprocess mode (`bench.py --gang-sweep-probe
+    [--gang-shape=bench|tiny]`): V policy-weight variants x the gang
+    fixpoint, vmapped into ONE scans-only XLA program
+    (`GangSweep(loop="static")`) at the bench shape — the north-star
+    program shape (variants x dense rounds x nodes), and the
     chip-filling answer to the gang round's latency floor: the variant
     axis amortizes each round's dependent small ops exactly like the
-    sequential sweep amortizes step latency. Scans-only control flow =
-    the same compile class as the proven static gang probe. One JSON
-    line."""
+    sequential sweep amortizes step latency. Scans-only control flow,
+    but VMAPPED — a different lowering than the proven static gang
+    program, so on accelerators it is its own tiny-rung-gated compile
+    class (shape=tiny proves it compiles before the full window is
+    spent). One JSON line."""
     import os
 
     import numpy as np
@@ -196,7 +328,9 @@ def _gang_sweep_probe():
     from kube_scheduler_simulator_tpu.synth import synthetic_cluster
 
     n_nodes, n_pods, n_var = N_NODES, N_PODS, 8
-    if os.environ.get("_KSS_BENCH_CPU_FALLBACK"):
+    if shape == "tiny":
+        n_nodes, n_pods, n_var = 64, 256, 4
+    elif os.environ.get("_KSS_BENCH_CPU_FALLBACK"):
         n_nodes, n_pods = CPU_FALLBACK["N_NODES"], CPU_FALLBACK["N_PODS"]
         n_var = 4
     nodes, pods = synthetic_cluster(n_nodes, n_pods, seed=42)
@@ -212,27 +346,45 @@ def _gang_sweep_probe():
     assigns, rounds = once()  # compile + warm
     best = _best_of(once, reps=2)
     scheduled = int((assigns >= 0).sum())
-    print(
-        json.dumps(
-            {
-                "gang_sweep_dps": round(n_var * n_pods / best, 1),
-                "variants": n_var,
-                "shape": f"{n_pods}x{n_nodes}",
-                "rounds_max": int(rounds.max()),
-                "scheduled": scheduled,
-                "pods": n_var * n_pods,
-            }
-        )
+    result = {
+        "gang_sweep_dps": round(n_var * n_pods / best, 1),
+        "variants": n_var,
+        "shape": f"{n_pods}x{n_nodes}",
+        "rounds_max": int(rounds.max()),
+        "scheduled": scheduled,
+        "pods": n_var * n_pods,
+    }
+    # measurement first, telemetry second — see _gang_probe
+    print(json.dumps(result), flush=True)
+    from kube_scheduler_simulator_tpu.utils.metrics import cost_fields
+
+    import jax.numpy as jnp
+
+    extra = cost_fields(
+        sweep._vrun,
+        (*sweep._args, jnp.asarray(variants, sweep.enc.policy.score)),
+        per="pass",
     )
+    if extra:
+        print(json.dumps({**result, **extra}), flush=True)
 
 
 def _sweep_preempt_probe():
     """Subprocess mode (`bench.py --sweep-preempt-probe`): the
-    Monte-Carlo sweep WITH the full default set incl. DefaultPreemption
-    in its vmap-safe masked form, one JSON line. Isolated because the
-    vmapped preemption dry-run is the program observed to CRASH the
-    experimental axon worker in round 2 (BASELINE.md config #4 note) —
-    in-process it would take the whole bench artifact down with it."""
+    Monte-Carlo sweep WITH the full default set incl. DefaultPreemption,
+    one JSON line carrying the preemption strategy in "mode".
+
+    Since round 5 `WeightSweep` defaults to the two-phase EVENT LOOP
+    (`preempt="phase"`, parallel/sweep.py): the scan never carries the
+    [N, P] victim dry-run — it stops at each variant's first failure, a
+    single-pod preempt program handles it, the scan resumes. Same
+    placements as masked mode (test-pinned), ~70x faster on the r4
+    comparison shape (123.6 -> 8,528 dec/s at 2x512x128 CPU). Still
+    isolated in a subprocess: the phase programs are a different compile
+    class than the proven static probes (vmapped scans + a vmapped
+    preempt step — the masked-mode class CRASHED the axon worker in
+    round 2, BASELINE.md config #4 note), and a crash or stall must cost
+    this measurement only."""
     import numpy as np
 
     from kube_scheduler_simulator_tpu.engine import TPU32, encode_cluster
@@ -259,44 +411,96 @@ def _sweep_preempt_probe():
                 "sweep_pre_dps": round(n_var * n_pods / best, 1),
                 "variants": n_var,
                 "shape": f"{n_pods}x{n_nodes}",
+                "mode": sweep.preempt,
             }
         )
     )
 
 
-def _probe_json_subprocess(argv, timeout_s: float, key: str) -> "dict | None":
+def _probe_json_subprocess(
+    argv, timeout_s: float, key: str, *, device: bool = False
+) -> "dict | None":
     """Run `bench.py <argv...>` isolated and return the last stdout JSON
     line carrying `key` — the shared contract of every wedge-contained
-    probe (a timeout or crash costs that measurement only)."""
-    import os
+    probe (a timeout or crash costs that measurement only).
+
+    Two containment modes, chosen by `device`:
+
+    * device=False (CPU backend): a timed-out child is killed — nothing a
+      CPU process holds can wedge anything.
+    * device=True (the child touches the axon accelerator): the child may
+      hold an IN-FLIGHT COMPILE, and killing that wedges the tunnel for
+      hours (round-4 postmortem, BASELINE.md). A timed-out child is
+      therefore ABANDONED to finish or die on its own — its stdout is
+      already redirected to a temp file so it can never block on a full
+      pipe — the persistent wedge marker is written, and every remaining
+      device probe (this one included, next call) skips by reading the
+      marker instead of poking the tunnel again. No code path here can
+      SIGKILL a process that may hold an axon compile.
+    """
     import subprocess
     import sys
+    import tempfile
+
+    if device and _tunnel_wedged_since() is not None:
+        return None
+    fd, out_path = tempfile.mkstemp(prefix="kss_bench_probe_", suffix=".out")
+    with _os.fdopen(fd, "w") as outf:
+        proc = subprocess.Popen(
+            [sys.executable, __file__, *argv],
+            stdout=outf,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=_os.environ.copy(),
+        )
+    def last_json_line(path):
+        try:
+            with open(path) as f:
+                lines = f.read().strip().splitlines()
+        except OSError:
+            return None
+        for line in reversed(lines):
+            try:
+                out = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(out, dict) and key in out:
+                return out
+        return None
 
     try:
-        proc = subprocess.run(
-            [sys.executable, __file__, *argv],
-            capture_output=True,
-            text=True,
-            timeout=timeout_s,
-            env=os.environ,
-        )
+        proc.wait(timeout=timeout_s)
     except subprocess.TimeoutExpired:
+        if device:
+            # the abandoned child still owns (and may write) its temp
+            # file — leaking it is deliberate. Probes print their
+            # measurement line BEFORE any post-measurement telemetry
+            # compile, so a child that measured and then hung has
+            # already banked the number: read it out of the temp file
+            # (marked, so it can't be mistaken for a clean probe).
+            _mark_tunnel_wedged(" ".join(argv))
+            banked = last_json_line(out_path)
+            if banked is not None:
+                return dict(banked, banked_before_timeout=True)
+        else:
+            proc.kill()
+            proc.wait()
+            try:
+                _os.unlink(out_path)
+            except OSError:
+                pass
         return None
-    if proc.returncode != 0:
-        return None
-    for line in reversed(proc.stdout.strip().splitlines()):
-        try:
-            out = json.loads(line)
-        except json.JSONDecodeError:
-            continue
-        if isinstance(out, dict) and key in out:
-            return out
-    return None
+    out = last_json_line(out_path)
+    try:
+        _os.unlink(out_path)
+    except OSError:
+        pass
+    return out if proc.returncode == 0 else None
 
 
-def _try_sweep_preempt_subprocess() -> "dict | None":
+def _try_sweep_preempt_subprocess(device: bool) -> "dict | None":
     return _probe_json_subprocess(
-        ["--sweep-preempt-probe"], 900.0, "sweep_pre_dps"
+        ["--sweep-preempt-probe"], 900.0, "sweep_pre_dps", device=device
     )
 
 
@@ -305,42 +509,44 @@ def _try_gang_subprocess(
 ) -> "dict | None":
     """Probe gang isolated. On CPU backends: the dynamic (while_loop)
     variant first, static as fallback. On accelerator backends: STATIC
-    ONLY — killing an in-flight dynamic compile on the experimental TPU
-    backend has been observed to wedge the tunnel for hours (BASELINE.md),
-    so the known-risky program is never started there. None when no
-    variant finishes in its window."""
+    PLAIN ONLY — the exact scans-only program class (compact=False,
+    rel_serialize=False) proven to compile on the axon backend in round
+    4; the compacted default adds lax.cond constructs that are their own
+    gated rung (`_try_gang_compact_upgrade`), and dynamic control flow
+    is strictly last (`_try_gang_hybrid_upgrade`). A probe that exceeds
+    its window is abandoned, never killed, and flips the wedge marker —
+    see _probe_json_subprocess. None when no variant finishes."""
 
-    def one(mode, probe_shape, timeout_s):
+    device = not platform.startswith("cpu")
+
+    def one(mode, probe_shape, timeout_s, plain=False):
+        argv = [f"--gang-probe={mode}", f"--gang-shape={probe_shape}"]
+        if plain:
+            argv.append("--gang-plain")
         return _probe_json_subprocess(
-            [f"--gang-probe={mode}", f"--gang-shape={probe_shape}"],
-            timeout_s,
-            "gang_dps",
+            argv, timeout_s, "gang_dps", device=device
         )
 
-    if platform.startswith("cpu"):
+    if not device:
         for mode, timeout_s in (("dynamic", 420.0), ("static", 600.0)):
             out = one(mode, shape, timeout_s)
             if out:
                 return out
         return None
-    # accelerator: compile-ladder, STATIC ONLY — killing an in-flight
-    # dynamic-control-flow compile on the experimental TPU backend has
-    # been observed to wedge the tunnel for hours (BASELINE.md), so the
-    # known-risky program is never started while measurements remain to
-    # be banked (_try_gang_hybrid_upgrade runs LAST for that reason).
-    # Prove the static control-flow shape compiles at a tiny size first
+    # accelerator: compile-ladder in the PROVEN class only. Prove the
+    # plain static control-flow shape compiles at a tiny size first
     # (skipped when the caller already proved it this run); only then
     # spend the full-shape window. A failed full rung returns the tiny
     # rung EXPLICITLY MARKED as a fallback (a tiny real-chip gang number
     # still beats none, but it must never read as the requested shape's
     # measurement).
     if not ladder_proved:
-        tiny = one("static", "tiny", 420.0)
+        tiny = one("static", "tiny", 420.0, plain=True)
         if tiny is None:
             return None
     else:
         tiny = None
-    full = one("static", shape, 600.0)
+    full = one("static", shape, 600.0, plain=True)
     if full:
         return full
     if tiny:
@@ -348,25 +554,63 @@ def _try_gang_subprocess(
     return None
 
 
-def _try_gang_hybrid_upgrade(shapes: list) -> dict:
-    """LAST-phase accelerator upgrade: the hybrid gang program (static
-    outer scan + `lax.while_loop` matching that exits when the round
-    settles — the matching scan is the round's latency floor on the
-    chip, BASELINE.md). It carries the construct that can wedge the
-    tunnel when its in-flight compile is killed, so it runs strictly
-    AFTER every static measurement is banked: a wedge here costs these
-    upgrades only. Tiny rung proves the shape compiles before any full
-    window is spent. Returns {shape: probe_json} for shapes that
-    completed."""
+def _try_gang_compact_upgrade(shapes: list) -> dict:
+    """Accelerator upgrade rung for the DEFAULT gang program (compact
+    pending-only evaluation + rel_serialize carrier handling): these add
+    per-chunk/per-round `lax.cond` constructs absent from the round-4
+    proven compile (ADVICE r4), so they are gated behind their own tiny
+    rung rather than assumed compatible. Runs after every plain static
+    number is banked. Returns {shape: probe_json} for shapes that
+    completed; stops at the first timeout (wedge marker already set by
+    the probe helper, later device probes will skip)."""
     out: dict = {}
     tiny = _probe_json_subprocess(
-        ["--gang-probe=hybrid", "--gang-shape=tiny"], 420.0, "gang_dps"
+        ["--gang-probe=static", "--gang-shape=tiny"],
+        420.0,
+        "gang_dps",
+        device=True,
     )
     if tiny is None:
         return out
     for shape in shapes:
         full = _probe_json_subprocess(
-            ["--gang-probe=hybrid", f"--gang-shape={shape}"], 600.0, "gang_dps"
+            ["--gang-probe=static", f"--gang-shape={shape}"],
+            600.0,
+            "gang_dps",
+            device=True,
+        )
+        if full is None:
+            return out
+        out[shape] = full
+    return out
+
+
+def _try_gang_hybrid_upgrade(shapes: list) -> dict:
+    """LAST-phase accelerator upgrade: the hybrid gang program (static
+    outer scan + `lax.while_loop` matching that exits when the round
+    settles — the matching scan is the round's latency floor on the
+    chip, BASELINE.md). Its dynamic inner loop is the class whose
+    in-flight compile historically never finished on axon, so it runs
+    strictly AFTER every static measurement is banked: a stall here
+    costs these upgrades only (and the probe helper abandons, never
+    kills, the child — the wedge marker makes later probes skip). Tiny
+    rung proves the shape compiles before any full window is spent.
+    Returns {shape: probe_json} for shapes that completed."""
+    out: dict = {}
+    tiny = _probe_json_subprocess(
+        ["--gang-probe=hybrid", "--gang-shape=tiny"],
+        420.0,
+        "gang_dps",
+        device=True,
+    )
+    if tiny is None:
+        return out
+    for shape in shapes:
+        full = _probe_json_subprocess(
+            ["--gang-probe=hybrid", f"--gang-shape={shape}"],
+            600.0,
+            "gang_dps",
+            device=True,
         )
         if full is None:
             return out  # don't poke a possibly-wedged tunnel again
@@ -386,6 +630,7 @@ def main(profile_dir: "str | None" = None):
     import os
     import sys
 
+    _enable_compile_cache()
     platform = _device_watchdog()
     global N_NODES, N_PODS, N_VARIANTS, SCALE_NODES, SCALE_PODS
     global AFF_NODES, AFF_PODS
@@ -419,12 +664,17 @@ def main(profile_dir: "str | None" = None):
 
     phases: dict[str, dict] = {}
 
+    from kube_scheduler_simulator_tpu.utils.metrics import cost_fields
+
     def timed_pass(nodes_, pods_, config, reps=3, label=None):
         """Encode → jit → compile → best-of timing of one sequential pass
         (the shared idiom for every single-pass measurement; sync via
-        host transfer — see module docstring). Per-phase host timings
-        land in `phases[label]`; under --profile the warm pass also runs
-        inside a jax.profiler trace."""
+        host transfer — see module docstring). Per-phase host timings +
+        XLA cost-model FLOPs/bytes + derived MFU land in
+        `phases[label]` (cost is read AFTER the measurement through the
+        cached AOT handle, so the proven jit execution path is what gets
+        timed); under --profile the warm pass also runs inside a
+        jax.profiler trace."""
         t0 = time.perf_counter()
         e = encode_cluster(nodes_, pods_, config, policy=TPU32)
         sc = BatchedScheduler(e, record=False, unroll=UNROLL)
@@ -441,6 +691,7 @@ def main(profile_dir: "str | None" = None):
                 "compile_s": round(t_compile, 4),
                 "best_run_s": round(best, 4),
             }
+            phases[label].update(cost_fields(r, a, best, platform))
         if profile_dir:
             from kube_scheduler_simulator_tpu.utils.metrics import profile_trace
 
@@ -479,25 +730,13 @@ def main(profile_dir: "str | None" = None):
     t_sweep = _best_of(lambda: np.asarray(vrun(*vargs)[1]))
     sweep_dps = N_VARIANTS * N_PODS / t_sweep
     phases["sweep"] = {"best_run_s": round(t_sweep, 4)}
+    phases["sweep"].update(cost_fields(vrun, vargs, t_sweep, platform))
     if profile_dir:
         from kube_scheduler_simulator_tpu.utils.metrics import profile_trace
 
         # the headline program's trace — one warm pass
         with profile_trace(profile_dir):
             np.asarray(vrun(*vargs)[1])
-
-    # 2b) sweep WITH preemption (the canonical parallel.WeightSweep —
-    # masked vmap-safe dry-run, the construction the per-variant parity
-    # test pins), probed in an ISOLATED subprocess: the vmapped dry-run
-    # is the program that crashed the axon worker in round 2, and a
-    # crash must cost this measurement only, not the bench artifact.
-    pre = _try_sweep_preempt_subprocess()
-    pre_note = (
-        f"sweep+preemption {pre['variants']}x{pre['shape']}="
-        f"{pre['sweep_pre_dps']}/s (full default set, masked dry-run)"
-        if pre
-        else "sweep+preemption=n/a (did not survive isolation window)"
-    )
 
     # 3) at-scale single pass (BASELINE config #2 shape)
     s_nodes, s_pods = synthetic_cluster(SCALE_NODES, SCALE_PODS, seed=7)
@@ -521,7 +760,8 @@ def main(profile_dir: "str | None" = None):
     def gang_desc(g):
         """Honest one-fragment description: the measured shape is always
         printed, tiny-rung fallbacks and incomplete passes are labeled."""
-        d = f"({g['mode']},{g['shape']})={g['gang_dps']}/s in {g['rounds']} rounds"
+        var = "," + g["variant"] if g.get("variant", "default") != "default" else ""
+        d = f"({g['mode']}{var},{g['shape']})={g['gang_dps']}/s in {g['rounds']} rounds"
         if g.get("fallback_from"):
             d += f" [tiny-rung fallback; {g['fallback_from']} shape did not finish]"
         if g.get("scheduled") != g.get("pods"):
@@ -556,15 +796,48 @@ def main(profile_dir: "str | None" = None):
         )
         if gang_sc:
             gang_note += f", gang atscale{gang_desc(gang_sc)}"
+    # compacted-default gang upgrade (accelerator only): the compact +
+    # rel_serialize program carries lax.cond constructs that were never
+    # part of the round-4 proven compile — its own tiny-rung-gated class
+    # (ADVICE r4), run only after the plain static numbers are banked
+    if (
+        not platform.startswith("cpu")
+        and gang
+        and not gang.get("fallback_from")
+    ):
+        compacts = _try_gang_compact_upgrade(["bench"])
+        comp = compacts.get("bench")
+        if comp:
+            gang_note += f", gang compact{gang_desc(comp)}"
+            if (
+                comp.get("scheduled") == comp.get("pods") == N_PODS
+                and comp["gang_dps"] > gang_headline
+            ):
+                gang_headline = comp["gang_dps"]
     # vmapped gang sweep (variants x dense rounds in one scans-only
-    # program — the north-star shape; same compile class as the static
-    # probes, so it is tunnel-safe to run before the hybrid upgrade).
-    # Eligible for the headline when every variant places every pod.
+    # program — the north-star shape). Scans-only but VMAPPED — a new
+    # lowering, so on accelerators it gets its own tiny rung before the
+    # full window (ADVICE r4). Eligible for the headline when every
+    # variant places every pod.
     gang_sweep = None
     if gang and not gang.get("fallback_from"):
-        gang_sweep = _probe_json_subprocess(
-            ["--gang-sweep-probe"], 900.0, "gang_sweep_dps"
-        )
+        device = not platform.startswith("cpu")
+        sweep_ok = True
+        if device:
+            sweep_ok = (
+                _probe_json_subprocess(
+                    ["--gang-sweep-probe", "--gang-shape=tiny"],
+                    420.0,
+                    "gang_sweep_dps",
+                    device=True,
+                )
+                is not None
+            )
+        if sweep_ok:
+            gang_sweep = _probe_json_subprocess(
+                ["--gang-sweep-probe"], 900.0, "gang_sweep_dps",
+                device=device,
+            )
     if gang_sweep:
         gang_note += (
             f", gang sweep {gang_sweep['variants']}x{gang_sweep['shape']}="
@@ -576,6 +849,22 @@ def main(profile_dir: "str | None" = None):
             gang_note += (
                 f" INCOMPLETE ({gang_sweep['scheduled']}/{gang_sweep['pods']})"
             )
+    # sweep WITH preemption (parallel.WeightSweep, two-phase event loop
+    # by default — see _sweep_preempt_probe), probed in an ISOLATED
+    # subprocess AFTER every in-process number and every proven-class
+    # gang probe is banked: its program class is unproven on the
+    # accelerator (the old masked class crashed the axon worker in
+    # round 2), so a stall or crash here may cost this measurement and
+    # the hybrid upgrades only. The JSON's "mode" says which strategy
+    # ran.
+    pre = _try_sweep_preempt_subprocess(not platform.startswith("cpu"))
+    pre_note = (
+        f"sweep+preemption {pre['variants']}x{pre['shape']}="
+        f"{pre['sweep_pre_dps']}/s (full default set, "
+        f"{pre.get('mode', 'masked')} preemption)"
+        if pre
+        else "sweep+preemption=n/a (did not survive isolation window)"
+    )
     # hybrid (while-loop matching) upgrade, accelerator only, strictly
     # last: every static number above is already banked, so the one
     # program class that can wedge the tunnel risks nothing but itself.
@@ -611,6 +900,32 @@ def main(profile_dir: "str | None" = None):
                 ),
                 # like-for-like: single pass and oracle share the config
                 "vs_baseline": round(single_dps / base_dps, 2),
+                # per-program phase walls + XLA cost-model work + MFU
+                # (VERDICT r4 #4): mfu is vs the v5e bf16 peak
+                # (utils/metrics.PEAK_FLOPS_PER_S) and only reported on
+                # the accelerator; a missing label means the backend
+                # exposed no cost model for that program.
+                "phase_s": {
+                    lbl: {
+                        k: v
+                        for k, v in p.items()
+                        if k in ("encode_s", "compile_s", "best_run_s")
+                    }
+                    for lbl, p in phases.items()
+                },
+                "flops": {
+                    lbl: p["flops"] for lbl, p in phases.items() if "flops" in p
+                },
+                "flops_per_s": {
+                    lbl: p["flops_per_s"]
+                    for lbl, p in phases.items()
+                    if "flops_per_s" in p
+                },
+                "mfu": {
+                    lbl: round(p["mfu"], 8)
+                    for lbl, p in phases.items()
+                    if "mfu" in p
+                },
             }
         )
     )
@@ -627,11 +942,44 @@ def main(profile_dir: "str | None" = None):
 if __name__ == "__main__":
     import sys
 
+    sleep_spec = [a for a in sys.argv if a.startswith("--probe-sleep=")]
+    if sleep_spec:
+        # test hook for the wedge-containment contract
+        # (tests/test_bench_probes.py): sleep, then touch the given path
+        # — a path that appears only AFTER the parent's probe window
+        # proves the child was abandoned (device mode), not killed
+        _, _, spec = sleep_spec[0].partition("=")
+        secs, _, path = spec.partition(":")
+        # --probe-emit-first models a probe that banks its measurement
+        # line and THEN hangs (e.g. in a telemetry compile): the parent
+        # must recover the line from the temp file on timeout
+        emit_first = "--probe-emit-first" in sys.argv
+        if emit_first:
+            print(json.dumps({"probe_sleep_done": True}), flush=True)
+        time.sleep(float(secs))
+        if path:
+            with open(path, "w") as f:
+                f.write("survived\n")
+        if not emit_first:
+            print(json.dumps({"probe_sleep_done": True}))
+        sys.exit(0)
+    _enable_compile_cache()
     if "--sweep-preempt-probe" in sys.argv:
         _sweep_preempt_probe()
         sys.exit(0)
+    def _shape_arg(allowed):
+        shape = allowed[0]
+        gs = [a for a in sys.argv if a.startswith("--gang-shape")]
+        if gs:
+            _, _, shape = gs[0].partition("=")
+            if shape not in allowed:
+                raise SystemExit(
+                    f"--gang-shape must be one of {allowed}, got {shape!r}"
+                )
+        return shape
+
     if "--gang-sweep-probe" in sys.argv:
-        _gang_sweep_probe()
+        _gang_sweep_probe(_shape_arg(("bench", "tiny")))
         sys.exit(0)
     probe = [a for a in sys.argv if a.startswith("--gang-probe")]
     if probe:
@@ -641,15 +989,11 @@ if __name__ == "__main__":
             raise SystemExit(
                 f"--gang-probe mode must be dynamic|static|hybrid, got {mode!r}"
             )
-        shape = "bench"
-        gs = [a for a in sys.argv if a.startswith("--gang-shape")]
-        if gs:
-            _, _, shape = gs[0].partition("=")
-            if shape not in ("bench", "atscale", "tiny"):
-                raise SystemExit(
-                    f"--gang-shape must be bench|atscale|tiny, got {shape!r}"
-                )
-        _gang_probe(mode, shape)
+        _gang_probe(
+            mode,
+            _shape_arg(("bench", "atscale", "tiny")),
+            plain="--gang-plain" in sys.argv,
+        )
     else:
         prof = [a for a in sys.argv if a.startswith("--profile")]
         profile_dir = None

