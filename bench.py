@@ -764,6 +764,10 @@ def main(profile_dir: "str | None" = None):
         d = f"({g['mode']}{var},{g['shape']})={g['gang_dps']}/s in {g['rounds']} rounds"
         if g.get("fallback_from"):
             d += f" [tiny-rung fallback; {g['fallback_from']} shape did not finish]"
+        if g.get("banked_before_timeout"):
+            # the measurement completed; the probe then hung (telemetry
+            # compile) — number valid, tunnel marker set
+            d += " [banked before probe timeout; wedge marker set]"
         if g.get("scheduled") != g.get("pods"):
             d += f" INCOMPLETE ({g['scheduled']}/{g['pods']} placed)"
         return d

@@ -101,10 +101,27 @@ _NO_ORDER = jnp.iinfo(jnp.int32).max
 class GangScheduler:
     """Fixpoint batch scheduler over one `EncodedCluster`.
 
-    record mode is not offered: the per-round trace would be [rounds, P,
-    N, plugins] and rounds are data-dependent. For the reference's
-    per-pod annotation records run the sequential `BatchedScheduler`
-    (same placements whenever the divergence conditions above are met).
+    Result records (`results()` / `run_recorded()`): the reference's
+    product is the per-pod scheduling trace flushed as 13 annotations
+    (reference simulator/scheduler/plugin/resultstore/store.go:129-190).
+    A naive gang trace would be [rounds, P, N, plugins] with
+    data-dependent rounds, so the record path instead runs the fixpoint
+    with a [P] bind-round tensor (`run_tracked`) and then REPLAYS the
+    chronology: each pod is re-evaluated ONCE — with full per-plugin
+    outputs — against the start state of the round that bound it
+    (exactly the state its committing evaluation saw), preempt phases
+    are replayed through the sequential engine's record segments (their
+    semantics are the sequential step's), and fixpoint leftovers are
+    evaluated against the final state (showing why every node fails).
+    Total record cost ~= ONE full evaluation of every pod, not
+    rounds x P.
+
+    One honest gang-specific caveat: a round's matching can commit a
+    pod to its 2nd..k-th best node when an earlier-priority pod takes
+    its argmax in the same round, so a record's `selectedNode` is the
+    ACTUAL committed node, which may not be the argmax of that record's
+    own score table — the score table explains the candidate set, the
+    selection explains the commit.
     """
 
     def __init__(
@@ -184,18 +201,37 @@ class GangScheduler:
         sequential engine's choice against this state), then batching
         resumes up to the next carrier. Two properties follow:
 
-          * soundness — no required term is ever violated in the final
-            state, in either direction: the carrier evaluates against
-            fully-committed state, and no same-round peer can slip
-            under its symmetric anti-affinity (next-round matchers are
-            blocked by the kernel's fail1 check once it is bound);
-          * order fidelity at carrier boundaries — pods before the
-            carrier bind before it, pods after bind after, exactly as
-            the sequential interleaving would. (Without this, carriers
-            committing before earlier-queued matching pods spread over
-            every topology domain first and their symmetric terms then
-            block those pods everywhere; a fuzz workload measured 22%
-            fewer placements than sequential from exactly that —
+          * soundness — no required ANTI-affinity term is ever violated
+            in the final state, in either direction: the carrier
+            evaluates against fully-committed state, and no same-round
+            peer can slip under its symmetric anti-affinity (next-round
+            matchers are blocked by the kernel's fail1 check once it is
+            bound). Positive required terms are satisfied in the final
+            state too, but with one residual feasibility-SHAPED
+            divergence: two series-starting pods whose required positive
+            terms self-match (the first-pod-in-series special case,
+            kernels.py) can batch in one round and pass via the
+            no-matches-anywhere rule in different topology domains,
+            where the sequential engine would co-locate the later with
+            the earlier — final-state required terms still hold under
+            self-inclusion (the invariant the fuzz checker pins,
+            tests/test_engine_fuzz.py), the sequential engine would just
+            have produced a more-co-located layout;
+          * order fidelity at carrier boundaries — for carriers
+            PLACEABLE at their round's start: pods before such a
+            carrier bind before it, pods after bind after, as the
+            sequential interleaving would. A carrier infeasible at
+            round start does not gate the batch (c_min considers
+            placeable carriers only), so later-queued pods can commit
+            past it; if the same round's commits then make it
+            placeable, it binds after pods the sequential engine would
+            have placed behind it — soundness unaffected (it still
+            evaluates against committed state), order not guaranteed.
+            (Without carrier serialization at all, carriers committing
+            before earlier-queued matching pods spread over every
+            topology domain first and their symmetric terms then block
+            those pods everywhere; a fuzz workload measured 22% fewer
+            placements than sequential from exactly that —
             tests/test_engine_fuzz.py.)
 
         Cost: rounds grow by ~one per pending carrier plus chunked
@@ -277,6 +313,14 @@ class GangScheduler:
         )
         self._final_state = None
         self._rounds = None
+        # record path (results()) — all built/filled lazily so the
+        # default fixpoint program and its compile class stay untouched
+        self._run_tracked = None
+        self._rec = None
+        self._eval_rec = None
+        self._chronology = None
+        self._trace = None
+        self._recorded_weights = None
 
     # -- host-side queue encoding ------------------------------------------
 
@@ -463,30 +507,28 @@ class GangScheduler:
             return state, commits.sum().astype(jnp.int32)
 
         self.preempt_phase_fn = preempt_phase if preempt_fn is not None else None
+        # building blocks for the record path (results()): advance a
+        # reconstructed state by one round's commits / re-evaluate pods
+        self._bind_all = bind_all
+        self._eval_attempt = attempt
 
-        def run(arrays, state0, order, weights):
-            """(arrays, state0, order, weights) -> (final_state, rounds).
-
-            `order` comes from `order_arrays()`; passing it as an
-            argument (like the sequential engine's queue) keeps the
-            compiled program reusable across retargets and lets sweeps
-            vmap over `weights` alone.
-            """
+        def make_round_once(arrays, order, weights):
+            """The one dense round (eval → match → bind), shared by the
+            default program (`run`) and the bind-round-tracking record
+            variant (`run_tracked`) so the two can never drift."""
             in_queue = order != _NO_ORDER
-
-            def cond(carry):
-                _, progressed, rounds = carry
-                return progressed & (rounds < max_rounds)
-
             C = arrays.pod_claim.shape[1]
             pod_claim = arrays.pod_claim.astype(bool)
             # [P] pods carrying required ANTI-affinity terms — the only
             # cluster-global coupling that needs serialization: positive
-            # required affinity is monotone (same-round peers can only
-            # SATISFY it, never violate it) and bound pods' positive
-            # terms never block incoming pods (upstream's symmetric
-            # check exists for anti-affinity only), so affinity-only
-            # pods batch freely
+            # required affinity is monotone in the feasibility sense
+            # (same-round peers can only ADD matches, never violate a
+            # term; the residual is the self-matching series-start
+            # divergence documented in __init__ — batched series
+            # starters may split domains sequential would co-locate)
+            # and bound pods' positive terms never block incoming pods
+            # (upstream's symmetric check exists for anti-affinity
+            # only), so affinity-only pods batch freely
             rel_carrier = (
                 (arrays.rel.ian_key >= 0).any(axis=1)
                 if rel_serialize
@@ -662,6 +704,22 @@ class GangScheduler:
                 state = bind_all(state, arrays, commit, sel, order)
                 return state, commit.any()
 
+            return round_once
+
+        def run(arrays, state0, order, weights):
+            """(arrays, state0, order, weights) -> (final_state, rounds).
+
+            `order` comes from `order_arrays()`; passing it as an
+            argument (like the sequential engine's queue) keeps the
+            compiled program reusable across retargets and lets sweeps
+            vmap over `weights` alone.
+            """
+            round_once = make_round_once(arrays, order, weights)
+
+            def cond(carry):
+                _, progressed, rounds = carry
+                return progressed & (rounds < max_rounds)
+
             if static:
                 # counted outer loop too: the whole program is scans, the
                 # same control-flow shape as the sequential engine
@@ -684,6 +742,48 @@ class GangScheduler:
             )
             return state, rounds
 
+        def run_tracked(arrays, state0, order, weights):
+            """`run` plus a [P] bind-round tensor (-1 = not bound this
+            pass): the record path's reconstruction key — results()
+            re-evaluates each pod against the start state of the round
+            that bound it. A separate program so the default (chip-
+            proven) compile class carries nothing extra; the round body
+            is the SAME `make_round_once` closure."""
+            round_once = make_round_once(arrays, order, weights)
+            br0 = jnp.full((P,), -1, jnp.int32)
+            if static:
+
+                def r_scan(carry, r):
+                    state, br = carry
+                    state2, progressed = round_once(state)
+                    newly = (state2.assignment >= 0) & (state.assignment < 0)
+                    br = jnp.where(newly, r, br)
+                    return (state2, br), progressed
+
+                (state, br), progressed = jax.lax.scan(
+                    r_scan,
+                    (state0, br0),
+                    jnp.arange(self.static_rounds, dtype=jnp.int32),
+                )
+                return state, progressed.sum().astype(jnp.int32), br
+
+            def t_cond(carry):
+                _, progressed, rounds, _ = carry
+                return progressed & (rounds < max_rounds)
+
+            def t_body(carry):
+                state, _, rounds, br = carry
+                state2, progressed = round_once(state)
+                newly = (state2.assignment >= 0) & (state.assignment < 0)
+                br = jnp.where(newly, rounds, br)
+                return state2, progressed, rounds + jnp.int32(1), br
+
+            state, _, rounds, br = jax.lax.while_loop(
+                t_cond, t_body, (state0, jnp.bool_(True), jnp.int32(0), br0)
+            )
+            return state, rounds, br
+
+        self.run_tracked_fn = run_tracked
         return run
 
     # -- execution ----------------------------------------------------------
@@ -706,13 +806,31 @@ class GangScheduler:
         preempt phases: rounds settle → the (few) still-pending pods go
         through the compiled sequential preempt pass → rounds resume;
         the host loop stops when a phase binds nothing."""
+        return self._drive(weights, chronology=None)
+
+    def _drive(self, weights, chronology: "list | None"):
+        """The ONE host driver behind `run()` and `run_recorded()`:
+        gang passes (with the static auto-resume rule) alternating with
+        preempt phases. When `chronology` is given, each pass runs the
+        bind-round-tracking program and appends its replay entry, each
+        phase appends its segment, and fixpoint leftovers append theirs
+        — identical control flow either way, so the record path can
+        never drift from the default one. (parallel/sweep.py gang_pass
+        carries the per-variant-array form of the resume rule — keep
+        the two in step.)"""
         w = self.weights if weights is None else weights
         order, in_q = self.order_arrays()
         arrays = self.enc.arrays
+        tracked = chronology is not None
+        if tracked and self._run_tracked is None:
+            self._run_tracked = jax.jit(self.run_tracked_fn)
         # the eligibility mask feeds host-side pending counts, which only
-        # the static auto-resume and the preempt-phase loop read — the
-        # plain dynamic path must not pay the two [P] host transfers
-        need_pending = self.loop == "static" or self._preempt_phase is not None
+        # the static auto-resume, the preempt-phase loop, and the record
+        # path read — the plain dynamic path must not pay the two [P]
+        # host transfers
+        need_pending = (
+            self.loop == "static" or self._preempt_phase is not None or tracked
+        )
         eligible = (
             np.asarray(in_q) & np.asarray(arrays.pod_mask)
             if need_pending
@@ -722,8 +840,24 @@ class GangScheduler:
         def pending_count(state) -> int:
             return int(((np.asarray(state.assignment) < 0) & eligible).sum())
 
+        def one_pass(state):
+            """One compiled pass (+ chronology entry when tracked)."""
+            if tracked:
+                state, rounds, br = self._run_tracked(arrays, state, order, w)
+                chronology.append(
+                    (
+                        "rounds",
+                        np.asarray(br),
+                        int(np.asarray(rounds)),
+                        np.asarray(state.assignment),
+                    )
+                )
+            else:
+                state, rounds = self._run(arrays, state, order, w)
+            return state, rounds
+
         def gang_pass(state):
-            state, rounds = self._run(arrays, state, order, w)
+            state, rounds = one_pass(state)
             if self.loop != "static":
                 return state, rounds
             # static auto-resume: continue while the LAST pass used its
@@ -732,8 +866,6 @@ class GangScheduler:
             # is infeasible, not under-budgeted. An EXPLICIT max_rounds
             # stays a TOTAL cap across passes, matching its hard-cap role
             # in the dynamic loop — never an unbounded-latency trap.
-            # (parallel/sweep.py gang_pass carries the per-variant-array
-            # form of this rule — keep the two in step.)
             total = rounds
             committed = last = int(np.asarray(rounds))
             pend = pending_count(state)
@@ -742,7 +874,7 @@ class GangScheduler:
                 and last >= self.static_rounds
                 and (self.max_rounds is None or committed < self.max_rounds)
             ):
-                state2, r2 = self._run(arrays, state, order, w)
+                state2, r2 = one_pass(state)
                 total = total + r2
                 last = int(np.asarray(r2))
                 committed += last
@@ -763,6 +895,10 @@ class GangScheduler:
                 if pending.size == 0:
                     break
                 pending = pending[np.argsort(order_np[pending])]
+                if tracked:
+                    # recorded even when the phase binds nothing: the
+                    # no-progress phase IS the leftovers' failure record
+                    chronology.append(("phase", pending.astype(np.int32)))
                 # pow2 padding bounds distinct compilations to log2(P)
                 pad = 1 << int(pending.size - 1).bit_length()
                 seg = np.full((max(pad, 1),), -1, np.int32)
@@ -774,8 +910,18 @@ class GangScheduler:
                     break
                 state, r2 = gang_pass(state)
                 rounds = rounds + r2
+        elif tracked:
+            leftovers = np.nonzero(
+                (np.asarray(state.assignment) < 0) & eligible
+            )[0]
+            if leftovers.size:
+                chronology.append(("leftover", leftovers.astype(np.int32)))
         self._final_state = state
         self._rounds = rounds
+        if tracked:
+            self._chronology = chronology
+            self._recorded_weights = w
+            self._trace = None  # decoded lazily by results()
         return state, rounds
 
     def placements(self) -> dict[tuple[str, str], str]:
@@ -783,6 +929,187 @@ class GangScheduler:
         if self._final_state is None:
             self.run()
         return self.enc.decode_assignment(self._final_state.assignment)
+
+    # -- record path (the reference's 13-annotation product) ---------------
+
+    def run_recorded(self, weights: "jnp.ndarray | None" = None):
+        """Execute to fixpoint like `run()` — same host driver, the
+        bind-round-tracking program — additionally capturing the replay
+        chronology the record decode needs: per gang pass, the [P]
+        bind-round tensor plus the pass-end assignment snapshot; per
+        preempt phase, its pending segment; plus the fixpoint leftovers
+        when no preempt phase exists. Returns (state, rounds),
+        bit-identical placements to `run()` (test-pinned)."""
+        return self._drive(weights, chronology=[])
+
+    def _recorder(self) -> BatchedScheduler:
+        """The record-mode base engine the decode borrows: its kernel
+        name tables, its `_run_segment` (phase replay), and its
+        `results()` (the one definition of the wire format)."""
+        if self._rec is None:
+            self._rec = BatchedScheduler(self.enc, record=True, strict=False)
+        return self._rec
+
+    def _assemble_trace(self) -> tuple:
+        """Replay the chronology into the sequential engine's trace slot
+        layout ([Q, ...] per-queue-position tensors, sparse for the
+        [N, P] victim masks) so `BatchedScheduler.results()` decodes
+        gang runs with zero new wire-format code."""
+        from .engine import (
+            TRACE_SLOTS_PREEMPT,
+            TRACE_SPARSE_SLOTS,
+            _SparseRows,
+        )
+
+        enc = self.enc
+        rec = self._recorder()
+        arrays = enc.arrays
+        wj = self._recorded_weights
+        order, _ = self.order_arrays()
+        queue = np.asarray(enc.queue)
+        Q = len(queue)
+        qpos = {int(p): qi for qi, p in enumerate(queue)}
+        N, P = enc.N, enc.P
+        has_pf = rec._preempt is not None
+        nPF = len(rec._prefilter_kernel_names)
+        F = len(rec._filter_names)
+        S = len(rec._score_specs)
+        sdt = np.dtype(jnp.zeros((), enc.policy.score).dtype.name)
+        pf_codes = np.zeros((Q, nPF), np.int32)
+        codes = np.zeros((Q, N, F), np.int32)
+        raw = np.zeros((Q, N, S), sdt)
+        final = np.zeros((Q, N, S), sdt)
+        sel = np.full((Q,), -1, np.int32)
+        if has_pf:
+            did = np.zeros((Q,), bool)
+            nominated = np.full((Q,), -1, np.int32)
+            sel2 = np.full((Q,), -1, np.int32)
+            nominated2 = np.full((Q,), -1, np.int32)
+            final_sel = np.full((Q,), -1, np.int32)
+            sparse: dict[str, dict] = {
+                n: {}
+                for n in (
+                    "pcode", "vmask", "codes2", "raw2", "final2",
+                    "pcode2", "vmask2",
+                )
+            }
+        if self._eval_rec is None:
+            # ONE compiled chunk evaluator for every round/leftover pod;
+            # chunks are padded by repeating the first pod (evaluation
+            # is read-only, duplicates are discarded host-side)
+            self._eval_rec = jax.jit(
+                jax.vmap(rec._attempt, in_axes=(None, None, None, 0))
+            )
+        CH = max(1, min(128, P))
+
+        def record_eval(state, pod_ids, assign_after):
+            for i in range(0, len(pod_ids), CH):
+                chunk = pod_ids[i : i + CH]
+                padded = np.full((CH,), chunk[0], np.int32)
+                padded[: len(chunk)] = chunk
+                pf, cd, rw, fn, _s, _ok = self._eval_rec(
+                    state, arrays, wj, jnp.asarray(padded)
+                )
+                pf, cd, rw, fn = (np.asarray(x) for x in (pf, cd, rw, fn))
+                for j, p in enumerate(chunk):
+                    qi = qpos[int(p)]
+                    pf_codes[qi] = pf[j]
+                    codes[qi] = cd[j]
+                    raw[qi] = rw[j]
+                    final[qi] = fn[j]
+                    if assign_after is not None:
+                        committed = np.int32(assign_after[int(p)])
+                        sel[qi] = committed
+                        if has_pf:
+                            final_sel[qi] = committed
+
+        state = enc.state0
+        bind_all_j = jax.jit(self._bind_all)
+        for entry in self._chronology:
+            kind = entry[0]
+            if kind == "rounds":
+                _, br, n_rounds, assign_after = entry
+                for r in range(n_rounds):
+                    pods_r = np.nonzero(br == r)[0].astype(np.int32)
+                    if pods_r.size == 0:
+                        continue
+                    record_eval(state, pods_r, assign_after)
+                    mask = np.zeros((P,), bool)
+                    mask[pods_r] = True
+                    selv = np.where(mask, assign_after, -1).astype(np.int32)
+                    state = bind_all_j(
+                        state, arrays, jnp.asarray(mask), jnp.asarray(selv),
+                        order,
+                    )
+            elif kind == "phase":
+                # the sequential engine's record segments replay the
+                # phase pod-by-pod (phase semantics ARE the sequential
+                # step's — engine.py step() vs preempt_phase pstep)
+                for p in entry[1]:
+                    qi = qpos[int(p)]
+                    state, out = rec._run_segment(
+                        arrays,
+                        state,
+                        jnp.asarray([int(p)], queue.dtype),
+                        jnp.asarray([qi], jnp.int32),
+                        wj,
+                    )
+                    vals = dict(zip(TRACE_SLOTS_PREEMPT, out))
+                    pf_codes[qi] = np.asarray(vals["pf_codes"])[0]
+                    codes[qi] = np.asarray(vals["codes"])[0]
+                    raw[qi] = np.asarray(vals["raw"])[0]
+                    final[qi] = np.asarray(vals["final"])[0]
+                    sel[qi] = int(np.asarray(vals["sel"])[0])
+                    did[qi] = bool(np.asarray(vals["did"])[0])
+                    nominated[qi] = int(np.asarray(vals["nominated"])[0])
+                    sel2[qi] = int(np.asarray(vals["sel2"])[0])
+                    nominated2[qi] = int(np.asarray(vals["nominated2"])[0])
+                    final_sel[qi] = int(np.asarray(vals["final_sel"])[0])
+                    if did[qi]:
+                        for nm in sparse:
+                            sparse[nm][qi] = np.asarray(vals[nm])[0]
+            else:  # leftover (no preempt phase configured)
+                record_eval(state, entry[1], None)
+
+        if not has_pf:
+            return (pf_codes, codes, raw, final, sel)
+        row_shapes = {
+            "pcode": ((N,), np.int32),
+            "vmask": ((N, P), bool),
+            "codes2": ((N, F), np.int32),
+            "raw2": ((N, S), sdt),
+            "final2": ((N, S), sdt),
+            "pcode2": ((N,), np.int32),
+            "vmask2": ((N, P), bool),
+        }
+        by_name = {
+            "pf_codes": pf_codes, "codes": codes, "raw": raw,
+            "final": final, "sel": sel, "did": did,
+            "nominated": nominated, "sel2": sel2,
+            "nominated2": nominated2, "final_sel": final_sel,
+        }
+        trace = []
+        for i, name in enumerate(TRACE_SLOTS_PREEMPT):
+            if i in TRACE_SPARSE_SLOTS:
+                shape, dtype = row_shapes[name]
+                trace.append(_SparseRows(sparse[name], shape, dtype))
+            else:
+                trace.append(by_name[name])
+        return tuple(trace)
+
+    def results(self, pods: "set[tuple[str, str]] | None" = None):
+        """The reference's per-pod scheduling records for a gang run
+        (13-annotation wire format, decoded by the sequential engine's
+        `results()` — one definition of the format). Runs
+        `run_recorded()` first when needed."""
+        if self._chronology is None:
+            self.run_recorded()
+        if self._trace is None:
+            self._trace = self._assemble_trace()
+        rec = self._recorder()
+        rec._trace = self._trace
+        rec._final_state = self._final_state
+        return rec.results(pods)
 
     @staticmethod
     def compile_signature(enc: EncodedCluster) -> tuple:
@@ -804,4 +1131,14 @@ class GangScheduler:
         self.enc = enc
         self._final_state = None
         self._rounds = None
+        # record state is per-encoding. _run_tracked survives (its
+        # shapes are part of the signature just checked); the recorder
+        # and its chunk evaluator bake enc-derived statics via their own
+        # kernel constructors, so rebuild them lazily (jit is lazy and
+        # the persistent compile cache absorbs the repeat).
+        self._chronology = None
+        self._trace = None
+        self._recorded_weights = None
+        self._rec = None
+        self._eval_rec = None
         return self

@@ -170,7 +170,11 @@ class ScenarioRunner:
                 }
 
             before = pod_keys()
-            placements, _ = self.scheduler.schedule_gang()
+            # record=False: the scenario product is the timeline +
+            # final state, not per-pod annotations — and annotation
+            # write-backs would change exported snapshots the scenario
+            # determinism fuzz compares
+            placements, _, _ = self.scheduler.schedule_gang(record=False)
             changed = False
             for ns, name in sorted(before - pod_keys()):
                 record(

@@ -226,28 +226,40 @@ def _make_handler(server: SimulatorServer):
                             400, f"unknown scheduling mode {mode!r}"
                         )
                     if mode == "gang":
+                        # records default ON (the annotations are the
+                        # product); ?record=0 is the bulk opt-out
+                        rec_q = parse_qs(url.query).get("record", ["1"])[0]
+                        record = rec_q not in ("0", "false", "no")
                         try:
-                            placements, rounds = (
-                                service.scheduler.schedule_gang()
+                            placements, rounds, results = (
+                                service.scheduler.schedule_gang(record=record)
                             )
                         except ValueError as e:
                             # known-unsupported combination (extenders
                             # configured) is the client's request, not a
                             # server fault
                             return self._error(400, str(e))
-                        return self._json(
-                            200,
-                            {
-                                "mode": "gang",
-                                "rounds": rounds,
-                                "scheduled": sum(
-                                    1 for v in placements.values() if v
-                                ),
-                                "unschedulable": sum(
-                                    1 for v in placements.values() if not v
-                                ),
-                            },
-                        )
+                        body = {
+                            "mode": "gang",
+                            "rounds": rounds,
+                            "scheduled": sum(
+                                1 for v in placements.values() if v
+                            ),
+                            "unschedulable": sum(
+                                1 for v in placements.values() if not v
+                            ),
+                        }
+                        if results is not None:
+                            body["results"] = [
+                                {
+                                    "namespace": r.pod_namespace,
+                                    "name": r.pod_name,
+                                    "status": r.status,
+                                    "selectedNode": r.selected_node,
+                                }
+                                for r in results
+                            ]
+                        return self._json(200, body)
                     results = service.scheduler.schedule()
                     return self._json(
                         200,

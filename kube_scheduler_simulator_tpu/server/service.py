@@ -173,15 +173,24 @@ class SchedulerService:
                 )
             return results
 
-    def schedule_gang(self) -> tuple[dict, int]:
+    def schedule_gang(
+        self, record: bool = True
+    ) -> "tuple[dict, int, list[PodSchedulingResult] | None]":
         """Gang pass with pass serialization; returns
-        ({(ns, name): node | ""}, rounds)."""
+        ({(ns, name): node | ""}, rounds, results).
+
+        `record=True` (default — the annotations ARE the product,
+        reference resultstore/store.go:129-190) runs the record path:
+        the 13 result annotations are written back onto every queued
+        pod exactly like the sequential pass, and the per-pod records
+        are returned. `record=False` is the bulk-throughput opt-out
+        (results is None, only nodeName is written back)."""
         if self.disabled:
             raise SchedulerServiceDisabled()
         with self._schedule_lock:
-            return self._schedule_gang_timed()
+            return self._schedule_gang_timed(record)
 
-    def _schedule_gang_timed(self) -> tuple[dict, int]:
+    def _schedule_gang_timed(self, record: bool):
         with self._lock:
             config = self._config
         if config.extenders:
@@ -189,23 +198,25 @@ class SchedulerService:
                 "gang mode does not support extenders; use sequential mode"
             )
         with self.metrics.time_pass("gang") as ctx:
-            placements, rounds = self._schedule_gang_locked(config)
+            placements, rounds, results = self._schedule_gang_locked(
+                config, record
+            )
             ctx.done(
                 pods=len(placements),
                 scheduled=sum(1 for v in placements.values() if v),
                 rounds=rounds,
             )
-        return placements, rounds
+        return placements, rounds, results
 
-    def _schedule_gang_locked(self, config) -> tuple[dict, int]:
-        """Gang pass: encode, run to fixpoint, write nodeName back."""
+    def _schedule_gang_locked(self, config, record: bool):
+        """Gang pass: encode, run to fixpoint, write results back."""
         import numpy as np
 
         from ..engine.gang import GangScheduler
 
         enc = self._encode_current(config)
         if enc is None:
-            return {}, 0
+            return {}, 0, ([] if record else None)
         sig = GangScheduler.compile_signature(enc)
         cache = self._gang_engine_cache
         if cache and cache[0] == sig:
@@ -213,7 +224,12 @@ class SchedulerService:
         else:
             gang = GangScheduler(enc, strict=True)
             self._gang_engine_cache = (sig, gang)
-        _, rounds = gang.run()
+        if record:
+            _, rounds = gang.run_recorded()
+            results = gang.results()
+        else:
+            _, rounds = gang.run()
+            results = None
         placements = gang.placements()
         # preemption victims: pre-bound pods the preempt phase evicted.
         # They are NOT in placements (decode covers queued pods only), so
@@ -224,18 +240,38 @@ class SchedulerService:
         for p_idx in np.nonzero((before >= 0) & (after < 0))[0]:
             ns, name = enc.pod_keys[int(p_idx)]
             self.store.delete("pods", name, ns)
-        for (ns, name), node_name in placements.items():
-            if not node_name:
-                continue
-            if self.store.get("pods", name, ns) is not None:
-                self.store.apply(
-                    "pods",
-                    {
-                        "metadata": {"name": name, "namespace": ns},
-                        "spec": {"nodeName": node_name},
-                    },
-                )
-        return placements, int(np.asarray(rounds))
+        if results is not None:
+            # the sequential write-back rule: last record per pod wins
+            # (a nominated pod's retry overwrites its first record)
+            for res in results:
+                patch: dict = {
+                    "metadata": {
+                        "name": res.pod_name,
+                        "namespace": res.pod_namespace,
+                        "annotations": res.to_annotations(),
+                    }
+                }
+                sel = placements.get((res.pod_namespace, res.pod_name), "")
+                if sel:
+                    patch["spec"] = {"nodeName": sel}
+                if (
+                    self.store.get("pods", res.pod_name, res.pod_namespace)
+                    is not None
+                ):
+                    self.store.apply("pods", patch)
+        else:
+            for (ns, name), node_name in placements.items():
+                if not node_name:
+                    continue
+                if self.store.get("pods", name, ns) is not None:
+                    self.store.apply(
+                        "pods",
+                        {
+                            "metadata": {"name": name, "namespace": ns},
+                            "spec": {"nodeName": node_name},
+                        },
+                    )
+        return placements, int(np.asarray(rounds)), results
 
     def _encode_current(self, config) -> "object | None":
         """Encode the store's current pending state under the pass's

@@ -166,6 +166,36 @@ class TestAuthoringWorkflow:
         with pytest.raises(urllib.error.HTTPError):
             _req(f"{base}/api/v1/resources/pods/default/{pod_name}")
 
+    def test_gang_schedule_inspect(self):
+        """The page's 'Schedule (gang)' button: a gang run must leave
+        the same per-plugin annotations the detail panel renders
+        (VERDICT r4 #6 — gang mode used to emit no records)."""
+        base = self.base
+        _req(
+            f"{base}/api/v1/resources/nodes",
+            data=TEMPLATES["nodes"],
+            method="POST",
+            ctype="application/yaml",
+        )
+        st, body = _req(
+            f"{base}/api/v1/resources/pods",
+            data=TEMPLATES["pods"],
+            method="POST",
+            ctype="application/yaml",
+        )
+        pod_name = json.loads(body)["metadata"]["name"]
+        st, body = _req(
+            f"{base}/api/v1/schedule?mode=gang", data=b"", method="POST"
+        )
+        assert st == 200 and json.loads(body)["scheduled"] == 1
+        st, body = _req(f"{base}/api/v1/resources/pods/default/{pod_name}")
+        pod = json.loads(body)
+        assert pod["spec"]["nodeName"]
+        ann = pod["metadata"]["annotations"]
+        assert "scheduler-simulator/filter-result" in ann
+        assert "scheduler-simulator/score-result" in ann
+        assert "scheduler-simulator/selected-node" in ann
+
     def test_all_templates_create_valid_objects(self):
         for kind in TEMPLATES:
             st, body = _req(
