@@ -195,7 +195,10 @@ def _device_watchdog(timeout_s: "float | None" = None) -> str:
     )
 
 
-def _gang_probe(mode: str, shape: str = "bench", plain: bool = False):
+def _gang_probe(
+    mode: str, shape: str = "bench", plain: bool = False,
+    inner_iters: int = 64,
+):
     """Subprocess mode (`bench.py --gang-probe=<dynamic|static>
     [--gang-shape=bench|atscale]`): measure the gang scheduler and print
     one JSON line. Run isolated because gang's dynamic `lax.while_loop`
@@ -244,17 +247,23 @@ def _gang_probe(mode: str, shape: str = "bench", plain: bool = False):
         seed, chunk, reps = 42, 128, 3
     nodes, pods = synthetic_cluster(n_nodes, n_pods, seed=seed)
     enc = encode_cluster(nodes, pods, supported_config(), policy=TPU32)
+    # --gang-inner=K trades matching depth for rounds: the CPU-measured
+    # trade at 2048x256 is 64 iters x 9 rounds = 576 dependent
+    # iterations vs 16 x 19 = 304 — a manual chip experiment flag (the
+    # automated ladder keeps the proven 64), placements stay valid at
+    # any K (losers past the depth retry next round)
     variant_kw = dict(compact=not plain, rel_serialize=not plain)
     if mode == "static":
         gang = GangScheduler(
-            enc, chunk=chunk, loop="static", inner_iters=64, **variant_kw
+            enc, chunk=chunk, loop="static", inner_iters=inner_iters,
+            **variant_kw,
         )
     elif mode == "hybrid":
         # static outer scan (the axon-compilable shape) + while-loop
         # matching that exits when the round settles — the matching scan
         # is the round's latency floor on the chip (BASELINE.md)
         gang = GangScheduler(
-            enc, chunk=chunk, loop="static", inner_iters=64,
+            enc, chunk=chunk, loop="static", inner_iters=inner_iters,
             inner_loop="dynamic", **variant_kw,
         )
     else:
@@ -274,6 +283,7 @@ def _gang_probe(mode: str, shape: str = "bench", plain: bool = False):
         "gang_dps": round(n_pods / best, 1),
         "mode": mode,
         "variant": "plain" if plain else "default",
+        **({"inner_iters": inner_iters} if inner_iters != 64 else {}),
         "shape": f"{n_pods}x{n_nodes}",
         "rounds": int(np.asarray(rounds)),
         "scheduled": int((np.asarray(state.assignment) >= 0).sum()),
@@ -394,7 +404,11 @@ def _sweep_preempt_probe():
 
     import os
 
-    n_nodes, n_pods, n_var = N_NODES, N_PODS, max(2, N_VARIANTS // 4)
+    # full variant count since r5: the phase event loop removed the
+    # per-step victim-search tax (the //4 shrink existed because masked
+    # mode was ~140x slower); CPU fallback keeps //4 for r3/r4 number
+    # comparability
+    n_nodes, n_pods, n_var = N_NODES, N_PODS, N_VARIANTS
     if os.environ.get("_KSS_BENCH_CPU_FALLBACK"):
         n_nodes, n_pods = CPU_FALLBACK["N_NODES"], CPU_FALLBACK["N_PODS"]
         n_var = max(2, CPU_FALLBACK["N_VARIANTS"] // 4)
@@ -993,10 +1007,16 @@ if __name__ == "__main__":
             raise SystemExit(
                 f"--gang-probe mode must be dynamic|static|hybrid, got {mode!r}"
             )
+        inner = 64
+        gi = [a for a in sys.argv if a.startswith("--gang-inner")]
+        if gi:
+            _, _, inner = gi[0].partition("=")
+            inner = int(inner)
         _gang_probe(
             mode,
             _shape_arg(("bench", "atscale", "tiny")),
             plain="--gang-plain" in sys.argv,
+            inner_iters=inner,
         )
     else:
         prof = [a for a in sys.argv if a.startswith("--profile")]

