@@ -138,6 +138,7 @@ class GangScheduler:
         compact: bool = True,
         inner_loop: "str | None" = None,
         rel_serialize: bool = True,
+        eval_window: "int | None" = None,
     ):
         """loop="dynamic" (default) runs rounds under `lax.while_loop`
         until a round commits nothing. loop="static" runs a FIXED number
@@ -250,7 +251,27 @@ class GangScheduler:
         evaluation work drops from rounds x P to ~sum of per-round
         pending counts (~P^2/2N on uniform workloads). Turn it off under
         `vmap` (GangSweep does): vmapped `cond` lowers to both-branches
-        select, so there is nothing to skip."""
+        select, so there is nothing to skip.
+
+        `eval_window` (default None = off; requires `compact`) bounds
+        each round's evaluation to the first `eval_window` PENDING pods
+        in queue order, rounded UP to the chunk boundary (the window is
+        chunk-granular: the last live chunk is evaluated whole, so the
+        effective window is ceil(W/chunk)*chunk) — the chip lever for
+        the eval-bound round wall
+        (round-5 measurement: ~95% of a live round is evaluation, yet
+        only ~N pods can commit per round, so evaluating all pending
+        pays ~P/2N times the useful work). Rounds become queue-prefix
+        greedy: pods beyond the window wait, exactly like losers of a
+        `match_width`/`inner_iters` depth bound. A windowed round that
+        commits NOTHING with pods still pending triggers one full-width
+        round (the `stuck` carry) so fixpoint detection stays sound:
+        the loop exits only when a FULL round commits nothing, and the
+        static auto-resume counts stuck probes as progress so windowed
+        passes never strand pods. Placements are a different valid
+        greedy order than the unwindowed fixpoint (same class of
+        divergence as `match_width`; all invariants hold — fuzz-pinned
+        in tests/test_engine_fuzz.py)."""
         self.enc = enc
         self.chunk = int(chunk)
         # fallback depth of the per-round matching: how many next-best
@@ -270,6 +291,18 @@ class GangScheduler:
             match_width = enc.N if enc.N <= 512 else 128
         self.match_width = max(1, min(int(match_width), enc.N))
         self.compact = bool(compact)
+        if eval_window is not None:
+            eval_window = int(eval_window)
+            if eval_window < 1:
+                raise ValueError(
+                    f"eval_window must be >= 1, got {eval_window}"
+                )
+            if not self.compact:
+                raise ValueError(
+                    "eval_window requires compact=True (the window rides"
+                    " the compaction permutation)"
+                )
+        self.eval_window = eval_window
         if loop not in ("dynamic", "static"):
             raise ValueError(f"loop must be dynamic|static, got {loop!r}")
         self.loop = loop
@@ -345,7 +378,21 @@ class GangScheduler:
         n_chunks = -(-P // CH)
         P_pad = n_chunks * CH
         attempt = self._base._attempt
-        max_rounds = self.max_rounds if self.max_rounds is not None else P + 1
+        # Dynamic-loop livelock guard. Unwindowed, every progressing
+        # round commits >= 1 pod, so P+1 bounds the loop. With
+        # eval_window, a committing full round can be preceded by one
+        # non-committing stuck-probe round (which still counts as
+        # progress — see round_once), so the worst case is 2 rounds per
+        # commit plus the final probe/full exit pair: 2P+2, not P+1
+        # (code-review r5: P+1 exhausted the budget on a 1-node cluster
+        # with an infeasible window prefix and silently stranded
+        # feasible pods).
+        if self.max_rounds is not None:
+            max_rounds = self.max_rounds
+        elif self.eval_window is not None:
+            max_rounds = 2 * P + 2
+        else:
+            max_rounds = P + 1
         inner_iters = self.inner_iters
         MW = self.match_width
         static = self.loop == "static"
@@ -358,8 +405,9 @@ class GangScheduler:
         FLOOR = NEG
 
         compact = self.compact
+        W = self.eval_window
 
-        def eval_all(state, a, weights, pending):
+        def eval_all(state, a, weights, pending, order, full_eval):
             """[P, N] masked total scores (NEG where infeasible),
             evaluated against `state`.
 
@@ -374,6 +422,17 @@ class GangScheduler:
             `lax.cond` — later rounds pay for their pending count, not
             for P. Settled pods' rows are floor either way (the caller
             masks on `pending`), so placements cannot depend on it.
+
+            Windowing (`eval_window`): the permutation becomes
+            queue-order-within-pending and only the first
+            min(n_pending, W) rows are live — unless `full_eval` (the
+            stuck-probe round), which restores the full pending count.
+            Out-of-window pods' rows are floor, so they cannot commit
+            this round; every in-window pod is queue-before every
+            out-of-window pending pod, which is what keeps the
+            rel_serialize carrier-prefix argument intact (a carrier
+            beyond the window is not placeable this round, and all
+            commits are before it in queue order).
             """
 
             def one_pod(state, a, weights, p):
@@ -401,8 +460,21 @@ class GangScheduler:
                 lambda s, aa, w: one_pod(s, aa, w, jnp.int32(0)),
                 state, a, weights,
             ).dtype
-            perm = jnp.argsort(~pending).astype(jnp.int32)
-            n_pending = pending.sum()
+            if W is None:
+                perm = jnp.argsort(~pending).astype(jnp.int32)
+                n_live = pending.sum()
+            else:
+                # queue-order within pending so the window is a strict
+                # queue prefix of the still-pending pods
+                perm = jnp.argsort(
+                    jnp.where(pending, order, _NO_ORDER)
+                ).astype(jnp.int32)
+                n_pending = pending.sum()
+                n_live = jnp.where(
+                    full_eval,
+                    n_pending,
+                    jnp.minimum(n_pending, jnp.int32(W)),
+                )
             if P_pad > P:
                 rows = jnp.concatenate(
                     [perm, jnp.full((P_pad - P,), jnp.int32(P))]
@@ -425,7 +497,7 @@ class GangScheduler:
                     return jnp.full((CH, N), NEG, row_dt)
 
                 return jax.lax.cond(
-                    i * CH < n_pending, live, settled, None
+                    i * CH < n_live, live, settled, None
                 )
 
             flat = jax.lax.map(
@@ -695,14 +767,29 @@ class GangScheduler:
                 sel_carrier = jnp.where(is_pick, cand, jnp.int32(-1))
                 return jnp.where(have_carrier, sel_carrier, sel_acc)
 
-            def round_once(state):
+            def round_once(state, full_eval=None):
+                """One dense round. With `eval_window` the caller passes
+                `full_eval` (the stuck-probe flag) and gets back
+                (state, committed, progressed): `committed` feeds the
+                stuck carry (~committed → next round is full-width),
+                `progressed` is the loop-exit/auto-resume signal — a
+                windowed round with pods pending always counts (the
+                follow-up full round is the real fixpoint test)."""
                 pending = (state.assignment < 0) & in_queue & arrays.pod_mask
-                scores = eval_all(state, arrays, weights, pending)
+                if W is None:
+                    fe = jnp.bool_(True)
+                else:
+                    fe = full_eval
+                scores = eval_all(state, arrays, weights, pending, order, fe)
                 scores = jnp.where(pending[:, None], scores, FLOOR)
                 sel = match(scores)
                 commit = sel >= 0
                 state = bind_all(state, arrays, commit, sel, order)
-                return state, commit.any()
+                committed = commit.any()
+                if W is None:
+                    return state, committed
+                progressed = committed | ((~fe) & pending.any())
+                return state, committed, progressed
 
             return round_once
 
@@ -723,6 +810,23 @@ class GangScheduler:
             if static:
                 # counted outer loop too: the whole program is scans, the
                 # same control-flow shape as the sequential engine
+                if W is not None:
+
+                    def rw_scan(carry, _):
+                        state, stuck = carry
+                        state, committed, progressed = round_once(
+                            state, stuck
+                        )
+                        return (state, ~committed), progressed
+
+                    (state, _), progressed = jax.lax.scan(
+                        rw_scan,
+                        (state0, jnp.bool_(False)),
+                        None,
+                        length=self.static_rounds,
+                    )
+                    return state, progressed.sum().astype(jnp.int32)
+
                 def r_scan(state, _):
                     state, progressed = round_once(state)
                     return state, progressed
@@ -731,6 +835,26 @@ class GangScheduler:
                     r_scan, state0, None, length=self.static_rounds
                 )
                 return state, progressed.sum().astype(jnp.int32)
+
+            if W is not None:
+
+                def w_cond(carry):
+                    _, progressed, rounds, _ = carry
+                    return progressed & (rounds < max_rounds)
+
+                def w_body(carry):
+                    state, _, rounds, stuck = carry
+                    state, committed, progressed = round_once(state, stuck)
+                    return (
+                        state, progressed, rounds + jnp.int32(1), ~committed
+                    )
+
+                state, _, rounds, _ = jax.lax.while_loop(
+                    w_cond,
+                    w_body,
+                    (state0, jnp.bool_(True), jnp.int32(0), jnp.bool_(False)),
+                )
+                return state, rounds
 
             def body(carry):
                 state, _, rounds = carry
@@ -752,6 +876,25 @@ class GangScheduler:
             round_once = make_round_once(arrays, order, weights)
             br0 = jnp.full((P,), -1, jnp.int32)
             if static:
+                if W is not None:
+
+                    def rw_scan(carry, r):
+                        state, br, stuck = carry
+                        state2, committed, progressed = round_once(
+                            state, stuck
+                        )
+                        newly = (
+                            (state2.assignment >= 0) & (state.assignment < 0)
+                        )
+                        br = jnp.where(newly, r, br)
+                        return (state2, br, ~committed), progressed
+
+                    (state, br, _), progressed = jax.lax.scan(
+                        rw_scan,
+                        (state0, br0, jnp.bool_(False)),
+                        jnp.arange(self.static_rounds, dtype=jnp.int32),
+                    )
+                    return state, progressed.sum().astype(jnp.int32), br
 
                 def r_scan(carry, r):
                     state, br = carry
@@ -766,6 +909,32 @@ class GangScheduler:
                     jnp.arange(self.static_rounds, dtype=jnp.int32),
                 )
                 return state, progressed.sum().astype(jnp.int32), br
+
+            if W is not None:
+
+                def tw_cond(carry):
+                    _, progressed, rounds, _, _ = carry
+                    return progressed & (rounds < max_rounds)
+
+                def tw_body(carry):
+                    state, _, rounds, br, stuck = carry
+                    state2, committed, progressed = round_once(state, stuck)
+                    newly = (state2.assignment >= 0) & (state.assignment < 0)
+                    br = jnp.where(newly, rounds, br)
+                    return (
+                        state2, progressed, rounds + jnp.int32(1), br,
+                        ~committed,
+                    )
+
+                state, _, rounds, br, _ = jax.lax.while_loop(
+                    tw_cond,
+                    tw_body,
+                    (
+                        state0, jnp.bool_(True), jnp.int32(0), br0,
+                        jnp.bool_(False),
+                    ),
+                )
+                return state, rounds, br
 
             def t_cond(carry):
                 _, progressed, rounds, _ = carry

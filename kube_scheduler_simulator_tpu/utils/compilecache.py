@@ -1,0 +1,41 @@
+"""The one definition of the repo-local persistent XLA compile cache.
+
+bench.py, tests/conftest.py, and tools/config5_e2e.py all want the same
+thing: repeat compiles of an identical program (across processes AND
+across judge re-runs) are disk hits, not fresh XLA compiles. Before this
+helper each carried its own copy and they drifted (different thresholds,
+only conftest honoring the KSS_JAX_CACHE_DIR override — code-review r5).
+
+The default directory is `.jax_cache` at the repo root (gitignored):
+per-checkout isolation — a world-shared /tmp dir would break on
+multi-user hosts and let another local user plant crafted cache entries
+that deserialize into in-process executables.
+"""
+
+from __future__ import annotations
+
+import os
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def enable_compile_cache(min_compile_time_secs: float = 0.1) -> None:
+    """Point JAX at the persistent compile cache. Honors the
+    KSS_JAX_CACHE_DIR env override (what conftest always did). Safe to
+    call repeatedly; failures are swallowed — the cache is an
+    optimization, never a correctness dependency."""
+    try:
+        import jax
+
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get(
+                "KSS_JAX_CACHE_DIR", os.path.join(_REPO_ROOT, ".jax_cache")
+            ),
+        )
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs",
+            min_compile_time_secs,
+        )
+    except Exception:  # noqa: BLE001 — cache is an optimization only
+        pass

@@ -26,15 +26,10 @@ jax.config.update("jax_enable_x64", True)
 # Persistent compilation cache: many tests build fresh engines whose
 # programs are HLO-identical (different BatchedScheduler instances can't
 # share the in-process jit cache) — dedupe them across tests AND runs.
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.environ.get(
-        "KSS_JAX_CACHE_DIR",
-        # inside the repo (gitignored): per-checkout isolation — a
-        # world-shared /tmp dir would break on multi-user hosts and let
-        # another local user plant crafted cache entries that deserialize
-        # into in-process executables
-        os.path.join(os.path.dirname(os.path.dirname(__file__)), ".jax_cache"),
-    ),
+# Single definition (incl. the KSS_JAX_CACHE_DIR override) lives in
+# utils/compilecache.py, shared with bench.py and tools/.
+from kube_scheduler_simulator_tpu.utils.compilecache import (  # noqa: E402
+    enable_compile_cache,
 )
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+
+enable_compile_cache(min_compile_time_secs=0.3)

@@ -171,8 +171,9 @@ def test_fuzz_full_default_set_parity(seed, policy_name):
     )
 
 
+@pytest.mark.parametrize("window", [None, 24])
 @pytest.mark.parametrize("seed", [2, 4])
-def test_fuzz_gang_invariants(seed):
+def test_fuzz_gang_invariants(seed, window):
     """The gang scheduler over the same random mixed-feature clusters:
     its divergence-policy invariants must survive arbitrary feature
     interactions, not just the hand-built contention shapes —
@@ -206,10 +207,10 @@ def test_fuzz_gang_invariants(seed):
     nodes, pods_ = _rand_cluster(rng)
     cfg = supported_config()
     enc = encode_cluster(nodes, pods_, cfg, policy=TPU32)
-    gang = GangScheduler(enc, chunk=16)
+    gang = GangScheduler(enc, chunk=16, eval_window=window)
     gang.run()
     got = gang.placements()
-    again = GangScheduler(enc, chunk=16)
+    again = GangScheduler(enc, chunk=16, eval_window=window)
     again.run()
     assert got == again.placements(), "gang must be deterministic"
     seq = BatchedScheduler(

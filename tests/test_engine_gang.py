@@ -617,3 +617,101 @@ def test_static_budget_auto_resumes():
     assert got[("default", "misfit")] == ""
     assert sum(1 for v in got.values() if v) == 12
     assert int(np.asarray(r3)) == 12  # committed rounds only, finite
+
+
+class TestEvalWindow:
+    """eval_window: queue-prefix-bounded rounds (the chip lever for the
+    eval-bound round wall — see GangScheduler.__init__). Placements are
+    a valid greedy order; completeness and the stuck-window fallback
+    are the load-bearing guarantees."""
+
+    def _cfg(self):
+        return restricted_config(
+            filters=(
+                "NodeUnschedulable", "NodeName", "NodeAffinity",
+                "NodeResourcesFit",
+            ),
+        )
+
+    def test_binding_window_places_all(self):
+        # chunk=2 < P so the window actually binds each round
+        nodes = [node(f"n{i}", cpu="8", pods="110") for i in range(3)]
+        pods = [pod(f"p{i}", cpu="1") for i in range(18)]
+        cfg = self._cfg()
+        enc = encode_cluster(nodes, pods, cfg, policy=EXACT)
+        for loop in ("static", "dynamic"):
+            gang = GangScheduler(
+                enc, loop=loop, chunk=2, eval_window=2, rel_serialize=False
+            )
+            gang.run()
+            assert all(v != "" for v in gang.placements().values()), loop
+
+    def test_wide_window_matches_unwindowed(self):
+        # W >= P: the window never binds, placements must be identical
+        nodes = [node(f"n{i}", cpu="4", pods="110") for i in range(4)]
+        pods = [pod(f"p{i}", cpu="1") for i in range(12)]
+        cfg = self._cfg()
+        enc = encode_cluster(nodes, pods, cfg, policy=EXACT)
+        wide = GangScheduler(enc, eval_window=64, chunk=4)
+        plain = GangScheduler(enc, chunk=4)
+        assert _placements(wide) == _placements(plain)
+
+    def test_stuck_window_falls_back_to_full_round(self):
+        """First-in-queue pods are infeasible everywhere (no preemption
+        in the config): a windowed round over them commits nothing, and
+        the stuck carry must trigger a full-width round so deeper
+        feasible pods still place — without the fallback the loop would
+        exit (dynamic) or burn its budget (static) with feasible pods
+        stranded."""
+        nodes = [node("n0", cpu="8", pods="110"), node("n1", cpu="8", pods="110")]
+        # higher priority -> first in the PrioritySort queue
+        blocked = [
+            pod(f"big{i}", cpu="100", priority=100) for i in range(4)
+        ]
+        ok = [pod(f"ok{i}", cpu="1", priority=1) for i in range(8)]
+        cfg = self._cfg()
+        enc = encode_cluster(nodes, blocked + ok, cfg, policy=EXACT)
+        for loop in ("static", "dynamic"):
+            gang = GangScheduler(
+                enc, loop=loop, chunk=2, eval_window=2, rel_serialize=False
+            )
+            _, rounds = gang.run()
+            got = gang.placements()
+            assert all(
+                got[("default", f"ok{i}")] != "" for i in range(8)
+            ), (loop, got)
+            assert all(
+                got[("default", f"big{i}")] == "" for i in range(4)
+            ), (loop, got)
+            # finite: stuck probes + full rounds settle well under the
+            # naive P-round ceiling
+            assert int(np.asarray(rounds)) <= 24, loop
+
+    def test_window_requires_compact(self):
+        nodes = [node("n0")]
+        pods = [pod("p0")]
+        enc = encode_cluster(nodes, pods, self._cfg(), policy=EXACT)
+        with pytest.raises(ValueError, match="compact"):
+            GangScheduler(enc, compact=False, eval_window=8)
+        with pytest.raises(ValueError, match="eval_window"):
+            GangScheduler(enc, eval_window=0)
+
+    def test_dynamic_window_stuck_probes_do_not_exhaust_budget(self):
+        """Code-review r5 repro: on ONE schedulable node with a
+        permanently infeasible window prefix, every commit needs a
+        stuck-probe round plus a full round (~2 rounds per pod). The
+        default dynamic max_rounds must cover that (2P+2, not P+1) or
+        the while_loop exits early and silently strands feasible pods —
+        there is no dynamic-mode auto-resume to catch it."""
+        nodes = [node("n0", cpu="32", pods="110")]
+        blocked = [pod(f"big{i}", cpu="100", priority=100) for i in range(2)]
+        ok = [pod(f"ok{i}", cpu="1", priority=1) for i in range(8)]
+        cfg = self._cfg()
+        enc = encode_cluster(nodes, blocked + ok, cfg, policy=EXACT)
+        gang = GangScheduler(
+            enc, loop="dynamic", chunk=2, eval_window=2, rel_serialize=False
+        )
+        gang.run()
+        got = gang.placements()
+        assert all(got[("default", f"ok{i}")] != "" for i in range(8)), got
+        assert all(got[("default", f"big{i}")] == "" for i in range(2)), got

@@ -28,7 +28,21 @@ def main() -> None:
     ap.add_argument("--pods", type=int, default=50000)
     ap.add_argument("--chunk", type=int, default=256)
     ap.add_argument("--seed", type=int, default=5)
+    ap.add_argument(
+        "--window", type=int, default=0,
+        help="eval_window (0 = off): queue-prefix eval bounding — the"
+        " round-5 eval-dominance lever; cuts per-round evaluation from"
+        " all-pending to a queue prefix (see GangScheduler)",
+    )
     args = ap.parse_args()
+
+    # persistent compile cache: a killed/retried run at this shape must
+    # not repay the (many-minute, host-CPU-bound) compile
+    from kube_scheduler_simulator_tpu.utils.compilecache import (
+        enable_compile_cache,
+    )
+
+    enable_compile_cache()
 
     def phase(name, t0):
         dt = time.perf_counter() - t0
@@ -76,7 +90,9 @@ def main() -> None:
     t_encode = phase("encode", t0)
 
     t0 = time.perf_counter()
-    gang = GangScheduler(enc, chunk=args.chunk)
+    gang = GangScheduler(
+        enc, chunk=args.chunk, eval_window=args.window or None
+    )
     state, rounds = gang.run()
     placed = int((np.asarray(state.assignment) >= 0).sum())
     t_sched = phase("gang_schedule", t0)
@@ -88,6 +104,7 @@ def main() -> None:
             {
                 "config5_dps": round(args.pods / t_sched, 1),
                 "shape": f"{args.pods}x{args.nodes}",
+                **({"window": args.window} if args.window else {}),
                 "rounds": int(np.asarray(rounds)),
                 "placed": placed,
                 "pods": args.pods,
