@@ -407,7 +407,7 @@ class GangScheduler:
         compact = self.compact
         W = self.eval_window
 
-        def eval_all(state, a, weights, pending, order, full_eval):
+        def eval_all(state, a, weights, pending):
             """[P, N] masked total scores (NEG where infeasible),
             evaluated against `state`.
 
@@ -423,16 +423,10 @@ class GangScheduler:
             for P. Settled pods' rows are floor either way (the caller
             masks on `pending`), so placements cannot depend on it.
 
-            Windowing (`eval_window`): the permutation becomes
-            queue-order-within-pending and only the first
-            min(n_pending, W) rows are live — unless `full_eval` (the
-            stuck-probe round), which restores the full pending count.
-            Out-of-window pods' rows are floor, so they cannot commit
-            this round; every in-window pod is queue-before every
-            out-of-window pending pod, which is what keeps the
-            rel_serialize carrier-prefix argument intact (a carrier
-            beyond the window is not placeable this round, and all
-            commits are before it in queue order).
+            Windowed rounds do NOT come through here — they use
+            `eval_rows` (no [P+1, N] scatter-back; see below). This
+            function stays byte-identical to the chip-proven compact
+            program.
             """
 
             def one_pod(state, a, weights, p):
@@ -460,21 +454,8 @@ class GangScheduler:
                 lambda s, aa, w: one_pod(s, aa, w, jnp.int32(0)),
                 state, a, weights,
             ).dtype
-            if W is None:
-                perm = jnp.argsort(~pending).astype(jnp.int32)
-                n_live = pending.sum()
-            else:
-                # queue-order within pending so the window is a strict
-                # queue prefix of the still-pending pods
-                perm = jnp.argsort(
-                    jnp.where(pending, order, _NO_ORDER)
-                ).astype(jnp.int32)
-                n_pending = pending.sum()
-                n_live = jnp.where(
-                    full_eval,
-                    n_pending,
-                    jnp.minimum(n_pending, jnp.int32(W)),
-                )
+            perm = jnp.argsort(~pending).astype(jnp.int32)
+            n_live = pending.sum()
             if P_pad > P:
                 rows = jnp.concatenate(
                     [perm, jnp.full((P_pad - P,), jnp.int32(P))]
@@ -508,6 +489,54 @@ class GangScheduler:
                 .at[rows]
                 .set(flat)[:P]
             )
+
+        # WP: the chunk-granular window row count (Python int, static).
+        # None when windowing is off or never binds (W >= P) — the
+        # builders then use the unwindowed program unchanged.
+        WP = None
+        if W is not None:
+            WP = min(-(-min(W, P) // CH) * CH, P)
+            if WP >= P:
+                WP = None
+
+        def eval_rows(state, a, weights, rows, n_live):
+            """[WP, N] masked total scores for the pod-id rows `rows`
+            (the eval window), chunked exactly like eval_all but
+            WITHOUT the [P+1, N] scatter-back: every downstream tensor
+            of a windowed round is [WP, ...], so per-round dense work
+            is bounded by the window, not by P — both the throughput
+            lever and the dodge for the chip's refusal of very tall
+            [P, N] constructs (round-5 crash bracket: P in
+            (8192, 10240] at N=1024)."""
+
+            def one_pod(p):
+                _, codes, raw, final, _, pf_ok = attempt(
+                    state, a, weights, p
+                )
+                feasible = (codes == 0).all(axis=1) & a.node_mask & pf_ok
+                total = final.sum(axis=1) if final.shape[1] else jnp.zeros(
+                    (N,), enc.policy.score
+                )
+                return jnp.where(feasible, total, NEG)
+
+            row_dt = jax.eval_shape(lambda: one_pod(jnp.int32(0))).dtype
+            w_chunks = WP // CH
+            ps = rows.reshape(w_chunks, CH)
+
+            def one_chunk(args):
+                i, pc = args
+
+                def live(_):
+                    return jax.vmap(one_pod)(pc)
+
+                def settled(_):
+                    return jnp.full((CH, N), NEG, row_dt)
+
+                return jax.lax.cond(i * CH < n_live, live, settled, None)
+
+            return jax.lax.map(
+                one_chunk, (jnp.arange(w_chunks, dtype=jnp.int32), ps)
+            ).reshape(WP, N)
 
         def bind_all(state, a, mask, sel, order):
             """Scatter-bind every masked pod to its selected node in one
@@ -607,56 +636,83 @@ class GangScheduler:
                 else None
             )
 
-            def match_step(taken, claim_taken, sel_acc, vals, idx, c_min):
-                """One matching iteration (shared by both loop modes):
-                argmax over untaken candidates → per-node order winner →
-                per-claim order winner → commit. `vals`/`idx` are the
-                [P, K] top-k candidate scores/node-indices (idx is None
-                in full-width mode, where column position == node)."""
-                node_taken = taken[idx] if idx is not None else taken[None, :]
-                m = jnp.where(node_taken, FLOOR, vals)
-                m = jnp.where((sel_acc >= 0)[:, None], FLOOR, m)
-                claim_blocked = (pod_claim & claim_taken[None, :]).any(axis=1)
-                m = jnp.where(claim_blocked[:, None], FLOOR, m)
-                if rel_carrier is not None:
-                    # queue-prefix batching: the batched matching may
-                    # only commit pods strictly BEFORE the first
-                    # placeable carrier in queue order — carriers (and
-                    # everything behind them) wait, preserving the
-                    # sequential interleaving at carrier boundaries
-                    m = jnp.where((order >= c_min)[:, None], FLOOR, m)
-                col = jnp.argmax(m, axis=1).astype(jnp.int32)
-                has = jnp.take_along_axis(m, col[:, None], axis=1)[:, 0] > NEG
-                cand = (
-                    jnp.take_along_axis(idx, col[:, None], axis=1)[:, 0]
-                    if idx is not None
-                    else col
-                )
-                tgt = jnp.where(has, cand, N)
-                winner = (
-                    jnp.full((N + 1,), _NO_ORDER, jnp.int32).at[tgt].min(order)
-                )
-                commit = has & (winner[jnp.maximum(cand, 0)] == order)
-                claim_order = jnp.where(
-                    commit[:, None] & pod_claim, order[:, None], _NO_ORDER
-                )
-                claim_min = claim_order.min(axis=0)  # [C]
-                claim_ok = jnp.where(
-                    pod_claim, claim_min[None, :] == order[:, None], True
-                ).all(axis=1)
-                commit = commit & claim_ok
-                sel_acc = jnp.where(commit, cand, sel_acc)
-                taken = taken | (
-                    jnp.zeros((N + 1,), bool)
-                    .at[jnp.where(commit, cand, N)]
-                    .set(True)[:N]
-                )
-                claim_taken = claim_taken | (
-                    pod_claim & commit[:, None]
-                ).any(axis=0)
-                return taken, claim_taken, sel_acc, commit.any()
+            def make_match_step(order_v, pod_claim_v, rel_carrier_v):
+                """Matching iteration over an arbitrary ROW SUBSET of
+                the queue: `order_v`/`pod_claim_v`/`rel_carrier_v` are
+                the [K]-row views (K == P for full rounds; K == the
+                eval window for windowed rounds). Queue positions in
+                `order_v` are global, so the per-node/per-claim
+                earliest-order winner logic is identical either way."""
 
-            def match(scores):
+                def match_step(taken, claim_taken, sel_acc, vals, idx, c_min):
+                    """One matching iteration (shared by both loop
+                    modes): argmax over untaken candidates → per-node
+                    order winner → per-claim order winner → commit.
+                    `vals`/`idx` are the [K, k] top-k candidate
+                    scores/node-indices (idx is None in full-width
+                    mode, where column position == node)."""
+                    node_taken = (
+                        taken[idx] if idx is not None else taken[None, :]
+                    )
+                    m = jnp.where(node_taken, FLOOR, vals)
+                    m = jnp.where((sel_acc >= 0)[:, None], FLOOR, m)
+                    claim_blocked = (
+                        pod_claim_v & claim_taken[None, :]
+                    ).any(axis=1)
+                    m = jnp.where(claim_blocked[:, None], FLOOR, m)
+                    if rel_carrier_v is not None:
+                        # queue-prefix batching: the batched matching
+                        # may only commit pods strictly BEFORE the
+                        # first placeable carrier in queue order —
+                        # carriers (and everything behind them) wait,
+                        # preserving the sequential interleaving at
+                        # carrier boundaries
+                        m = jnp.where((order_v >= c_min)[:, None], FLOOR, m)
+                    col = jnp.argmax(m, axis=1).astype(jnp.int32)
+                    has = (
+                        jnp.take_along_axis(m, col[:, None], axis=1)[:, 0]
+                        > NEG
+                    )
+                    cand = (
+                        jnp.take_along_axis(idx, col[:, None], axis=1)[:, 0]
+                        if idx is not None
+                        else col
+                    )
+                    tgt = jnp.where(has, cand, N)
+                    winner = (
+                        jnp.full((N + 1,), _NO_ORDER, jnp.int32)
+                        .at[tgt]
+                        .min(order_v)
+                    )
+                    commit = has & (winner[jnp.maximum(cand, 0)] == order_v)
+                    claim_order = jnp.where(
+                        commit[:, None] & pod_claim_v,
+                        order_v[:, None],
+                        _NO_ORDER,
+                    )
+                    claim_min = claim_order.min(axis=0)  # [C]
+                    claim_ok = jnp.where(
+                        pod_claim_v,
+                        claim_min[None, :] == order_v[:, None],
+                        True,
+                    ).all(axis=1)
+                    commit = commit & claim_ok
+                    sel_acc = jnp.where(commit, cand, sel_acc)
+                    taken = taken | (
+                        jnp.zeros((N + 1,), bool)
+                        .at[jnp.where(commit, cand, N)]
+                        .set(True)[:N]
+                    )
+                    claim_taken = claim_taken | (
+                        pod_claim_v & commit[:, None]
+                    ).any(axis=0)
+                    return taken, claim_taken, sel_acc, commit.any()
+
+                return match_step
+
+            def match(
+                scores, order_v=None, pod_claim_v=None, rel_carrier_v=...,
+            ):
                 """One-commit-per-node matching over the round's masked
                 score matrix: argmax → earliest-order winner per node →
                 losers retry their next-best untaken node. No kernel
@@ -682,30 +738,47 @@ class GangScheduler:
                 carrier, and once the prefix is exhausted the carrier
                 takes an EXCLUSIVE round at its argmax node (the
                 sequential engine's choice against this state). See
-                __init__."""
+                __init__.
+
+                Row-subset form: `order_v`/`pod_claim_v`/`rel_carrier_v`
+                override the full-queue views for windowed rounds (the
+                scores' rows are then the window's pods). Defaults keep
+                the full-round call sites unchanged."""
+                if order_v is None:
+                    order_v = order
+                if pod_claim_v is None:
+                    pod_claim_v = pod_claim
+                if rel_carrier_v is ...:
+                    rel_carrier_v = rel_carrier
+                K_rows = scores.shape[0]
+                match_step = make_match_step(
+                    order_v, pod_claim_v, rel_carrier_v
+                )
                 if MW < N:
                     vals, idx = jax.lax.top_k(scores, MW)
                     idx = idx.astype(jnp.int32)
                 else:
                     vals, idx = scores, None
-                if rel_carrier is not None:
+                if rel_carrier_v is not None:
                     # non-pending rows are FLOOR, so row_ok means
                     # "pending with at least one feasible node"
                     row_best = vals.max(axis=1)
                     row_ok = row_best > NEG
-                    c_min = jnp.where(rel_carrier & row_ok, order, _NO_ORDER).min()
+                    c_min = jnp.where(
+                        rel_carrier_v & row_ok, order_v, _NO_ORDER
+                    ).min()
                     # exclusive carrier round (see __init__ docstring):
                     # the earliest placeable carrier commits alone, but
                     # only once nothing placeable sits before it in
                     # queue order
-                    prefix_exists = (row_ok & (order < c_min)).any()
+                    prefix_exists = (row_ok & (order_v < c_min)).any()
                     have_carrier = (~prefix_exists) & (c_min != _NO_ORDER)
                 else:
                     c_min = jnp.int32(_NO_ORDER)
                     have_carrier = None
                 taken0 = jnp.zeros((N,), bool)
                 claims0 = jnp.zeros((C,), bool)
-                sel0 = jnp.full((P,), -1, jnp.int32)
+                sel0 = jnp.full((K_rows,), -1, jnp.int32)
 
                 def run_matching(_):
                     if inner_static:
@@ -747,7 +820,7 @@ class GangScheduler:
                     )
                     return sel_acc
 
-                if rel_carrier is None:
+                if rel_carrier_v is None:
                     return run_matching(None)
                 # a carrier round's matching is all-FLOOR no-ops; skip
                 # it through cond so the static scan doesn't pay
@@ -757,7 +830,7 @@ class GangScheduler:
                 sel_acc = jax.lax.cond(
                     have_carrier, lambda _: sel0, run_matching, None
                 )
-                is_pick = rel_carrier & row_ok & (order == c_min)
+                is_pick = rel_carrier_v & row_ok & (order_v == c_min)
                 col = jnp.argmax(vals, axis=1).astype(jnp.int32)
                 cand = (
                     jnp.take_along_axis(idx, col[:, None], axis=1)[:, 0]
@@ -774,21 +847,147 @@ class GangScheduler:
                 stuck carry (~committed → next round is full-width),
                 `progressed` is the loop-exit/auto-resume signal — a
                 windowed round with pods pending always counts (the
-                follow-up full round is the real fixpoint test)."""
+                follow-up full round is the real fixpoint test).
+
+                A BINDING window (WP < P) routes the whole round's
+                dense work — eval, top_k, matching — through [WP, N]
+                row-subset tensors (`eval_rows` + the row-subset
+                `match`): the stuck-probe full round is the lax.cond
+                other branch. Every in-window pod is queue-before every
+                out-of-window pending pod (the perm sorts by global
+                queue position), so the carrier-prefix soundness
+                argument carries over unchanged."""
                 pending = (state.assignment < 0) & in_queue & arrays.pod_mask
-                if W is None:
-                    fe = jnp.bool_(True)
+
+                def full_round(st):
+                    scores = eval_all(st, arrays, weights, pending)
+                    scores = jnp.where(pending[:, None], scores, FLOOR)
+                    return match(scores)
+
+                if W is None or WP is None:
+                    sel = full_round(state)
                 else:
-                    fe = full_eval
-                scores = eval_all(state, arrays, weights, pending, order, fe)
-                scores = jnp.where(pending[:, None], scores, FLOOR)
-                sel = match(scores)
+                    n_pending = pending.sum()
+                    perm = jnp.argsort(
+                        jnp.where(pending, order, _NO_ORDER)
+                    ).astype(jnp.int32)
+                    n_win = -(-P // WP)  # static sweep bound
+
+                    def window_k(st, k):
+                        """Evaluate + match window k of the pending
+                        queue: [WP, N] row-subset tensors only. The
+                        last window's start clamps to P-WP (it may
+                        overlap the previous — harmless, those rows
+                        were committed-nothing against the same state);
+                        liveness uses the SAME clamped start so a
+                        clamped window can never floor-skip chunks that
+                        hold pending rows."""
+                        start = jnp.minimum(
+                            k * jnp.int32(WP), jnp.int32(P - WP)
+                        )
+                        rows = jax.lax.dynamic_slice_in_dim(
+                            perm, start, WP
+                        )
+                        rows_pending = pending[rows]
+                        n_live = jnp.clip(
+                            n_pending - start, 0, jnp.int32(WP)
+                        )
+                        scores_w = eval_rows(
+                            st, arrays, weights, rows, n_live
+                        )
+                        scores_w = jnp.where(
+                            rows_pending[:, None], scores_w, FLOOR
+                        )
+                        sel_w = match(
+                            scores_w,
+                            order_v=order[rows],
+                            pod_claim_v=pod_claim[rows],
+                            rel_carrier_v=(
+                                None
+                                if rel_carrier is None
+                                else rel_carrier[rows]
+                            ),
+                        )
+                        sel_full = (
+                            jnp.full((P,), -1, jnp.int32)
+                            .at[rows]
+                            .set(jnp.where(rows_pending, sel_w, -1))
+                        )
+                        return sel_full, (sel_w >= 0).any()
+
+                    def probe_round(st):
+                        """The stuck-probe 'full' round as a SWEEP of
+                        [WP, N] windows over every pending pod — the
+                        monolithic eval_all/match pair would reintroduce
+                        the tall [P, N] constructs the windowed program
+                        exists to avoid (both lax.cond branches compile;
+                        code-review r5). Commits come from the FIRST
+                        window that can commit anything; 'no window can'
+                        is exactly the unwindowed full round's fixpoint
+                        signal, because windows sweep an unchanged
+                        state. Counted scan in static mode (the
+                        scans-only compile class), early-exit while_loop
+                        otherwise."""
+                        if static:
+
+                            def p_scan(carry, k):
+                                sel, found = carry
+                                sel_k, found_k = window_k(st, k)
+                                take = found_k & (~found)
+                                sel = jnp.where(take, sel_k, sel)
+                                return (sel, found | found_k), None
+
+                            (sel_acc, _), _ = jax.lax.scan(
+                                p_scan,
+                                (
+                                    jnp.full((P,), -1, jnp.int32),
+                                    jnp.bool_(False),
+                                ),
+                                jnp.arange(n_win, dtype=jnp.int32),
+                            )
+                            return sel_acc
+
+                        def p_cond(c):
+                            k, _, found = c
+                            return (
+                                (~found)
+                                & (k < n_win)
+                                & (k * jnp.int32(WP) < n_pending)
+                            )
+
+                        def p_body(c):
+                            k, _, _ = c
+                            sel_k, found_k = window_k(st, k)
+                            return k + jnp.int32(1), sel_k, found_k
+
+                        _, sel_acc, _ = jax.lax.while_loop(
+                            p_cond,
+                            p_body,
+                            (
+                                jnp.int32(0),
+                                jnp.full((P,), -1, jnp.int32),
+                                jnp.bool_(False),
+                            ),
+                        )
+                        return sel_acc
+
+                    def windowed_round(st):
+                        sel_full, _ = window_k(st, jnp.int32(0))
+                        return sel_full
+
+                    sel = jax.lax.cond(
+                        full_eval, probe_round, windowed_round, state
+                    )
                 commit = sel >= 0
                 state = bind_all(state, arrays, commit, sel, order)
                 committed = commit.any()
                 if W is None:
                     return state, committed
-                progressed = committed | ((~fe) & pending.any())
+                if WP is None:
+                    # the window never binds: full rounds with the
+                    # windowed carry shape — plain fixpoint signal
+                    return state, committed, committed
+                progressed = committed | ((~full_eval) & (n_pending > 0))
                 return state, committed, progressed
 
             return round_once
