@@ -247,9 +247,11 @@ def _gang_probe(
     # iterations vs 16 x 19 = 304 — a manual chip experiment flag (the
     # automated ladder keeps the proven 64), placements stay valid at
     # any K (losers past the depth retry next round)
-    # --gang-window=W (requires compact): queue-prefix eval windowing —
-    # the round-5 chip lever (a live round is ~95% evaluation, and only
-    # ~N of the pending pods can commit per round; see GangScheduler)
+    # --gang-window=W: queue-prefix windowed rounds — the round-5 chip
+    # lever (a live round is ~95% evaluation, and only ~N of the
+    # pending pods can commit per round; see GangScheduler). Applied to
+    # the default variant only: --gang-plain pins the round-4 proven
+    # program, which windowing would change.
     variant_kw = dict(compact=not plain, rel_serialize=not plain)
     if window is not None and not plain:
         variant_kw["eval_window"] = window

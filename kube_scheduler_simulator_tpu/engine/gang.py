@@ -253,25 +253,30 @@ class GangScheduler:
         `vmap` (GangSweep does): vmapped `cond` lowers to both-branches
         select, so there is nothing to skip.
 
-        `eval_window` (default None = off; requires `compact`) bounds
-        each round's evaluation to the first `eval_window` PENDING pods
-        in queue order, rounded UP to the chunk boundary (the window is
-        chunk-granular: the last live chunk is evaluated whole, so the
-        effective window is ceil(W/chunk)*chunk) — the chip lever for
-        the eval-bound round wall
-        (round-5 measurement: ~95% of a live round is evaluation, yet
-        only ~N pods can commit per round, so evaluating all pending
-        pays ~P/2N times the useful work). Rounds become queue-prefix
-        greedy: pods beyond the window wait, exactly like losers of a
-        `match_width`/`inner_iters` depth bound. A windowed round that
-        commits NOTHING with pods still pending triggers one full-width
-        round (the `stuck` carry) so fixpoint detection stays sound:
-        the loop exits only when a FULL round commits nothing, and the
-        static auto-resume counts stuck probes as progress so windowed
-        passes never strand pods. Placements are a different valid
-        greedy order than the unwindowed fixpoint (same class of
-        divergence as `match_width`; all invariants hold — fuzz-pinned
-        in tests/test_engine_fuzz.py)."""
+        `eval_window` (default None = off; independent of `compact` —
+        a binding window routes rounds through its own row-subset
+        pipeline and never touches the compacted eval program) bounds
+        each round's dense work — eval, top_k, matching — to a window
+        of `eval_window` PENDING pods in queue order, rounded UP to the
+        chunk boundary (chunk-granular: the effective window is
+        ceil(W/chunk)*chunk). It is the chip lever for the eval-bound
+        round wall (round-5 measurement: ~95% of a live round is
+        evaluation, yet only ~N pods can commit per round, so
+        evaluating all pending pays ~P/2N times the useful work), and
+        it keeps every tall [P, N] dense construct out of the compiled
+        program (the experimental axon backend faults on them past
+        P ~ 8k at N ~ 1k). Rounds carry a window OFFSET: a commit
+        resets it to 0 (earlier-queue pods get first claim on the new
+        state), a no-commit round advances to the next window, and a
+        full sweep of the pending windows with no commit anywhere —
+        against a provably unchanged state — is exactly the unwindowed
+        fixpoint signal, so windowed passes can never strand pods
+        (test-pinned, including a 1-node budget-exhaustion repro).
+        Placements are a different valid greedy order than the
+        unwindowed fixpoint (same class of divergence as `match_width`;
+        all invariants hold — fuzz-pinned in
+        tests/test_engine_fuzz.py). Pure selects (no lax.cond), so the
+        same program stays efficient under GangSweep's vmap."""
         self.enc = enc
         self.chunk = int(chunk)
         # fallback depth of the per-round matching: how many next-best
@@ -297,11 +302,6 @@ class GangScheduler:
                 raise ValueError(
                     f"eval_window must be >= 1, got {eval_window}"
                 )
-            if not self.compact:
-                raise ValueError(
-                    "eval_window requires compact=True (the window rides"
-                    " the compaction permutation)"
-                )
         self.eval_window = eval_window
         if loop not in ("dynamic", "static"):
             raise ValueError(f"loop must be dynamic|static, got {loop!r}")
@@ -324,6 +324,25 @@ class GangScheduler:
                 else (-(-enc.P // max(1, enc.N))) + 4
             )
         self.static_rounds = int(static_rounds)
+        # A binding eval_window spreads the fixpoint sweep across round
+        # slots (one window per slot), and every pass restarts its
+        # window offset at 0 — so the auto-resume rule's "zero-commit
+        # pass == infeasible remainder" proof needs the budget to cover
+        # a COMPLETE sweep: clamp to ceil(P/WP). Without this, a pass
+        # could exhaust its quantum mid-sweep with zero commits and the
+        # driver would strand feasible later-window pods (code-review
+        # r5 repro: 14 infeasible high-priority pods ahead of 2
+        # feasible ones at window size 2). Same rule protects
+        # GangSweep's per-variant-array form of the resume check.
+        self._wp = None
+        if self.eval_window is not None:
+            ch = max(1, min(self.chunk, enc.P))
+            wp = min(-(-min(self.eval_window, enc.P) // ch) * ch, enc.P)
+            if wp < enc.P:
+                self._wp = wp
+                self.static_rounds = max(
+                    self.static_rounds, -(-enc.P // wp)
+                )
         # Reuse the sequential engine's compiled-kernel construction and
         # its `attempt` program — gang mode is a different driver around
         # the identical per-pod evaluation.
@@ -378,19 +397,25 @@ class GangScheduler:
         n_chunks = -(-P // CH)
         P_pad = n_chunks * CH
         attempt = self._base._attempt
+        # WP: the chunk-granular window row count (Python int, static;
+        # computed once in __init__ so the static-budget clamp and the
+        # program builder can never disagree). None when windowing is
+        # off or never binds (W >= P) — the builders then use the
+        # unwindowed program unchanged.
+        WP = self._wp
         # Dynamic-loop livelock guard. Unwindowed, every progressing
-        # round commits >= 1 pod, so P+1 bounds the loop. With
-        # eval_window, a committing full round can be preceded by one
-        # non-committing stuck-probe round (which still counts as
-        # progress — see round_once), so the worst case is 2 rounds per
-        # commit plus the final probe/full exit pair: 2P+2, not P+1
-        # (code-review r5: P+1 exhausted the budget on a 1-node cluster
-        # with an infeasible window prefix and silently stranded
-        # feasible pods).
+        # round commits >= 1 pod, so P+1 bounds the loop. With a
+        # binding eval_window, each commit may be preceded by a
+        # no-commit sweep over up to ceil(P/WP) windows (each counts as
+        # progress — see round_once), so the guard scales by the sweep
+        # width (code-review r5: an undersized guard exhausted the
+        # budget on a 1-node cluster with an infeasible window prefix
+        # and silently stranded feasible pods — there is no
+        # dynamic-mode auto-resume to catch that).
         if self.max_rounds is not None:
             max_rounds = self.max_rounds
-        elif self.eval_window is not None:
-            max_rounds = 2 * P + 2
+        elif WP is not None:
+            max_rounds = (P + 1) * (-(-P // WP)) + 1
         else:
             max_rounds = P + 1
         inner_iters = self.inner_iters
@@ -406,6 +431,18 @@ class GangScheduler:
 
         compact = self.compact
         W = self.eval_window
+
+        def pod_score_row(state, a, weights, p):
+            """[N] masked total score of pod p against `state` (NEG
+            where infeasible) — the ONE per-pod evaluation body, shared
+            by eval_all and eval_rows so windowed and full rounds can
+            never diverge in feasibility/scoring semantics."""
+            _, codes, raw, final, _, pf_ok = attempt(state, a, weights, p)
+            feasible = (codes == 0).all(axis=1) & a.node_mask & pf_ok
+            total = final.sum(axis=1) if final.shape[1] else jnp.zeros(
+                (N,), enc.policy.score
+            )
+            return jnp.where(feasible, total, NEG)
 
         def eval_all(state, a, weights, pending):
             """[P, N] masked total scores (NEG where infeasible),
@@ -429,13 +466,7 @@ class GangScheduler:
             program.
             """
 
-            def one_pod(state, a, weights, p):
-                _, codes, raw, final, _, pf_ok = attempt(state, a, weights, p)
-                feasible = (codes == 0).all(axis=1) & a.node_mask & pf_ok
-                total = final.sum(axis=1) if final.shape[1] else jnp.zeros(
-                    (N,), enc.policy.score
-                )
-                return jnp.where(feasible, total, NEG)
+            one_pod = pod_score_row
 
             if not compact:
                 ps = jnp.arange(P_pad, dtype=jnp.int32) % P
@@ -490,15 +521,6 @@ class GangScheduler:
                 .set(flat)[:P]
             )
 
-        # WP: the chunk-granular window row count (Python int, static).
-        # None when windowing is off or never binds (W >= P) — the
-        # builders then use the unwindowed program unchanged.
-        WP = None
-        if W is not None:
-            WP = min(-(-min(W, P) // CH) * CH, P)
-            if WP >= P:
-                WP = None
-
         def eval_rows(state, a, weights, rows, n_live):
             """[WP, N] masked total scores for the pod-id rows `rows`
             (the eval window), chunked exactly like eval_all but
@@ -510,14 +532,7 @@ class GangScheduler:
             (8192, 10240] at N=1024)."""
 
             def one_pod(p):
-                _, codes, raw, final, _, pf_ok = attempt(
-                    state, a, weights, p
-                )
-                feasible = (codes == 0).all(axis=1) & a.node_mask & pf_ok
-                total = final.sum(axis=1) if final.shape[1] else jnp.zeros(
-                    (N,), enc.policy.score
-                )
-                return jnp.where(feasible, total, NEG)
+                return pod_score_row(state, a, weights, p)
 
             row_dt = jax.eval_shape(lambda: one_pod(jnp.int32(0))).dtype
             w_chunks = WP // CH
@@ -840,155 +855,99 @@ class GangScheduler:
                 sel_carrier = jnp.where(is_pick, cand, jnp.int32(-1))
                 return jnp.where(have_carrier, sel_carrier, sel_acc)
 
-            def round_once(state, full_eval=None):
-                """One dense round. With `eval_window` the caller passes
-                `full_eval` (the stuck-probe flag) and gets back
-                (state, committed, progressed): `committed` feeds the
-                stuck carry (~committed → next round is full-width),
-                `progressed` is the loop-exit/auto-resume signal — a
-                windowed round with pods pending always counts (the
-                follow-up full round is the real fixpoint test).
+            def round_once(state, w_idx=None):
+                """One dense round.
 
-                A BINDING window (WP < P) routes the whole round's
-                dense work — eval, top_k, matching — through [WP, N]
-                row-subset tensors (`eval_rows` + the row-subset
-                `match`): the stuck-probe full round is the lax.cond
-                other branch. Every in-window pod is queue-before every
-                out-of-window pending pod (the perm sorts by global
-                queue position), so the carrier-prefix soundness
-                argument carries over unchanged."""
+                With a BINDING window (WP < P) the caller carries a
+                window offset `w_idx` and gets back
+                (state, w_idx', progressed). The round's dense work —
+                eval, top_k, matching — runs on [WP, N] row-subset
+                tensors ONLY (window `w_idx` of the pending queue, in
+                queue order): per-round cost is bounded by the window
+                regardless of P, and the compiled program carries no
+                tall [P, N] construct at any P. The offset advance IS
+                the fixpoint machinery: a commit resets w_idx to 0
+                (earlier-queue pods get first claim on the new state),
+                a no-commit round advances to the next window, and a
+                full sweep 0..ceil(n_pending/WP)-1 with no commit
+                anywhere — swept against a provably unchanged state —
+                is exactly the unwindowed full round's
+                nothing-can-place signal, so `progressed` goes False.
+                Pure selects throughout: no lax.cond, so the same
+                program is vmap-efficient (GangSweep) — a vmapped cond
+                would pay both branches every round (code-review r5).
+
+                Soundness of skipping earlier windows at offset k > 0:
+                those windows' pods ARE queue-before the in-window pods
+                — but every one of them was matched against this EXACT
+                state earlier in the no-commit streak (a no-commit
+                round leaves state bytes unchanged, and any commit
+                resets the offset to 0) and could not place, which is
+                precisely the condition under which the carrier-prefix
+                and priority-order arguments allow batching past them.
+                Any change to the offset advance (not resetting on
+                commit, resuming mid-sweep across passes) breaks that
+                premise — don't."""
                 pending = (state.assignment < 0) & in_queue & arrays.pod_mask
-
-                def full_round(st):
-                    scores = eval_all(st, arrays, weights, pending)
-                    scores = jnp.where(pending[:, None], scores, FLOOR)
-                    return match(scores)
-
                 if W is None or WP is None:
-                    sel = full_round(state)
-                else:
-                    n_pending = pending.sum()
-                    perm = jnp.argsort(
-                        jnp.where(pending, order, _NO_ORDER)
-                    ).astype(jnp.int32)
-                    n_win = -(-P // WP)  # static sweep bound
+                    scores = eval_all(state, arrays, weights, pending)
+                    scores = jnp.where(pending[:, None], scores, FLOOR)
+                    sel = match(scores)
+                    commit = sel >= 0
+                    state = bind_all(state, arrays, commit, sel, order)
+                    committed = commit.any()
+                    if W is None:
+                        return state, committed
+                    # the window never binds: full rounds with the
+                    # windowed carry shape — plain fixpoint signal
+                    return state, jnp.int32(0), committed
 
-                    def window_k(st, k):
-                        """Evaluate + match window k of the pending
-                        queue: [WP, N] row-subset tensors only. The
-                        last window's start clamps to P-WP (it may
-                        overlap the previous — harmless, those rows
-                        were committed-nothing against the same state);
-                        liveness uses the SAME clamped start so a
-                        clamped window can never floor-skip chunks that
-                        hold pending rows."""
-                        start = jnp.minimum(
-                            k * jnp.int32(WP), jnp.int32(P - WP)
-                        )
-                        rows = jax.lax.dynamic_slice_in_dim(
-                            perm, start, WP
-                        )
-                        rows_pending = pending[rows]
-                        n_live = jnp.clip(
-                            n_pending - start, 0, jnp.int32(WP)
-                        )
-                        scores_w = eval_rows(
-                            st, arrays, weights, rows, n_live
-                        )
-                        scores_w = jnp.where(
-                            rows_pending[:, None], scores_w, FLOOR
-                        )
-                        sel_w = match(
-                            scores_w,
-                            order_v=order[rows],
-                            pod_claim_v=pod_claim[rows],
-                            rel_carrier_v=(
-                                None
-                                if rel_carrier is None
-                                else rel_carrier[rows]
-                            ),
-                        )
-                        sel_full = (
-                            jnp.full((P,), -1, jnp.int32)
-                            .at[rows]
-                            .set(jnp.where(rows_pending, sel_w, -1))
-                        )
-                        return sel_full, (sel_w >= 0).any()
-
-                    def probe_round(st):
-                        """The stuck-probe 'full' round as a SWEEP of
-                        [WP, N] windows over every pending pod — the
-                        monolithic eval_all/match pair would reintroduce
-                        the tall [P, N] constructs the windowed program
-                        exists to avoid (both lax.cond branches compile;
-                        code-review r5). Commits come from the FIRST
-                        window that can commit anything; 'no window can'
-                        is exactly the unwindowed full round's fixpoint
-                        signal, because windows sweep an unchanged
-                        state. Counted scan in static mode (the
-                        scans-only compile class), early-exit while_loop
-                        otherwise."""
-                        if static:
-
-                            def p_scan(carry, k):
-                                sel, found = carry
-                                sel_k, found_k = window_k(st, k)
-                                take = found_k & (~found)
-                                sel = jnp.where(take, sel_k, sel)
-                                return (sel, found | found_k), None
-
-                            (sel_acc, _), _ = jax.lax.scan(
-                                p_scan,
-                                (
-                                    jnp.full((P,), -1, jnp.int32),
-                                    jnp.bool_(False),
-                                ),
-                                jnp.arange(n_win, dtype=jnp.int32),
-                            )
-                            return sel_acc
-
-                        def p_cond(c):
-                            k, _, found = c
-                            return (
-                                (~found)
-                                & (k < n_win)
-                                & (k * jnp.int32(WP) < n_pending)
-                            )
-
-                        def p_body(c):
-                            k, _, _ = c
-                            sel_k, found_k = window_k(st, k)
-                            return k + jnp.int32(1), sel_k, found_k
-
-                        _, sel_acc, _ = jax.lax.while_loop(
-                            p_cond,
-                            p_body,
-                            (
-                                jnp.int32(0),
-                                jnp.full((P,), -1, jnp.int32),
-                                jnp.bool_(False),
-                            ),
-                        )
-                        return sel_acc
-
-                    def windowed_round(st):
-                        sel_full, _ = window_k(st, jnp.int32(0))
-                        return sel_full
-
-                    sel = jax.lax.cond(
-                        full_eval, probe_round, windowed_round, state
-                    )
+                n_pending = pending.sum()
+                perm = jnp.argsort(
+                    jnp.where(pending, order, _NO_ORDER)
+                ).astype(jnp.int32)
+                n_win = -(-P // WP)  # static sweep bound
+                # windows past the sweep bound only occur in static
+                # budget slots after the fixpoint — clamp them to the
+                # last window (liveness gates their eval to ~nothing)
+                k = jnp.minimum(w_idx, jnp.int32(n_win - 1))
+                # the last window's start clamps to P-WP (it may overlap
+                # the previous — harmless: those rows committed nothing
+                # against this same state); liveness uses the SAME
+                # clamped start so a clamped window can never
+                # floor-skip chunks that hold pending rows
+                start = jnp.minimum(k * jnp.int32(WP), jnp.int32(P - WP))
+                rows = jax.lax.dynamic_slice_in_dim(perm, start, WP)
+                rows_pending = pending[rows]
+                n_live = jnp.clip(n_pending - start, 0, jnp.int32(WP))
+                scores_w = eval_rows(state, arrays, weights, rows, n_live)
+                scores_w = jnp.where(rows_pending[:, None], scores_w, FLOOR)
+                sel_w = match(
+                    scores_w,
+                    order_v=order[rows],
+                    pod_claim_v=pod_claim[rows],
+                    rel_carrier_v=(
+                        None if rel_carrier is None else rel_carrier[rows]
+                    ),
+                )
+                sel = (
+                    jnp.full((P,), -1, jnp.int32)
+                    .at[rows]
+                    .set(jnp.where(rows_pending, sel_w, -1))
+                )
                 commit = sel >= 0
                 state = bind_all(state, arrays, commit, sel, order)
                 committed = commit.any()
-                if W is None:
-                    return state, committed
-                if WP is None:
-                    # the window never binds: full rounds with the
-                    # windowed carry shape — plain fixpoint signal
-                    return state, committed, committed
-                progressed = committed | ((~full_eval) & (n_pending > 0))
-                return state, committed, progressed
+                # sweep accounting against THIS round's pending count
+                # (constant across a no-commit streak, so the streak
+                # really does cover every pending window)
+                w_max = jnp.maximum(
+                    jnp.int32(1),
+                    -(-n_pending // jnp.int32(WP)),
+                )
+                done = (~committed) & (k + 1 >= w_max)
+                w_next = jnp.where(committed, jnp.int32(0), w_idx + 1)
+                return state, w_next, ~done
 
             return round_once
 
@@ -1012,15 +971,15 @@ class GangScheduler:
                 if W is not None:
 
                     def rw_scan(carry, _):
-                        state, stuck = carry
-                        state, committed, progressed = round_once(
-                            state, stuck
+                        state, w_idx = carry
+                        state, w_next, progressed = round_once(
+                            state, w_idx
                         )
-                        return (state, ~committed), progressed
+                        return (state, w_next), progressed
 
                     (state, _), progressed = jax.lax.scan(
                         rw_scan,
-                        (state0, jnp.bool_(False)),
+                        (state0, jnp.int32(0)),
                         None,
                         length=self.static_rounds,
                     )
@@ -1042,16 +1001,16 @@ class GangScheduler:
                     return progressed & (rounds < max_rounds)
 
                 def w_body(carry):
-                    state, _, rounds, stuck = carry
-                    state, committed, progressed = round_once(state, stuck)
+                    state, _, rounds, w_idx = carry
+                    state, w_next, progressed = round_once(state, w_idx)
                     return (
-                        state, progressed, rounds + jnp.int32(1), ~committed
+                        state, progressed, rounds + jnp.int32(1), w_next
                     )
 
                 state, _, rounds, _ = jax.lax.while_loop(
                     w_cond,
                     w_body,
-                    (state0, jnp.bool_(True), jnp.int32(0), jnp.bool_(False)),
+                    (state0, jnp.bool_(True), jnp.int32(0), jnp.int32(0)),
                 )
                 return state, rounds
 
@@ -1078,19 +1037,19 @@ class GangScheduler:
                 if W is not None:
 
                     def rw_scan(carry, r):
-                        state, br, stuck = carry
-                        state2, committed, progressed = round_once(
-                            state, stuck
+                        state, br, w_idx = carry
+                        state2, w_next, progressed = round_once(
+                            state, w_idx
                         )
                         newly = (
                             (state2.assignment >= 0) & (state.assignment < 0)
                         )
                         br = jnp.where(newly, r, br)
-                        return (state2, br, ~committed), progressed
+                        return (state2, br, w_next), progressed
 
                     (state, br, _), progressed = jax.lax.scan(
                         rw_scan,
-                        (state0, br0, jnp.bool_(False)),
+                        (state0, br0, jnp.int32(0)),
                         jnp.arange(self.static_rounds, dtype=jnp.int32),
                     )
                     return state, progressed.sum().astype(jnp.int32), br
@@ -1116,13 +1075,13 @@ class GangScheduler:
                     return progressed & (rounds < max_rounds)
 
                 def tw_body(carry):
-                    state, _, rounds, br, stuck = carry
-                    state2, committed, progressed = round_once(state, stuck)
+                    state, _, rounds, br, w_idx = carry
+                    state2, w_next, progressed = round_once(state, w_idx)
                     newly = (state2.assignment >= 0) & (state.assignment < 0)
                     br = jnp.where(newly, rounds, br)
                     return (
                         state2, progressed, rounds + jnp.int32(1), br,
-                        ~committed,
+                        w_next,
                     )
 
                 state, _, rounds, br, _ = jax.lax.while_loop(
@@ -1130,7 +1089,7 @@ class GangScheduler:
                     tw_body,
                     (
                         state0, jnp.bool_(True), jnp.int32(0), br0,
-                        jnp.bool_(False),
+                        jnp.int32(0),
                     ),
                 )
                 return state, rounds, br

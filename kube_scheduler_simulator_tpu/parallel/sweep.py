@@ -286,7 +286,8 @@ class GangSweep:
     the host loop continues until no variant makes progress."""
 
     def __init__(self, enc: EncodedCluster, *, mesh: "Mesh | None" = None,
-                 chunk: int = 256, loop: str = "dynamic"):
+                 chunk: int = 256, loop: str = "dynamic",
+                 eval_window: "int | None" = None):
         from ..engine.gang import GangScheduler
 
         self.enc = enc
@@ -300,8 +301,14 @@ class GangSweep:
         # whole round budget still committing, the vmapped form of the
         # single-variant auto-resume (finished variants ride along as
         # no-ops), so the budget stays a quantum, not a cap.
+        # eval_window is a STATIC shrink (rounds run on [WP, N]
+        # row-subset tensors), so unlike compaction it keeps its value
+        # under vmap — the per-variant perm/gather just vmaps.
         self.loop = loop
-        self.gang = GangScheduler(enc, chunk=chunk, compact=False, loop=loop)
+        self.gang = GangScheduler(
+            enc, chunk=chunk, compact=False, loop=loop,
+            eval_window=eval_window,
+        )
         self._vrun = jax.jit(
             jax.vmap(self.gang.run_fn, in_axes=(None, None, None, 0))
         )

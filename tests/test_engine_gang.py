@@ -687,12 +687,18 @@ class TestEvalWindow:
             # naive P-round ceiling
             assert int(np.asarray(rounds)) <= 24, loop
 
-    def test_window_requires_compact(self):
-        nodes = [node("n0")]
-        pods = [pod("p0")]
+    def test_window_independent_of_compact(self):
+        """A binding window routes rounds through its own row-subset
+        pipeline, so it composes with compact=False (what vmapped
+        sweeps use — vmapped cond can't skip anything anyway)."""
+        nodes = [node(f"n{i}", cpu="8", pods="110") for i in range(3)]
+        pods = [pod(f"p{i}", cpu="1") for i in range(18)]
         enc = encode_cluster(nodes, pods, self._cfg(), policy=EXACT)
-        with pytest.raises(ValueError, match="compact"):
-            GangScheduler(enc, compact=False, eval_window=8)
+        gang = GangScheduler(
+            enc, compact=False, chunk=2, eval_window=2, rel_serialize=False
+        )
+        gang.run()
+        assert all(v != "" for v in gang.placements().values())
         with pytest.raises(ValueError, match="eval_window"):
             GangScheduler(enc, eval_window=0)
 
@@ -715,3 +721,49 @@ class TestEvalWindow:
         got = gang.placements()
         assert all(got[("default", f"ok{i}")] != "" for i in range(8)), got
         assert all(got[("default", f"big{i}")] == "" for i in range(2)), got
+
+    def test_static_budget_covers_full_window_sweep(self):
+        """Code-review r5 repro #2: an infeasible queue prefix spanning
+        more windows than the static budget. The budget clamp
+        (static_rounds >= ceil(P/WP)) keeps the auto-resume rule's
+        'zero-commit pass means infeasible remainder' proof valid; an
+        unclamped budget ends a pass mid-sweep with zero commits and
+        the driver strands the feasible tail."""
+        nodes = [node(f"n{i}", cpu="8", pods="110") for i in range(8)]
+        blocked = [
+            pod(f"big{i}", cpu="100", priority=100) for i in range(14)
+        ]
+        ok = [pod(f"ok{i}", cpu="1", priority=1) for i in range(2)]
+        cfg = self._cfg()
+        enc = encode_cluster(nodes, blocked + ok, cfg, policy=EXACT)
+        gang = GangScheduler(
+            enc, loop="static", chunk=2, eval_window=2, rel_serialize=False
+        )
+        assert gang.static_rounds >= 8  # the clamp engaged
+        gang.run()
+        got = gang.placements()
+        assert all(got[("default", f"ok{i}")] != "" for i in range(2)), got
+        assert all(got[("default", f"big{i}")] == "" for i in range(14))
+
+    def test_windowed_static_sweep_with_blocked_prefix(self):
+        """The GangSweep form of the same trap: every variant's static
+        pass must survive an infeasible prefix wider than the naive
+        budget (the per-variant-array resume rule breaks on any
+        zero-commit pass, so the clamp must hold under vmap too)."""
+        import numpy as np
+
+        from kube_scheduler_simulator_tpu.parallel import GangSweep
+        from kube_scheduler_simulator_tpu.parallel.sweep import weights_for
+
+        nodes = [node(f"n{i}", cpu="8", pods="110") for i in range(8)]
+        blocked = [
+            pod(f"big{i}", cpu="100", priority=100) for i in range(14)
+        ]
+        ok = [pod(f"ok{i}", cpu="1", priority=1) for i in range(2)]
+        cfg = self._cfg()
+        enc = encode_cluster(nodes, blocked + ok, cfg, policy=EXACT)
+        sweep = GangSweep(enc, chunk=2, loop="static", eval_window=2)
+        w = np.stack([weights_for(enc, {}), weights_for(enc, {"NodeResourcesFit": 3})])
+        assignments, _ = sweep.run(w)
+        for d in sweep.placements(assignments):
+            assert all(d[("default", f"ok{i}")] != "" for i in range(2)), d

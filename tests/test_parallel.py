@@ -279,3 +279,36 @@ class TestGangSweep:
         np.testing.assert_array_equal(np.asarray(a_dyn), np.asarray(a_stat))
         for d in stat.placements(a_stat):
             assert sum(1 for v in d.values() if v) > 0
+
+
+def test_windowed_gang_sweep_matches_per_variant_windowed_runs():
+    """eval_window under vmap: the row-subset round pipeline is a
+    STATIC shrink (unlike compaction's cond), so a windowed GangSweep
+    must place every variant exactly like a per-variant windowed
+    GangScheduler run — and place everything on an easy cluster."""
+    import numpy as np
+
+    from kube_scheduler_simulator_tpu.engine import TPU32, encode_cluster
+    from kube_scheduler_simulator_tpu.engine.gang import GangScheduler
+    from kube_scheduler_simulator_tpu.parallel import GangSweep
+    from kube_scheduler_simulator_tpu.parallel.sweep import weights_for
+    from kube_scheduler_simulator_tpu.synth import synthetic_cluster
+    from test_engine_parity import restricted_config
+
+    cfg = restricted_config()
+    nodes, pods = synthetic_cluster(8, 48, seed=9)
+    enc = encode_cluster(nodes, pods, cfg, policy=TPU32)
+    for loop in ("dynamic", "static"):
+        sweep = GangSweep(enc, chunk=8, loop=loop, eval_window=8)
+        variants = [{}, {"NodeResourcesFit": 4}, {"NodeResourcesBalancedAllocation": 7}]
+        w = np.stack([weights_for(enc, ov) for ov in variants])
+        assignments, _ = sweep.run(w)
+        placements = sweep.placements(assignments)
+        for i, ov in enumerate(variants):
+            assert all(v for v in placements[i].values()), (loop, i)
+            solo = GangScheduler(
+                encode_cluster(nodes, pods, cfg, policy=TPU32),
+                chunk=8, loop=loop, eval_window=8, compact=False,
+            )
+            solo.run(weights_for(enc, ov))
+            assert placements[i] == solo.placements(), (loop, i)
