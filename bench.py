@@ -318,7 +318,7 @@ def _gang_probe(
             print(json.dumps({**result, **extra}), flush=True)
 
 
-def _gang_sweep_probe(shape: str = "bench"):
+def _gang_sweep_probe(shape: str = "bench", window: "int | None" = None):
     """Subprocess mode (`bench.py --gang-sweep-probe
     [--gang-shape=bench|tiny]`): V policy-weight variants x the gang
     fixpoint, vmapped into ONE scans-only XLA program
@@ -348,7 +348,9 @@ def _gang_sweep_probe(shape: str = "bench"):
         n_var = 4
     nodes, pods = synthetic_cluster(n_nodes, n_pods, seed=42)
     enc = encode_cluster(nodes, pods, supported_config(), policy=TPU32)
-    sweep = GangSweep(enc, chunk=128, loop="static")
+    # --gang-window: the eval_window is a STATIC per-round shrink (row-
+    # subset rounds), so unlike compaction it survives the variant vmap
+    sweep = GangSweep(enc, chunk=128, loop="static", eval_window=window)
     wbase = np.asarray(sweep.gang.weights)
     variants = np.stack([wbase + i for i in range(n_var)]).astype(np.int32)
 
@@ -362,6 +364,7 @@ def _gang_sweep_probe(shape: str = "bench"):
     result = {
         "gang_sweep_dps": round(n_var * n_pods / best, 1),
         "variants": n_var,
+        **({"window": window} if window else {}),
         "shape": f"{n_pods}x{n_nodes}",
         "rounds_max": int(rounds.max()),
         "scheduled": scheduled,
@@ -623,18 +626,29 @@ def _try_gang_dynamic_upgrade(shapes: list) -> dict:
     )
     if tiny is None:
         return out
+    # atscale runs WINDOWED ONLY: the windowed program carries no tall
+    # [P, N] dense construct (the round-5 crash class at 10k x 1k), so
+    # it is the one dynamic variant with a chip story at that shape —
+    # the unwindowed atscale program is a known worker-crash class and
+    # is deliberately not probed.
+    plan = []
     for shape in shapes:
-        for wargs in ([], ["--gang-window=512"]):
-            full = _probe_json_subprocess(
-                ["--gang-probe=dynamic", f"--gang-shape={shape}", *wargs],
-                600.0,
-                "gang_dps",
-                device=True,
-            )
-            if full is None and _tunnel_wedged_since() is not None:
-                return out  # timeout path — stop poking the tunnel
-            if full is not None:
-                out[(shape, bool(wargs))] = full
+        if shape == "atscale":
+            plan.append((shape, ["--gang-window=1024"]))
+        else:
+            plan.append((shape, []))
+            plan.append((shape, ["--gang-window=512"]))
+    for shape, wargs in plan:
+        full = _probe_json_subprocess(
+            ["--gang-probe=dynamic", f"--gang-shape={shape}", *wargs],
+            600.0,
+            "gang_dps",
+            device=True,
+        )
+        if full is None and _tunnel_wedged_since() is not None:
+            return out  # timeout path — stop poking the tunnel
+        if full is not None:
+            out[(shape, tuple(wargs))] = full
     return out
 
 
@@ -930,7 +944,7 @@ def main(profile_dir: "str | None" = None):
     # by skipping no-op budget slots; the windowed variant is the
     # eval-dominance lever. Same wedge-risk class as hybrid.
     if not platform.startswith("cpu") and gang and not gang.get("fallback_from"):
-        dyns = _try_gang_dynamic_upgrade(["bench"])
+        dyns = _try_gang_dynamic_upgrade(["bench", "atscale"])
         for d in dyns.values():
             gang_note += f", gang dyn{gang_desc(d)}"
             if (
@@ -938,6 +952,34 @@ def main(profile_dir: "str | None" = None):
                 and d["gang_dps"] > gang_headline
             ):
                 gang_headline = d["gang_dps"]
+        # windowed vmapped sweep upgrade (its own rung: the row-subset
+        # gathers are new constructs for the vmapped class); tiny rung
+        # uses window=128 so the window actually binds at 256 pods
+        if gang_sweep:
+            wtiny = _probe_json_subprocess(
+                ["--gang-sweep-probe", "--gang-shape=tiny",
+                 "--gang-window=128"],
+                420.0,
+                "gang_sweep_dps",
+                device=True,
+            )
+            if wtiny is not None:
+                wsweep = _probe_json_subprocess(
+                    ["--gang-sweep-probe", "--gang-window=512"],
+                    900.0,
+                    "gang_sweep_dps",
+                    device=True,
+                )
+                if wsweep:
+                    gang_note += (
+                        f", gang sweep w512 {wsweep['variants']}x"
+                        f"{wsweep['shape']}={wsweep['gang_sweep_dps']}/s"
+                        f" in <={wsweep['rounds_max']} rounds"
+                    )
+                    if wsweep["scheduled"] == wsweep["pods"]:
+                        gang_headline = max(
+                            gang_headline, wsweep["gang_sweep_dps"]
+                        )
     # hybrid (while-loop matching) upgrade, accelerator only, strictly
     # last: every static number above is already banked, so the one
     # program class that can wedge the tunnel risks nothing but itself.
@@ -1052,7 +1094,11 @@ if __name__ == "__main__":
         return shape
 
     if "--gang-sweep-probe" in sys.argv:
-        _gang_sweep_probe(_shape_arg(("bench", "tiny")))
+        gw = [a for a in sys.argv if a.startswith("--gang-window")]
+        _gang_sweep_probe(
+            _shape_arg(("bench", "tiny")),
+            window=int(gw[0].partition("=")[2]) if gw else None,
+        )
         sys.exit(0)
     probe = [a for a in sys.argv if a.startswith("--gang-probe")]
     if probe:

@@ -313,6 +313,7 @@ class GangScheduler:
                 f"inner_loop must be dynamic|static|None, got {inner_loop!r}"
             )
         self.inner_loop = inner_loop
+        explicit_budget = static_rounds is not None or max_rounds is not None
         if static_rounds is None:
             # honor an explicit max_rounds as the static budget too.
             # Default per-pass quantum: ~max-pods-per-node rounds plus
@@ -327,12 +328,15 @@ class GangScheduler:
         # A binding eval_window spreads the fixpoint sweep across round
         # slots (one window per slot), and every pass restarts its
         # window offset at 0 — so the auto-resume rule's "zero-commit
-        # pass == infeasible remainder" proof needs the budget to cover
-        # a COMPLETE sweep: clamp to ceil(P/WP). Without this, a pass
+        # pass == infeasible remainder" proof needs the static budget to
+        # cover a COMPLETE sweep (ceil(P/WP) slots). Otherwise a pass
         # could exhaust its quantum mid-sweep with zero commits and the
         # driver would strand feasible later-window pods (code-review
         # r5 repro: 14 infeasible high-priority pods ahead of 2
-        # feasible ones at window size 2). Same rule protects
+        # feasible ones at window size 2). The DEFAULT budget is raised
+        # to the sweep width; an EXPLICIT static_rounds/max_rounds below
+        # it is rejected rather than silently overridden (the cap is a
+        # documented per-pass latency contract). Same rule protects
         # GangSweep's per-variant-array form of the resume check.
         self._wp = None
         if self.eval_window is not None:
@@ -340,9 +344,21 @@ class GangScheduler:
             wp = min(-(-min(self.eval_window, enc.P) // ch) * ch, enc.P)
             if wp < enc.P:
                 self._wp = wp
-                self.static_rounds = max(
-                    self.static_rounds, -(-enc.P // wp)
-                )
+                n_win = -(-enc.P // wp)
+                if explicit_budget:
+                    # an explicit cap below a full sweep would void the
+                    # completeness proof — make the caller choose
+                    # (bigger budget or bigger window) instead of
+                    # silently overriding their per-pass latency cap
+                    if self.static_rounds < n_win and loop == "static":
+                        raise ValueError(
+                            f"static per-pass budget {self.static_rounds}"
+                            f" cannot cover a full eval_window sweep"
+                            f" (ceil(P/WP) = {n_win}): raise"
+                            f" static_rounds/max_rounds or eval_window"
+                        )
+                else:
+                    self.static_rounds = max(self.static_rounds, n_win)
         # Reuse the sequential engine's compiled-kernel construction and
         # its `attempt` program — gang mode is a different driver around
         # the identical per-pod evaluation.

@@ -622,8 +622,8 @@ def test_static_budget_auto_resumes():
 class TestEvalWindow:
     """eval_window: queue-prefix-bounded rounds (the chip lever for the
     eval-bound round wall — see GangScheduler.__init__). Placements are
-    a valid greedy order; completeness and the stuck-window fallback
-    are the load-bearing guarantees."""
+    a valid greedy order; completeness and the window-offset sweep's
+    fixpoint soundness are the load-bearing guarantees."""
 
     def _cfg(self):
         return restricted_config(
@@ -656,13 +656,12 @@ class TestEvalWindow:
         plain = GangScheduler(enc, chunk=4)
         assert _placements(wide) == _placements(plain)
 
-    def test_stuck_window_falls_back_to_full_round(self):
+    def test_blocked_window_prefix_sweeps_to_feasible_pods(self):
         """First-in-queue pods are infeasible everywhere (no preemption
-        in the config): a windowed round over them commits nothing, and
-        the stuck carry must trigger a full-width round so deeper
-        feasible pods still place — without the fallback the loop would
-        exit (dynamic) or burn its budget (static) with feasible pods
-        stranded."""
+        in the config): windows over them commit nothing, so the carried
+        offset must advance to deeper windows until the feasible pods
+        place — without the offset sweep the loop would exit (dynamic)
+        or burn its budget (static) with feasible pods stranded."""
         nodes = [node("n0", cpu="8", pods="110"), node("n1", cpu="8", pods="110")]
         # higher priority -> first in the PrioritySort queue
         blocked = [
@@ -683,8 +682,8 @@ class TestEvalWindow:
             assert all(
                 got[("default", f"big{i}")] == "" for i in range(4)
             ), (loop, got)
-            # finite: stuck probes + full rounds settle well under the
-            # naive P-round ceiling
+            # finite: no-commit window hops + committing rounds settle
+            # well under the naive P-round ceiling
             assert int(np.asarray(rounds)) <= 24, loop
 
     def test_window_independent_of_compact(self):
@@ -702,13 +701,27 @@ class TestEvalWindow:
         with pytest.raises(ValueError, match="eval_window"):
             GangScheduler(enc, eval_window=0)
 
-    def test_dynamic_window_stuck_probes_do_not_exhaust_budget(self):
+    def test_explicit_budget_below_sweep_width_rejected(self):
+        """An explicit static budget is a documented per-pass latency
+        cap — silently raising it for the window sweep would break that
+        contract, and honoring it would void the completeness proof, so
+        the combination is rejected (code-review r5)."""
+        nodes = [node("n0", cpu="8", pods="110")]
+        pods = [pod(f"p{i}", cpu="1") for i in range(16)]
+        enc = encode_cluster(nodes, pods, self._cfg(), policy=EXACT)
+        with pytest.raises(ValueError, match="full eval_window sweep"):
+            GangScheduler(
+                enc, loop="static", chunk=2, eval_window=2, max_rounds=4
+            )
+
+    def test_dynamic_window_budget_scales_with_sweep_width(self):
         """Code-review r5 repro: on ONE schedulable node with a
-        permanently infeasible window prefix, every commit needs a
-        stuck-probe round plus a full round (~2 rounds per pod). The
-        default dynamic max_rounds must cover that (2P+2, not P+1) or
-        the while_loop exits early and silently strands feasible pods —
-        there is no dynamic-mode auto-resume to catch it."""
+        permanently infeasible window prefix, every commit is preceded
+        by a no-commit sweep over the blocked windows (several rounds
+        per pod). The default dynamic max_rounds must scale by the
+        sweep width — at P+1 the while_loop exits early and silently
+        strands feasible pods; there is no dynamic-mode auto-resume to
+        catch it."""
         nodes = [node("n0", cpu="32", pods="110")]
         blocked = [pod(f"big{i}", cpu="100", priority=100) for i in range(2)]
         ok = [pod(f"ok{i}", cpu="1", priority=1) for i in range(8)]
