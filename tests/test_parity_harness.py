@@ -67,6 +67,7 @@ def test_cli_roundtrip(tmp_path):
             "--b", f"http://127.0.0.1:{srv_b.port}",
             "--snapshot", str(snap_path),
             "--annotations",
+            "--timeout", "300",
         ])
         assert rc == 0
     finally:

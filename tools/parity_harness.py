@@ -135,12 +135,16 @@ class Backend:
             time.sleep(0.25)
 
 
-def run_backend(backend: Backend, snapshot: dict) -> dict:
+def run_backend(
+    backend: Backend, snapshot: dict, settle_s: float = 120.0
+) -> dict:
     backend.reset()
     backend.import_snapshot(snapshot)
     triggered = backend.try_trigger_schedule()
     return backend.wait_for_placements(
-        expected=len(snapshot.get("pods", [])), synchronous=triggered
+        expected=len(snapshot.get("pods", [])),
+        synchronous=triggered,
+        timeout_s=settle_s,
     )
 
 
@@ -176,12 +180,26 @@ def main(argv=None) -> int:
         action="store_true",
         help="also compare the per-plugin result annotations",
     )
+    ap.add_argument(
+        "--timeout",
+        type=float,
+        default=30.0,
+        help="per-HTTP-request timeout in seconds (the schedule-settle"
+        " deadline is 4x this): raise it for slow backends — a cold jit"
+        " compile or a loaded host can push one schedule request past"
+        " the default",
+    )
     args = ap.parse_args(argv)
     with open(args.snapshot) as f:
         snapshot = json.load(f)
     try:
-        res_a = run_backend(Backend(args.a), snapshot)
-        res_b = run_backend(Backend(args.b), snapshot)
+        settle = max(120.0, 4 * args.timeout)
+        res_a = run_backend(
+            Backend(args.a, timeout=args.timeout), snapshot, settle_s=settle
+        )
+        res_b = run_backend(
+            Backend(args.b, timeout=args.timeout), snapshot, settle_s=settle
+        )
     except (urllib.error.URLError, OSError) as e:
         print(f"parity-harness: backend unreachable: {e}", file=sys.stderr)
         return 2
