@@ -144,3 +144,24 @@ class TestGangRecords:
         assert set(subset) == set(some)
         for k in some:
             assert subset[k] == all_recs[k]
+
+    def test_windowed_run_records_decode(self):
+        """eval_window composes with the record path: the tracked
+        program carries the same offset-sweep rounds, and the replay
+        re-evaluates each pod against its bind round's start state —
+        records must decode the full wire format with selectedNode
+        matching the (windowed) placements."""
+        nodes, pds = synthetic_cluster(8, 48, seed=6)
+        enc = encode_cluster(nodes, pds, supported_config(), policy=TPU32)
+        gang = GangScheduler(enc, chunk=8, eval_window=8)
+        recs = _ann_by_pod(gang.results())
+        placements = gang.placements()
+        assert set(recs) == set(placements)
+        for key, node_name in placements.items():
+            status, ann = recs[key]
+            assert len(ann) >= 13
+            assert ann["scheduler-simulator/selected-node"] == node_name
+        # record-path placements == run() placements (same program)
+        again = GangScheduler(enc, chunk=8, eval_window=8)
+        again.run()
+        assert placements == again.placements()
