@@ -11,7 +11,8 @@ materialized.
 
 Selector → clauses (oracle match_label_selector semantics):
   * matchLabels k=v and In(k, vs)  → PAIR_ANY over the (k,v) pair ids
-  * NotIn(k, vs)                   → key present AND no pair hit
+  * NotIn(k, vs)                   → no pair hit (an absent key MATCHES,
+                                     upstream labels.Requirement)
   * Exists(k) / DoesNotExist(k)    → key-presence bit
   * nil selector                   → NEVER (matches nothing)
   * empty selector                 → zero clauses (matches everything)
@@ -414,7 +415,9 @@ def _eval_clauses(t, pair_hit, key_hit) -> jnp.ndarray:
     neutral for the enclosing AND."""
     m = jnp.where(
         t == PAIR_ANY, pair_hit,
-        jnp.where(t == NOTIN, key_hit & ~pair_hit,
+        # upstream labels.Requirement: NotIn matches when the key is
+        # absent too (no key bit -> no pair bit -> ~pair_hit is exact)
+        jnp.where(t == NOTIN, ~pair_hit,
         jnp.where(t == EXISTS, key_hit,
         jnp.where(t == DNE, ~key_hit, False))))
     return m | (t == CL_PAD)

@@ -396,7 +396,10 @@ def _terms_match(a: ClusterArrays, key, op, vals, num, num_ok, term_valid):
     present = nval >= 0
     eq_any = (nval[..., None, :] == vals[..., :, None]).any(axis=-2)  # [TM, E, N]
     is_in = present & eq_any
-    not_in = present & ~eq_any
+    # upstream labels.Requirement: NotIn matches when the key is ABSENT
+    # too (value-id padding is VAL_PAD=-3, never the absent sentinel -1,
+    # so eq_any is False for absent keys and ~is_in is exact)
+    not_in = ~is_in
     exists = present
     dne = ~present
     num_cmp_ok = present & nnum_ok & num_ok[..., None]

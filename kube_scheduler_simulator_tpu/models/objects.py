@@ -256,7 +256,13 @@ def _match_expression(req: dict, labels: dict[str, str], allow_numeric: bool) ->
     if op == "In":
         return present and val in values
     if op == "NotIn":
-        return present and val not in values
+        # upstream labels.Requirement.Matches: NotIn (and NotEquals)
+        # returns TRUE when the key is ABSENT — `if !ls.Has(r.key)
+        # { return true }` — for both node-selector requirements and
+        # metav1.LabelSelector conversion (caught by the round-5
+        # upstream-vector suite; the old present-required reading was a
+        # correlated oracle+kernel misreading)
+        return (not present) or (val not in values)
     if op == "Exists":
         return present
     if op == "DoesNotExist":

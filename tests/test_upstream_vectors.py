@@ -623,3 +623,195 @@ class TestPreemptionVectors:
             assert [r.status for r in rec_list] == [
                 "Nominated", "Scheduled"], impl
             assert rec_list[0].nominated_node == "n0", impl
+
+
+# ---------------------------------------------------------------------------
+# TaintToleration (upstream
+# pkg/scheduler/framework/plugins/tainttoleration/taint_toleration_test.go
+# TestTaintTolerationFilter)
+# ---------------------------------------------------------------------------
+
+
+def taint_config():
+    from test_engine_parity import restricted_config
+
+    return restricted_config(
+        filters=("NodeUnschedulable", "NodeResourcesFit", "TaintToleration"),
+    )
+
+
+def tnode(name, taints=None):
+    return node(name, cpu="8", taints=taints)
+
+
+NO_SCHED = [{"key": "dedicated", "value": "user1", "effect": "NoSchedule"}]
+PREFER = [{"key": "dedicated", "value": "user1", "effect": "PreferNoSchedule"}]
+
+
+class TestTaintTolerationVectors:
+    PLUGIN = "TaintToleration"
+
+    def _nodes(self, taints):
+        return [tnode("n-tainted", taints), tnode("n-clean")]
+
+    def test_no_tolerations_cannot_schedule_on_tainted(self):
+        # upstream "A pod having no tolerations can't be scheduled onto
+        # a node with nonempty taints"
+        assert_filter_vector(
+            self._nodes(NO_SCHED), [pod("t")], taint_config(), "t",
+            {"n-clean"}, self.PLUGIN)
+
+    def test_matching_equal_toleration_schedules(self):
+        # upstream "A pod which can be scheduled on a dedicated node
+        # assigned to user1 with effect NoSchedule"
+        tol = [{"key": "dedicated", "operator": "Equal", "value": "user1",
+                "effect": "NoSchedule"}]
+        assert_filter_vector(
+            self._nodes(NO_SCHED), [pod("t", tolerations=tol)],
+            taint_config(), "t", {"n-tainted", "n-clean"}, self.PLUGIN)
+
+    def test_unmatched_value_filters(self):
+        # upstream "A pod which can't be scheduled due to unmatched value"
+        tol = [{"key": "dedicated", "operator": "Equal", "value": "user2",
+                "effect": "NoSchedule"}]
+        assert_filter_vector(
+            self._nodes(NO_SCHED), [pod("t", tolerations=tol)],
+            taint_config(), "t", {"n-clean"}, self.PLUGIN)
+
+    def test_exists_operator_ignores_value(self):
+        # upstream: operator Exists tolerates any value of the key
+        tol = [{"key": "dedicated", "operator": "Exists",
+                "effect": "NoSchedule"}]
+        assert_filter_vector(
+            self._nodes(NO_SCHED), [pod("t", tolerations=tol)],
+            taint_config(), "t", {"n-tainted", "n-clean"}, self.PLUGIN)
+
+    def test_empty_key_exists_tolerates_everything(self):
+        # upstream toleration semantics: empty key + Exists matches all
+        tol = [{"operator": "Exists"}]
+        assert_filter_vector(
+            self._nodes(NO_SCHED), [pod("t", tolerations=tol)],
+            taint_config(), "t", {"n-tainted", "n-clean"}, self.PLUGIN)
+
+    def test_prefer_no_schedule_never_filters(self):
+        # upstream "A pod can be scheduled onto the node whose taints'
+        # effect is PreferNoSchedule" — filtering ignores soft taints
+        assert_filter_vector(
+            self._nodes(PREFER), [pod("t")], taint_config(), "t",
+            {"n-tainted", "n-clean"}, self.PLUGIN)
+
+    def test_effect_mismatch_does_not_tolerate(self):
+        # a NoExecute toleration does not tolerate a NoSchedule taint
+        tol = [{"key": "dedicated", "operator": "Exists",
+                "effect": "NoExecute"}]
+        assert_filter_vector(
+            self._nodes(NO_SCHED), [pod("t", tolerations=tol)],
+            taint_config(), "t", {"n-clean"}, self.PLUGIN)
+
+
+# ---------------------------------------------------------------------------
+# NodeAffinity (upstream
+# pkg/scheduler/framework/plugins/nodeaffinity/node_affinity_test.go
+# TestNodeAffinity)
+# ---------------------------------------------------------------------------
+
+
+def na_config():
+    from test_engine_parity import restricted_config
+
+    return restricted_config(
+        filters=("NodeUnschedulable", "NodeResourcesFit", "NodeAffinity"),
+    )
+
+
+def req_affinity(terms):
+    return {
+        "nodeAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": {
+                "nodeSelectorTerms": terms
+            }
+        }
+    }
+
+
+def lnode(name, **labels):
+    return node(name, cpu="8", labels=labels)
+
+
+class TestNodeAffinityVectors:
+    PLUGIN = "NodeAffinity"
+
+    def _nodes(self):
+        return [
+            lnode("n1", foo="bar", gpu="2"),
+            lnode("n2", foo="baz", gpu="6"),
+            lnode("n3"),
+        ]
+
+    def test_in_operator(self):
+        # upstream "Pod with matchExpressions using In operator"
+        aff = req_affinity([{"matchExpressions": [
+            {"key": "foo", "operator": "In", "values": ["bar"]}]}])
+        assert_filter_vector(
+            self._nodes(), [pod("t", affinity=aff)], na_config(), "t",
+            {"n1"}, self.PLUGIN)
+
+    def test_not_in_excludes_missing_label_passes(self):
+        # upstream NotIn: nodes WITHOUT the label also pass
+        aff = req_affinity([{"matchExpressions": [
+            {"key": "foo", "operator": "NotIn", "values": ["bar"]}]}])
+        assert_filter_vector(
+            self._nodes(), [pod("t", affinity=aff)], na_config(), "t",
+            {"n2", "n3"}, self.PLUGIN)
+
+    def test_exists_and_does_not_exist(self):
+        aff = req_affinity([{"matchExpressions": [
+            {"key": "foo", "operator": "Exists"}]}])
+        assert_filter_vector(
+            self._nodes(), [pod("t", affinity=aff)], na_config(), "t",
+            {"n1", "n2"}, self.PLUGIN)
+        aff2 = req_affinity([{"matchExpressions": [
+            {"key": "foo", "operator": "DoesNotExist"}]}])
+        assert_filter_vector(
+            self._nodes(), [pod("t2", affinity=aff2)], na_config(), "t2",
+            {"n3"}, self.PLUGIN)
+
+    def test_gt_lt_numeric(self):
+        # upstream Gt/Lt parse label values as integers
+        aff = req_affinity([{"matchExpressions": [
+            {"key": "gpu", "operator": "Gt", "values": ["3"]}]}])
+        assert_filter_vector(
+            self._nodes(), [pod("t", affinity=aff)], na_config(), "t",
+            {"n2"}, self.PLUGIN)
+        aff2 = req_affinity([{"matchExpressions": [
+            {"key": "gpu", "operator": "Lt", "values": ["3"]}]}])
+        assert_filter_vector(
+            self._nodes(), [pod("t2", affinity=aff2)], na_config(), "t2",
+            {"n1"}, self.PLUGIN)
+
+    def test_terms_are_ored_expressions_are_anded(self):
+        # upstream: nodeSelectorTerms OR; matchExpressions within AND
+        aff = req_affinity([
+            {"matchExpressions": [
+                {"key": "foo", "operator": "In", "values": ["bar"]},
+                {"key": "gpu", "operator": "Gt", "values": ["1"]}]},
+            {"matchExpressions": [
+                {"key": "foo", "operator": "In", "values": ["baz"]}]},
+        ])
+        assert_filter_vector(
+            self._nodes(), [pod("t", affinity=aff)], na_config(), "t",
+            {"n1", "n2"}, self.PLUGIN)
+        # AND failure: foo=bar but gpu not > 3
+        aff2 = req_affinity([{"matchExpressions": [
+            {"key": "foo", "operator": "In", "values": ["bar"]},
+            {"key": "gpu", "operator": "Gt", "values": ["3"]}]}])
+        assert_filter_vector(
+            self._nodes(), [pod("t2", affinity=aff2)], na_config(), "t2",
+            set(), self.PLUGIN)
+
+    def test_no_matching_term_unschedulable(self):
+        aff = req_affinity([{"matchExpressions": [
+            {"key": "foo", "operator": "In", "values": ["nope"]}]}])
+        assert_filter_vector(
+            self._nodes(), [pod("t", affinity=aff)], na_config(), "t",
+            set(), self.PLUGIN)
