@@ -815,3 +815,32 @@ class TestNodeAffinityVectors:
         assert_filter_vector(
             self._nodes(), [pod("t", affinity=aff)], na_config(), "t",
             set(), self.PLUGIN)
+
+
+def test_no_execute_taint_filters_too():
+    # upstream DoNotScheduleTaintsFilterFunc: NoSchedule AND NoExecute
+    # both filter at scheduling time
+    taints = [{"key": "evict", "value": "now", "effect": "NoExecute"}]
+    assert_filter_vector(
+        [tnode("n-tainted", taints), tnode("n-clean")], [pod("t")],
+        taint_config(), "t", {"n-clean"}, "TaintToleration")
+
+
+def test_unschedulable_node_tolerated():
+    # upstream NodeUnschedulable plugin: spec.unschedulable acts as the
+    # node.kubernetes.io/unschedulable:NoSchedule taint, and a pod
+    # TOLERATING it schedules there (plugins.NodeUnschedulable
+    # TestNodeUnschedulable "unschedulable node + tolerated pod")
+    def mk():
+        ns = [tnode("n-off"), tnode("n-on")]
+        ns[0]["spec"] = {"unschedulable": True}
+        return ns
+
+    tol = [{"key": "node.kubernetes.io/unschedulable",
+            "operator": "Exists", "effect": "NoSchedule"}]
+    assert_filter_vector(
+        mk(), [pod("t", tolerations=tol)], taint_config(), "t",
+        {"n-off", "n-on"}, "NodeUnschedulable")
+    assert_filter_vector(
+        mk(), [pod("t2")], taint_config(), "t2",
+        {"n-on"}, "NodeUnschedulable")
