@@ -844,3 +844,76 @@ def test_unschedulable_node_tolerated():
     assert_filter_vector(
         mk(), [pod("t2")], taint_config(), "t2",
         {"n-on"}, "NodeUnschedulable")
+
+
+# ---------------------------------------------------------------------------
+# Scoring vectors: NodeResourcesFit (LeastAllocated) and
+# NodeResourcesBalancedAllocation (upstream
+# pkg/scheduler/framework/plugins/noderesources/least_allocated_test.go and
+# balanced_allocation_test.go) — expected RAW scores computed by hand from
+# the upstream formulas, never from either implementation:
+#   LeastAllocated = sum_r[ (alloc_r - req_r) * 100 / alloc_r * w_r ]
+#                    / sum(w_r)          (integer division per upstream)
+#   Balanced       = (1 - std({req_r/alloc_r})) * 100, rounded down
+# ---------------------------------------------------------------------------
+
+
+def score_table(rec):
+    raw = rec.to_annotations()["scheduler-simulator/score-result"]
+    return json.loads(raw) if raw else {}
+
+
+def assert_score_vector(nodes, pods_, cfg, test_pod, plugin, expect):
+    want, got = run_both(nodes, pods_, cfg)
+    for impl, recs in (("oracle", want), ("engine", got)):
+        rec = recs[("default", test_pod)][-1]
+        table = score_table(rec)
+        scores = {
+            n: int(plugins[plugin])
+            for n, plugins in table.items()
+            if plugin in plugins
+        }
+        assert scores == expect, (impl, plugin, scores, expect)
+
+
+class TestResourceScoreVectors:
+    def _cfg(self):
+        from test_engine_parity import restricted_config
+
+        return restricted_config()
+
+    def _nodes(self):
+        # n1: 8 cpu / 16Gi; n2: 4 cpu / 16Gi — chosen so every upstream
+        # formula lands on exact integers or known truncations
+        return [
+            node("n1", cpu="8", mem="16Gi"),
+            node("n2", cpu="4", mem="16Gi"),
+        ]
+
+    def test_least_allocated_empty_nodes(self):
+        # upstream least_allocated_test.go "nothing scheduled, resources
+        # requested" family: pod 2cpu/4Gi →
+        #   n1: ((8-2)*100/8 + (16-4)*100/16) / 2 = (75 + 75) / 2 = 75
+        #   n2: ((4-2)*100/4 + 75) / 2 = (50 + 75) / 2 = 62 (truncated)
+        assert_score_vector(
+            self._nodes(), [pod("t", cpu="2", mem="4Gi")], self._cfg(),
+            "t", "NodeResourcesFit", {"n1": 75, "n2": 62})
+
+    def test_balanced_allocation_empty_nodes(self):
+        # upstream balanced_allocation_test.go: fractions cpu/mem →
+        #   n1: 0.25 vs 0.25 → std 0 → 100
+        #   n2: 0.50 vs 0.25 → std |0.5-0.25|/2 = 0.125 → 87 (truncated)
+        assert_score_vector(
+            self._nodes(), [pod("t", cpu="2", mem="4Gi")], self._cfg(),
+            "t", "NodeResourcesBalancedAllocation", {"n1": 100, "n2": 87})
+
+    def test_least_allocated_counts_existing_pods(self):
+        # existing pod on n1 consumes 4cpu/4Gi: requested totals include
+        # it (upstream "resources requested, pods scheduled with
+        # resources"):
+        #   n1: ((8-4-2)*100/8 + (16-4-4)*100/16) / 2 = (25 + 50) / 2 = 37
+        #   n2 unchanged: 62
+        existing = pod("e", cpu="4", mem="4Gi", node_name="n1")
+        assert_score_vector(
+            self._nodes(), [existing, pod("t", cpu="2", mem="4Gi")],
+            self._cfg(), "t", "NodeResourcesFit", {"n1": 37, "n2": 62})
