@@ -113,7 +113,17 @@ metadata:
 
 import json as _json
 
+from ..sched.config import default_plugins as _default_plugins
+
 _TEMPLATES_JS = _json.dumps(TEMPLATES)
+# the v1.26 default score set seeds the per-plugin weight editor when the
+# active config leaves `.score.enabled` empty (defaults implied)
+_SCORE_DEFAULTS_JS = _json.dumps(
+    [
+        {"name": p["name"], "weight": int(p.get("weight") or 1)}
+        for p in _default_plugins()["score"]
+    ]
+)
 
 PAGE = """<!doctype html>
 <html><head><meta charset="utf-8"><title>kube-scheduler-simulator-tpu</title>
@@ -170,6 +180,14 @@ PAGE = """<!doctype html>
 <div id="detail">click a pod row to inspect its per-plugin results; click a
 node row for its pods</div>
 <h2>Scheduler configuration</h2>
+<div id="weights">
+ <b>Score plugin weights</b>
+ <table id="wtable"><thead><tr><th>plugin</th><th>weight</th></tr></thead>
+ <tbody></tbody></table>
+ <button onclick="applyWeights()">Apply weights</button>
+ <span class="hint">writes .profiles[0].plugins.score.enabled and
+ re-applies the configuration</span>
+</div>
 <textarea id="cfg"></textarea><br>
 <button onclick="applyCfg()">Apply configuration</button>
 <script>
@@ -360,10 +378,51 @@ async function importSnap(file){
   const r=await fetch('/api/v1/import',{method:'POST',body:await file.text()});
   setStatus('import → '+r.status+(r.ok?'':' '+await r.text()));
 }
+const SCORE_DEFAULTS = __SCORE_DEFAULTS__;
+function effectiveScoreSet(cfg){
+  // the active enabled list when present, else the v1.26 defaults
+  // (an empty enabled list means "defaults implied", the reference's
+  // own conversion semantics)
+  try{
+    const en=((((cfg.profiles||[])[0]||{}).plugins||{}).score||{}).enabled||[];
+    if(en.length) return en.map(p=>({name:p.name,weight:p.weight||1}));
+  }catch(e){}
+  return SCORE_DEFAULTS.map(p=>({name:p.name,weight:p.weight}));
+}
+function renderWeights(cfg){
+  const tb=document.querySelector('#wtable tbody'); tb.innerHTML='';
+  for(const p of effectiveScoreSet(cfg)){
+    const tr=document.createElement('tr');
+    tr.innerHTML='<td>'+esc(p.name)+'</td><td><input type="number" '+
+      'min="0" max="100" data-plugin="'+esc(p.name)+'" value="'+
+      esc(p.weight)+'"></td>';
+    tb.appendChild(tr);
+  }
+}
+async function applyWeights(){
+  let cfg;
+  try{ cfg=JSON.parse(document.getElementById('cfg').value); }
+  catch(e){ setStatus('apply weights: config box is not valid JSON — '+e);
+            return; }
+  cfg.profiles=cfg.profiles&&cfg.profiles.length?cfg.profiles:[{}];
+  const prof=cfg.profiles[0];
+  prof.plugins=prof.plugins||{};
+  prof.plugins.score=prof.plugins.score||{};
+  prof.plugins.score.disabled=[{name:'*'}];
+  // weight 0 REMOVES the plugin from scoring (the min="0" affordance)
+  prof.plugins.score.enabled=[...document.querySelectorAll(
+    '#wtable input')].map(i=>{const w=parseInt(i.value,10);
+      return {name:i.dataset.plugin,weight:isNaN(w)?1:w};})
+    .filter(p=>p.weight>0);
+  document.getElementById('cfg').value=JSON.stringify(cfg,null,2);
+  await applyCfg();
+}
 async function loadCfg(){
   try{
     const r=await fetch('/api/v1/schedulerconfiguration');
-    document.getElementById('cfg').value=JSON.stringify(await r.json(),null,2);
+    const cfg=await r.json();
+    document.getElementById('cfg').value=JSON.stringify(cfg,null,2);
+    renderWeights(cfg);
   }catch(e){setStatus('config load failed: '+e);}
 }
 async function applyCfg(){
@@ -403,4 +462,6 @@ async function watch(){
 }
 loadCfg(); watch();
 </script></body></html>
-""".replace("__TEMPLATES__", _TEMPLATES_JS)
+""".replace("__TEMPLATES__", _TEMPLATES_JS).replace(
+    "__SCORE_DEFAULTS__", _SCORE_DEFAULTS_JS
+)
