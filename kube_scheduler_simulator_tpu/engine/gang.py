@@ -338,27 +338,23 @@ class GangScheduler:
         # it is rejected rather than silently overridden (the cap is a
         # documented per-pass latency contract). Same rule protects
         # GangSweep's per-variant-array form of the resume check.
-        self._wp = None
-        if self.eval_window is not None:
-            ch = max(1, min(self.chunk, enc.P))
-            wp = min(-(-min(self.eval_window, enc.P) // ch) * ch, enc.P)
-            if wp < enc.P:
-                self._wp = wp
-                n_win = -(-enc.P // wp)
-                if explicit_budget:
-                    # an explicit cap below a full sweep would void the
-                    # completeness proof — make the caller choose
-                    # (bigger budget or bigger window) instead of
-                    # silently overriding their per-pass latency cap
-                    if self.static_rounds < n_win and loop == "static":
-                        raise ValueError(
-                            f"static per-pass budget {self.static_rounds}"
-                            f" cannot cover a full eval_window sweep"
-                            f" (ceil(P/WP) = {n_win}): raise"
-                            f" static_rounds/max_rounds or eval_window"
-                        )
-                else:
-                    self.static_rounds = max(self.static_rounds, n_win)
+        self._wp = self.effective_window(enc, self.eval_window, self.chunk)
+        if self._wp is not None:
+            n_win = -(-enc.P // self._wp)
+            if explicit_budget:
+                # an explicit cap below a full sweep would void the
+                # completeness proof — make the caller choose (bigger
+                # budget or bigger window) instead of silently
+                # overriding their per-pass latency cap
+                if self.static_rounds < n_win and loop == "static":
+                    raise ValueError(
+                        f"static per-pass budget {self.static_rounds}"
+                        f" cannot cover a full eval_window sweep"
+                        f" (ceil(P/WP) = {n_win}): raise"
+                        f" static_rounds/max_rounds or eval_window"
+                    )
+            else:
+                self.static_rounds = max(self.static_rounds, n_win)
         # Reuse the sequential engine's compiled-kernel construction and
         # its `attempt` program — gang mode is a different driver around
         # the identical per-pod evaluation.
@@ -1463,6 +1459,21 @@ class GangScheduler:
         return BatchedScheduler.compile_signature(
             enc, record=False, include_queue_len=False
         )
+
+    @staticmethod
+    def effective_window(
+        enc: EncodedCluster, eval_window: "int | None", chunk: int = 256
+    ) -> "int | None":
+        """The chunk-granular window row count the compiled program
+        actually uses — None when windowing is off or never binds
+        (eval_window >= P). THIS, not the raw eval_window value, is
+        what program identity depends on: cache keys canonicalized on
+        it never recompile for raw windows that round to the same WP."""
+        if eval_window is None:
+            return None
+        ch = max(1, min(int(chunk), enc.P))
+        wp = min(-(-min(int(eval_window), enc.P) // ch) * ch, enc.P)
+        return None if wp >= enc.P else wp
 
     def retarget(self, enc: EncodedCluster) -> "GangScheduler":
         """Point at a compile-compatible new encoding (see

@@ -227,12 +227,27 @@ def _make_handler(server: SimulatorServer):
                         )
                     if mode == "gang":
                         # records default ON (the annotations are the
-                        # product); ?record=0 is the bulk opt-out
-                        rec_q = parse_qs(url.query).get("record", ["1"])[0]
+                        # product); ?record=0 is the bulk opt-out;
+                        # ?window=W passes eval_window through (the
+                        # at-scale round-cost lever)
+                        q = parse_qs(url.query)
+                        rec_q = q.get("record", ["1"])[0]
                         record = rec_q not in ("0", "false", "no")
+                        window = None
+                        if "window" in q:
+                            try:
+                                window = int(q["window"][0])
+                            except ValueError:
+                                return self._error(
+                                    400,
+                                    f"window must be an integer, got"
+                                    f" {q['window'][0]!r}",
+                                )
                         try:
                             placements, rounds, results = (
-                                service.scheduler.schedule_gang(record=record)
+                                service.scheduler.schedule_gang(
+                                    record=record, window=window
+                                )
                             )
                         except ValueError as e:
                             # known-unsupported combination (extenders

@@ -98,7 +98,10 @@ class SchedulerService:
         self._schedule_lock = threading.Lock()
         self._engine_cache: "tuple[tuple, BatchedScheduler] | None" = None
         self._extender_engine_cache: "tuple[tuple, object] | None" = None
-        self._gang_engine_cache: "tuple[tuple, object] | None" = None
+        # (compile signature, effective window) -> GangScheduler; small
+        # FIFO dict so alternating windowed/unwindowed clients don't
+        # recompile on every pass (code-review r5)
+        self._gang_engine_cache: "dict[tuple, object]" = {}
         self.extender_service = ExtenderService(self._config.extenders)
 
     # -- configuration lifecycle -------------------------------------------
@@ -174,7 +177,7 @@ class SchedulerService:
             return results
 
     def schedule_gang(
-        self, record: bool = True
+        self, record: bool = True, window: "int | None" = None
     ) -> "tuple[dict, int, list[PodSchedulingResult] | None]":
         """Gang pass with pass serialization; returns
         ({(ns, name): node | ""}, rounds, results).
@@ -184,13 +187,19 @@ class SchedulerService:
         the 13 result annotations are written back onto every queued
         pod exactly like the sequential pass, and the per-pod records
         are returned. `record=False` is the bulk-throughput opt-out
-        (results is None, only nodeName is written back)."""
+        (results is None, only nodeName is written back).
+
+        `window` passes GangScheduler's eval_window through (the
+        at-scale round-cost lever — docs/gang-scheduler.md); placements
+        are a valid greedy order of the windowed contract."""
         if self.disabled:
             raise SchedulerServiceDisabled()
+        if window is not None and int(window) < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
         with self._schedule_lock:
-            return self._schedule_gang_timed(record)
+            return self._schedule_gang_timed(record, window)
 
-    def _schedule_gang_timed(self, record: bool):
+    def _schedule_gang_timed(self, record: bool, window: "int | None" = None):
         with self._lock:
             config = self._config
         if config.extenders:
@@ -199,7 +208,7 @@ class SchedulerService:
             )
         with self.metrics.time_pass("gang") as ctx:
             placements, rounds, results = self._schedule_gang_locked(
-                config, record
+                config, record, window
             )
             ctx.done(
                 pods=len(placements),
@@ -208,7 +217,7 @@ class SchedulerService:
             )
         return placements, rounds, results
 
-    def _schedule_gang_locked(self, config, record: bool):
+    def _schedule_gang_locked(self, config, record: bool, window=None):
         """Gang pass: encode, run to fixpoint, write results back."""
         import numpy as np
 
@@ -217,13 +226,23 @@ class SchedulerService:
         enc = self._encode_current(config)
         if enc is None:
             return {}, 0, ([] if record else None)
-        sig = GangScheduler.compile_signature(enc)
+        # the window joins the cache key as the CANONICAL chunk-rounded
+        # value program identity actually depends on (raw windows that
+        # round to the same WP share one compilation); the dict keeps a
+        # few programs live so alternating windowed/unwindowed passes
+        # don't recompile every request
+        sig = (
+            GangScheduler.compile_signature(enc),
+            GangScheduler.effective_window(enc, window),
+        )
         cache = self._gang_engine_cache
-        if cache and cache[0] == sig:
-            gang = cache[1].retarget(enc)
+        if sig in cache:
+            gang = cache[sig].retarget(enc)
         else:
-            gang = GangScheduler(enc, strict=True)
-            self._gang_engine_cache = (sig, gang)
+            gang = GangScheduler(enc, strict=True, eval_window=window)
+            while len(cache) >= 4:  # FIFO bound
+                cache.pop(next(iter(cache)))
+            cache[sig] = gang
         if record:
             _, rounds = gang.run_recorded()
             results = gang.results()
