@@ -5,7 +5,7 @@
 PORT ?= 1212
 PY ?= python
 
-.PHONY: test test-fast lint start bench dryrun batch docker docker-up clean
+.PHONY: test test-fast lint start bench dryrun batch lifecycle-smoke docker docker-up clean
 
 # full suite on the 8-device virtual CPU mesh (tests/conftest.py pins it)
 test:
@@ -33,6 +33,12 @@ dryrun:
 # KEP-184 one-shot batch runner: make batch IN=specs/ OUT=results/
 batch:
 	$(PY) -m kube_scheduler_simulator_tpu.scenario.batch --input-dir $(IN) --out-dir $(OUT)
+
+# chaos-engine smoke: the example ~20-event timeline end-to-end on CPU
+# (docs/lifecycle.md); fails non-zero unless the run Succeeds
+lifecycle-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m kube_scheduler_simulator_tpu.lifecycle \
+		--spec examples/chaos.json --trace-out /tmp/kss-lifecycle-smoke.jsonl
 
 # containerized dev flow (reference `make docker_build_and_up`, one service)
 docker:

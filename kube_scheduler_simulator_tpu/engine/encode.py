@@ -779,3 +779,44 @@ def encode_cluster(
         "namespaces": list(namespaces or []),
     }
     return enc
+
+
+class EncodingCache:
+    """Incremental re-encode hook: skip `encode_cluster` entirely when the
+    store has not mutated since the last pass.
+
+    Full re-encoding is O(cluster) host work per scheduling pass; a
+    discrete-event driver (lifecycle/engine.py) or an HTTP client issuing
+    back-to-back passes pays it even when nothing changed. The store's
+    monotonically increasing resourceVersion is a complete change token —
+    every apply/replace/delete bumps it — so `(latest_rv, config
+    identity)` keys exactly one valid encoding. The config is compared by
+    IDENTITY (a restart swaps the object; equal-by-value configs from
+    different objects would be safe to share, but identity is the
+    conservative choice that can never alias a stale encoding). The miss
+    sentinel keeps `None` cacheable: "nothing schedulable" is itself a
+    valid encode result.
+    """
+
+    MISS = object()
+
+    def __init__(self):
+        self._key: "tuple | None" = None
+        self._config: "object | None" = None
+        self._enc: "object | None" = None
+
+    def get(self, key: tuple, config: object):
+        """The cached encoding for (key, config), or `EncodingCache.MISS`."""
+        if self._key == key and self._config is config:
+            return self._enc
+        return EncodingCache.MISS
+
+    def put(self, key: tuple, config: object, enc: object) -> None:
+        self._key = key
+        self._config = config
+        self._enc = enc
+
+    def invalidate(self) -> None:
+        self._key = None
+        self._config = None
+        self._enc = None

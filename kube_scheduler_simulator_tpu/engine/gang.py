@@ -152,7 +152,12 @@ class GangScheduler:
         per-pass budget bounds wasted no-op rounds (at most ~budget
         past the fixpoint) without ever starving a workload — the
         budget is a quantum, not a cap. An explicit `max_rounds` caps
-        the per-pass budget too.
+        the per-pass budget too. In dynamic mode with a BINDING
+        `eval_window`, an explicit `max_rounds` is denominated in
+        COMMIT rounds (the unit it caps unwindowed, where every counted
+        round commits): no-commit window-sweep rounds don't burn it, so
+        the cap can never exhaust the loop mid-sweep and strand
+        feasible pods (ADVICE r5).
 
         With equal `inner_iters` the two modes place identically (the
         extra static iterations/rounds are provably no-ops); a SMALLER
@@ -418,18 +423,20 @@ class GangScheduler:
         # Dynamic-loop livelock guard. Unwindowed, every progressing
         # round commits >= 1 pod, so P+1 bounds the loop. With a
         # binding eval_window, each commit may be preceded by a
-        # no-commit sweep over up to ceil(P/WP) windows (each counts as
-        # progress — see round_once), so the guard scales by the sweep
-        # width (code-review r5: an undersized guard exhausted the
-        # budget on a 1-node cluster with an infeasible window prefix
-        # and silently stranded feasible pods — there is no
-        # dynamic-mode auto-resume to catch that).
-        if self.max_rounds is not None:
-            max_rounds = self.max_rounds
-        elif WP is not None:
-            max_rounds = (P + 1) * (-(-P // WP)) + 1
-        else:
-            max_rounds = P + 1
+        # no-commit sweep over up to ceil(P/WP) windows, so the windowed
+        # dynamic loops guard on COMMIT rounds instead of total rounds
+        # (w_cond/tw_cond below): sweep rounds never burn the budget,
+        # and an explicit max_rounds below the sweep width can no longer
+        # exhaust the while_loop mid-sweep and silently strand feasible
+        # pods (ADVICE r5 — there is no dynamic-mode auto-resume to
+        # catch that; the old rounds-based guard scaled the DEFAULT by
+        # the sweep width but an explicit cap still bit). Termination
+        # needs no total-rounds bound: between commits the offset sweep
+        # reaches its fixpoint signal in <= ceil(P/WP) rounds, and
+        # commits are capped, so total rounds <= (cap+1) * sweep width —
+        # max_rounds stays a bounded-latency cap, denominated in the
+        # same unit the unwindowed loop counts (rounds that commit).
+        max_rounds = self.max_rounds if self.max_rounds is not None else P + 1
         inner_iters = self.inner_iters
         MW = self.match_width
         static = self.loop == "static"
@@ -1007,22 +1014,31 @@ class GangScheduler:
                 return state, progressed.sum().astype(jnp.int32)
 
             if W is not None:
+                # commit-round budget (see the max_rounds comment above):
+                # w_next == 0 identifies a committing round — a commit
+                # resets the window offset, a no-commit round advances it
+                # past 0
 
                 def w_cond(carry):
-                    _, progressed, rounds, _ = carry
-                    return progressed & (rounds < max_rounds)
+                    _, progressed, _, _, commits = carry
+                    return progressed & (commits < max_rounds)
 
                 def w_body(carry):
-                    state, _, rounds, w_idx = carry
+                    state, _, rounds, w_idx, commits = carry
                     state, w_next, progressed = round_once(state, w_idx)
+                    commits = commits + (w_next == 0).astype(jnp.int32)
                     return (
-                        state, progressed, rounds + jnp.int32(1), w_next
+                        state, progressed, rounds + jnp.int32(1), w_next,
+                        commits,
                     )
 
-                state, _, rounds, _ = jax.lax.while_loop(
+                state, _, rounds, _, _ = jax.lax.while_loop(
                     w_cond,
                     w_body,
-                    (state0, jnp.bool_(True), jnp.int32(0), jnp.int32(0)),
+                    (
+                        state0, jnp.bool_(True), jnp.int32(0), jnp.int32(0),
+                        jnp.int32(0),
+                    ),
                 )
                 return state, rounds
 
@@ -1081,27 +1097,29 @@ class GangScheduler:
                 return state, progressed.sum().astype(jnp.int32), br
 
             if W is not None:
+                # same commit-round budget as the untracked loop
 
                 def tw_cond(carry):
-                    _, progressed, rounds, _, _ = carry
-                    return progressed & (rounds < max_rounds)
+                    _, progressed, _, _, _, commits = carry
+                    return progressed & (commits < max_rounds)
 
                 def tw_body(carry):
-                    state, _, rounds, br, w_idx = carry
+                    state, _, rounds, br, w_idx, commits = carry
                     state2, w_next, progressed = round_once(state, w_idx)
                     newly = (state2.assignment >= 0) & (state.assignment < 0)
                     br = jnp.where(newly, rounds, br)
+                    commits = commits + (w_next == 0).astype(jnp.int32)
                     return (
                         state2, progressed, rounds + jnp.int32(1), br,
-                        w_next,
+                        w_next, commits,
                     )
 
-                state, _, rounds, br, _ = jax.lax.while_loop(
+                state, _, rounds, br, _, _ = jax.lax.while_loop(
                     tw_cond,
                     tw_body,
                     (
                         state0, jnp.bool_(True), jnp.int32(0), br0,
-                        jnp.int32(0),
+                        jnp.int32(0), jnp.int32(0),
                     ),
                 )
                 return state, rounds, br

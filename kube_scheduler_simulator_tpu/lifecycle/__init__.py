@@ -1,0 +1,36 @@
+"""Cluster-lifecycle chaos engine (ROADMAP: "as many scenarios as you
+can imagine" over cluster *timelines*, not static snapshots).
+
+Three layers:
+
+  * `scenario.chaos.ChaosSpec` — the declarative, seeded timeline schema
+    (fault schedule + workload arrival processes);
+  * `engine.LifecycleEngine` — the host-side discrete-event loop: pop
+    the event heap in simulated-time order, mutate the `ResourceStore`
+    (node fail/recover/drain/cordon/taint flaps, pod arrivals), derive
+    evictions (pods on failed/drained nodes re-enqueued pending), run
+    controllers to fixpoint plus a batched scheduling pass per event,
+    and append every step to a replayable, byte-deterministic JSONL
+    trace while latency/disruption metrics flow into
+    `utils.metrics.SchedulingMetrics`;
+  * `faultsweep.FaultSweep` — the performance core: per-scenario node
+    failure masks drawn with `jax.random` and swept via `vmap` over the
+    scenario axis (sharded over the mesh's 'replicas' axis like
+    parallel/sweep.py), so ONE compiled program evaluates a policy's
+    disruption profile across hundreds of sampled failure scenarios.
+
+Surfaces: `POST /api/v1/lifecycle` + `GET /api/v1/lifecycle/trace`
+(server/httpserver.py) and `python -m kube_scheduler_simulator_tpu.lifecycle`.
+"""
+
+from ..scenario.chaos import ArrivalProcess, ChaosSpec, FaultEvent
+from .engine import LifecycleEngine
+from .faultsweep import FaultSweep
+
+__all__ = [
+    "ArrivalProcess",
+    "ChaosSpec",
+    "FaultEvent",
+    "LifecycleEngine",
+    "FaultSweep",
+]

@@ -1,0 +1,103 @@
+"""CLI: ``python -m kube_scheduler_simulator_tpu.lifecycle``.
+
+Two modes over one ChaosSpec file (JSON or YAML):
+
+  * default        — run the discrete-event timeline (engine.py); the
+    result document prints to stdout, the replayable JSONL trace lands
+    at ``--trace-out`` when given;
+  * ``--sweep S``  — additionally run the vmapped fault sweep
+    (faultsweep.py) over the spec's snapshot cluster: S sampled failure
+    scenarios at ``--fail-prob``, seeded from the spec.
+
+Exit code 0 on a Succeeded run, 1 otherwise (the KEP-184 runner's
+contract, same as scenario/batch.py).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def _load_spec(path: str) -> dict:
+    with open(path) as f:
+        if path.endswith((".yaml", ".yml")):
+            import yaml
+
+            return yaml.safe_load(f)
+        return json.load(f)
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="kube_scheduler_simulator_tpu.lifecycle",
+        description="Cluster-lifecycle chaos runner (discrete-event churn, "
+        "fault injection, vmapped failure sweeps).",
+    )
+    ap.add_argument("--spec", required=True, help="ChaosSpec file (json/yaml)")
+    ap.add_argument(
+        "--trace-out", help="write the replayable JSONL event trace here"
+    )
+    ap.add_argument(
+        "--sweep", type=int, default=0, metavar="S",
+        help="also run a vmapped fault sweep over S sampled scenarios",
+    )
+    ap.add_argument(
+        "--fail-prob", type=float, default=0.1,
+        help="per-node failure probability for --sweep (default 0.1)",
+    )
+    args = ap.parse_args(argv)
+
+    from ..scenario.chaos import ChaosSpec
+    from .engine import LifecycleEngine
+
+    spec = ChaosSpec.from_dict(_load_spec(args.spec))
+    engine = LifecycleEngine(spec)
+    result = engine.run()
+    if args.trace_out:
+        with open(args.trace_out, "w") as f:
+            f.write(engine.trace_jsonl())
+        result["traceFile"] = args.trace_out
+
+    if args.sweep > 0:
+        from ..sched.config import SchedulerConfiguration
+        from .faultsweep import FaultSweep
+
+        # sweep the POST-RUN cluster: the timeline's surviving placements
+        # are exactly the state whose disruption profile matters
+        cfg = (
+            SchedulerConfiguration.from_dict(spec.scheduler_config)
+            if spec.scheduler_config
+            else SchedulerConfiguration.default()
+        )
+        store = engine.store
+        nodes = store.list("nodes")
+        pods = store.list("pods")
+        if nodes and pods:
+            sweep = FaultSweep.from_cluster(
+                nodes, pods, cfg,
+                priorityclasses=store.list("priorityclasses"),
+                namespaces=store.list("namespaces"),
+                pvcs=store.list("pvcs"),
+                pvs=store.list("pvs"),
+                storageclasses=store.list("storageclasses"),
+            )
+            masks = sweep.sample_masks(args.sweep, spec.seed, args.fail_prob)
+            profile = sweep.run(masks)
+            profile.pop("assignments")  # tensors don't print
+            result["faultSweep"] = profile
+        else:
+            result["faultSweep"] = {
+                "scenarios": 0,
+                "message": "post-run cluster has no nodes or no pods",
+            }
+
+    json.dump(result, sys.stdout, indent=2, sort_keys=True)
+    print()
+    return 0 if result.get("phase") == "Succeeded" else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,361 @@
+"""The discrete-event cluster-lifecycle loop.
+
+Execution model (per event batch, in simulated-time order):
+
+  1. Mutate   — apply the event to the `ResourceStore`: pod arrivals
+     land as pending pods; node faults remove/restore/cordon/taint the
+     node. A ``fail`` or ``drain`` EVICTS the node's bound pods: each is
+     re-applied as a pending pod (nodeName, scheduling annotations, and
+     server-stamped metadata stripped) — the derived re-enqueue the
+     tentpole requires — and every eviction appends its own trace event.
+  2. Converge — run the deterministic controller subset to fixpoint
+     (controllers/steps.py), then one batched scheduling pass
+     (sequential or gang per the spec) through `SchedulerService`, whose
+     `EncodingCache` makes no-mutation passes re-encode-free.
+  3. Record   — append a `SchedulingPass` trace event with the pass's
+     disruption accounting: pods scheduled/pending, which evicted pods
+     re-bound, and their simulated time-to-reschedule. Wall-clock pass
+     latency and disruption tallies flow into `SchedulingMetrics`
+     (`record` via the service's timed pass + `record_disruption`); the
+     TRACE carries only deterministic fields, so the same seeded spec
+     yields byte-identical trace JSONL (the KEP-140 determinism
+     requirement, strengthened exactly as scenario/runner.py does).
+
+The trace is replayable: each line carries the simulated time, the event
+that fired, and the store-visible consequence — feeding it back through
+`ChaosSpec`-less scenario tooling (or diffing two runs) needs nothing
+but the JSONL.
+"""
+
+from __future__ import annotations
+
+import copy
+import heapq
+import json
+import time
+
+from ..controllers import CONTROLLERS
+from ..controllers.steps import run_to_fixpoint
+from ..models.snapshot import import_snapshot
+from ..models.store import ResourceStore
+from ..scenario.chaos import ChaosSpec
+from ..sched.config import SchedulerConfiguration
+from ..sched.results import ANNOTATION_KEYS
+from ..server.service import SchedulerService
+from ..utils import metrics as metrics_mod
+
+
+def _pod_key(pod: dict) -> tuple[str, str]:
+    meta = pod.get("metadata", {}) or {}
+    return (meta.get("namespace", "default"), meta.get("name", ""))
+
+
+def trace_jsonl(trace: list[dict]) -> str:
+    """The ONE definition of the replayable trace's byte format (sorted
+    keys, compact separators, one event per line, trailing newline) —
+    shared by the CLI's --trace-out and GET /api/v1/lifecycle/trace so
+    the byte-identical-trace contract can't drift between surfaces."""
+    return "\n".join(
+        json.dumps(e, sort_keys=True, separators=(",", ":")) for e in trace
+    ) + ("\n" if trace else "")
+
+
+def _as_pending(pod: dict) -> dict:
+    """An evicted pod's next incarnation: same spec, no binding, no
+    server-stamped metadata, no stale scheduling-result annotations."""
+    p = copy.deepcopy(pod)
+    (p.get("spec", {}) or {}).pop("nodeName", None)
+    p.pop("status", None)
+    meta = p.setdefault("metadata", {})
+    meta.pop("resourceVersion", None)
+    meta.pop("uid", None)
+    ann = meta.get("annotations")
+    if ann:
+        for key in ANNOTATION_KEYS.values():
+            ann.pop(key, None)
+        if not ann:
+            meta.pop("annotations", None)
+    return p
+
+
+class LifecycleEngine:
+    """Runs one `ChaosSpec` timeline over a (fresh or provided) store."""
+
+    def __init__(
+        self,
+        spec: ChaosSpec,
+        *,
+        store: "ResourceStore | None" = None,
+        metrics: "metrics_mod.SchedulingMetrics | None" = None,
+        max_controller_rounds: int = 100,
+    ):
+        self.spec = spec
+        self.store = store or ResourceStore()
+        if spec.snapshot:
+            _, errors = import_snapshot(self.store, spec.snapshot)
+            if errors:
+                raise ValueError(f"chaos snapshot import: {errors}")
+        config = (
+            SchedulerConfiguration.from_dict(spec.scheduler_config)
+            if spec.scheduler_config
+            else None
+        )
+        self.scheduler = SchedulerService(self.store, config, metrics=metrics)
+        self.max_controller_rounds = max_controller_rounds
+        # the replayable JSONL event log (deterministic fields only)
+        self.trace: list[dict] = []
+        # wall-clock pass latencies, OUTSIDE the trace (nondeterministic)
+        self.timings: list[dict] = []
+        self._downed: dict[str, dict] = {}  # failed node name -> manifest
+        self._evicted_at: dict[tuple[str, str], float] = {}
+        self._tts: list[float] = []  # completed time-to-reschedule samples
+        self._arrived = 0
+        self._evicted = 0
+        self._rescheduled = 0
+        self._lost = 0  # evicted pods later deleted (e.g. preemption)
+
+    # -- trace --------------------------------------------------------------
+
+    def _record(self, ev_type: str, t: float, **fields) -> None:
+        self.trace.append({"type": ev_type, "t": round(float(t), 9), **fields})
+
+    def trace_jsonl(self) -> str:
+        """The trace as replayable JSONL (sorted keys: byte-stable)."""
+        return trace_jsonl(self.trace)
+
+    # -- event application --------------------------------------------------
+
+    def _evict(self, pod: dict, node: str, t: float, reason: str) -> None:
+        key = _pod_key(pod)
+        self.store.apply("pods", _as_pending(pod))
+        self._evicted_at[key] = t
+        self._evicted += 1
+        self.scheduler.metrics.record_disruption(evicted=1)
+        self._record(
+            "Eviction", t,
+            pod=f"{key[0]}/{key[1]}", node=node, reason=reason,
+        )
+
+    def _apply_arrival(self, t: float, payload: dict) -> None:
+        for pod in payload["pods"]:
+            obj = self.store.apply("pods", copy.deepcopy(pod))
+            self._arrived += 1
+            fields = {"pod": "{}/{}".format(*_pod_key(obj)),
+                      "process": payload.get("process", "")}
+            if payload.get("job"):
+                fields["job"] = payload["job"]
+            self._record("PodArrival", t, **fields)
+
+    def _apply_fault(self, t: float, payload: dict) -> None:
+        action, name = payload["action"], payload["node"]
+        node = self.store.get("nodes", name)
+        if action == "recover":
+            manifest = self._downed.pop(name, None)
+            if manifest is None:
+                self._record("FaultSkipped", t, action=action, node=name,
+                             reason="node was not failed")
+                return
+            meta = manifest.setdefault("metadata", {})
+            meta.pop("resourceVersion", None)
+            meta.pop("uid", None)
+            self.store.apply("nodes", manifest)
+            self._record("NodeRecover", t, node=name)
+            return
+        if node is None:
+            self._record("FaultSkipped", t, action=action, node=name,
+                         reason="node not found")
+            return
+        if action == "fail":
+            victims = self.store.pods_on_node(name)
+            self._downed[name] = node
+            # node deletion cascades its pods away; the pending
+            # re-incarnations below are the derived eviction events
+            self.store.delete("nodes", name)
+            self._record("NodeFail", t, node=name, evicted=len(victims))
+            for v in victims:
+                self._evict(v, name, t, reason="node failed")
+        elif action == "drain":
+            victims = self.store.pods_on_node(name)
+            self.store.apply(
+                "nodes",
+                {"metadata": {"name": name}, "spec": {"unschedulable": True}},
+            )
+            self._record("NodeDrain", t, node=name, evicted=len(victims))
+            for v in victims:
+                self.store.delete(
+                    "pods",
+                    (v.get("metadata") or {}).get("name", ""),
+                    (v.get("metadata") or {}).get("namespace", "default"),
+                )
+                self._evict(v, name, t, reason="node drained")
+        elif action == "cordon":
+            self.store.apply(
+                "nodes",
+                {"metadata": {"name": name}, "spec": {"unschedulable": True}},
+            )
+            self._record("NodeCordon", t, node=name)
+        elif action == "uncordon":
+            self.store.apply(
+                "nodes",
+                {"metadata": {"name": name}, "spec": {"unschedulable": False}},
+            )
+            self._record("NodeUncordon", t, node=name)
+        elif action in ("taint", "untaint"):
+            taint = payload["taint"]
+            taints = [
+                x
+                for x in ((node.get("spec") or {}).get("taints") or [])
+                if not (
+                    x.get("key") == taint.get("key")
+                    and x.get("effect", "") == taint.get("effect", "")
+                )
+            ]
+            if action == "taint":
+                taints.append(dict(taint))
+            # merge semantics replace non-dict values wholesale, so the
+            # rebuilt list IS the node's new taint set
+            self.store.apply(
+                "nodes", {"metadata": {"name": name}, "spec": {"taints": taints}}
+            )
+            self._record(
+                "NodeTaint" if action == "taint" else "NodeUntaint",
+                t, node=name, key=taint.get("key", ""),
+            )
+
+    # -- convergence --------------------------------------------------------
+
+    def _converge(self, t: float) -> None:
+        """Controllers to fixpoint, one scheduling pass, disruption
+        accounting — step 2+3 of the event loop."""
+        run_to_fixpoint(self.store, CONTROLLERS, self.max_controller_rounds)
+        t0 = time.perf_counter()
+        if self.spec.scheduler_mode == "gang":
+            placements, _, _ = self.scheduler.schedule_gang(
+                record=False, window=self.spec.window
+            )
+            scheduled = sum(1 for v in placements.values() if v)
+        else:
+            results = self.scheduler.schedule()
+            scheduled = sum(1 for r in results if r.status == "Scheduled")
+        wall = time.perf_counter() - t0
+
+        # which evicted pods found a node (or vanished) this pass
+        rescheduled: list[str] = []
+        times: list[float] = []
+        for key in sorted(self._evicted_at):
+            pod = self.store.get("pods", key[1], key[0])
+            if pod is None:
+                # deleted while pending (preemption victim, node cascade)
+                del self._evicted_at[key]
+                self._lost += 1
+                self._record("EvictedPodLost", t, pod=f"{key[0]}/{key[1]}")
+                continue
+            if (pod.get("spec") or {}).get("nodeName"):
+                tts = t - self._evicted_at.pop(key)
+                self._tts.append(tts)
+                times.append(tts)
+                rescheduled.append(f"{key[0]}/{key[1]}")
+                self._rescheduled += 1
+        if rescheduled:
+            self.scheduler.metrics.record_disruption(
+                rescheduled=len(rescheduled), times_to_reschedule_s=times
+            )
+        pending = sum(
+            1
+            for p in self.store.list("pods")
+            if not (p.get("spec") or {}).get("nodeName")
+        )
+        self._record(
+            "SchedulingPass", t,
+            mode=self.spec.scheduler_mode,
+            scheduled=scheduled,
+            pending=pending,
+            rescheduled=rescheduled,
+        )
+        self.timings.append({"t": t, "wallSeconds": round(wall, 6)})
+
+    # -- the loop -----------------------------------------------------------
+
+    def run(self) -> dict:
+        """Execute the timeline; returns the result document (phase,
+        counts, disruption summary, metrics). `self.trace` holds the
+        replayable event log afterwards."""
+        spec = self.spec
+        heap = list(spec.events())
+        heapq.heapify(heap)
+        self._record(
+            "Start", 0.0,
+            spec=spec.name, seed=spec.seed, horizon=spec.horizon,
+            nodes=self.store.count("nodes"), pods=self.store.count("pods"),
+        )
+        # settle the initial cluster (imported pending pods schedule at t=0)
+        self._converge(0.0)
+        end_t = 0.0
+        try:
+            while heap:
+                t, _, kind, payload = heapq.heappop(heap)
+                end_t = max(end_t, t)
+                if kind == "arrival":
+                    self._apply_arrival(t, payload)
+                else:
+                    self._apply_fault(t, dict(payload))
+                # batch events sharing a timestamp into one convergence
+                # (they are simultaneous in simulated time)
+                while heap and heap[0][0] == t:
+                    _, _, kind2, payload2 = heapq.heappop(heap)
+                    if kind2 == "arrival":
+                        self._apply_arrival(t, payload2)
+                    else:
+                        self._apply_fault(t, dict(payload2))
+                self._converge(t)
+        except Exception as e:  # noqa: BLE001 — a chaos run's failure is a result
+            self._record("Abort", end_t, error=f"{type(e).__name__}: {e}")
+            return self._result("Failed", end_t, message=f"{type(e).__name__}: {e}")
+
+        # pods still pending from an eviction are reported, never dropped
+        unschedulable = sorted(
+            f"{ns}/{name}" for ns, name in self._evicted_at
+        )
+        self._record(
+            "End", end_t,
+            pending=sum(
+                1
+                for p in self.store.list("pods")
+                if not (p.get("spec") or {}).get("nodeName")
+            ),
+            unschedulableEvicted=unschedulable,
+        )
+        return self._result("Succeeded", end_t)
+
+    def _result(self, phase: str, end_t: float, message: str = "") -> dict:
+        out = {
+            "phase": phase,
+            "name": self.spec.name,
+            "seed": self.spec.seed,
+            "simTime": round(end_t, 9),
+            "events": len(self.trace),
+            "pods": {
+                "arrived": self._arrived,
+                "evicted": self._evicted,
+                "rescheduled": self._rescheduled,
+                "lost": self._lost,
+                "unschedulableEvicted": sorted(
+                    f"{ns}/{name}" for ns, name in self._evicted_at
+                ),
+            },
+            "timeToReschedule": {
+                "count": len(self._tts),
+                "meanS": round(sum(self._tts) / len(self._tts), 9)
+                if self._tts
+                else 0.0,
+                "maxS": round(max(self._tts), 9) if self._tts else 0.0,
+            },
+            "passes": len(self.timings),
+            "wallSeconds": round(
+                sum(x["wallSeconds"] for x in self.timings), 6
+            ),
+            "metrics": self.scheduler.metrics.snapshot(),
+        }
+        if message:
+            out["message"] = message
+        return out

@@ -1,0 +1,260 @@
+"""Vmapped failure sweeps: one compiled program, many sampled fault
+scenarios.
+
+The host-side lifecycle loop (engine.py) replays ONE timeline; answering
+"what is this policy's disruption profile under node failure?" needs
+hundreds of sampled failure scenarios, which at host speed would be
+hundreds of full simulator runs. Here a scenario is a tensor:
+
+  1. the cluster is encoded ONCE with every pod in the queue (bound pods
+     are re-bound into the baseline state with the gang engine's
+     scatter-bind, so eviction can re-enqueue them without re-encoding);
+  2. per scenario, a node-failure mask is drawn with `jax.random`
+     (Bernoulli per real node, one fold of the seed per scenario);
+  3. `one_scenario` evicts the failed nodes' bound pods with the
+     engine's own `evict_all`, masks the failed nodes out of
+     `node_mask` (feasibility flows through every kernel from there),
+     runs the gang fixpoint (`GangScheduler.run_fn` — pure in (arrays,
+     state, order, weights), exactly why it vmaps), and reports the
+     disruption counters;
+  4. `vmap` sweeps the scenario axis — `[S, N]` masks against shared
+     arrays/state — and, with a mesh attached, the scenario axis shards
+     over 'replicas' exactly like parallel/sweep.py's variant axis.
+
+Parity contract (test-pinned): the vmapped sweep and S sequential
+single-scenario executions of the SAME program produce identical
+assignments and counters — vmap is a batching transform, not a
+semantics change. The sweep runs the round fixpoint only (no host-side
+preemption phases): disruption profiles measure re-placement capacity,
+not eviction cascades.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..engine.encode import EncodedCluster, TPU32, encode_cluster
+from ..engine.gang import GangScheduler
+
+
+class FaultSweep:
+    """Monte-Carlo node-failure sweep over one encoded cluster."""
+
+    def __init__(
+        self,
+        enc: EncodedCluster,
+        assignment: "dict[tuple[str, str], str] | None" = None,
+        *,
+        mesh=None,
+        chunk: int = 256,
+        loop: str = "dynamic",
+        eval_window: "int | None" = None,
+    ):
+        """`enc` must be encoded with the swept pods PENDING (in the
+        queue) — `from_cluster` does this for you; `assignment` maps
+        (ns, name) -> node name for the pods bound in the baseline
+        state (the placements whose disruption is being measured)."""
+        self.enc = enc
+        self.mesh = mesh
+        # compact=False: the sweep vmaps the program, and vmapped cond
+        # pays both branches — same reasoning as GangSweep
+        self.gang = GangScheduler(
+            enc, compact=False, chunk=chunk, loop=loop,
+            eval_window=eval_window,
+        )
+        order, in_q = self.gang.order_arrays()
+        self._order = order
+        self._in_q = jnp.asarray(np.asarray(in_q))
+        self.weights = self.gang.weights
+        self._state_bound = self._bind_baseline(assignment or {})
+
+        evict_all = self.gang._base._evict_all
+        run_fn = self.gang.run_fn
+        in_q_mask = self._in_q
+
+        def one_scenario(arrays, state_bound, order, weights, fail_mask):
+            """One failure scenario end-to-end on device. Returns
+            (assignment[P], evicted, rescheduled, stranded, rounds)."""
+            bound = state_bound.assignment >= 0
+            evict = bound & fail_mask[jnp.clip(state_bound.assignment, 0)]
+            state = evict_all(state_bound, arrays, evict)
+            arrays2 = arrays.replace(
+                node_mask=arrays.node_mask & ~fail_mask
+            )
+            final, rounds = run_fn(arrays2, state, order, weights)
+            rebound = (final.assignment >= 0) & evict
+            evicted = evict.sum().astype(jnp.int32)
+            rescheduled = rebound.sum().astype(jnp.int32)
+            return (
+                final.assignment,
+                evicted,
+                rescheduled,
+                evicted - rescheduled,
+                rounds,
+            )
+
+        self._one = jax.jit(one_scenario)
+        self._vrun = jax.jit(
+            jax.vmap(one_scenario, in_axes=(None, None, None, None, 0))
+        )
+
+    # -- construction helpers ----------------------------------------------
+
+    @classmethod
+    def from_cluster(
+        cls,
+        nodes: list[dict],
+        pods: list[dict],
+        config,
+        *,
+        policy=TPU32,
+        priorityclasses=None,
+        namespaces=None,
+        pvcs=None,
+        pvs=None,
+        storageclasses=None,
+        **kwargs,
+    ) -> "FaultSweep":
+        """Encode `nodes`+`pods` for sweeping: every pod joins the queue
+        (its `spec.nodeName` is stripped for encoding) and the recorded
+        bindings become the baseline state via scatter-bind."""
+        assignment: dict[tuple[str, str], str] = {}
+        pending = []
+        for p in pods:
+            meta = p.get("metadata", {}) or {}
+            key = (meta.get("namespace", "default"), meta.get("name", ""))
+            node_name = (p.get("spec") or {}).get("nodeName", "")
+            if node_name:
+                assignment[key] = node_name
+                p = {**p, "spec": {k: v for k, v in (p.get("spec") or {}).items()
+                                   if k != "nodeName"}}
+            pending.append(p)
+        enc = encode_cluster(
+            nodes, pending, config, policy=policy,
+            priorityclasses=priorityclasses, namespaces=namespaces,
+            pvcs=pvcs, pvs=pvs, storageclasses=storageclasses,
+        )
+        return cls(enc, assignment, **kwargs)
+
+    def _bind_baseline(self, assignment: dict):
+        """state0 with every assigned pod scatter-bound to its node."""
+        enc = self.enc
+        if not assignment:
+            return enc.state0
+        node_idx = {n: i for i, n in enumerate(enc.node_names)}
+        sel = np.full((enc.P,), -1, np.int32)
+        mask = np.zeros((enc.P,), bool)
+        for p_idx, key in enumerate(enc.pod_keys):
+            node_name = assignment.get(key, "")
+            if node_name:
+                if node_name not in node_idx:
+                    raise ValueError(
+                        f"pod {key} assigned to unknown node {node_name!r}"
+                    )
+                sel[p_idx] = node_idx[node_name]
+                mask[p_idx] = True
+        bind = jax.jit(self.gang._bind_all)
+        return bind(
+            enc.state0, enc.arrays, jnp.asarray(mask), jnp.asarray(sel),
+            self._order,
+        )
+
+    # -- sampling -----------------------------------------------------------
+
+    def sample_masks(
+        self, n_scenarios: int, seed: int, fail_prob: float
+    ) -> jnp.ndarray:
+        """[S, N] bool failure masks: each REAL node fails independently
+        with `fail_prob` per scenario; deterministic in (seed, S, p)."""
+        if n_scenarios < 1:
+            raise ValueError(f"n_scenarios must be >= 1, got {n_scenarios}")
+        if not (0.0 <= fail_prob <= 1.0):
+            raise ValueError(f"fail_prob must be in [0, 1], got {fail_prob}")
+        key = jax.random.PRNGKey(seed)
+        draw = jax.random.bernoulli(
+            key, fail_prob, (n_scenarios, self.enc.N)
+        )
+        return draw & jnp.asarray(np.asarray(self.enc.arrays.node_mask))[None, :]
+
+    # -- execution ----------------------------------------------------------
+
+    def _place_masks(self, masks: jnp.ndarray) -> jnp.ndarray:
+        if self.mesh is not None:
+            reps = self.mesh.shape["replicas"]
+            if masks.shape[0] % reps != 0:
+                raise ValueError(
+                    f"{masks.shape[0]} scenarios not divisible by the "
+                    f"{reps}-way 'replicas' mesh axis"
+                )
+            masks = jax.device_put(
+                masks, NamedSharding(self.mesh, P("replicas", None))
+            )
+        return masks
+
+    def run(
+        self,
+        masks: jnp.ndarray,
+        weights: "jnp.ndarray | None" = None,
+    ) -> dict:
+        """Sweep the [S, N] failure masks in ONE vmapped program; the
+        scenario axis shards over 'replicas' when a mesh is attached.
+        Returns the disruption profile (see `_profile`)."""
+        masks = jnp.asarray(masks)
+        if masks.ndim != 2 or masks.shape[1] != self.enc.N:
+            raise ValueError(
+                f"masks must be [S, {self.enc.N}], got {tuple(masks.shape)}"
+            )
+        w = self.weights if weights is None else weights
+        out = self._vrun(
+            self.enc.arrays, self._state_bound, self._order, w,
+            self._place_masks(masks),
+        )
+        return self._profile(masks, out)
+
+    def run_one(
+        self, mask: jnp.ndarray, weights: "jnp.ndarray | None" = None
+    ) -> tuple:
+        """One scenario through the SAME program, unvmapped — the parity
+        reference for `run` (and a debugging probe). Returns the raw
+        (assignment, evicted, rescheduled, stranded, rounds) tensors."""
+        w = self.weights if weights is None else weights
+        return self._one(
+            self.enc.arrays, self._state_bound, self._order, w,
+            jnp.asarray(mask),
+        )
+
+    def _profile(self, masks, out) -> dict:
+        assignments, evicted, rescheduled, stranded, rounds = (
+            np.asarray(x) for x in out
+        )
+        S = assignments.shape[0]
+        failed_per = np.asarray(masks).sum(axis=1)
+        return {
+            "scenarios": int(S),
+            "failedNodes": {
+                "mean": float(failed_per.mean()),
+                "max": int(failed_per.max()),
+            },
+            "evicted": evicted.astype(int).tolist(),
+            "rescheduled": rescheduled.astype(int).tolist(),
+            "stranded": stranded.astype(int).tolist(),
+            "rounds": rounds.astype(int).tolist(),
+            "totals": {
+                "evicted": int(evicted.sum()),
+                "rescheduled": int(rescheduled.sum()),
+                "stranded": int(stranded.sum()),
+            },
+            "worstScenario": int(stranded.argmax()) if S else -1,
+            "assignments": assignments,
+        }
+
+    def placements(self, assignments) -> list[dict]:
+        """Per-scenario {(ns, name): node | ""} decode."""
+        assignments = np.asarray(assignments)
+        return [
+            self.enc.decode_assignment(assignments[s])
+            for s in range(assignments.shape[0])
+        ]

@@ -1,5 +1,6 @@
 """KEP-140 scenario engine: a deterministic discrete-event scenario VM."""
 
+from .chaos import ArrivalProcess, ChaosSpec, FaultEvent
 from .results import summarize
 from .runner import (
     Operation,
@@ -11,6 +12,9 @@ from .runner import (
 
 __all__ = [
     "summarize",
+    "ArrivalProcess",
+    "ChaosSpec",
+    "FaultEvent",
     "Operation",
     "ScenarioResult",
     "ScenarioRunner",

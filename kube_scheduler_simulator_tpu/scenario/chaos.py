@@ -1,0 +1,285 @@
+"""ChaosSpec — the declarative schema of a cluster-lifecycle chaos
+timeline (the input of lifecycle/engine.py and POST /api/v1/lifecycle).
+
+Where a KEP-140 Scenario (runner.py) replays a hand-written operation
+list against a virtual step clock, a ChaosSpec DERIVES its timeline from
+processes and fault schedules over continuous simulated time:
+
+  * ``faults``   — explicitly timed node-lifecycle events: ``fail`` /
+    ``recover`` / ``drain`` / ``cordon`` / ``uncordon`` / ``taint`` /
+    ``untaint``, each ``{"at": t, "action": ..., "node": ...}`` (taint
+    flaps carry the taint body);
+  * ``arrivals`` — workload arrival processes: ``poisson`` (exponential
+    inter-arrival gaps at ``rate`` pods per simulated second, capped by
+    ``count`` and the horizon), ``trace`` (explicit ``times``), and
+    ``gang`` (``replicas`` pods landing together at ``at`` — a gang-job
+    arrival, scheduled in one batch).
+
+Determinism is the load-bearing contract (the KEP-140 requirement
+strengthened to byte-identical, like scenario/runner.py): all sampling
+uses ``random.Random`` seeded from ``(seed, process index)`` — no global
+RNG, no wall clock — so `events()` is a pure function of the spec and
+the same seeded spec always yields the same trace bytes.
+
+The schema intentionally parses STRICTLY (unknown actions/kinds raise)
+so a typo'd chaos spec fails at POST time, not as a silently empty run.
+"""
+
+from __future__ import annotations
+
+import copy
+import random
+from dataclasses import dataclass, field
+
+FAULT_ACTIONS = (
+    "fail",
+    "recover",
+    "drain",
+    "cordon",
+    "uncordon",
+    "taint",
+    "untaint",
+)
+
+ARRIVAL_KINDS = ("poisson", "trace", "gang")
+
+
+@dataclass(frozen=True)
+class FaultEvent:
+    """One injected node-lifecycle fault at simulated time `at`."""
+
+    at: float
+    action: str
+    node: str
+    taint: "dict | None" = None  # taint/untaint: {"key", "value", "effect"}
+
+    @classmethod
+    def from_dict(cls, d: dict, idx: int) -> "FaultEvent":
+        if not isinstance(d, dict):
+            raise ValueError(f"faults[{idx}]: must be a mapping")
+        action = d.get("action", "")
+        if action not in FAULT_ACTIONS:
+            raise ValueError(
+                f"faults[{idx}]: unknown action {action!r} "
+                f"(one of {'/'.join(FAULT_ACTIONS)})"
+            )
+        node = d.get("node", "")
+        if not node or not isinstance(node, str):
+            raise ValueError(f"faults[{idx}]: 'node' is required")
+        at = d.get("at", None)
+        if not isinstance(at, (int, float)) or isinstance(at, bool) or at < 0:
+            raise ValueError(f"faults[{idx}]: 'at' must be a time >= 0")
+        taint = d.get("taint")
+        if action in ("taint", "untaint"):
+            if not isinstance(taint, dict) or not taint.get("key"):
+                raise ValueError(
+                    f"faults[{idx}]: {action} needs a taint body with a 'key'"
+                )
+        elif taint is not None:
+            raise ValueError(f"faults[{idx}]: 'taint' only valid for taint/untaint")
+        return cls(at=float(at), action=action, node=node, taint=taint)
+
+
+@dataclass(frozen=True)
+class ArrivalProcess:
+    """One workload arrival process; pods are stamped `<prefix>-<k>`."""
+
+    kind: str
+    template: dict  # pod manifest template (metadata.name is the prefix)
+    rate: float = 0.0  # poisson: arrivals per simulated second
+    count: int = 0  # poisson: max pods drawn
+    times: tuple = ()  # trace: explicit arrival times
+    at: float = 0.0  # gang: the job's arrival time
+    replicas: int = 1  # gang: pods arriving together
+
+    @classmethod
+    def from_dict(cls, d: dict, idx: int) -> "ArrivalProcess":
+        if not isinstance(d, dict):
+            raise ValueError(f"arrivals[{idx}]: must be a mapping")
+        kind = d.get("kind", "poisson")
+        if kind not in ARRIVAL_KINDS:
+            raise ValueError(
+                f"arrivals[{idx}]: unknown kind {kind!r} "
+                f"(one of {'/'.join(ARRIVAL_KINDS)})"
+            )
+        template = d.get("template")
+        if not isinstance(template, dict):
+            raise ValueError(f"arrivals[{idx}]: 'template' (a pod manifest) is required")
+        if not ((template.get("metadata") or {}).get("name")):
+            raise ValueError(
+                f"arrivals[{idx}]: template needs metadata.name (the pod name prefix)"
+            )
+        rate = d.get("rate", 0.0)
+        count = d.get("count", 0)
+        times = d.get("times", [])
+        replicas = d.get("replicas", 1)
+        at = d.get("at", 0.0)
+        if kind == "poisson":
+            if not isinstance(rate, (int, float)) or rate <= 0:
+                raise ValueError(f"arrivals[{idx}]: poisson needs rate > 0")
+            if not isinstance(count, int) or isinstance(count, bool) or count < 1:
+                raise ValueError(f"arrivals[{idx}]: poisson needs count >= 1")
+        elif kind == "trace":
+            if not isinstance(times, list) or not times:
+                raise ValueError(f"arrivals[{idx}]: trace needs a 'times' list")
+            for t in times:
+                if not isinstance(t, (int, float)) or isinstance(t, bool) or t < 0:
+                    raise ValueError(
+                        f"arrivals[{idx}]: trace times must be numbers >= 0"
+                    )
+        else:  # gang
+            if not isinstance(replicas, int) or isinstance(replicas, bool) or replicas < 1:
+                raise ValueError(f"arrivals[{idx}]: gang needs replicas >= 1")
+            if not isinstance(at, (int, float)) or isinstance(at, bool) or at < 0:
+                raise ValueError(f"arrivals[{idx}]: gang needs 'at' >= 0")
+        return cls(
+            kind=kind,
+            template=template,
+            rate=float(rate or 0.0),
+            count=int(count or 0),
+            times=tuple(float(t) for t in times),
+            at=float(at or 0.0),
+            replicas=int(replicas or 1),
+        )
+
+    @property
+    def prefix(self) -> str:
+        return (self.template.get("metadata") or {}).get("name", "pod")
+
+    def pod_manifest(self, k: int) -> dict:
+        """The k-th pod this process emits: the template with the name
+        stamped `<prefix>-<k>` (deterministic — no generateName)."""
+        pod = copy.deepcopy(self.template)
+        meta = pod.setdefault("metadata", {})
+        meta["name"] = f"{self.prefix}-{k}"
+        meta.setdefault("namespace", "default")
+        return pod
+
+
+@dataclass(frozen=True)
+class ChaosSpec:
+    """One seeded cluster-lifecycle chaos timeline."""
+
+    seed: int = 0
+    horizon: float = 60.0  # end of simulated time; later events are dropped
+    arrivals: tuple = ()  # ArrivalProcess
+    faults: tuple = ()  # FaultEvent
+    snapshot: "dict | None" = None  # initial cluster, import wire shape
+    scheduler_config: "dict | None" = None
+    scheduler_mode: str = "gang"  # "gang" | "sequential"
+    window: "int | None" = None  # gang eval_window passthrough
+    name: str = "chaos"
+    extra: dict = field(default_factory=dict, compare=False)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ChaosSpec":
+        if not isinstance(d, dict):
+            raise ValueError("chaos spec must be a mapping")
+        seed = d.get("seed", 0)
+        if not isinstance(seed, int) or isinstance(seed, bool):
+            raise ValueError("'seed' must be an integer")
+        horizon = d.get("horizon", 60.0)
+        if not isinstance(horizon, (int, float)) or isinstance(horizon, bool) or horizon <= 0:
+            raise ValueError("'horizon' must be a number > 0")
+        mode = d.get("schedulerMode", d.get("scheduler_mode", "gang"))
+        if mode not in ("gang", "sequential"):
+            raise ValueError(f"schedulerMode must be gang|sequential, got {mode!r}")
+        window = d.get("window")
+        if window is not None and (
+            not isinstance(window, int) or isinstance(window, bool) or window < 1
+        ):
+            raise ValueError(f"'window' must be an integer >= 1, got {window!r}")
+        arrivals = tuple(
+            ArrivalProcess.from_dict(a, i)
+            for i, a in enumerate(d.get("arrivals", []))
+        )
+        # two processes sharing a name prefix would emit colliding pod
+        # names; the store's apply-merge would silently fuse them into
+        # one pod — reject at parse time (the strict-schema contract)
+        prefixes = [p.prefix for p in arrivals]
+        dupes = {p for p in prefixes if prefixes.count(p) > 1}
+        if dupes:
+            raise ValueError(
+                f"arrival processes share pod-name prefixes: {sorted(dupes)}"
+            )
+        faults = tuple(
+            FaultEvent.from_dict(f, i) for i, f in enumerate(d.get("faults", []))
+        )
+        if not arrivals and not faults:
+            raise ValueError("chaos spec has neither arrivals nor faults")
+        snapshot = d.get("snapshot")
+        if snapshot is not None and not isinstance(snapshot, dict):
+            raise ValueError("'snapshot' must be a mapping (import wire shape)")
+        return cls(
+            seed=seed,
+            horizon=float(horizon),
+            arrivals=arrivals,
+            faults=faults,
+            snapshot=snapshot,
+            scheduler_config=d.get("schedulerConfig"),
+            scheduler_mode=mode,
+            window=window,
+            name=str(d.get("name", "chaos")),
+        )
+
+    # -- deterministic timeline derivation ---------------------------------
+
+    def events(self) -> list[tuple[float, int, str, dict]]:
+        """The spec's full derived timeline: `(time, tiebreak, kind,
+        payload)` tuples sorted by time (tiebreak = stable spec order).
+        Kinds: ``arrival`` (payload: {"pods": [manifests], "process",
+        "job"?}) and ``fault`` (payload: the FaultEvent fields). Pure —
+        same spec, same list; all randomness comes from `random.Random`
+        seeded on (seed, process index)."""
+        out: list[tuple[float, int, str, dict]] = []
+        tiebreak = 0
+        for i, proc in enumerate(self.arrivals):
+            if proc.kind == "poisson":
+                # one private stream per process: adding a process never
+                # reshuffles another's arrivals
+                rng = random.Random(f"kss-chaos:{self.seed}:{i}")
+                t = 0.0
+                for k in range(proc.count):
+                    t += rng.expovariate(proc.rate)
+                    if t > self.horizon:
+                        break
+                    out.append(
+                        (t, tiebreak, "arrival",
+                         {"process": proc.prefix, "pods": [proc.pod_manifest(k)]})
+                    )
+                    tiebreak += 1
+            elif proc.kind == "trace":
+                for k, t in enumerate(proc.times):
+                    if t > self.horizon:
+                        continue
+                    out.append(
+                        (t, tiebreak, "arrival",
+                         {"process": proc.prefix, "pods": [proc.pod_manifest(k)]})
+                    )
+                    tiebreak += 1
+            else:  # gang: one event, all replicas at once
+                if proc.at <= self.horizon:
+                    out.append(
+                        (
+                            proc.at, tiebreak, "arrival",
+                            {
+                                "process": proc.prefix,
+                                "job": proc.prefix,
+                                "pods": [
+                                    proc.pod_manifest(k)
+                                    for k in range(proc.replicas)
+                                ],
+                            },
+                        )
+                    )
+                    tiebreak += 1
+        for f in self.faults:
+            if f.at > self.horizon:
+                continue
+            payload = {"action": f.action, "node": f.node}
+            if f.taint is not None:
+                payload["taint"] = f.taint
+            out.append((f.at, tiebreak, "fault", payload))
+            tiebreak += 1
+        out.sort(key=lambda e: (e[0], e[1]))
+        return out

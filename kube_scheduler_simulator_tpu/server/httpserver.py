@@ -19,6 +19,9 @@ typed store — SURVEY.md §2 #3):
     POST               /api/v1/schedule      run one batched scheduling pass
     GET                /api/v1/metrics       scheduling-pass counters
                                              (decisions/sec, utils/metrics.py)
+    POST               /api/v1/lifecycle     run a ChaosSpec chaos timeline
+                                             (lifecycle/engine.py, isolated store)
+    GET                /api/v1/lifecycle/trace   last run's JSONL event trace
     GET                /  (or /ui)           built-in dashboard (webui.py)
 
 The watch stream mirrors the reference's wire shape — a sequence of JSON
@@ -317,6 +320,50 @@ def _make_handler(server: SimulatorServer):
                         return self._error(400, f"{type(e).__name__}: {e}")
                     with server._scenario_lock:
                         return self._json(200, run_job(job))
+                if rest == ["lifecycle"] and method == "POST":
+                    # one-shot cluster-lifecycle chaos run: the body is a
+                    # ChaosSpec (scenario/chaos.py — seeded fault schedule
+                    # + arrival processes + optional snapshot). Runs over
+                    # its OWN isolated store (service.run_lifecycle), the
+                    # serving store is untouched; synchronous, returns the
+                    # result document WITH the replayable trace inline.
+                    # Serialized with scenario runs (one device-driving
+                    # timeline at a time).
+                    from ..scenario.chaos import ChaosSpec
+
+                    try:
+                        spec = ChaosSpec.from_dict(self._body() or {})
+                    except (ValueError, KeyError, TypeError) as e:
+                        return self._error(400, f"{type(e).__name__}: {e}")
+                    try:
+                        with server._scenario_lock:
+                            result = service.run_lifecycle(spec)
+                            # read under the lock: a concurrent run must
+                            # not swap ITS trace into THIS response
+                            result["trace"] = service.last_lifecycle_trace
+                    except ValueError as e:
+                        # a spec that parses but can't build a run (bad
+                        # snapshot, unusable scheduler config) is the
+                        # client's input, not a server fault
+                        return self._error(400, str(e))
+                    return self._json(200, result)
+                if rest == ["lifecycle", "trace"] and method == "GET":
+                    # the last run's replayable event trace as JSONL
+                    # (application/x-ndjson), byte-identical across
+                    # re-runs of the same seeded spec
+                    trace = service.last_lifecycle_trace
+                    if trace is None:
+                        return self._error(404, "no lifecycle run yet")
+                    from ..lifecycle.engine import trace_jsonl
+
+                    body = trace_jsonl(trace).encode()
+                    self.send_response(200)
+                    self._cors_headers()
+                    self.send_header("Content-Type", "application/x-ndjson")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return None
                 if rest and rest[0] == "extender":
                     return self._extender(method, rest[1:])
                 if rest and rest[0] == "resources":

@@ -33,6 +33,7 @@ from __future__ import annotations
 import threading
 
 from ..engine import TPU32, BatchedScheduler, encode_cluster
+from ..engine.encode import EncodingCache
 from ..engine.engine import unsupported_plugins
 from ..models.snapshot import export_snapshot, import_snapshot
 from ..models.store import ResourceStore
@@ -102,6 +103,12 @@ class SchedulerService:
         # FIFO dict so alternating windowed/unwindowed clients don't
         # recompile on every pass (code-review r5)
         self._gang_engine_cache: "dict[tuple, object]" = {}
+        # incremental re-encode hook: the store's latest resourceVersion
+        # is a complete mutation token, so back-to-back passes over an
+        # unchanged store reuse the previous pass's encoding instead of
+        # re-listing + re-encoding the whole cluster (engine/encode.py
+        # EncodingCache; the lifecycle event loop leans on this)
+        self._enc_cache = EncodingCache()
         self.extender_service = ExtenderService(self._config.extenders)
 
     # -- configuration lifecycle -------------------------------------------
@@ -295,7 +302,18 @@ class SchedulerService:
     def _encode_current(self, config) -> "object | None":
         """Encode the store's current pending state under the pass's
         single config read (shared by the sequential and gang passes);
-        None when nothing is schedulable."""
+        None when nothing is schedulable. Cached on the store's latest
+        resourceVersion: a pass over a store no mutation has touched
+        since the last encode reuses that encoding verbatim."""
+        cache_key = (self.store.latest_rv(),)
+        cached = self._enc_cache.get(cache_key, config)
+        if cached is not EncodingCache.MISS:
+            return cached
+        enc = self._encode_fresh(config)
+        self._enc_cache.put(cache_key, config, enc)
+        return enc
+
+    def _encode_fresh(self, config) -> "object | None":
         nodes = self.store.list("nodes")
         pods = self.store.list("pods")
         if not nodes or not pods:
@@ -408,6 +426,9 @@ class SimulatorService:
         self.store = ResourceStore()
         self._controllers_lock = threading.Lock()
         self.external_scheduler_enabled = external_scheduler_enabled
+        # replayable JSONL trace of the most recent lifecycle chaos run
+        # (run_lifecycle; served by GET /api/v1/lifecycle/trace)
+        self.last_lifecycle_trace: "list[dict] | None" = None
         self.scheduler = SchedulerService(
             self.store, initial_config, disabled=external_scheduler_enabled
         )
@@ -507,3 +528,23 @@ class SimulatorService:
             self.scheduler.reset()
         except SchedulerServiceDisabled:
             pass
+
+    # -- lifecycle / chaos runs --------------------------------------------
+
+    def run_lifecycle(self, spec: "dict | object") -> dict:
+        """Run one cluster-lifecycle chaos timeline (lifecycle/engine.py)
+        over its OWN isolated store — like the /api/v1/scenario route, the
+        serving store is never mutated — while the passes and disruption
+        tallies flow into THIS service's scheduler metrics, so
+        `GET /api/v1/metrics` reflects lifecycle activity. The run's
+        replayable JSONL trace is retained on `last_lifecycle_trace` for
+        `GET /api/v1/lifecycle/trace`."""
+        from ..lifecycle.engine import LifecycleEngine
+        from ..scenario.chaos import ChaosSpec
+
+        if not isinstance(spec, ChaosSpec):
+            spec = ChaosSpec.from_dict(spec)
+        engine = LifecycleEngine(spec, metrics=self.scheduler.metrics)
+        result = engine.run()
+        self.last_lifecycle_trace = engine.trace
+        return result

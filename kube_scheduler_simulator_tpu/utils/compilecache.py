@@ -9,7 +9,10 @@ only conftest honoring the KSS_JAX_CACHE_DIR override — code-review r5).
 The default directory is `.jax_cache` at the repo root (gitignored):
 per-checkout isolation — a world-shared /tmp dir would break on
 multi-user hosts and let another local user plant crafted cache entries
-that deserialize into in-process executables.
+that deserialize into in-process executables. When the `__file__`-derived
+root is NOT a writable checkout (a site-packages install run by an
+unprivileged user — ADVICE r5), the default falls back to the per-user
+`~/.cache/kss-jax`, which keeps the same single-user isolation property.
 """
 
 from __future__ import annotations
@@ -17,6 +20,17 @@ from __future__ import annotations
 import os
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def default_cache_dir(repo_root: "str | None" = None) -> str:
+    """The cache directory `enable_compile_cache` uses absent the
+    KSS_JAX_CACHE_DIR override: `<repo_root>/.jax_cache` when the root
+    is a writable directory, else the per-user `~/.cache/kss-jax` (the
+    package may live in a read-only site-packages tree)."""
+    root = _REPO_ROOT if repo_root is None else repo_root
+    if os.path.isdir(root) and os.access(root, os.W_OK):
+        return os.path.join(root, ".jax_cache")
+    return os.path.join(os.path.expanduser("~"), ".cache", "kss-jax")
 
 
 def enable_compile_cache(min_compile_time_secs: float = 0.1) -> None:
@@ -29,9 +43,7 @@ def enable_compile_cache(min_compile_time_secs: float = 0.1) -> None:
 
         jax.config.update(
             "jax_compilation_cache_dir",
-            os.environ.get(
-                "KSS_JAX_CACHE_DIR", os.path.join(_REPO_ROOT, ".jax_cache")
-            ),
+            os.environ.get("KSS_JAX_CACHE_DIR", default_cache_dir()),
         )
         jax.config.update(
             "jax_persistent_cache_min_compile_time_secs",

@@ -46,6 +46,14 @@ class SchedulingMetrics:
     _total_pods: int = 0
     _total_scheduled: int = 0
     _total_wall_s: float = 0.0
+    # disruption counters (lifecycle/ chaos runs): evictions caused by
+    # injected faults, how many of those pods found a node again, and
+    # the simulated time each spent pending before its re-bind
+    _evicted: int = 0
+    _rescheduled: int = 0
+    _tts_sum_s: float = 0.0  # sum of time-to-reschedule, sim seconds
+    _tts_max_s: float = 0.0
+    _tts_count: int = 0
 
     def record(self, rec: PassRecord) -> None:
         with self._lock:
@@ -56,6 +64,23 @@ class SchedulingMetrics:
             self._total_pods += rec.pods
             self._total_scheduled += rec.scheduled
             self._total_wall_s += rec.wall_s
+
+    def record_disruption(
+        self,
+        evicted: int = 0,
+        rescheduled: int = 0,
+        times_to_reschedule_s: "list[float] | None" = None,
+    ) -> None:
+        """One fault-injection event's disruption tally: pods evicted by
+        the fault, pods re-bound afterwards, and per-pod simulated
+        time-to-reschedule for the re-binds that happened this event."""
+        with self._lock:
+            self._evicted += int(evicted)
+            self._rescheduled += int(rescheduled)
+            for t in times_to_reschedule_s or ():
+                self._tts_sum_s += float(t)
+                self._tts_max_s = max(self._tts_max_s, float(t))
+                self._tts_count += 1
 
     @contextmanager
     def time_pass(self, mode: str):
@@ -98,6 +123,16 @@ class SchedulingMetrics:
                     }
                     for r in recent
                 ],
+                "disruption": {
+                    "evicted": self._evicted,
+                    "rescheduled": self._rescheduled,
+                    "meanTimeToRescheduleS": round(
+                        self._tts_sum_s / self._tts_count, 6
+                    )
+                    if self._tts_count
+                    else 0.0,
+                    "maxTimeToRescheduleS": round(self._tts_max_s, 6),
+                },
             }
 
     def reset(self) -> None:
@@ -107,6 +142,11 @@ class SchedulingMetrics:
             self._total_pods = 0
             self._total_scheduled = 0
             self._total_wall_s = 0.0
+            self._evicted = 0
+            self._rescheduled = 0
+            self._tts_sum_s = 0.0
+            self._tts_max_s = 0.0
+            self._tts_count = 0
 
 
 # process-wide shared registry for ad-hoc callers (benchmarks, scripts).

@@ -1,0 +1,54 @@
+"""utils/compilecache.py: the default-directory resolution — repo-local
+when the checkout is writable, per-user fallback when not (site-packages
+installs, ADVICE r5)."""
+
+import os
+
+from kube_scheduler_simulator_tpu.utils import compilecache
+
+
+def test_writable_root_uses_repo_local_dir(tmp_path):
+    assert compilecache.default_cache_dir(str(tmp_path)) == str(
+        tmp_path / ".jax_cache"
+    )
+
+
+def test_unwritable_root_falls_back_to_user_cache(tmp_path):
+    ro = tmp_path / "ro"
+    ro.mkdir()
+    ro.chmod(0o555)
+    try:
+        got = compilecache.default_cache_dir(str(ro))
+    finally:
+        ro.chmod(0o755)
+    expect = os.path.join(os.path.expanduser("~"), ".cache", "kss-jax")
+    # root runs bypass permission bits; accept either resolution there
+    if os.access(str(ro), os.W_OK):
+        assert got == str(ro / ".jax_cache")
+    else:
+        assert got == expect
+
+
+def test_missing_root_falls_back_to_user_cache(tmp_path):
+    assert compilecache.default_cache_dir(
+        str(tmp_path / "nope")
+    ) == os.path.join(os.path.expanduser("~"), ".cache", "kss-jax")
+
+
+def test_env_override_wins(monkeypatch):
+    seen = {}
+
+    class _Cfg:
+        @staticmethod
+        def update(key, value):
+            seen[key] = value
+
+    import types
+
+    fake_jax = types.SimpleNamespace(config=_Cfg())
+    monkeypatch.setitem(
+        __import__("sys").modules, "jax", fake_jax
+    )
+    monkeypatch.setenv("KSS_JAX_CACHE_DIR", "/tmp/elsewhere")
+    compilecache.enable_compile_cache()
+    assert seen["jax_compilation_cache_dir"] == "/tmp/elsewhere"

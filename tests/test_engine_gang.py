@@ -735,6 +735,42 @@ class TestEvalWindow:
         assert all(got[("default", f"ok{i}")] != "" for i in range(8)), got
         assert all(got[("default", f"big{i}")] == "" for i in range(2)), got
 
+    def test_explicit_dynamic_cap_counts_commit_rounds(self):
+        """ADVICE r5: an explicit dynamic `max_rounds` below the window
+        sweep's total round cost used to exhaust the while_loop
+        mid-sweep and silently strand feasible pods (no-commit sweep
+        rounds burned the cap). The cap is now denominated in COMMIT
+        rounds — the unit it caps unwindowed, where every counted round
+        commits — so a cap covering the commits completes regardless of
+        how many sweep rounds the blocked prefix costs."""
+        nodes = [node("n0", cpu="32", pods="110")]
+        blocked = [pod(f"big{i}", cpu="100", priority=100) for i in range(2)]
+        ok = [pod(f"ok{i}", cpu="1", priority=1) for i in range(8)]
+        cfg = self._cfg()
+        enc = encode_cluster(nodes, blocked + ok, cfg, policy=EXACT)
+        # one node -> one commit per round: 8 commits needed, each
+        # preceded by a no-commit hop over the infeasible prefix window,
+        # so TOTAL rounds far exceed the cap of 12 — commit-counting is
+        # what lets this complete
+        gang = GangScheduler(
+            enc, loop="dynamic", chunk=2, eval_window=2,
+            rel_serialize=False, max_rounds=12,
+        )
+        _, rounds = gang.run()
+        got = gang.placements()
+        assert all(got[("default", f"ok{i}")] != "" for i in range(8)), got
+        assert all(got[("default", f"big{i}")] == "" for i in range(2)), got
+        assert int(np.asarray(rounds)) > 12  # sweep rounds ran uncapped
+        # the cap still binds on commits: 7 < 8 feasible pods strands
+        # the tail deterministically (the documented hard-cap role)
+        capped = GangScheduler(
+            enc, loop="dynamic", chunk=2, eval_window=2,
+            rel_serialize=False, max_rounds=7,
+        )
+        capped.run()
+        placed = sum(1 for v in capped.placements().values() if v)
+        assert placed == 7
+
     def test_static_budget_covers_full_window_sweep(self):
         """Code-review r5 repro #2: an infeasible queue prefix spanning
         more windows than the static budget. The budget clamp

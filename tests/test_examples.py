@@ -59,3 +59,23 @@ def test_scenario_and_sweep_examples_run(tmp_path):
     # result files landed (KEP-184 file contract)
     assert (tmp_path / "scenario.result.json").exists()
     assert (tmp_path / "sweep.result.json").exists()
+
+
+def test_chaos_example_runs(tmp_path):
+    """The chaos timeline (`make lifecycle-smoke`'s spec) runs to
+    Succeeded with its node-failure evictions all re-placed."""
+    from kube_scheduler_simulator_tpu.lifecycle.engine import LifecycleEngine
+    from kube_scheduler_simulator_tpu.scenario.chaos import ChaosSpec
+
+    with open(os.path.join(EXAMPLES, "chaos.json")) as f:
+        spec = ChaosSpec.from_dict(json.load(f))
+    eng = LifecycleEngine(spec)
+    res = eng.run()
+    assert res["phase"] == "Succeeded"
+    assert res["pods"]["evicted"] > 0  # the n1 failure evicted someone
+    assert res["pods"]["unschedulableEvicted"] == []
+    assert any(e["type"] == "NodeFail" for e in eng.trace)
+    assert any(e["type"] == "NodeRecover" for e in eng.trace)
+    # trace JSONL round-trips
+    lines = eng.trace_jsonl().splitlines()
+    assert [json.loads(x) for x in lines] == eng.trace

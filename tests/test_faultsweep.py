@@ -1,0 +1,130 @@
+"""Vmapped failure sweeps (lifecycle/faultsweep.py): the vmap/sequential
+parity contract, failure-mask semantics (no placement on failed nodes,
+eviction accounting), and seeded mask determinism."""
+
+import numpy as np
+import pytest
+
+from kube_scheduler_simulator_tpu.lifecycle.faultsweep import FaultSweep
+
+from helpers import node, pod
+from test_engine_parity import restricted_config
+
+
+def _cfg():
+    """A restricted config keeps the sweep's compiled program small."""
+    return restricted_config()
+
+
+def _sweep(n_nodes=4, bound=10, pending=2, cpu="8"):
+    nodes = [node(f"n{i}", cpu=cpu) for i in range(n_nodes)]
+    pods = [
+        pod(f"b{i}", cpu="1", node_name=f"n{i % n_nodes}") for i in range(bound)
+    ] + [pod(f"q{i}", cpu="1") for i in range(pending)]
+    return FaultSweep.from_cluster(nodes, pods, _cfg())
+
+
+class TestFaultSweep:
+    def test_vmapped_matches_sequential(self):
+        sweep = _sweep()
+        masks = sweep.sample_masks(8, seed=42, fail_prob=0.3)
+        profile = sweep.run(masks)
+        assert profile["scenarios"] == 8
+        for s in range(8):
+            a, ev, re, st, rounds = sweep.run_one(np.asarray(masks)[s])
+            assert np.array_equal(np.asarray(a), profile["assignments"][s]), s
+            assert int(ev) == profile["evicted"][s], s
+            assert int(re) == profile["rescheduled"][s], s
+            assert int(st) == profile["stranded"][s], s
+
+    def test_failed_nodes_take_no_pods_and_eviction_counts(self):
+        sweep = _sweep()
+        masks = np.asarray(sweep.sample_masks(8, seed=7, fail_prob=0.4))
+        profile = sweep.run(masks)
+        baseline = np.asarray(sweep._state_bound.assignment)
+        for s in range(8):
+            failed = np.nonzero(masks[s])[0]
+            a = profile["assignments"][s]
+            placed = a[a >= 0]
+            assert not np.isin(placed, failed).any(), s
+            # evicted == baseline-bound pods whose node failed
+            expect = int(np.isin(baseline[baseline >= 0], failed).sum())
+            assert profile["evicted"][s] == expect, s
+            assert (
+                profile["rescheduled"][s] + profile["stranded"][s]
+                == profile["evicted"][s]
+            ), s
+
+    def test_no_failures_is_a_no_op_for_bound_pods(self):
+        sweep = _sweep()
+        masks = sweep.sample_masks(2, seed=1, fail_prob=0.0)
+        profile = sweep.run(masks)
+        assert profile["totals"]["evicted"] == 0
+        baseline = np.asarray(sweep._state_bound.assignment)
+        for s in range(2):
+            a = profile["assignments"][s]
+            keep = baseline >= 0
+            assert np.array_equal(a[keep], baseline[keep]), s
+            # the two pending queue pods placed too
+            assert (a >= 0).sum() >= keep.sum()
+
+    def test_stranded_when_capacity_lost(self):
+        # 2 nodes exactly full; failing n1 leaves nowhere to go
+        nodes = [node("n0", cpu="2"), node("n1", cpu="2")]
+        pods = [
+            pod("a0", cpu="2", node_name="n0"),
+            pod("a1", cpu="2", node_name="n1"),
+        ]
+        sweep = FaultSweep.from_cluster(nodes, pods, _cfg())
+        mask = np.zeros((1, sweep.enc.N), bool)
+        mask[0, sweep.enc.node_names.index("n1")] = True
+        profile = sweep.run(mask)
+        assert profile["evicted"] == [1]
+        assert profile["stranded"] == [1]
+        assert profile["worstScenario"] == 0
+        # the evicted pod is unplaced in the decode
+        (placements,) = sweep.placements(profile["assignments"])
+        assert placements[("default", "a1")] == ""
+        assert placements[("default", "a0")] == "n0"
+
+    def test_masks_deterministic_and_validated(self):
+        sweep = _sweep(n_nodes=3, bound=3, pending=0)
+        m1 = np.asarray(sweep.sample_masks(16, seed=5, fail_prob=0.5))
+        m2 = np.asarray(sweep.sample_masks(16, seed=5, fail_prob=0.5))
+        assert np.array_equal(m1, m2)
+        assert m1.shape == (16, sweep.enc.N)
+        # only REAL nodes fail (padding, if any, stays False)
+        assert not m1[:, 3:].any()
+        with pytest.raises(ValueError, match="fail_prob"):
+            sweep.sample_masks(4, seed=0, fail_prob=1.5)
+        with pytest.raises(ValueError, match="n_scenarios"):
+            sweep.sample_masks(0, seed=0, fail_prob=0.5)
+        with pytest.raises(ValueError, match="masks must be"):
+            sweep.run(np.zeros((2, sweep.enc.N + 1), bool))
+
+    def test_unknown_baseline_node_rejected(self):
+        nodes = [node("n0")]
+        pods = [pod("a0", cpu="1", node_name="ghost")]
+        with pytest.raises(ValueError, match="unknown node"):
+            FaultSweep.from_cluster(nodes, pods, _cfg())
+
+    def test_mesh_shards_scenario_axis_over_replicas(self):
+        # the scenario axis is the Monte-Carlo axis: sharded over
+        # 'replicas' like parallel/sweep.py's variant axis, results
+        # identical to the unsharded run
+        from kube_scheduler_simulator_tpu.parallel.mesh import build_mesh
+
+        mesh = build_mesh(4, replicas=4, node_shards=1)
+        nodes = [node(f"n{i}", cpu="8") for i in range(4)]
+        pods = [
+            pod(f"b{i}", cpu="1", node_name=f"n{i % 4}") for i in range(8)
+        ]
+        plain = FaultSweep.from_cluster(nodes, pods, _cfg())
+        sharded = FaultSweep.from_cluster(nodes, pods, _cfg(), mesh=mesh)
+        masks = plain.sample_masks(8, seed=9, fail_prob=0.3)
+        p1 = plain.run(masks)
+        p2 = sharded.run(masks)
+        assert np.array_equal(p1["assignments"], p2["assignments"])
+        assert p1["totals"] == p2["totals"]
+        with pytest.raises(ValueError, match="replicas"):
+            sharded.run(np.asarray(masks)[:6])  # 6 % 4 != 0

@@ -1,0 +1,337 @@
+"""Cluster-lifecycle chaos engine (lifecycle/): ChaosSpec schema,
+discrete-event determinism (byte-identical traces), eviction →
+reschedule round trips, disruption metrics, the encoding cache, and the
+HTTP surface (POST /api/v1/lifecycle + GET /api/v1/lifecycle/trace)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kube_scheduler_simulator_tpu.lifecycle.engine import LifecycleEngine
+from kube_scheduler_simulator_tpu.scenario.chaos import ChaosSpec
+from kube_scheduler_simulator_tpu.server.httpserver import SimulatorServer
+from kube_scheduler_simulator_tpu.server.service import SimulatorService
+
+from helpers import node, pod
+
+
+def _tmpl(name="web", cpu="500m"):
+    return {
+        "metadata": {"name": name},
+        "spec": {
+            "containers": [
+                {"name": "c", "resources": {"requests": {"cpu": cpu, "memory": "128Mi"}}}
+            ]
+        },
+    }
+
+
+def _snapshot(n_nodes=3, cpu="4", pods=()):
+    return {
+        "nodes": [node(f"n{i}", cpu=cpu) for i in range(n_nodes)],
+        "pods": list(pods),
+    }
+
+
+def _spec(**over):
+    base = {
+        "seed": 3,
+        "horizon": 20,
+        "schedulerMode": "sequential",
+        "snapshot": _snapshot(),
+        "arrivals": [
+            {"kind": "poisson", "rate": 0.6, "count": 6, "template": _tmpl()}
+        ],
+        "faults": [
+            {"at": 8.0, "action": "fail", "node": "n1"},
+            {"at": 15.0, "action": "recover", "node": "n1"},
+        ],
+    }
+    base.update(over)
+    return ChaosSpec.from_dict(base)
+
+
+class TestChaosSpecSchema:
+    def test_strict_parse_errors(self):
+        with pytest.raises(ValueError, match="unknown action"):
+            ChaosSpec.from_dict(
+                {"faults": [{"at": 1, "action": "explode", "node": "n0"}]}
+            )
+        with pytest.raises(ValueError, match="node"):
+            ChaosSpec.from_dict({"faults": [{"at": 1, "action": "fail"}]})
+        with pytest.raises(ValueError, match="taint"):
+            ChaosSpec.from_dict(
+                {"faults": [{"at": 1, "action": "taint", "node": "n0"}]}
+            )
+        with pytest.raises(ValueError, match="rate"):
+            ChaosSpec.from_dict(
+                {"arrivals": [{"kind": "poisson", "count": 3, "template": _tmpl()}]}
+            )
+        with pytest.raises(ValueError, match="metadata.name"):
+            ChaosSpec.from_dict(
+                {"arrivals": [{"kind": "poisson", "rate": 1, "count": 3,
+                               "template": {"spec": {}}}]}
+            )
+        with pytest.raises(ValueError, match="unknown kind"):
+            ChaosSpec.from_dict(
+                {"arrivals": [{"kind": "burst", "template": _tmpl()}]}
+            )
+        with pytest.raises(ValueError, match="neither"):
+            ChaosSpec.from_dict({"seed": 1})
+        with pytest.raises(ValueError, match="share pod-name prefixes"):
+            ChaosSpec.from_dict(
+                {"arrivals": [
+                    {"kind": "poisson", "rate": 1, "count": 2, "template": _tmpl("web")},
+                    {"kind": "gang", "at": 1.0, "replicas": 2, "template": _tmpl("web")},
+                ]}
+            )
+        with pytest.raises(ValueError, match="horizon"):
+            ChaosSpec.from_dict({"horizon": 0, "faults": [
+                {"at": 1, "action": "fail", "node": "n0"}]})
+
+    def test_event_derivation_is_deterministic_and_horizon_capped(self):
+        spec = _spec()
+        e1, e2 = spec.events(), spec.events()
+        assert e1 == e2
+        assert all(t <= spec.horizon for t, *_ in e1)
+        arrivals = [e for e in e1 if e[2] == "arrival"]
+        assert 1 <= len(arrivals) <= 6  # count cap
+        # sorted by time
+        assert [e[0] for e in e1] == sorted(e[0] for e in e1)
+        # a different seed reshuffles the poisson draws
+        other = _spec(seed=4).events()
+        assert [e[0] for e in other] != [e[0] for e in e1]
+
+    def test_gang_arrival_is_one_batch(self):
+        spec = _spec(
+            arrivals=[{"kind": "gang", "at": 2.0, "replicas": 3,
+                       "template": _tmpl("job")}],
+            faults=[],
+        )
+        evs = spec.events()
+        assert len(evs) == 1
+        t, _, kind, payload = evs[0]
+        assert (t, kind, payload["job"]) == (2.0, "arrival", "job")
+        names = [p["metadata"]["name"] for p in payload["pods"]]
+        assert names == ["job-0", "job-1", "job-2"]
+
+
+class TestLifecycleEngine:
+    def test_seeded_determinism_byte_identical_trace(self):
+        a = LifecycleEngine(_spec())
+        b = LifecycleEngine(_spec())
+        ra, rb = a.run(), b.run()
+        assert ra["phase"] == rb["phase"] == "Succeeded"
+        assert a.trace_jsonl() == b.trace_jsonl()
+        assert a.trace_jsonl()  # non-empty
+
+    def test_eviction_reschedule_round_trip(self):
+        # pods pinned by capacity: 2 nodes, each half full; failing one
+        # moves its pods to the survivor
+        snap = _snapshot(
+            n_nodes=2, cpu="4",
+            pods=[pod("a0", cpu="1", node_name=None), pod("a1", cpu="1")],
+        )
+        spec = _spec(
+            snapshot=snap,
+            arrivals=[{"kind": "trace", "times": [1.0], "template": _tmpl("late", cpu="1")}],
+            faults=[{"at": 5.0, "action": "fail", "node": "n0"}],
+        )
+        eng = LifecycleEngine(spec)
+        res = eng.run()
+        assert res["phase"] == "Succeeded"
+        evictions = [e for e in eng.trace if e["type"] == "Eviction"]
+        fail = next(e for e in eng.trace if e["type"] == "NodeFail")
+        assert fail["evicted"] == len(evictions)
+        # the acceptance invariant: every evicted pod is re-scheduled or
+        # reported unschedulable — never silently dropped
+        rescheduled = {
+            p
+            for e in eng.trace
+            if e["type"] == "SchedulingPass"
+            for p in e["rescheduled"]
+        }
+        end = eng.trace[-1]
+        assert end["type"] == "End"
+        lost = {e["pod"] for e in eng.trace if e["type"] == "EvictedPodLost"}
+        for e in evictions:
+            assert (
+                e["pod"] in rescheduled
+                or e["pod"] in end["unschedulableEvicted"]
+                or e["pod"] in lost
+            ), e
+        # this cluster has capacity: everything re-bound, onto n1 only
+        assert end["unschedulableEvicted"] == []
+        assert res["pods"]["evicted"] == len(evictions) > 0
+        assert res["pods"]["rescheduled"] == res["pods"]["evicted"]
+        for p in eng.store.list("pods"):
+            assert p["spec"].get("nodeName") == "n1"
+
+    def test_stranded_until_recover_measures_time_to_reschedule(self):
+        # ONE schedulable node, sized to exactly its bound pods; the
+        # other node's pods cannot re-place until the failed node
+        # recovers at t=12 — time-to-reschedule must be 12 - 4 = 8
+        snap = _snapshot(n_nodes=2, cpu="2", pods=[
+            pod("a0", cpu="2", node_name="n0"),
+            pod("a1", cpu="2", node_name="n1"),
+        ])
+        spec = _spec(
+            snapshot=snap,
+            arrivals=[{"kind": "trace", "times": [1.0],
+                       "template": _tmpl("noise", cpu="4")}],  # never fits
+            faults=[
+                {"at": 4.0, "action": "fail", "node": "n0"},
+                {"at": 12.0, "action": "recover", "node": "n0"},
+            ],
+        )
+        eng = LifecycleEngine(spec)
+        res = eng.run()
+        assert res["phase"] == "Succeeded"
+        assert res["pods"]["evicted"] == 1
+        assert res["pods"]["rescheduled"] == 1
+        assert res["timeToReschedule"]["count"] == 1
+        assert res["timeToReschedule"]["meanS"] == pytest.approx(8.0)
+        snap_metrics = eng.scheduler.metrics.snapshot()["disruption"]
+        assert snap_metrics["evicted"] == 1
+        assert snap_metrics["rescheduled"] == 1
+        assert snap_metrics["meanTimeToRescheduleS"] == pytest.approx(8.0)
+
+    def test_drain_and_cordon_respected(self):
+        snap = _snapshot(n_nodes=2, cpu="4", pods=[pod("a0", cpu="1", node_name="n0")])
+        spec = _spec(
+            snapshot=snap,
+            arrivals=[{"kind": "trace", "times": [6.0],
+                       "template": _tmpl("late", cpu="1")}],
+            faults=[{"at": 2.0, "action": "drain", "node": "n0"}],
+        )
+        eng = LifecycleEngine(spec)
+        assert eng.run()["phase"] == "Succeeded"
+        # drained node keeps existing but takes no pods; a0 moved to n1,
+        # the later arrival avoids n0 too
+        assert eng.store.get("nodes", "n0")["spec"]["unschedulable"] is True
+        for p in eng.store.list("pods"):
+            assert p["spec"].get("nodeName") == "n1", p["metadata"]["name"]
+
+    def test_gang_mode_runs_the_timeline(self):
+        spec = _spec(schedulerMode="gang")
+        eng = LifecycleEngine(spec)
+        res = eng.run()
+        assert res["phase"] == "Succeeded"
+        assert any(e["mode"] == "gang" for e in eng.trace
+                   if e["type"] == "SchedulingPass")
+
+
+class TestEncodingCache:
+    def test_unchanged_store_reuses_encoding(self):
+        svc = SimulatorService()
+        svc.store.apply("nodes", node("n0"))
+        svc.store.apply("pods", pod("p0"))
+        cfg = svc.scheduler.config
+        enc1 = svc.scheduler._encode_current(cfg)
+        enc2 = svc.scheduler._encode_current(cfg)
+        assert enc1 is enc2  # cache hit: same object, no re-encode
+        svc.store.apply("pods", pod("p1"))
+        enc3 = svc.scheduler._encode_current(cfg)
+        assert enc3 is not enc2  # any mutation invalidates
+        # a config swap invalidates even at the same resourceVersion
+        svc.scheduler.restart(cfg.to_dict())
+        assert svc.scheduler._encode_current(svc.scheduler.config) is not enc3
+
+    def test_none_result_is_cacheable(self):
+        svc = SimulatorService()
+        cfg = svc.scheduler.config
+        assert svc.scheduler._encode_current(cfg) is None
+        assert svc.scheduler._encode_current(cfg) is None
+
+    def test_schedule_results_unaffected(self):
+        svc = SimulatorService()
+        svc.store.apply("nodes", node("n0"))
+        svc.store.apply("pods", pod("p0"))
+        first = svc.scheduler.schedule()
+        assert [r.status for r in first] == ["Scheduled"]
+        # write-backs bumped the rv; a fresh pod schedules correctly
+        svc.store.apply("pods", pod("p1"))
+        second = svc.scheduler.schedule()
+        assert [r.pod_name for r in second] == ["p1"]
+        assert second[0].status == "Scheduled"
+
+
+class TestLifecycleRoutes:
+    def setup_method(self):
+        self.server = SimulatorServer(SimulatorService(), port=0).start()
+        self.base = f"http://127.0.0.1:{self.server.port}/api/v1"
+
+    def teardown_method(self):
+        self.server.shutdown()
+
+    def _post(self, payload):
+        req = urllib.request.Request(
+            f"{self.base}/lifecycle",
+            data=json.dumps(payload).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read())
+
+    def test_end_to_end_chaos_run(self):
+        # the acceptance-criteria spec: seeded, >= 1 node failure, a
+        # Poisson arrival process, end-to-end over HTTP
+        spec = {
+            "seed": 11,
+            "horizon": 16,
+            "schedulerMode": "gang",
+            "snapshot": _snapshot(
+                n_nodes=2, cpu="4",
+                pods=[pod("base0", cpu="1"), pod("base1", cpu="1")],
+            ),
+            "arrivals": [
+                {"kind": "poisson", "rate": 0.4, "count": 4, "template": _tmpl()}
+            ],
+            "faults": [{"at": 6.0, "action": "fail", "node": "n0"}],
+        }
+        st, out = self._post(spec)
+        assert st == 200
+        assert out["phase"] == "Succeeded"
+        trace = out["trace"]
+        evictions = [e for e in trace if e["type"] == "Eviction"]
+        rescheduled = {
+            p
+            for e in trace
+            if e["type"] == "SchedulingPass"
+            for p in e["rescheduled"]
+        }
+        lost = {e["pod"] for e in trace if e["type"] == "EvictedPodLost"}
+        end = trace[-1]
+        for e in evictions:
+            assert (
+                e["pod"] in rescheduled
+                or e["pod"] in end["unschedulableEvicted"]
+                or e["pod"] in lost
+            ), e
+        # isolation: the serving store saw none of it
+        with urllib.request.urlopen(f"{self.base}/resources/pods") as resp:
+            assert json.load(resp)["items"] == []
+        # the run's passes + disruption flowed into the server's metrics
+        with urllib.request.urlopen(f"{self.base}/metrics") as resp:
+            m = json.load(resp)
+        assert m["passes"] > 0
+        assert m["disruption"]["evicted"] == len(evictions)
+
+        # GET /lifecycle/trace replays the same events as JSONL
+        with urllib.request.urlopen(f"{self.base}/lifecycle/trace") as resp:
+            assert resp.headers["Content-Type"] == "application/x-ndjson"
+            lines = resp.read().decode().splitlines()
+        assert [json.loads(x) for x in lines] == trace
+
+    def test_trace_404_before_any_run(self):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{self.base}/lifecycle/trace")
+        assert ei.value.code == 404
+
+    def test_bad_spec_is_400(self):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._post({"faults": [{"at": 1, "action": "explode", "node": "x"}]})
+        assert ei.value.code == 400
