@@ -5,7 +5,7 @@
 PORT ?= 1212
 PY ?= python
 
-.PHONY: test test-fast lint start bench dryrun batch lifecycle-smoke docker docker-up clean
+.PHONY: test test-fast lint start bench dryrun batch lifecycle-smoke perf-smoke docker docker-up clean
 
 # full suite on the 8-device virtual CPU mesh (tests/conftest.py pins it)
 test:
@@ -39,6 +39,12 @@ batch:
 lifecycle-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m kube_scheduler_simulator_tpu.lifecycle \
 		--spec examples/chaos.json --trace-out /tmp/kss-lifecycle-smoke.jsonl
+
+# incremental-encoding smoke: tiny CPU-only churn run asserting the
+# delta encoder carries steady-state passes (docs/performance.md);
+# one JSON line, fails non-zero when the O(Δ) wiring regresses
+perf-smoke:
+	env JAX_PLATFORMS=cpu $(PY) tools/perf_smoke.py
 
 # containerized dev flow (reference `make docker_build_and_up`, one service)
 docker:

@@ -98,6 +98,9 @@ OP_NEVER = 6  # unknown operator: matches nothing (oracle _match_expression)
 # real label-key namespace via the NUL prefix).
 FIELD_NAME_KEY = "\x00metadata.name"
 VAL_PAD = -3  # padding slot in expression value lists; matches no value id
+# The taint every unschedulable node implicitly carries (oracle
+# taint_toleration semantics); shared with the delta encoder.
+UNSCHED_TAINT = {"key": "node.kubernetes.io/unschedulable", "effect": "NoSchedule"}
 
 
 @chex.dataclass
@@ -262,6 +265,32 @@ class EncodedCluster:
         return out
 
 
+def _fill_tol_rows(pod_tols, kv, L):
+    """Toleration rows for a list of pods' toleration lists, interning
+    through `kv` — the ONE fill used by the full encode and by the delta
+    encoder's appended-pod path (engine/delta.py), so the two can never
+    disagree on a row."""
+    n = len(pod_tols)
+    tol_key = np.full((n, L), -1, np.int32)
+    tol_val = np.full((n, L), -1, np.int32)
+    tol_effect = np.full((n, L), -1, np.int32)
+    tol_op = np.full((n, L), -1, np.int32)
+    for i, tols in enumerate(pod_tols):
+        for j, t in enumerate(tols):
+            k = t.get("key") or ""
+            tol_key[i, j] = kv.intern(k) if k else -1  # empty key = any
+            tol_val[i, j] = kv.intern(t.get("value") or "")
+            eff = t.get("effect") or ""
+            tol_effect[i, j] = EFFECTS.get(eff, -2) if eff else -1  # -1 = any
+            # 0 = Equal, 1 = Exists, 2 = unknown operator (tolerates
+            # nothing, oracle toleration_tolerates_taint fallthrough)
+            op = t.get("operator") or "Equal"
+            tol_op[i, j] = {"Equal": 0, "Exists": 1}.get(op, 2)
+    return dict(
+        tol_key=tol_key, tol_val=tol_val, tol_effect=tol_effect, tol_op=tol_op
+    )
+
+
 def _encode_taints(node_views, pod_views, N, P):
     """TaintToleration encodings (oracle: taint_toleration_filter/score,
     models/objects.py toleration_tolerates_taint)."""
@@ -278,30 +307,19 @@ def _encode_taints(node_views, pod_views, N, P):
             taint_key[i, j] = kv.intern(t.get("key") or "")
             taint_val[i, j] = kv.intern(t.get("value") or "")
             taint_effect[i, j] = EFFECTS.get(t.get("effect") or "", -1)
-    tol_key = np.full((P, L), -1, np.int32)
-    tol_val = np.full((P, L), -1, np.int32)
-    tol_effect = np.full((P, L), -1, np.int32)
-    tol_op = np.full((P, L), -1, np.int32)
-    for i, tols in enumerate(pod_tols):
-        for j, t in enumerate(tols):
-            k = t.get("key") or ""
-            tol_key[i, j] = kv.intern(k) if k else -1  # empty key = any
-            tol_val[i, j] = kv.intern(t.get("value") or "")
-            eff = t.get("effect") or ""
-            tol_effect[i, j] = EFFECTS.get(eff, -2) if eff else -1  # -1 = any
-            # 0 = Equal, 1 = Exists, 2 = unknown operator (tolerates
-            # nothing, oracle toleration_tolerates_taint fallthrough)
-            op = t.get("operator") or "Equal"
-            tol_op[i, j] = {"Equal": 0, "Exists": 1}.get(op, 2)
+    tol = _fill_tol_rows(pod_tols, kv, L)
+    padded = {
+        k: np.concatenate([v, np.full((P - len(pod_views), L), -1, np.int32)])
+        if len(pod_views) < P
+        else v
+        for k, v in tol.items()
+    }
     return dict(
         taint_key=taint_key,
         taint_val=taint_val,
         taint_effect=taint_effect,
-        tol_key=tol_key,
-        tol_val=tol_val,
-        tol_effect=tol_effect,
-        tol_op=tol_op,
-    ), {"node_taints": node_taints}
+        **padded,
+    ), {"node_taints": node_taints, "taint_vocab": kv}
 
 
 def _num_or_none(s, policy: DTypePolicy):
@@ -317,18 +335,13 @@ def _num_or_none(s, policy: DTypePolicy):
     return v
 
 
-def _encode_labels_affinity(node_views, pod_views, N, P, policy: DTypePolicy, extra_keys=()):
-    """NodeAffinity / nodeSelector encodings (oracle: node_affinity_filter/
-    score; models/objects.py match_node_selector_term[s]). `extra_keys` are
-    interned up front so other consumers of the key vocab (spread topology
-    keys) index the same label_val columns."""
-    keys, vals = Vocab(), Vocab()
-    for k in extra_keys:
-        keys.intern(k)
-    num_np = np.int64
+def _parse_pod_terms(pv, keys, vals, policy: DTypePolicy):
+    """Parse ONE pod's nodeSelector + node-affinity terms against the
+    key/value vocabularies (anything with .intern). Returns
+    (nsel_pairs, req_terms, pref_terms) in the exact shapes
+    `_fill_terms`/`_fill_nsel_rows` pack. Shared by the full encode and
+    the delta encoder's appended-pod path."""
 
-    # Pre-pass: parse every pod-side term so the vocabularies are final
-    # before arrays are sized.
     def parse_expr(e, is_field):
         if is_field:
             # matchFields evaluate against {"metadata.name": node.name}
@@ -354,17 +367,70 @@ def _encode_labels_affinity(node_views, pod_views, N, P, policy: DTypePolicy, ex
         exprs += [parse_expr(e, True) for e in term.get("matchFields") or []]
         return exprs
 
+    nsel = [
+        (keys.intern(k), vals.intern(str(v))) for k, v in pv.node_selector.items()
+    ]
+    req = pv.node_affinity.get("requiredDuringSchedulingIgnoredDuringExecution") or {}
+    req_terms = [parse_term(t) for t in req.get("nodeSelectorTerms") or []]
+    prefs = pv.node_affinity.get("preferredDuringSchedulingIgnoredDuringExecution") or []
+    pref_terms = [
+        (int(pr.get("weight", 0)), parse_term(pr.get("preference") or {}))
+        for pr in prefs
+    ]
+    return nsel, req_terms, pref_terms
+
+
+def _fill_nsel_rows(pod_nsel, n, NS):
+    nsel_key = np.full((n, NS), -1, np.int32)
+    nsel_val = np.full((n, NS), -1, np.int32)
+    for i, sel in enumerate(pod_nsel):
+        for j, (k, v) in enumerate(sel):
+            nsel_key[i, j] = k
+            nsel_val[i, j] = v
+    return nsel_key, nsel_val
+
+
+def _fill_terms(all_terms, n, TM, E, VV):
+    """Pack parsed (key, op, value-ids, num) term lists into dense rows
+    for `n` pods at fixed dims — shared full/delta fill."""
+    key = np.full((n, TM, E), -1, np.int32)
+    op = np.full((n, TM, E), OP_NEVER, np.int32)
+    vvals = np.full((n, TM, E, VV), VAL_PAD, np.int32)
+    num = np.zeros((n, TM, E), np.int64)
+    num_ok = np.zeros((n, TM, E), bool)
+    term_valid = np.zeros((n, TM), bool)
+    for i, terms in enumerate(all_terms):
+        for ti, exprs in enumerate(terms):
+            term_valid[i, ti] = len(exprs) > 0
+            for ei, (k, o, vv, nnum) in enumerate(exprs):
+                key[i, ti, ei] = k
+                op[i, ti, ei] = o
+                for vi, v in enumerate(vv):
+                    vvals[i, ti, ei, vi] = v
+                if nnum is not None:
+                    num[i, ti, ei] = nnum
+                    num_ok[i, ti, ei] = True
+    return key, op, vvals, num, num_ok, term_valid
+
+
+def _encode_labels_affinity(node_views, pod_views, N, P, policy: DTypePolicy, extra_keys=()):
+    """NodeAffinity / nodeSelector encodings (oracle: node_affinity_filter/
+    score; models/objects.py match_node_selector_term[s]). `extra_keys` are
+    interned up front so other consumers of the key vocab (spread topology
+    keys) index the same label_val columns."""
+    keys, vals = Vocab(), Vocab()
+    for k in extra_keys:
+        keys.intern(k)
+    num_np = np.int64
+
+    # Pre-pass: parse every pod-side term so the vocabularies are final
+    # before arrays are sized.
     pod_nsel, pod_req_terms, pod_pref_terms = [], [], []
     for pv in pod_views:
-        pod_nsel.append(
-            [(keys.intern(k), vals.intern(str(v))) for k, v in pv.node_selector.items()]
-        )
-        req = pv.node_affinity.get("requiredDuringSchedulingIgnoredDuringExecution") or {}
-        pod_req_terms.append([parse_term(t) for t in req.get("nodeSelectorTerms") or []])
-        prefs = pv.node_affinity.get("preferredDuringSchedulingIgnoredDuringExecution") or []
-        pod_pref_terms.append(
-            [(int(pr.get("weight", 0)), parse_term(pr.get("preference") or {})) for pr in prefs]
-        )
+        nsel, req_terms, pref_terms = _parse_pod_terms(pv, keys, vals, policy)
+        pod_nsel.append(nsel)
+        pod_req_terms.append(req_terms)
+        pod_pref_terms.append(pref_terms)
     field_col = keys.intern(FIELD_NAME_KEY)
     for nv in node_views:
         for k in nv.labels:
@@ -390,32 +456,7 @@ def _encode_labels_affinity(node_views, pod_views, N, P, policy: DTypePolicy, ex
             label_num_ok[i, field_col] = True
 
     NS = max(1, max((len(s) for s in pod_nsel), default=0))
-    nsel_key = np.full((P, NS), -1, np.int32)
-    nsel_val = np.full((P, NS), -1, np.int32)
-    for i, sel in enumerate(pod_nsel):
-        for j, (k, v) in enumerate(sel):
-            nsel_key[i, j] = k
-            nsel_val[i, j] = v
-
-    def fill_terms(all_terms, TM, E, VV):
-        key = np.full((P, TM, E), -1, np.int32)
-        op = np.full((P, TM, E), OP_NEVER, np.int32)
-        vvals = np.full((P, TM, E, VV), VAL_PAD, np.int32)
-        num = np.zeros((P, TM, E), num_np)
-        num_ok = np.zeros((P, TM, E), bool)
-        term_valid = np.zeros((P, TM), bool)
-        for i, terms in enumerate(all_terms):
-            for ti, exprs in enumerate(terms):
-                term_valid[i, ti] = len(exprs) > 0
-                for ei, (k, o, vv, n) in enumerate(exprs):
-                    key[i, ti, ei] = k
-                    op[i, ti, ei] = o
-                    for vi, v in enumerate(vv):
-                        vvals[i, ti, ei, vi] = v
-                    if n is not None:
-                        num[i, ti, ei] = n
-                        num_ok[i, ti, ei] = True
-        return key, op, vvals, num, num_ok, term_valid
+    nsel_key, nsel_val = _fill_nsel_rows(pod_nsel, P, NS)
 
     TM = max(1, max((len(t) for t in pod_req_terms), default=0))
     E = max(
@@ -434,10 +475,10 @@ def _encode_labels_affinity(node_views, pod_views, N, P, policy: DTypePolicy, ex
             default=0,
         ),
     )
-    rk, ro, rv, rn, rno, rtv = fill_terms(pod_req_terms, TM, E, VV)
+    rk, ro, rv, rn, rno, rtv = _fill_terms(pod_req_terms, P, TM, E, VV)
     PR = max(1, max((len(t) for t in pod_pref_terms), default=0))
-    pk, po, pvv, pn, pno, ptv = fill_terms(
-        [[e for _, e in t] for t in pod_pref_terms], PR, E, VV
+    pk, po, pvv, pn, pno, ptv = _fill_terms(
+        [[e for _, e in t] for t in pod_pref_terms], P, PR, E, VV
     )
     paff_weight = np.zeros((P, PR), np.int32)
     for i, prefs in enumerate(pod_pref_terms):
@@ -464,7 +505,26 @@ def _encode_labels_affinity(node_views, pod_views, N, P, policy: DTypePolicy, ex
         paff_num_ok=pno,
         paff_weight=paff_weight,
         paff_term_valid=ptv,
-    ), keys
+    ), keys, vals
+
+
+def _fill_port_rows(wants, pair_ids, trip_ids, Q, V2):
+    """Port-demand rows for pods' host-port lists against FIXED pair /
+    triple vocabularies. Raises KeyError on a port identity outside the
+    vocabs — the delta path turns that into a full-re-encode fallback."""
+    n = len(wants)
+    want_wild = np.zeros((n, Q), np.int32)
+    want_trip = np.zeros((n, V2), np.int32)
+    want_pair = np.zeros((n, Q), np.int32)
+    for i, ports in enumerate(wants):
+        for proto, ip, port in ports:
+            q = pair_ids[(proto, port)]
+            want_pair[i, q] += 1
+            if ip == "0.0.0.0":
+                want_wild[i, q] += 1
+            else:
+                want_trip[i, trip_ids[(proto, ip, port)]] += 1
+    return want_wild, want_trip, want_pair
 
 
 def _encode_ports(pod_views, N, P):
@@ -481,26 +541,21 @@ def _encode_ports(pod_views, N, P):
                 trip_ids.setdefault((proto, ip, port), len(trip_ids))
     Q = max(1, len(pair_ids))
     V2 = max(1, len(trip_ids))
-    want_wild = np.zeros((P, Q), np.int32)
-    want_trip = np.zeros((P, V2), np.int32)
-    want_pair = np.zeros((P, Q), np.int32)
     trip_pair = np.zeros(V2, np.int32)
     for (proto, ip, port), v in trip_ids.items():
         trip_pair[v] = pair_ids[(proto, port)]
-    for i, ports in enumerate(wants):
-        for proto, ip, port in ports:
-            q = pair_ids[(proto, port)]
-            want_pair[i, q] += 1
-            if ip == "0.0.0.0":
-                want_wild[i, q] += 1
-            else:
-                want_trip[i, trip_ids[(proto, ip, port)]] += 1
+    ww, wt, wp = _fill_port_rows(wants, pair_ids, trip_ids, Q, V2)
+    pad = P - len(wants)
+    if pad:
+        ww = np.concatenate([ww, np.zeros((pad, Q), np.int32)])
+        wt = np.concatenate([wt, np.zeros((pad, V2), np.int32)])
+        wp = np.concatenate([wp, np.zeros((pad, Q), np.int32)])
     return dict(
-        want_wild=want_wild,
-        want_trip=want_trip,
-        want_pair=want_pair,
+        want_wild=ww,
+        want_trip=wt,
+        want_pair=wp,
         trip_pair=trip_pair,
-    )
+    ), {"port_pair_ids": pair_ids, "port_trip_ids": trip_ids}
 
 
 # ImageLocality thresholds are defined once in the oracle (Ki-unit integer
@@ -511,6 +566,24 @@ from ..sched.oracle_plugins import (  # noqa: E402
     _IMG_MAX_CONTAINERS as IMG_MAX_CONTAINERS,
     _IMG_MIN_KI as IMG_MIN_KI,
 )
+
+
+def _fill_pod_image_rows(pod_views, img_ids, I):
+    """pod_img/pod_ncont rows against a FIXED node-image vocabulary
+    (images a pod wants that no node holds simply don't count — matching
+    `_encode_images`' use of `img_ids.get`). Shared full/delta fill."""
+    from ..sched.oracle_plugins import _normalized_image_name
+
+    n = len(pod_views)
+    pod_img = np.zeros((n, I), np.int32)
+    pod_ncont = np.zeros(n, np.int32)
+    for p, pv in enumerate(pod_views):
+        pod_ncont[p] = min(pv.num_containers, IMG_MAX_CONTAINERS)
+        for name in pv.container_images:
+            i = img_ids.get(_normalized_image_name(name))
+            if i is not None:
+                pod_img[p, i] += 1
+    return pod_img, pod_ncont
 
 
 def _encode_images(node_views, pod_views, N, P, n_real_nodes):
@@ -537,15 +610,14 @@ def _encode_images(node_views, pod_views, N, P, n_real_nodes):
     for n, m in enumerate(node_imgs):
         for i, size in m.items():
             img_contrib[n, i] = (size * int(have[i]) // total) >> 10  # Ki
-    pod_img = np.zeros((P, I), np.int32)
-    pod_ncont = np.zeros(P, np.int32)
-    for p, pv in enumerate(pod_views):
-        pod_ncont[p] = min(pv.num_containers, IMG_MAX_CONTAINERS)
-        for name in pv.container_images:
-            i = img_ids.get(_normalized_image_name(name))
-            if i is not None:
-                pod_img[p, i] += 1
-    return dict(img_contrib=img_contrib, pod_img=pod_img, pod_ncont=pod_ncont)
+    pi, pc = _fill_pod_image_rows(pod_views, img_ids, I)
+    pad = P - len(pod_views)
+    if pad:
+        pi = np.concatenate([pi, np.zeros((pad, I), np.int32)])
+        pc = np.concatenate([pc, np.zeros(pad, np.int32)])
+    return dict(img_contrib=img_contrib, pod_img=pi, pod_ncont=pc), {
+        "img_ids": img_ids
+    }
 
 
 def encode_cluster(
@@ -619,7 +691,7 @@ def encode_cluster(
     pod_tol_unsched = np.zeros(P, bool)
     pod_priority = np.zeros(P, np.int32)
     pod_mask = np.zeros(P, bool)
-    unsched_taint = {"key": "node.kubernetes.io/unschedulable", "effect": "NoSchedule"}
+    unsched_taint = UNSCHED_TAINT
     for i, (pv, ri, si) in enumerate(zip(pod_views, pod_req_ints, pod_sreq_ints)):
         pod_mask[i] = True
         for rank, (r, v) in enumerate(ri.items()):
@@ -659,11 +731,11 @@ def encode_cluster(
         pvcs or [], pvs or [], storageclasses or [], config,
     )
     taint_arrays, taint_aux = _encode_taints(node_views, pod_views, N, P)
-    label_arrays, label_keys = _encode_labels_affinity(
+    label_arrays, label_keys, label_vals = _encode_labels_affinity(
         node_views, pod_views, N, P, policy, extra_keys=topo_keys
     )
-    port_arrays = _encode_ports(pod_views, N, P)
-    img_arrays = _encode_images(node_views, pod_views, N, P, len(nodes))
+    port_arrays, port_aux = _encode_ports(pod_views, N, P)
+    img_arrays, img_aux = _encode_images(node_views, pod_views, N, P, len(nodes))
     rel, rel_aux = encode_pod_relations(
         node_views,
         pod_views,
@@ -764,7 +836,19 @@ def encode_cluster(
         config=config,
         n_nodes=len(nodes),
         n_pods=len(pods),
-        aux={**taint_aux, **rel_aux, **vol_aux},
+        aux={
+            **taint_aux,
+            **rel_aux,
+            **vol_aux,
+            **port_aux,
+            **img_aux,
+            # retained-vocabulary state the incremental encoder
+            # (engine/delta.py) replays events against
+            "label_keys": label_keys,
+            "label_vals": label_vals,
+            "res_vocab": res_vocab,
+            "topo_keys": set(topo_keys),
+        },
     )
     # Retained for the kernel builders that consume them (volume-binding
     # family, namespace-selector terms). The engine's strict mode refuses
@@ -782,8 +866,9 @@ def encode_cluster(
 
 
 class EncodingCache:
-    """Incremental re-encode hook: skip `encode_cluster` entirely when the
-    store has not mutated since the last pass.
+    """Bounded LRU over recent encode results: skip `encode_cluster` (and
+    even the delta replay) entirely when the store has not mutated since
+    a recent pass under the same configuration.
 
     Full re-encoding is O(cluster) host work per scheduling pass; a
     discrete-event driver (lifecycle/engine.py) or an HTTP client issuing
@@ -796,27 +881,52 @@ class EncodingCache:
     conservative choice that can never alias a stale encoding). The miss
     sentinel keeps `None` cacheable: "nothing schedulable" is itself a
     valid encode result.
+
+    The cache is a small fixed-size LRU (`capacity` entries): a long
+    chaos run restarting the scheduler with many config identities must
+    not grow it without bound. The store key is MONOTONIC (latest rv
+    only grows), so entries at any other key than the newest can never
+    hit again — `put` drops them eagerly rather than letting stale
+    `EncodedCluster`s (a full device-array set each) ride the LRU
+    window; the capacity bound covers the genuinely live alternates:
+    many config identities at ONE resourceVersion.
     """
 
     MISS = object()
 
-    def __init__(self):
-        self._key: "tuple | None" = None
-        self._config: "object | None" = None
-        self._enc: "object | None" = None
+    def __init__(self, capacity: int = 8):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        # (key, id(config)) -> (config, enc); the config object rides in
+        # the value so its id cannot be recycled while the entry lives
+        self._entries: "dict[tuple, tuple]" = {}
+
+    def __len__(self) -> int:
+        return len(self._entries)
 
     def get(self, key: tuple, config: object):
         """The cached encoding for (key, config), or `EncodingCache.MISS`."""
-        if self._key == key and self._config is config:
-            return self._enc
-        return EncodingCache.MISS
+        k = (key, id(config))
+        hit = self._entries.get(k)
+        if hit is None or hit[0] is not config:
+            return EncodingCache.MISS
+        # refresh recency (dicts iterate in insertion order)
+        self._entries[k] = self._entries.pop(k)
+        return hit[1]
 
     def put(self, key: tuple, config: object, enc: object) -> None:
-        self._key = key
-        self._config = config
-        self._enc = enc
+        # supersede: the store key is monotonic, so entries at any other
+        # key are permanently unreachable — free their encodings now
+        if any(k[0] != key for k in self._entries):
+            self._entries = {
+                k: v for k, v in self._entries.items() if k[0] == key
+            }
+        k = (key, id(config))
+        self._entries.pop(k, None)
+        self._entries[k] = (config, enc)
+        while len(self._entries) > self.capacity:
+            self._entries.pop(next(iter(self._entries)))
 
     def invalidate(self) -> None:
-        self._key = None
-        self._config = None
-        self._enc = None
+        self._entries.clear()

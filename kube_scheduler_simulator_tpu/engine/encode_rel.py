@@ -145,6 +145,79 @@ def _fill_clauses(slots, builder_dims, P):
     return ctype, ckey, cpairs
 
 
+def parse_pod_spread(pv, constraint_triple, label_keys, cb):
+    """ONE pod's resolved spread constraints → the (hard_terms,
+    soft_terms, explicit) triple `_pack_spread` packs. `label_keys` and
+    `cb` are anything vocab-shaped (.intern / .pair_id+.key_vocab) — the
+    full encode passes the live vocabularies, the delta encoder passes
+    no-grow guards. Shared so the two fills can never drift."""
+    from ..models.objects import match_label_selector
+
+    hard, soft, explicit = constraint_triple
+    hard_terms = [
+        (
+            label_keys.intern(c["topologyKey"]),
+            int(c.get("maxSkew", 1)),
+            match_label_selector(c.get("labelSelector"), pv.labels),
+            _ClauseBuilder.compile(cb, c.get("labelSelector")),
+            False,
+        )
+        for c in hard
+    ]
+    soft_terms = [
+        (
+            label_keys.intern(c["topologyKey"]),
+            int(c.get("maxSkew", 1)),
+            False,
+            _ClauseBuilder.compile(cb, c.get("labelSelector")),
+            c["topologyKey"] == "kubernetes.io/hostname",
+        )
+        for c in soft
+    ]
+    return hard_terms, soft_terms, explicit
+
+
+def _pack_spread(all_terms, n, TC, C, VP):
+    """Dense spread-constraint rows for `n` pods at FIXED dims (the full
+    encode computes the dims as content maxima; the delta path reuses the
+    retained arrays' shapes)."""
+    key = np.full((n, TC), -1, np.int32)
+    skew = np.ones((n, TC), np.int32)
+    selfm = np.zeros((n, TC), bool)
+    host = np.zeros((n, TC), bool)
+    for p, terms in enumerate(all_terms):
+        for t, (k, ms, sm, _cl, hh) in enumerate(terms):
+            key[p, t] = k
+            skew[p, t] = ms
+            selfm[p, t] = sm
+            host[p, t] = hh
+    ctype, ckey, cpairs = _fill_clauses(
+        [[cl for (_, _, _, cl, _) in t] for t in all_terms], (TC, C, VP), n
+    )
+    return key, skew, selfm, host, ctype, ckey, cpairs
+
+
+def _pack_ia(parsed, n, T, C, VP, NSV):
+    """Dense InterPodAffinity term rows for `n` pods at FIXED dims."""
+    key = np.full((n, T), -1, np.int32)
+    nsall = np.zeros((n, T), bool)
+    nsmh = np.zeros((n, T, NSV), bool)
+    weight = np.zeros((n, T), np.int32)
+    selfm = np.zeros((n, T), bool)
+    for p, terms in enumerate(parsed):
+        for t, term in enumerate(terms):
+            key[p, t] = term["kcol"]
+            nsall[p, t] = term["nsall"]
+            for nid in term["nsids"]:
+                nsmh[p, t, nid] = True
+            weight[p, t] = term.get("weight", 0)
+            selfm[p, t] = term.get("selfm", False)
+    ctype, ckey, cpairs = _fill_clauses(
+        [[t["clauses"] for t in x] for x in parsed], (T, C, VP), n
+    )
+    return key, ctype, ckey, cpairs, nsall, nsmh, weight, selfm
+
+
 def encode_pod_relations(
     node_views,
     pod_views,
@@ -185,32 +258,12 @@ def encode_pod_relations(
     hard_all, soft_all = [], []
     req_all = np.zeros(P, bool)
     for i, pv in enumerate(pod_views):
-        hard, soft, explicit = constraints[i]
+        hard_terms, soft_terms, explicit = parse_pod_spread(
+            pv, constraints[i], label_keys, cb
+        )
         req_all[i] = explicit
-        hard_all.append(
-            [
-                (
-                    label_keys.intern(c["topologyKey"]),
-                    int(c.get("maxSkew", 1)),
-                    match_label_selector(c.get("labelSelector"), pv.labels),
-                    cb.compile(c.get("labelSelector")),
-                    False,
-                )
-                for c in hard
-            ]
-        )
-        soft_all.append(
-            [
-                (
-                    label_keys.intern(c["topologyKey"]),
-                    int(c.get("maxSkew", 1)),
-                    False,
-                    cb.compile(c.get("labelSelector")),
-                    c["topologyKey"] == "kubernetes.io/hostname",
-                )
-                for c in soft
-            ]
-        )
+        hard_all.append(hard_terms)
+        soft_all.append(soft_terms)
 
     # -- InterPodAffinity terms, parsed (oracle interpod_pre_filter /
     # interpod_pre_score term handling; _term_matches_pod semantics) --------
@@ -288,7 +341,7 @@ def encode_pod_relations(
                 node_pair[n, col] = node_pair_vocab.intern(f"{k}\x00{v}") + 1
 
     # -- pack constraint tensors ---------------------------------------------
-    def pack(all_terms):
+    def spread_dims(all_terms):
         TC = max(1, max((len(t) for t in all_terms), default=0))
         C = max(
             1, max((len(cl) for t in all_terms for (_, _, _, cl, _) in t), default=0)
@@ -300,20 +353,10 @@ def encode_pod_relations(
                 default=0,
             ),
         )
-        key = np.full((P, TC), -1, np.int32)
-        skew = np.ones((P, TC), np.int32)
-        selfm = np.zeros((P, TC), bool)
-        host = np.zeros((P, TC), bool)
-        for p, terms in enumerate(all_terms):
-            for t, (k, ms, sm, _cl, hh) in enumerate(terms):
-                key[p, t] = k
-                skew[p, t] = ms
-                selfm[p, t] = sm
-                host[p, t] = hh
-        ctype, ckey, cpairs = _fill_clauses(
-            [[cl for (_, _, _, cl, _) in t] for t in all_terms], (TC, C, VP), P
-        )
-        return key, skew, selfm, host, ctype, ckey, cpairs
+        return TC, C, VP
+
+    def pack(all_terms):
+        return _pack_spread(all_terms, P, *spread_dims(all_terms))
 
     hk, hs, hself, _, hct, hck, hcp = pack(hard_all)
     sk, ss_, _, shost, sct, sck, scp = pack(soft_all)
@@ -332,23 +375,7 @@ def encode_pod_relations(
                 default=0,
             ),
         )
-        key = np.full((P, T), -1, np.int32)
-        nsall = np.zeros((P, T), bool)
-        nsmh = np.zeros((P, T, NSV), bool)
-        weight = np.zeros((P, T), np.int32)
-        selfm = np.zeros((P, T), bool)
-        for p, terms in enumerate(parsed):
-            for t, term in enumerate(terms):
-                key[p, t] = term["kcol"]
-                nsall[p, t] = term["nsall"]
-                for nid in term["nsids"]:
-                    nsmh[p, t, nid] = True
-                weight[p, t] = term.get("weight", 0)
-                selfm[p, t] = term.get("selfm", False)
-        ctype, ckey, cpairs = _fill_clauses(
-            [[t["clauses"] for t in x] for x in parsed], (T, C, VP), P
-        )
-        return key, ctype, ckey, cpairs, nsall, nsmh, weight, selfm
+        return _pack_ia(parsed, P, T, C, VP, NSV)
 
     iak, iact, iack, iacp, iana, ians_, _, iaself = pack_terms(ia_parsed)
     nk, nct, nck, ncp, nna, nns, _, _ = pack_terms(ian_parsed)

@@ -32,6 +32,31 @@ import numpy as np
 VOL_LIMIT_PLUGINS = ("EBSLimits", "GCEPDLimits", "AzureDiskLimits")
 
 
+def pod_disk_vol_rows(pv, disk_ids, D):
+    """(pod_disk_any, pod_disk_rw, pod_vol3) rows for ONE pod against a
+    FIXED exclusive-disk vocabulary — the shared fill for the full
+    encode's per-pod loops and the delta encoder's appended-pod path.
+    Raises KeyError on a disk identity outside `disk_ids` (the delta
+    path turns that into a full-re-encode fallback; the full encode
+    builds the vocab first so it never hits it)."""
+    from ..sched import oracle_plugins as op
+
+    disk_any = np.zeros(D, np.int32)
+    disk_rw = np.zeros(D, np.int32)
+    for kind, ident, ro in op.pod_disk_keys(pv):
+        d = disk_ids[(kind, ident)]
+        disk_any[d] += 1
+        if not ro:
+            disk_rw[d] += 1
+    vol3 = np.zeros(len(VOL_LIMIT_PLUGINS), np.int32)
+    for j, plugin in enumerate(VOL_LIMIT_PLUGINS):
+        vol_type, _ = op._VOLUME_LIMITS[plugin]
+        vol3[j] = sum(
+            1 for v in pv.spec.get("volumes", []) or [] if v.get(vol_type)
+        )
+    return disk_any, disk_rw, vol3
+
+
 def encode_volumes(
     node_views: list,
     pod_views: list,
@@ -142,24 +167,14 @@ def encode_volumes(
         for kind, ident, _ in keys:
             disk_ids.setdefault((kind, ident), len(disk_ids))
     D = max(1, len(disk_ids))
+    V3 = len(VOL_LIMIT_PLUGINS)
     pod_disk_any = np.zeros((P, D), np.int32)
     pod_disk_rw = np.zeros((P, D), np.int32)
-    for i, keys in enumerate(pod_disks):
-        for kind, ident, ro in keys:
-            d = disk_ids[(kind, ident)]
-            pod_disk_any[i, d] += 1
-            if not ro:
-                pod_disk_rw[i, d] += 1
-
-    # -- per-type volume counts (EBS/GCEPD/AzureDisk limits) ----------------
-    V3 = len(VOL_LIMIT_PLUGINS)
     pod_vol3 = np.zeros((P, V3), np.int32)
     for i, pv in enumerate(pod_views):
-        for j, plugin in enumerate(VOL_LIMIT_PLUGINS):
-            vol_type, _ = op._VOLUME_LIMITS[plugin]
-            pod_vol3[i, j] = sum(
-                1 for v in pv.spec.get("volumes", []) or [] if v.get(vol_type)
-            )
+        pod_disk_any[i], pod_disk_rw[i], pod_vol3[i] = pod_disk_vol_rows(
+            pv, disk_ids, D
+        )
 
     arrays = dict(
         vb_row=vb_row,
@@ -171,4 +186,8 @@ def encode_volumes(
         pod_disk_rw=pod_disk_rw,
         pod_vol3=pod_vol3,
     )
-    return arrays, {"vol_messages": messages}
+    return arrays, {
+        "vol_messages": messages,
+        "disk_ids": disk_ids,
+        "rwop_ids": rwop_ids,
+    }

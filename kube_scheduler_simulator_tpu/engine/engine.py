@@ -245,6 +245,16 @@ class BatchedScheduler:
     # -- compile reuse ------------------------------------------------------
 
     @staticmethod
+    def queue_bucket(n: int) -> int:
+        """The padded sequential-scan length for a pending queue of `n`
+        pods: the scan is compiled at the geometric bucket above the live
+        length and padded with no-op steps (pod index -1), so churn that
+        moves the pending count within a bucket reuses the compilation."""
+        from ..utils.compilecache import shape_bucket
+
+        return shape_bucket(n, lo=8)
+
+    @staticmethod
     def compile_signature(
         enc: EncodedCluster, record: bool = True, include_queue_len: bool = True
     ) -> tuple:
@@ -255,7 +265,20 @@ class BatchedScheduler:
         bound (derived from node capacities + initial assignment), and the
         full shape/dtype signature of the argument pytrees. Two encodings
         with equal signatures can share one compiled scheduler via
-        `retarget` — the serving layer's recompile-avoidance contract."""
+        `retarget` — the serving layer's recompile-avoidance contract.
+
+        Memoized on the encoding (it is pure in the encoding's content):
+        repeat signature probes — every pass's engine-cache lookup, and
+        `retarget`'s compatibility check against an encoding whose stale
+        buffers the delta encoder may have donated since — reuse the
+        tuple instead of re-reading device arrays."""
+        memo = getattr(enc, "_sig_memo", None)
+        if memo is None:
+            memo = enc._sig_memo = {}
+        mkey = (record, include_queue_len)
+        if mkey in memo:
+            return memo[mkey]
+
         from .preempt import _victim_bound
 
         shapes = tuple(
@@ -275,20 +298,25 @@ class BatchedScheduler:
             (name, K.COMPILE_STATICS[name](enc))
             for name in sorted(enabled & set(K.COMPILE_STATICS))
         )
-        return (
+        sig = (
             enc.config.fingerprint(),
             enc.policy.name,
             tuple(enc.resource_names),
             enc.aux.get("n_node_pairs"),
             victim_bound,
-            # the scan length is baked into the sequential program; gang
-            # mode passes the queue as a fixed-[P] order argument and
-            # drops this component (GangScheduler.compile_signature)
-            len(enc.queue) if include_queue_len else None,
+            # the BUCKETED scan length is baked into the sequential
+            # program (run() pads the queue to it); gang mode passes the
+            # queue as a fixed-[P] order argument and drops this
+            # component (GangScheduler.compile_signature)
+            BatchedScheduler.queue_bucket(len(enc.queue))
+            if include_queue_len
+            else None,
             record,
             custom_statics,
             shapes,
         )
+        memo[mkey] = sig
+        return sig
 
     def retarget(self, enc: EncodedCluster) -> "BatchedScheduler":
         """Point this compiled scheduler at a new encoding with an equal
@@ -367,28 +395,40 @@ class BatchedScheduler:
         def bind(state, a, p, sel, qi):
             # Unschedulable pods scatter-add zeros to row 0 (valid == 0),
             # keeping the node axis exactly [N] for mesh sharding.
+            # p < 0 marks a queue-bucket padding step (run() pads the
+            # scan to its geometric bucket): every write is gated off so
+            # the step is an exact no-op on the carried state.
+            ok = p >= 0
+            ps = jnp.maximum(p, 0)
+            sel = jnp.where(ok, sel, jnp.int32(-1))
             tgt = jnp.maximum(sel, 0)
             valid = (sel >= 0).astype(a.pod_req.dtype)
             vi = (sel >= 0).astype(jnp.int32)
             return state.replace(
-                requested=state.requested.at[tgt].add(a.pod_req[p] * valid),
-                s_requested=state.s_requested.at[tgt].add(a.pod_sreq[p] * valid),
+                requested=state.requested.at[tgt].add(a.pod_req[ps] * valid),
+                s_requested=state.s_requested.at[tgt].add(a.pod_sreq[ps] * valid),
                 n_pods=state.n_pods.at[tgt].add(vi),
-                assignment=state.assignment.at[p].set(sel),
-                used_pair=state.used_pair.at[tgt].add(a.want_pair[p] * vi),
-                used_wild=state.used_wild.at[tgt].add(a.want_wild[p] * vi),
-                used_trip=state.used_trip.at[tgt].add(a.want_trip[p] * vi),
+                assignment=state.assignment.at[ps].set(
+                    jnp.where(ok, sel, state.assignment[ps])
+                ),
+                used_pair=state.used_pair.at[tgt].add(a.want_pair[ps] * vi),
+                used_wild=state.used_wild.at[tgt].add(a.want_wild[ps] * vi),
+                used_trip=state.used_trip.at[tgt].add(a.want_trip[ps] * vi),
                 used_claims=state.used_claims
-                + a.pod_claim[p].astype(jnp.int32) * vi,
+                + a.pod_claim[ps].astype(jnp.int32) * vi,
                 node_disk_any=state.node_disk_any.at[tgt].add(
-                    a.pod_disk_any[p] * vi
+                    a.pod_disk_any[ps] * vi
                 ),
                 node_disk_rw=state.node_disk_rw.at[tgt].add(
-                    a.pod_disk_rw[p] * vi
+                    a.pod_disk_rw[ps] * vi
                 ),
-                node_vol3=state.node_vol3.at[tgt].add(a.pod_vol3[p] * vi),
-                bound_seq=state.bound_seq.at[p].set(
-                    jnp.where(sel >= 0, jnp.int32(P) + qi, jnp.int32(-1))
+                node_vol3=state.node_vol3.at[tgt].add(a.pod_vol3[ps] * vi),
+                bound_seq=state.bound_seq.at[ps].set(
+                    jnp.where(
+                        ok,
+                        jnp.where(sel >= 0, jnp.int32(P) + qi, jnp.int32(-1)),
+                        state.bound_seq[ps],
+                    )
                 ),
             )
 
@@ -429,7 +469,12 @@ class BatchedScheduler:
         def step(carry, x):
             state, a, weights = carry
             p, qi = x
-            pf_codes, codes, raw, final, sel, pf_ok = attempt(state, a, weights, p)
+            # ps is p with queue-bucket padding steps (p == -1) clamped
+            # to a safe gather row; their attempt outputs are discarded
+            # (sel forced to -1, bind gated, preemption gated).
+            ps = jnp.maximum(p, 0)
+            pf_codes, codes, raw, final, sel, pf_ok = attempt(state, a, weights, ps)
+            sel = jnp.where(p >= 0, sel, jnp.int32(-1))
             if preempt_fn is None:
                 state = bind(state, a, p, sel, qi)
                 out = (pf_codes, codes, raw, final, sel) if record else sel
@@ -439,7 +484,7 @@ class BatchedScheduler:
             # preemption dry-run; on nomination, evict victims and retry the
             # full cycle within the same step (oracle schedule_all re-queues
             # the pod at the queue head — nothing schedules in between).
-            do = (sel < 0) & pf_ok & a.pod_mask[p]
+            do = (sel < 0) & pf_ok & a.pod_mask[ps] & (p >= 0)
 
             def masked_preempt(st):
                 # Always-run form of `with_preempt` below: gate the victim
@@ -449,14 +494,14 @@ class BatchedScheduler:
                 # — so binding proceeds from `sel` exactly as the skipped
                 # branch would. Retry outputs are zero-gated to match the
                 # cond mode's `without` trace bit-for-bit.
-                pcode, vmask, nominated = preempt_fn(a, st, p)
+                pcode, vmask, nominated = preempt_fn(a, st, ps)
                 nominated = jnp.where(do, nominated, jnp.int32(-1))
                 vmask = vmask & do
                 pcode = jnp.where(do, pcode, 0)
                 evict = vmask[jnp.maximum(nominated, 0)] & (nominated >= 0)
                 st2 = evict_all(st, a, evict)
-                _, codes2, raw2, final2, sel2, _ = attempt(st2, a, weights, p)
-                pcode2, vmask2, nominated2 = preempt_fn(a, st2, p)
+                _, codes2, raw2, final2, sel2, _ = attempt(st2, a, weights, ps)
+                pcode2, vmask2, nominated2 = preempt_fn(a, st2, ps)
                 return st2, (
                     pcode, vmask, nominated, evict,
                     jnp.where(do, codes2, 0),
@@ -469,13 +514,13 @@ class BatchedScheduler:
                 )
 
             def with_preempt(st):
-                pcode, vmask, nominated = preempt_fn(a, st, p)
+                pcode, vmask, nominated = preempt_fn(a, st, ps)
                 evict = vmask[jnp.maximum(nominated, 0)] & (nominated >= 0)
                 st2 = evict_all(st, a, evict)
-                _, codes2, raw2, final2, sel2, _ = attempt(st2, a, weights, p)
+                _, codes2, raw2, final2, sel2, _ = attempt(st2, a, weights, ps)
                 # retry-failure postfilter (recorded, never evicts — the
                 # oracle's retried-set forces Unschedulable on 2nd failure)
-                pcode2, vmask2, nominated2 = preempt_fn(a, st2, p)
+                pcode2, vmask2, nominated2 = preempt_fn(a, st2, ps)
                 return st2, (
                     pcode, vmask, nominated, evict,
                     codes2, raw2, final2, sel2, pcode2, vmask2, nominated2,
@@ -538,10 +583,21 @@ class BatchedScheduler:
     # -- execution ----------------------------------------------------------
 
     def run(self, weights: "jnp.ndarray | None" = None):
-        """Execute the scan; returns (final_state, trace)."""
+        """Execute the scan; returns (final_state, trace).
+
+        The queue is padded to its geometric bucket with no-op steps
+        (pod index -1) so pending-count churn inside a bucket reuses the
+        compiled program — trace rows beyond the live queue are unused
+        padding (`results()`/decode iterate the live queue only)."""
         w = self.weights if weights is None else weights
+        queue = np.asarray(self.enc.queue, np.int32)
+        bucket = self.queue_bucket(len(queue))
+        if bucket > len(queue):
+            queue = np.concatenate(
+                [queue, np.full(bucket - len(queue), -1, np.int32)]
+            )
         state, out = self._run(
-            self.enc.arrays, self.enc.state0, jnp.asarray(self.enc.queue), w
+            self.enc.arrays, self.enc.state0, jnp.asarray(queue), w
         )
         self._final_state = state
         self._trace = out
@@ -561,7 +617,9 @@ class BatchedScheduler:
         of preemption events, not P x N x P. `results()` then decodes
         (optionally a subset of pods; see `results(pods=...)`).
 
-        At most two program compilations occur (full chunk + remainder).
+        The trailing partial chunk is padded to the full chunk length
+        with no-op steps (pod index -1), so exactly ONE segment program
+        compiles regardless of queue length.
         """
         if not self.record:
             raise RuntimeError("engine built with record=False has no trace")
@@ -578,8 +636,13 @@ class BatchedScheduler:
         sparse: dict[int, dict[int, np.ndarray]] = {i: {} for i in sparse_slots}
         zero_spec: dict[int, tuple] = {}  # slot -> (row shape, dtype)
         for i in range(0, len(queue), chunk):
-            qseg = jnp.asarray(queue[i : i + chunk])
-            qis = jnp.arange(i, i + len(queue[i : i + chunk]), dtype=jnp.int32)
+            seg = np.asarray(queue[i : i + chunk], np.int32)
+            if len(seg) < chunk:
+                seg = np.concatenate(
+                    [seg, np.full(chunk - len(seg), -1, np.int32)]
+                )
+            qseg = jnp.asarray(seg)
+            qis = jnp.arange(i, i + chunk, dtype=jnp.int32)
             state, out = self._run_segment(enc.arrays, state, qseg, qis, w)
             out = list(out) if isinstance(out, (tuple, list)) else [out]
             # fired-row indices first: event-free chunks transfer nothing

@@ -372,7 +372,16 @@ ROW_FILTERS = {
 
 def _victim_bound(enc: EncodedCluster, filter_names) -> int:
     """Static bound on victims per node: with NodeResourcesFit enabled no
-    node ever holds more pods than max(pods capacity, its initial load)."""
+    node ever holds more pods than max(pods capacity, its initial load).
+
+    Rounded UP to the geometric shape bucket: the bound is baked into
+    the compiled program (it sizes the reprieve scan), and the raw value
+    moves with the initial per-node load — exact, it would recompile as
+    churn shifts pods around. Over-approximation is safe: the extra
+    reprieve slots carry sort-key sentinels (vm[v] False) and are exact
+    no-ops."""
+    from ..utils.compilecache import shape_bucket
+
     P = enc.P
     if "NodeResourcesFit" not in filter_names:
         return P
@@ -382,7 +391,8 @@ def _victim_bound(enc: EncodedCluster, filter_names) -> int:
     assign0 = np.asarray(enc.state0.assignment)
     bound0 = assign0[assign0 >= 0]
     init_max = int(np.bincount(bound0).max()) if bound0.size else 0
-    return max(1, min(P, max(cap_max, init_max)))
+    raw = max(1, min(P, max(cap_max, init_max)))
+    return min(P, shape_bucket(raw, lo=1))
 
 
 def build_preemption(enc: EncodedCluster, filter_names):

@@ -11,7 +11,10 @@ Execution model (per event batch, in simulated-time order):
   2. Converge — run the deterministic controller subset to fixpoint
      (controllers/steps.py), then one batched scheduling pass
      (sequential or gang per the spec) through `SchedulerService`, whose
-     `EncodingCache` makes no-mutation passes re-encode-free.
+     encoding stack makes no-mutation passes re-encode-free
+     (`EncodingCache` LRU) and mutating passes O(Δ) (`DeltaEncoder`
+     replays the store's events as device scatter updates instead of
+     re-encoding the cluster — docs/performance.md).
   3. Record   — append a `SchedulingPass` trace event with the pass's
      disruption accounting: pods scheduled/pending, which evicted pods
      re-bound, and their simulated time-to-reschedule. Wall-clock pass
@@ -272,7 +275,15 @@ class LifecycleEngine:
             pending=pending,
             rescheduled=rescheduled,
         )
-        self.timings.append({"t": t, "wallSeconds": round(wall, 6)})
+        # wall latency + which encode path served the pass (delta / full
+        # / cached / empty) — kept OUT of the trace: the trace carries
+        # deterministic fields only, and the encode path is an
+        # implementation detail of the serving stack, not the timeline
+        timing = {"t": t, "wallSeconds": round(wall, 6)}
+        info = self.scheduler.last_encode_info
+        if info:
+            timing["encodeMode"] = info["mode"]
+        self.timings.append(timing)
 
     # -- the loop -----------------------------------------------------------
 

@@ -13,6 +13,7 @@ simulator/node/node.go:69-92), and a boot-time snapshot for reset
 
 from __future__ import annotations
 
+import bisect
 import copy
 import itertools
 import threading
@@ -60,6 +61,9 @@ class ResourceStore:
         self._rv = itertools.count(1)
         self._objs: dict[str, dict[str, dict]] = {k: {} for k in KINDS}
         self._events: list[WatchEvent] = []
+        # parallel resourceVersion index over _events (same pruning) so
+        # events_since/dirty_since start at a bisect, not a full scan
+        self._event_rvs: list[int] = []
         # bounded event log: past capacity, the older half is dropped and
         # watchers behind it get StaleResourceVersion (410-Gone analogue)
         self._event_log_capacity = max(2, int(event_log_capacity))
@@ -273,7 +277,70 @@ class ResourceStore:
                     f"resourceVersion {last_rv} is too old (oldest retained: "
                     f"{self._pruned_through + 1}); relist required"
                 )
-            return [e for e in self._events if e.kind == kind and e.resource_version > last_rv]
+            start = bisect.bisect_right(self._event_rvs, last_rv)
+            return [e for e in self._events[start:] if e.kind == kind]
+
+    def dirty_since(self, last_rv: int) -> dict[str, dict[str, str]]:
+        """Net per-object change classification after `last_rv` — the
+        cheap dirty-index feed for the incremental encoder
+        (engine/delta.py): {kind: {key: status}} with statuses
+
+          * ``ADDED``     — did not exist at last_rv, exists now (any
+            later modifications folded in); appended at the END of the
+            kind's iteration order, so existing indices are unmoved.
+            ADDED keys appear in the returned dict in the store's
+            (re-)insertion order — the order their rows must append in;
+          * ``MODIFIED``  — existed then and now, object changed;
+          * ``DELETED``   — existed at last_rv, gone now (later objects'
+            iteration indices SHIFTED down);
+          * ``REPLACED``  — deleted and re-added within the window: the
+            key survives but moved to the END of iteration order (an
+            index move, like DELETED for encoding purposes);
+          * ``TRANSIENT`` — added and fully deleted within the window;
+            the current keyspace never saw it.
+
+        Costs O(log E + events-in-window), not O(cluster). Raises
+        StaleResourceVersion exactly like `events_since` when the window
+        predates the retained log.
+        """
+        with self._lock:
+            if last_rv < self._pruned_through:
+                raise StaleResourceVersion(
+                    f"resourceVersion {last_rv} is too old (oldest retained: "
+                    f"{self._pruned_through + 1}); relist required"
+                )
+            start = bisect.bisect_right(self._event_rvs, last_rv)
+            out: dict[str, dict[str, str]] = {}
+            for e in self._events[start:]:
+                per = out.setdefault(e.kind, {})
+                key = self.key(e.kind, e.obj)
+                prev = per.get(key)
+                if e.event_type == "ADDED":
+                    # an ADDED event (re-)inserts the key at the END of
+                    # the kind's iteration order, so its dirty-dict slot
+                    # must move to the end too — the delta encoder
+                    # appends new rows in this dict's order and it has
+                    # to match the store's (add a, add b, delete a,
+                    # re-add a iterates [b, a], not [a, b])
+                    per.pop(key, None)
+                    if prev == "DELETED":
+                        per[key] = "REPLACED"
+                    elif prev in (None, "TRANSIENT"):
+                        per[key] = "ADDED"
+                    else:  # ADDED/MODIFIED/REPLACED: impossible from a
+                        per[key] = prev  # consistent log; keep status
+                elif e.event_type == "MODIFIED":
+                    if prev is None:
+                        per[key] = "MODIFIED"
+                    # mods fold into ADDED/REPLACED/MODIFIED unchanged
+                elif e.event_type == "DELETED":
+                    if prev == "ADDED":
+                        per[key] = "TRANSIENT"
+                    elif prev == "REPLACED":
+                        per[key] = "DELETED"
+                    else:  # None | MODIFIED
+                        per[key] = "DELETED"
+            return out
 
     def list_as_added(self, kind: str) -> list[WatchEvent]:
         """Initial list replayed as ADDED events (resourcewatcher.go:94-105)."""
@@ -291,10 +358,12 @@ class ResourceStore:
         """Append to the event log (under self._lock) and queue for
         subscriber delivery — callbacks run later, outside the lock."""
         self._events.append(ev)
+        self._event_rvs.append(ev.resource_version)
         if len(self._events) > self._event_log_capacity:
             drop = self._event_log_capacity // 2
             self._pruned_through = self._events[drop - 1].resource_version
             del self._events[:drop]
+            del self._event_rvs[:drop]
         self._delivery.append(ev)
 
     def _dispatch(self):

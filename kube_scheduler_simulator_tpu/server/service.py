@@ -31,8 +31,10 @@ in PrioritySort order with identical placement semantics.
 from __future__ import annotations
 
 import threading
+import time
 
-from ..engine import TPU32, BatchedScheduler, encode_cluster
+from ..engine import BatchedScheduler
+from ..engine.delta import DeltaEncoder
 from ..engine.encode import EncodingCache
 from ..engine.engine import unsupported_plugins
 from ..models.snapshot import export_snapshot, import_snapshot
@@ -57,15 +59,6 @@ class SchedulerServiceDisabled(RuntimeError):
         super().__init__(
             "an external scheduler is enabled: scheduler service is disabled"
         )
-
-
-def _pow2(n: int, lo: int = 8) -> int:
-    """Pad capacities to powers of two so repeated passes over a growing
-    cluster reuse XLA compilations instead of recompiling per size."""
-    c = lo
-    while c < n:
-        c *= 2
-    return c
 
 
 class SchedulerService:
@@ -103,12 +96,20 @@ class SchedulerService:
         # FIFO dict so alternating windowed/unwindowed clients don't
         # recompile on every pass (code-review r5)
         self._gang_engine_cache: "dict[tuple, object]" = {}
-        # incremental re-encode hook: the store's latest resourceVersion
-        # is a complete mutation token, so back-to-back passes over an
-        # unchanged store reuse the previous pass's encoding instead of
-        # re-listing + re-encoding the whole cluster (engine/encode.py
-        # EncodingCache; the lifecycle event loop leans on this)
-        self._enc_cache = EncodingCache()
+        # the incremental encoding stack (docs/performance.md):
+        #   * EncodingCache — bounded LRU keyed (latest rv, config
+        #     identity): back-to-back passes over an unchanged store
+        #     reuse the encoding verbatim, across recent configs;
+        #   * DeltaEncoder — on a cache miss, replays the store's event
+        #     log into the retained encoding with device scatter
+        #     updates, falling back to a full re-encode when it can't
+        #     prove exactness. The lifecycle event loop leans on this
+        #     for its O(Δ) steady state.
+        self._enc_cache = EncodingCache(capacity=8)
+        self._delta = DeltaEncoder()
+        # the last _encode_current outcome ({"mode": ..., ...}) — read
+        # by the lifecycle engine to stamp per-pass encode modes
+        self.last_encode_info: "dict | None" = None
         self.extender_service = ExtenderService(self._config.extenders)
 
     # -- configuration lifecycle -------------------------------------------
@@ -243,19 +244,28 @@ class SchedulerService:
             GangScheduler.effective_window(enc, window),
         )
         cache = self._gang_engine_cache
+        t0 = time.perf_counter()
         if sig in cache:
             gang = cache[sig].retarget(enc)
+            built = False
         else:
             gang = GangScheduler(enc, strict=True, eval_window=window)
             while len(cache) >= 4:  # FIFO bound
                 cache.pop(next(iter(cache)))
             cache[sig] = gang
+            built = True
         if record:
             _, rounds = gang.run_recorded()
-            results = gang.results()
         else:
             _, rounds = gang.run()
-            results = None
+        dt = time.perf_counter() - t0
+        # a fresh build's first run IS the XLA compile (jit is lazy)
+        if built:
+            self.metrics.record_engine_build(dt)
+        else:
+            self.metrics.record_phase_seconds(execute=dt)
+        t_decode = time.perf_counter()
+        results = gang.results() if record else None
         placements = gang.placements()
         # preemption victims: pre-bound pods the preempt phase evicted.
         # They are NOT in placements (decode covers queued pods only), so
@@ -297,45 +307,34 @@ class SchedulerService:
                             "spec": {"nodeName": node_name},
                         },
                     )
+        self.metrics.record_phase_seconds(
+            decode=time.perf_counter() - t_decode
+        )
         return placements, int(np.asarray(rounds)), results
 
     def _encode_current(self, config) -> "object | None":
         """Encode the store's current pending state under the pass's
         single config read (shared by the sequential and gang passes);
-        None when nothing is schedulable. Cached on the store's latest
-        resourceVersion: a pass over a store no mutation has touched
-        since the last encode reuses that encoding verbatim."""
+        None when nothing is schedulable.
+
+        Three tiers, cheapest first: the (latest rv, config) LRU serves
+        byte-unchanged stores verbatim; the delta encoder replays the
+        store's events into the retained encoding (O(Δ)); a full
+        `encode_cluster` covers everything the delta path can't prove
+        exact. Encode wall time + the path taken land in the metrics'
+        phase breakdown."""
+        t0 = time.perf_counter()
         cache_key = (self.store.latest_rv(),)
         cached = self._enc_cache.get(cache_key, config)
         if cached is not EncodingCache.MISS:
+            self.last_encode_info = {"mode": "cached"}
+            self.metrics.record_encode("cached", time.perf_counter() - t0)
             return cached
-        enc = self._encode_fresh(config)
+        enc, info = self._delta.encode(self.store, config)
         self._enc_cache.put(cache_key, config, enc)
+        self.last_encode_info = info
+        self.metrics.record_encode(info["mode"], time.perf_counter() - t0)
         return enc
-
-    def _encode_fresh(self, config) -> "object | None":
-        nodes = self.store.list("nodes")
-        pods = self.store.list("pods")
-        if not nodes or not pods:
-            return None
-        pending = [
-            p for p in pods if not (p.get("spec", {}) or {}).get("nodeName")
-        ]
-        if not pending:
-            return None
-        return encode_cluster(
-            nodes,
-            pods,
-            config,
-            policy=TPU32,
-            priorityclasses=self.store.list("priorityclasses"),
-            namespaces=self.store.list("namespaces"),
-            pvcs=self.store.list("pvcs"),
-            pvs=self.store.list("pvs"),
-            storageclasses=self.store.list("storageclasses"),
-            node_capacity=_pow2(len(nodes)),
-            pod_capacity=_pow2(len(pods)),
-        )
 
     def _schedule_locked(self, config) -> list[PodSchedulingResult]:
         enc = self._encode_current(config)
@@ -351,29 +350,46 @@ class SchedulerService:
             if cache and cache[0] == sig:
                 ext_sched = cache[1].retarget(enc, self.extender_service)
             else:
+                t0 = time.perf_counter()
                 ext_sched = ExtenderScheduler(enc, self.extender_service)
                 self._extender_engine_cache = (sig, ext_sched)
+                self.metrics.record_engine_build(time.perf_counter() - t0)
+            t0 = time.perf_counter()
             results = ext_sched.run()
+            self.metrics.record_phase_seconds(execute=time.perf_counter() - t0)
             placements = ext_sched.placements()
             final_assignment = ext_sched.final_state.assignment
         else:
             # reuse the previous pass's compiled program when the encoding
             # is compile-compatible (same padded shapes + baked statics)
             sig = BatchedScheduler.compile_signature(enc)
+            t0 = time.perf_counter()
             if self._engine_cache and self._engine_cache[0] == sig:
                 sched = self._engine_cache[1].retarget(enc)
+                built = False
             else:
                 sched = BatchedScheduler(enc, record=True, strict=True)
                 self._engine_cache = (sig, sched)
+                built = True
             sched.run()
+            dt = time.perf_counter() - t0
+            # a fresh build's first run IS the XLA compile (jit is
+            # lazy): book it as compile; warm passes book as execute
+            if built:
+                self.metrics.record_engine_build(dt)
+            else:
+                self.metrics.record_phase_seconds(execute=dt)
+            t0 = time.perf_counter()
             results = sched.results()
             placements = sched.placements()
             final_assignment = sched._final_state.assignment
+            self.metrics.record_phase_seconds(decode=time.perf_counter() - t0)
 
         # preemption victims: pre-bound pods that lost their node (upstream
         # preemption deletes victims through the API)
         import numpy as np
 
+        t_decode = time.perf_counter()
         before = np.asarray(enc.state0.assignment)
         after = np.asarray(final_assignment)
         for p_idx in np.nonzero((before >= 0) & (after < 0))[0]:
@@ -405,6 +421,9 @@ class SchedulerService:
             # flushed results are purged, like the reference reflector's
             # DeleteData after AddStoredResultToPod (storereflector.go:70-119)
             self.extender_service.delete_data(res.pod_namespace, res.pod_name)
+        self.metrics.record_phase_seconds(
+            decode=time.perf_counter() - t_decode
+        )
         return results
 
 

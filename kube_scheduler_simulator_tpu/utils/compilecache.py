@@ -22,6 +22,41 @@ import os
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def shape_bucket(n: int, lo: int = 8) -> int:
+    """Geometric (power-of-two) shape bucket for a live object count.
+
+    Every padded-axis length that reaches XLA — node capacity, pod
+    capacity, the sequential scan's queue length — is rounded up to the
+    next power of two at or above `lo`, so churn that adds or removes a
+    few objects keeps reusing the program compiled for the current
+    bucket instead of recompiling per exact count. A bucket is crossed
+    (and one recompile paid, amortized by the persistent disk cache
+    below) only when the live count doubles past it or shrink passes
+    re-encode at a smaller bucket. `n <= 0` maps to 0: an empty axis is
+    its own (trivial) shape class, not an 8-wide one.
+    """
+    if n <= 0:
+        return 0
+    c = lo
+    while c < n:
+        c *= 2
+    return c
+
+
+def capacity_buckets(
+    n_nodes: int, n_pods: int, *, node_lo: int = 8, pod_lo: int = 8
+) -> tuple[int, int]:
+    """(node_capacity, pod_capacity) for a cluster of live counts — THE
+    bucket policy encode_cluster callers share (server/service.py, the
+    delta encoder, benchmarks). Also the bucket component of encoding /
+    compiled-program cache keys: two stores whose counts land in the
+    same buckets produce shape-identical programs."""
+    return (
+        max(shape_bucket(n_nodes, node_lo), 1),
+        max(shape_bucket(n_pods, pod_lo), 1),
+    )
+
+
 def default_cache_dir(repo_root: "str | None" = None) -> str:
     """The cache directory `enable_compile_cache` uses absent the
     KSS_JAX_CACHE_DIR override: `<repo_root>/.jax_cache` when the root

@@ -54,6 +54,22 @@ class SchedulingMetrics:
     _tts_sum_s: float = 0.0  # sum of time-to-reschedule, sim seconds
     _tts_max_s: float = 0.0
     _tts_count: int = 0
+    # phase-timing breakdown (perf_opt PR: where a pass's wall-clock
+    # goes — encode vs compile vs execute vs decode) plus the encode-path
+    # counters that prove the incremental encoder is carrying churn
+    # (docs/performance.md). encodeSeconds includes cache probes;
+    # compileSeconds is engine-build time (jit compile included).
+    _phase_s: dict = field(
+        default_factory=lambda: {
+            "encode": 0.0, "compile": 0.0, "execute": 0.0, "decode": 0.0
+        },
+        repr=False,
+    )
+    _encode_counts: dict = field(
+        default_factory=lambda: {"delta": 0, "full": 0, "cached": 0, "empty": 0},
+        repr=False,
+    )
+    _engine_builds: int = 0
 
     def record(self, rec: PassRecord) -> None:
         with self._lock:
@@ -81,6 +97,32 @@ class SchedulingMetrics:
                 self._tts_sum_s += float(t)
                 self._tts_max_s = max(self._tts_max_s, float(t))
                 self._tts_count += 1
+
+    def record_encode(self, mode: str, seconds: float = 0.0) -> None:
+        """One encode attempt: `mode` is the path that served it
+        (``delta`` / ``full`` / ``cached`` / ``empty``); `seconds` is the
+        host time it took (including event replay / cache probes)."""
+        with self._lock:
+            if mode not in self._encode_counts:
+                self._encode_counts[mode] = 0
+            self._encode_counts[mode] += 1
+            self._phase_s["encode"] += float(seconds)
+
+    def record_engine_build(self, seconds: float = 0.0) -> None:
+        """One compiled-engine construction (the recompile proxy: a
+        warm churn pass retargets instead and never lands here)."""
+        with self._lock:
+            self._engine_builds += 1
+            self._phase_s["compile"] += float(seconds)
+
+    def record_phase_seconds(
+        self, execute: float = 0.0, decode: float = 0.0
+    ) -> None:
+        """Per-pass execute (compiled program) / decode (results +
+        write-backs) wall seconds."""
+        with self._lock:
+            self._phase_s["execute"] += float(execute)
+            self._phase_s["decode"] += float(decode)
 
     @contextmanager
     def time_pass(self, mode: str):
@@ -133,6 +175,17 @@ class SchedulingMetrics:
                     else 0.0,
                     "maxTimeToRescheduleS": round(self._tts_max_s, 6),
                 },
+                "phases": {
+                    "encodeSeconds": round(self._phase_s["encode"], 6),
+                    "compileSeconds": round(self._phase_s["compile"], 6),
+                    "executeSeconds": round(self._phase_s["execute"], 6),
+                    "decodeSeconds": round(self._phase_s["decode"], 6),
+                    "deltaEncodes": self._encode_counts.get("delta", 0),
+                    "fullEncodes": self._encode_counts.get("full", 0),
+                    "cachedEncodes": self._encode_counts.get("cached", 0),
+                    "emptyEncodes": self._encode_counts.get("empty", 0),
+                    "engineBuilds": self._engine_builds,
+                },
             }
 
     def reset(self) -> None:
@@ -147,6 +200,13 @@ class SchedulingMetrics:
             self._tts_sum_s = 0.0
             self._tts_max_s = 0.0
             self._tts_count = 0
+            self._phase_s = {
+                "encode": 0.0, "compile": 0.0, "execute": 0.0, "decode": 0.0
+            }
+            self._encode_counts = {
+                "delta": 0, "full": 0, "cached": 0, "empty": 0
+            }
+            self._engine_builds = 0
 
 
 # process-wide shared registry for ad-hoc callers (benchmarks, scripts).

@@ -1,0 +1,172 @@
+"""EncodingCache LRU bound + ResourceStore.dirty_since classification."""
+
+from __future__ import annotations
+
+import pytest
+
+from kube_scheduler_simulator_tpu.engine.encode import EncodingCache
+from kube_scheduler_simulator_tpu.models.store import (
+    ResourceStore,
+    StaleResourceVersion,
+)
+
+from helpers import node, pod
+
+
+class TestEncodingCacheLRU:
+    def test_hit_and_miss(self):
+        c = EncodingCache(capacity=2)
+        cfg = object()
+        assert c.get((1,), cfg) is EncodingCache.MISS
+        c.put((1,), cfg, "enc1")
+        assert c.get((1,), cfg) == "enc1"
+        # same key, different config identity: miss
+        assert c.get((1,), object()) is EncodingCache.MISS
+
+    def test_none_is_cacheable(self):
+        c = EncodingCache(capacity=2)
+        cfg = object()
+        c.put((5,), cfg, None)
+        assert c.get((5,), cfg) is None
+
+    def test_eviction_is_lru_not_fifo(self):
+        # the LRU axis is config identity at ONE store key (the live
+        # alternates; older keys are superseded eagerly — see below)
+        c = EncodingCache(capacity=2)
+        cfg_a, cfg_b, cfg_c = object(), object(), object()
+        c.put((1,), cfg_a, "a")
+        c.put((1,), cfg_b, "b")
+        assert c.get((1,), cfg_a) == "a"  # refresh cfg_a
+        c.put((1,), cfg_c, "c")  # evicts cfg_b, the least recently used
+        assert c.get((1,), cfg_b) is EncodingCache.MISS
+        assert c.get((1,), cfg_a) == "a"
+        assert c.get((1,), cfg_c) == "c"
+        assert len(c) == 2
+
+    def test_put_supersedes_older_keys(self):
+        # the store key is monotonic: entries at any older key can never
+        # hit again, so a put at a newer key drops them immediately
+        # instead of pinning dead encodings for the LRU window
+        c = EncodingCache(capacity=8)
+        cfg = object()
+        c.put((1,), cfg, "a")
+        c.put((2,), cfg, "b")
+        assert len(c) == 1
+        assert c.get((1,), cfg) is EncodingCache.MISS
+        assert c.get((2,), cfg) == "b"
+
+    def test_many_config_identities_stay_bounded(self):
+        c = EncodingCache(capacity=4)
+        configs = [object() for _ in range(64)]
+        for i, cfg in enumerate(configs):
+            c.put((7,), cfg, f"enc{i}")  # one rv, many configs
+            assert len(c) <= 4
+        # only the newest survive
+        assert c.get((7,), configs[63]) == "enc63"
+        assert c.get((7,), configs[0]) is EncodingCache.MISS
+
+    def test_put_same_key_replaces(self):
+        c = EncodingCache(capacity=2)
+        cfg = object()
+        c.put((1,), cfg, "a")
+        c.put((1,), cfg, "a2")
+        assert c.get((1,), cfg) == "a2"
+        assert len(c) == 1
+
+    def test_capacity_validated(self):
+        with pytest.raises(ValueError):
+            EncodingCache(capacity=0)
+
+    def test_invalidate_clears(self):
+        c = EncodingCache(capacity=2)
+        cfg = object()
+        c.put((1,), cfg, "a")
+        c.invalidate()
+        assert c.get((1,), cfg) is EncodingCache.MISS
+        assert len(c) == 0
+
+
+class TestDirtySince:
+    def test_added_and_modified(self):
+        s = ResourceStore()
+        rv0 = s.latest_rv()
+        s.apply("nodes", node("n0"))
+        s.apply("pods", pod("a"))
+        s.apply("pods", {"metadata": {"name": "a"}, "spec": {"nodeName": "n0"}})
+        d = s.dirty_since(rv0)
+        assert d["nodes"] == {"n0": "ADDED"}
+        assert d["pods"] == {"default/a": "ADDED"}  # mods fold into ADDED
+
+    def test_modified_only(self):
+        s = ResourceStore()
+        s.apply("pods", pod("a"))
+        rv = s.latest_rv()
+        s.apply("pods", {"metadata": {"name": "a"}, "spec": {"nodeName": "x"}})
+        assert s.dirty_since(rv) == {"pods": {"default/a": "MODIFIED"}}
+
+    def test_deleted_and_transient(self):
+        s = ResourceStore()
+        s.apply("pods", pod("a"))
+        rv = s.latest_rv()
+        s.delete("pods", "a")
+        s.apply("pods", pod("b"))
+        s.delete("pods", "b")
+        d = s.dirty_since(rv)["pods"]
+        assert d["default/a"] == "DELETED"
+        assert d["default/b"] == "TRANSIENT"
+
+    def test_replaced(self):
+        s = ResourceStore()
+        s.apply("pods", pod("a"))
+        rv = s.latest_rv()
+        s.delete("pods", "a")
+        s.apply("pods", pod("a"))
+        assert s.dirty_since(rv)["pods"]["default/a"] == "REPLACED"
+        # replaced then deleted nets to deleted
+        s2 = ResourceStore()
+        s2.apply("pods", pod("a"))
+        rv2 = s2.latest_rv()
+        s2.delete("pods", "a")
+        s2.apply("pods", pod("a"))
+        s2.delete("pods", "a")
+        assert s2.dirty_since(rv2)["pods"]["default/a"] == "DELETED"
+
+    def test_no_changes_is_empty(self):
+        s = ResourceStore()
+        s.apply("pods", pod("a"))
+        assert s.dirty_since(s.latest_rv()) == {}
+
+    def test_stale_raises(self):
+        s = ResourceStore(event_log_capacity=4)
+        s.apply("pods", pod("a"))
+        rv = s.latest_rv()
+        for i in range(16):
+            s.apply("pods", pod(f"p{i}"))
+        with pytest.raises(StaleResourceVersion):
+            s.dirty_since(rv)
+
+    def test_readd_moves_key_to_end_of_iteration_order(self):
+        # add a, add b, delete a, re-add a: the store iterates [b, a],
+        # and the delta encoder appends rows in dirty-dict order — the
+        # dict must agree with the store (regression: the key used to
+        # keep its first-event slot, encoding a before b)
+        s = ResourceStore()
+        rv = s.latest_rv()
+        s.apply("pods", pod("a"))
+        s.apply("pods", pod("b"))
+        s.delete("pods", "a")
+        s.apply("pods", pod("a"))
+        d = s.dirty_since(rv)["pods"]
+        assert list(d) == ["default/b", "default/a"], d
+        assert d == {"default/b": "ADDED", "default/a": "ADDED"}
+        assert [p["metadata"]["name"] for p in s.list("pods")] == ["b", "a"]
+
+    def test_cascade_deletes_are_recorded(self):
+        s = ResourceStore()
+        s.apply("nodes", node("n0"))
+        s.apply("pods", pod("a", node_name="n0"))
+        rv = s.latest_rv()
+        s.delete("nodes", "n0")  # cascades the bound pod away
+        d = s.dirty_since(rv)
+        assert d["nodes"]["n0"] == "DELETED"
+        assert d["pods"]["default/a"] == "DELETED"

@@ -1,0 +1,165 @@
+"""Churn-run performance wiring: the acceptance-criteria assertions for
+the incremental encoding PR.
+
+A lifecycle run with STABLE bucket occupancy (pod count stays inside one
+capacity bucket, pending queue inside one queue bucket) must, after the
+warm-up pass:
+
+  * never re-compile — exactly one engine build for the whole timeline
+    (`phases.engineBuilds`), and
+  * never full-re-encode — exactly one full encode (the cold start),
+    every later pass served by the delta path (`phases.deltaEncodes`).
+
+Also covers the phase-timing breakdown plumbing end-to-end (service →
+SchedulingMetrics → lifecycle result → metrics API shape).
+"""
+
+from __future__ import annotations
+
+from kube_scheduler_simulator_tpu.lifecycle.engine import LifecycleEngine
+from kube_scheduler_simulator_tpu.scenario.chaos import ChaosSpec
+
+from helpers import node, pod
+
+
+def _churn_spec(mode: str, *, n_nodes=6, seed_pods=33, arrivals=18) -> ChaosSpec:
+    nodes = [node(f"n{i}", cpu="32", mem="64Gi", pods="110") for i in range(n_nodes)]
+    # pre-bound pods hold the pod count inside ONE capacity bucket for the
+    # whole run: the first encode sees 34 pods → bucket 64, and
+    # 33 + 18 arrivals = 51 ≤ 64 — no crossing, so the cold start is the
+    # only full encode and the only compile
+    pods = [
+        pod(f"seed-{i}", cpu="100m", node_name=f"n{i % n_nodes}")
+        for i in range(seed_pods)
+    ]
+    return ChaosSpec.from_dict(
+        {
+            "name": f"churn-{mode}",
+            "seed": 11,
+            "horizon": 60.0,
+            "schedulerMode": mode,
+            "snapshot": {"nodes": nodes, "pods": pods},
+            "arrivals": [
+                {
+                    "kind": "poisson",
+                    "rate": 0.8,
+                    "count": arrivals,
+                    "template": {
+                        "metadata": {"name": "churn"},
+                        "spec": {
+                            "containers": [
+                                {
+                                    "name": "c",
+                                    "resources": {
+                                        "requests": {
+                                            "cpu": "100m",
+                                            "memory": "64Mi",
+                                        }
+                                    },
+                                }
+                            ]
+                        },
+                    },
+                }
+            ],
+        }
+    )
+
+
+def _run(mode: str):
+    eng = LifecycleEngine(_churn_spec(mode))
+    res = eng.run()
+    assert res["phase"] == "Succeeded", res
+    return eng, res
+
+
+class TestWarmChurnIsIncremental:
+    def test_gang_zero_recompiles_zero_full_reencodes_after_warmup(self):
+        eng, res = _run("gang")
+        phases = res["metrics"]["phases"]
+        # the cold start is the ONLY full encode and the ONLY build
+        assert phases["fullEncodes"] == 1, phases
+        assert phases["engineBuilds"] == 1, phases
+        # and the delta path actually carried the run
+        assert phases["deltaEncodes"] >= 10, phases
+        # every arrival got scheduled (the run did real work)
+        assert res["pods"]["arrived"] >= 10
+        pending = [
+            p
+            for p in eng.store.list("pods")
+            if not (p.get("spec") or {}).get("nodeName")
+        ]
+        assert not pending
+
+    def test_sequential_zero_recompiles_zero_full_reencodes_after_warmup(self):
+        eng, res = _run("sequential")
+        phases = res["metrics"]["phases"]
+        assert phases["fullEncodes"] == 1, phases
+        # the sequential scan bakes the BUCKETED queue length; a small
+        # steady churn stays in the lowest bucket → one build
+        assert phases["engineBuilds"] == 1, phases
+        assert phases["deltaEncodes"] >= 10, phases
+
+    def test_phase_seconds_populated(self):
+        _, res = _run("gang")
+        phases = res["metrics"]["phases"]
+        assert phases["encodeSeconds"] > 0
+        assert phases["compileSeconds"] >= 0
+        assert phases["executeSeconds"] > 0
+        assert phases["decodeSeconds"] >= 0
+
+    def test_timings_carry_encode_mode(self):
+        eng, _ = _run("gang")
+        modes = {t.get("encodeMode") for t in eng.timings}
+        assert "delta" in modes, eng.timings
+        # the trace itself stays deterministic: no encode mode leaks in
+        assert not any("encodeMode" in e for e in eng.trace)
+
+
+class TestMetricsApiShape:
+    def test_snapshot_exposes_phdi_block(self):
+        from kube_scheduler_simulator_tpu.utils.metrics import SchedulingMetrics
+
+        m = SchedulingMetrics()
+        m.record_encode("full", 0.25)
+        m.record_encode("delta", 0.01)
+        m.record_encode("cached", 0.0)
+        m.record_encode("empty", 0.0)
+        m.record_engine_build(1.5)
+        m.record_phase_seconds(execute=0.5, decode=0.125)
+        snap = m.snapshot()["phases"]
+        assert snap["fullEncodes"] == 1
+        assert snap["deltaEncodes"] == 1
+        assert snap["cachedEncodes"] == 1
+        assert snap["emptyEncodes"] == 1
+        assert snap["engineBuilds"] == 1
+        assert snap["encodeSeconds"] == 0.26
+        assert snap["compileSeconds"] == 1.5
+        assert snap["executeSeconds"] == 0.5
+        assert snap["decodeSeconds"] == 0.125
+        m.reset()
+        snap = m.snapshot()["phases"]
+        assert snap["fullEncodes"] == 0 and snap["encodeSeconds"] == 0.0
+
+    def test_http_metrics_route_carries_phases(self):
+        import json
+        import urllib.request
+
+        from kube_scheduler_simulator_tpu.server.httpserver import SimulatorServer
+        from kube_scheduler_simulator_tpu.server.service import SimulatorService
+
+        server = SimulatorServer(SimulatorService(), port=0).start()
+        try:
+            svc = server.service
+            svc.store.apply("nodes", node("n0"))
+            svc.store.apply("pods", pod("p0"))
+            svc.scheduler.schedule()
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/api/v1/metrics"
+            ) as resp:
+                snap = json.loads(resp.read())
+            assert "phases" in snap
+            assert snap["phases"]["fullEncodes"] >= 1
+            assert snap["phases"]["encodeSeconds"] > 0
+        finally:
+            server.shutdown()

@@ -1,0 +1,141 @@
+"""`make perf-smoke`: tiny CPU-only lifecycle throughput sanity check.
+
+Runs a small seeded churn timeline (Poisson arrivals + a cordon flap
+against a 6-node cluster) through the full service stack — store events,
+delta encoder, compiled engine — and asserts the wiring that makes churn
+O(Δ) actually engaged:
+
+  * the run Succeeds and schedules pods;
+  * the delta encoder took over after warm-up (deltaEncodes > 0, and
+    fullEncodes stays at the warm-up handful);
+  * the phase-timing breakdown is populated (encode/execute seconds).
+
+One JSON line on stdout (the bench.py contract); exit 0 on pass. Small
+enough for tier-1 (seconds, CPU-only) — this is a sanity gate, not a
+measurement; `python bench.py` owns the numbers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # runnable from a bare checkout: the package lives at the repo root
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from kube_scheduler_simulator_tpu.lifecycle.engine import LifecycleEngine
+    from kube_scheduler_simulator_tpu.scenario.chaos import ChaosSpec
+    from kube_scheduler_simulator_tpu.utils.compilecache import (
+        enable_compile_cache,
+    )
+
+    enable_compile_cache()
+    nodes = [
+        {
+            "metadata": {"name": f"n{i}"},
+            "status": {
+                "allocatable": {"cpu": "16", "memory": "32Gi", "pods": "110"}
+            },
+        }
+        for i in range(6)
+    ]
+    # pre-bound seed pods hold the pod count inside ONE capacity bucket
+    # for the whole run (first encode at 34 pods → bucket 64; 33 + 30
+    # arrivals = 63 ≤ 64): the cold start is the only full encode
+    seed_pods = [
+        {
+            "metadata": {"name": f"seed-{i}"},
+            "spec": {
+                "nodeName": f"n{i % 6}",
+                "containers": [
+                    {
+                        "name": "c",
+                        "resources": {
+                            "requests": {"cpu": "100m", "memory": "64Mi"}
+                        },
+                    }
+                ],
+            },
+        }
+        for i in range(33)
+    ]
+    spec = ChaosSpec.from_dict(
+        {
+            "name": "perf-smoke",
+            "seed": 7,
+            "horizon": 40.0,
+            "schedulerMode": "gang",
+            "snapshot": {"nodes": nodes, "pods": seed_pods},
+            "arrivals": [
+                {
+                    "kind": "poisson",
+                    "rate": 1.5,
+                    "count": 30,
+                    "template": {
+                        "metadata": {"name": "churn"},
+                        "spec": {
+                            "containers": [
+                                {
+                                    "name": "c",
+                                    "resources": {
+                                        "requests": {
+                                            "cpu": "100m",
+                                            "memory": "64Mi",
+                                        }
+                                    },
+                                }
+                            ]
+                        },
+                    },
+                }
+            ],
+            "faults": [
+                {"at": 10.0, "action": "cordon", "node": "n0"},
+                {"at": 20.0, "action": "uncordon", "node": "n0"},
+            ],
+        }
+    )
+    eng = LifecycleEngine(spec)
+    result = eng.run()
+    snap = result["metrics"]
+    phases = snap.get("phases", {})
+    wall = result["wallSeconds"]
+    line = {
+        "config": "perf_smoke",
+        "phase": result["phase"],
+        "events": result["events"],
+        "passes": result["passes"],
+        "arrived": result["pods"]["arrived"],
+        "events_per_s": round(result["events"] / wall, 1) if wall > 0 else 0.0,
+        "delta_encodes": phases.get("deltaEncodes", 0),
+        "full_encodes": phases.get("fullEncodes", 0),
+        "engine_builds": phases.get("engineBuilds", 0),
+        "encode_s": phases.get("encodeSeconds", 0.0),
+        "execute_s": phases.get("executeSeconds", 0.0),
+    }
+    print(json.dumps(line), flush=True)
+    problems = []
+    if result["phase"] != "Succeeded":
+        problems.append(f"run phase {result['phase']!r}")
+    if result["pods"]["arrived"] < 10:
+        problems.append("timeline produced too few arrivals")
+    if not phases:
+        problems.append("phase-timing breakdown missing from metrics")
+    if phases.get("deltaEncodes", 0) == 0:
+        problems.append("delta encoder never engaged")
+    if phases.get("fullEncodes", 0) > 3:
+        problems.append(
+            f"too many full re-encodes ({phases.get('fullEncodes')}) for a "
+            "stable churn timeline"
+        )
+    if problems:
+        print("perf-smoke FAILED: " + "; ".join(problems), file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
