@@ -385,7 +385,7 @@ def _gang_sweep_probe(shape: str = "bench", window: "int | None" = None):
         print(json.dumps({**result, **extra}), flush=True)
 
 
-def _lifecycle_probe(events: int = 300, n_nodes: int = 64, seed_pods: int = 520):
+def _lifecycle_probe(events: int = 300, n_nodes: int = 64, seed_pods: int = 500):
     """Subprocess mode (`bench.py --lifecycle-probe`): the churn-heavy
     lifecycle measurement — a seeded Poisson arrival storm (plus cordon
     flaps) against a pre-loaded cluster, driven through the full service
@@ -394,8 +394,10 @@ def _lifecycle_probe(events: int = 300, n_nodes: int = 64, seed_pods: int = 520)
     the encode-time fraction: before the incremental encoder, encode
     dominated this wall-clock; now steady-state passes are O(Δ). One
     JSON line, same contract as the other probes. Sized to stay inside
-    one capacity bucket (seed 520 + 300 arrivals < 1024) so the warm run
-    measures the steady state, not bucket crossings.
+    one capacity bucket AND below its 80% speculation watermark
+    (seed 500 + 300 arrivals = 800 < 819) so the warm run measures the
+    steady state — no bucket crossing, and no background speculative
+    compile competing for the box during the measurement.
 
     Pinned to the CPU backend: the measurement is host-path throughput,
     and the parent launches this probe with device=False (timeout =>
@@ -443,6 +445,10 @@ def _lifecycle_probe(events: int = 300, n_nodes: int = 64, seed_pods: int = 520)
             "seed": 42,
             "horizon": 10_000.0,
             "schedulerMode": "gang",
+            # the async pipelined dispatch (byte-identical trace,
+            # parity-pinned): device execution overlaps host-side event
+            # application, decode is one batched device transfer
+            "pipeline": "async",
             "snapshot": {"nodes": nodes, "pods": pods},
             "arrivals": [
                 {
@@ -500,6 +506,13 @@ def _lifecycle_probe(events: int = 300, n_nodes: int = 64, seed_pods: int = 520)
         "delta_encodes": phases["deltaEncodes"],
         "full_encodes": phases["fullEncodes"],
         "engine_builds": phases["engineBuilds"],
+        "pipeline": "async",
+        # compile-broker counters (utils/broker.py): serving-thread
+        # compile stalls vs broker-warm passes vs background compiles
+        "compile_hits": phases["compileHits"],
+        "compile_misses": phases["compileMisses"],
+        "speculative_compiles": phases["speculativeCompiles"],
+        "stall_seconds": phases["stallSeconds"],
     }
     print(json.dumps(line), flush=True)
 

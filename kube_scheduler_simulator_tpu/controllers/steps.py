@@ -65,6 +65,10 @@ def deployment_controller_step(store: ResourceStore) -> bool:
     is no ambient owner-reference GC (the reference's controller subset
     runs no garbage collector either, controller.go:77-86), so imported
     pods carrying ownerReferences to absent ReplicaSets are left alone."""
+    if store.count("deployments") == 0:
+        # nothing to reconcile — and the churn-heavy lifecycle loop runs
+        # this every event, so the count probe (no deep copies) matters
+        return False
     changed = False
     # list once, index by owner (store.list deep-copies; per-object
     # re-listing would make a round O(objects^2) in copies)
@@ -147,6 +151,10 @@ def replicaset_controller_step(store: ResourceStore) -> bool:
     """One reconcile round: each ReplicaSet owns pods named <rs>-<i>;
     scale up fills the lowest free ordinals, scale down deletes the
     highest ones (deterministic victim choice)."""
+    if store.count("replicasets") == 0:
+        # the pod listing below deep-copies the whole cluster — skip it
+        # outright when no ReplicaSet exists (the lifecycle loop's case)
+        return False
     changed = False
     # list once; index pods by (ns, name) and by owning ReplicaSet.
     # Pods whose owner ReplicaSet no longer exists are LEFT ALONE: the
@@ -235,6 +243,8 @@ def pv_controller_step(store: ResourceStore) -> bool:
     from ..sched.oracle_plugins import _static_pv_matches
     from ..utils.quantity import parse_quantity
 
+    if store.count("pvcs") == 0 or store.count("pvs") == 0:
+        return False
     changed = False
     pvs = store.list("pvs")
     all_pvcs = sorted(

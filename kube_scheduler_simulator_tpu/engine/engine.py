@@ -32,6 +32,7 @@ from ..sched.results import (
     PodSchedulingResult,
     record_bind_points,
 )
+from ..utils import broker as broker_mod
 from . import kernels as K
 from .encode import EncodedCluster
 
@@ -226,13 +227,15 @@ class BatchedScheduler:
         # -> (final_state, trace). Exposed for the graft entry point, for
         # vmap over weight variants (Monte-Carlo), and for mesh-sharded jit.
         self.run_fn = self._build_run()
-        self._run = jax.jit(self.run_fn)
-        self._run_segment = jax.jit(self._run_segment_fn)
+        # jits route through the broker module: the persistent compile
+        # cache is armed before the first lowering (utils/broker.py)
+        self._run = broker_mod.jit(self.run_fn)
+        self._run_segment = broker_mod.jit(self._run_segment_fn)
         # single-pod segments for host-callback (extender) scheduling
-        self.attempt_fn = jax.jit(
+        self.attempt_fn = broker_mod.jit(
             lambda arrays, state, weights, p: self._attempt(state, arrays, weights, p)
         )
-        self.bind_fn = jax.jit(
+        self.bind_fn = broker_mod.jit(
             lambda arrays, state, p, sel, qi: self._bind(state, arrays, p, sel, qi)
         )
         self._trace = None
@@ -603,6 +606,16 @@ class BatchedScheduler:
         self._trace = out
         return state, out
 
+    def warmup(self) -> "BatchedScheduler":
+        """Compile the main program by executing one pass, then drop the
+        result — the CompileBroker's speculative-build contract: a later
+        pass at an equal compile signature `retarget`s onto this instance
+        and runs warm (zero XLA compile on the serving thread)."""
+        self.run()
+        self._trace = None
+        self._final_state = None
+        return self
+
     def run_chunked(self, chunk: int = 64, weights: "jnp.ndarray | None" = None):
         """Execute the scan in queue segments, offloading each segment's
         trace to host memory — the at-scale `record=True` strategy.
@@ -646,20 +659,28 @@ class BatchedScheduler:
             state, out = self._run_segment(enc.arrays, state, qseg, qis, w)
             out = list(out) if isinstance(out, (tuple, list)) else [out]
             # fired-row indices first: event-free chunks transfer nothing
-            # from the big per-attempt slots, and per-row device gathers
-            # produce owned host copies (no view pinning the whole chunk)
+            # from the big per-attempt slots, and the sparse-slot gathers
+            # keep host memory proportional to fired rows, not P x N x P
             fired = (
                 np.nonzero(np.asarray(out[TRACE_DID_SLOT]))[0] if has_pf else ()
             )
+            to_fetch: dict[int, object] = {}
             for j, x in enumerate(out):
                 if j in sparse_slots:
                     if j not in zero_spec:
                         zero_spec[j] = (tuple(x.shape[1:]), np.dtype(str(x.dtype)))
                     if len(fired):
-                        # one batched gather + transfer per slot per chunk
-                        rows = np.asarray(x[jnp.asarray(fired)])
-                        for r, k in enumerate(fired):
-                            sparse[j][i + int(k)] = rows[r]
+                        to_fetch[j] = x[jnp.asarray(fired)]
+                else:
+                    to_fetch[j] = x
+            # ONE device_get per chunk for the whole trace pytree (plus
+            # the `did` probe above) instead of one host sync per slot —
+            # the chunked-run decode batching the perf_opt PR pins
+            host = jax.device_get(to_fetch)
+            for j, x in host.items():
+                if j in sparse_slots:
+                    for r, k in enumerate(fired):
+                        sparse[j][i + int(k)] = x[r]
                 else:
                     dense[j].append(np.asarray(x))
         trace = []
@@ -777,15 +798,25 @@ class BatchedScheduler:
             self.run()
         enc = self.enc
         has_pf = self._preempt is not None
-        cvt = lambda x: x if isinstance(x, _SparseRows) else np.asarray(x)  # noqa: E731
+        # one batched device_get for every on-device trace tensor (a
+        # full `run()` leaves all of them on device; `run_chunked` has
+        # already landed them host-side) — not one sync per slot
+        vals = list(self._trace)
+        dev_idx = [
+            i
+            for i, x in enumerate(vals)
+            if not isinstance(x, (_SparseRows, np.ndarray))
+        ]
+        if dev_idx:
+            fetched = jax.device_get([vals[i] for i in dev_idx])
+            for i, v in zip(dev_idx, fetched):
+                vals[i] = np.asarray(v)
         if has_pf:
             (pf_codes, codes, raw, final, sel, did, pcode, vmask, nominated,
              codes2, raw2, final2, sel2, pcode2, vmask2, nominated2,
-             final_sel) = (cvt(x) for x in self._trace)
+             final_sel) = vals
         else:
-            pf_codes, codes, raw, final, sel = (
-                cvt(x) for x in self._trace
-            )
+            pf_codes, codes, raw, final, sel = vals
             final_sel = sel
         results = []
         # bind chronology for victim-ordering (mirrors state.bound_seq)

@@ -39,6 +39,7 @@ from ..sched.results import (
     record_bind_points,
 )
 from ..sched.extender import ExtenderError, ExtenderService
+from ..utils import broker as broker_mod
 from . import kernels as K
 from .engine import BatchedScheduler
 from .encode import EncodedCluster
@@ -64,10 +65,10 @@ class ExtenderScheduler:
         # kernel and the batched eviction, jitted once like
         # attempt_fn/bind_fn
         if self.sched._preempt is not None:
-            self.preempt_fn = jax.jit(
+            self.preempt_fn = broker_mod.jit(
                 lambda arrays, state, p: self.sched._preempt(arrays, state, p)
             )
-            self.evict_fn = jax.jit(
+            self.evict_fn = broker_mod.jit(
                 lambda arrays, state, mask: self.sched._evict_all(
                     state, arrays, mask
                 )

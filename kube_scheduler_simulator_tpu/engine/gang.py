@@ -90,6 +90,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import broker as broker_mod
 from . import kernels as K
 from .encode import EncodedCluster
 from .engine import BatchedScheduler
@@ -374,9 +375,9 @@ class GangScheduler:
         self.weights = self._base.weights
         self.max_rounds = max_rounds
         self.run_fn = self._build_run()
-        self._run = jax.jit(self.run_fn)
+        self._run = broker_mod.jit(self.run_fn)
         self._preempt_phase = (
-            jax.jit(self.preempt_phase_fn)
+            broker_mod.jit(self.preempt_phase_fn)
             if self.preempt_phase_fn is not None
             else None
         )
@@ -1165,6 +1166,23 @@ class GangScheduler:
         the host loop stops when a phase binds nothing."""
         return self._drive(weights, chronology=None)
 
+    def warmup(self, record: bool = False) -> "GangScheduler":
+        """Compile the fixpoint program (and, with `record=True`, the
+        bind-round-tracking variant) by executing one full drive, then
+        drop the result — the CompileBroker's speculative-build contract:
+        a later pass at an equal compile signature `retarget`s onto this
+        instance and runs warm (zero XLA compile on the serving thread)."""
+        if record:
+            self.run_recorded()
+        else:
+            self.run()
+        self._final_state = None
+        self._rounds = None
+        self._chronology = None
+        self._trace = None
+        self._recorded_weights = None
+        return self
+
     def _drive(self, weights, chronology: "list | None"):
         """The ONE host driver behind `run()` and `run_recorded()`:
         gang passes (with the static auto-resume rule) alternating with
@@ -1180,7 +1198,7 @@ class GangScheduler:
         arrays = self.enc.arrays
         tracked = chronology is not None
         if tracked and self._run_tracked is None:
-            self._run_tracked = jax.jit(self.run_tracked_fn)
+            self._run_tracked = broker_mod.jit(self.run_tracked_fn)
         # the eligibility mask feeds host-side pending counts, which only
         # the static auto-resume, the preempt-phase loop, and the record
         # path read — the plain dynamic path must not pay the two [P]
@@ -1354,7 +1372,7 @@ class GangScheduler:
             # ONE compiled chunk evaluator for every round/leftover pod;
             # chunks are padded by repeating the first pod (evaluation
             # is read-only, duplicates are discarded host-side)
-            self._eval_rec = jax.jit(
+            self._eval_rec = broker_mod.jit(
                 jax.vmap(rec._attempt, in_axes=(None, None, None, 0))
             )
         CH = max(1, min(128, P))
@@ -1381,7 +1399,7 @@ class GangScheduler:
                             final_sel[qi] = committed
 
         state = enc.state0
-        bind_all_j = jax.jit(self._bind_all)
+        bind_all_j = broker_mod.jit(self._bind_all)
         for entry in self._chronology:
             kind = entry[0]
             if kind == "rounds":

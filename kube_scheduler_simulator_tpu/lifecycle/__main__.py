@@ -41,6 +41,11 @@ def main(argv: "list[str] | None" = None) -> int:
         "--trace-out", help="write the replayable JSONL event trace here"
     )
     ap.add_argument(
+        "--pipeline", choices=("sync", "async"), default=None,
+        help="override the spec's dispatch pipeline: async overlaps "
+        "device execution with event application (byte-identical trace)",
+    )
+    ap.add_argument(
         "--sweep", type=int, default=0, metavar="S",
         help="also run a vmapped fault sweep over S sampled scenarios",
     )
@@ -54,7 +59,7 @@ def main(argv: "list[str] | None" = None) -> int:
     from .engine import LifecycleEngine
 
     spec = ChaosSpec.from_dict(_load_spec(args.spec))
-    engine = LifecycleEngine(spec)
+    engine = LifecycleEngine(spec, pipeline=args.pipeline)
     result = engine.run()
     if args.trace_out:
         with open(args.trace_out, "w") as f:

@@ -28,6 +28,29 @@ The trace is replayable: each line carries the simulated time, the event
 that fired, and the store-visible consequence — feeding it back through
 `ChaosSpec`-less scenario tooling (or diffing two runs) needs nothing
 but the JSONL.
+
+Async pipeline mode (``pipeline="async"``, the perf_opt tentpole): a
+scheduling pass is split at the service's dispatch/resolve seam
+(`SchedulerService.begin_pass`/`begin_gang_pass`). The engine dispatches
+pass *k* and, while its device program executes, applies the NEXT
+timeline events and emits their trace records; the deferred tail —
+result decode (one batched `jax.device_get` of the assignment diff),
+store write-backs, disruption accounting, the `SchedulingPass` trace
+record — runs at the resolve point. Soundness fences keep the semantics
+exactly the synchronous ones:
+
+  * any fault event resolves the in-flight pass first (faults read
+    binding state: `pods_on_node`, cordon/taint interactions);
+  * an arrival whose pod name already exists in the store resolves
+    first (an overwrite would race the deferred write-backs);
+  * controllers and the next encode run only after resolution (they
+    must see the pass's bindings).
+
+The `SchedulingPass` record is appended as a PLACEHOLDER slot at
+dispatch and filled in place at resolve, so the trace's total order is
+the synchronous order and the JSONL is byte-identical (parity-pinned in
+tests/test_async_pipeline.py); its `pending` count is derived as
+`pending-at-dispatch - scheduled`, which the fences above make exact.
 """
 
 from __future__ import annotations
@@ -91,8 +114,19 @@ class LifecycleEngine:
         store: "ResourceStore | None" = None,
         metrics: "metrics_mod.SchedulingMetrics | None" = None,
         max_controller_rounds: int = 100,
+        pipeline: "str | None" = None,
     ):
         self.spec = spec
+        # "sync" | "async" (None → the spec's choice): see module
+        # docstring — async overlaps device execution with host-side
+        # event application under the byte-identical-trace contract
+        self.pipeline = pipeline if pipeline is not None else spec.pipeline
+        if self.pipeline not in ("sync", "async"):
+            raise ValueError(
+                f"pipeline must be sync|async, got {self.pipeline!r}"
+            )
+        # the in-flight dispatched pass (async mode; at most one)
+        self._inflight: "dict | None" = None
         self.store = store or ResourceStore()
         if spec.snapshot:
             _, errors = import_snapshot(self.store, spec.snapshot)
@@ -229,8 +263,14 @@ class LifecycleEngine:
 
     def _converge(self, t: float) -> None:
         """Controllers to fixpoint, one scheduling pass, disruption
-        accounting — step 2+3 of the event loop."""
+        accounting — step 2+3 of the event loop. In async mode the pass
+        is DISPATCHED here (after resolving any in-flight predecessor)
+        and resolved later — at the next fence or the next converge."""
+        self._resolve_inflight()  # controllers + encode need its bindings
         run_to_fixpoint(self.store, CONTROLLERS, self.max_controller_rounds)
+        if self.pipeline == "async":
+            self._dispatch_pass(t)
+            return
         t0 = time.perf_counter()
         if self.spec.scheduler_mode == "gang":
             placements, _, _ = self.scheduler.schedule_gang(
@@ -243,36 +283,18 @@ class LifecycleEngine:
         wall = time.perf_counter() - t0
 
         # which evicted pods found a node (or vanished) this pass
-        rescheduled: list[str] = []
-        times: list[float] = []
-        for key in sorted(self._evicted_at):
-            pod = self.store.get("pods", key[1], key[0])
-            if pod is None:
-                # deleted while pending (preemption victim, node cascade)
-                del self._evicted_at[key]
-                self._lost += 1
-                self._record("EvictedPodLost", t, pod=f"{key[0]}/{key[1]}")
-                continue
-            if (pod.get("spec") or {}).get("nodeName"):
-                tts = t - self._evicted_at.pop(key)
-                self._tts.append(tts)
-                times.append(tts)
-                rescheduled.append(f"{key[0]}/{key[1]}")
-                self._rescheduled += 1
+        rescheduled, times, lost = self._disruption_scan(t)
+        for rec in lost:
+            self.trace.append(rec)
         if rescheduled:
             self.scheduler.metrics.record_disruption(
                 rescheduled=len(rescheduled), times_to_reschedule_s=times
             )
-        pending = sum(
-            1
-            for p in self.store.list("pods")
-            if not (p.get("spec") or {}).get("nodeName")
-        )
         self._record(
             "SchedulingPass", t,
             mode=self.spec.scheduler_mode,
             scheduled=scheduled,
-            pending=pending,
+            pending=self.store.count_pending_pods(),
             rescheduled=rescheduled,
         )
         # wall latency + which encode path served the pass (delta / full
@@ -284,6 +306,126 @@ class LifecycleEngine:
         if info:
             timing["encodeMode"] = info["mode"]
         self.timings.append(timing)
+
+    def _disruption_scan(self, t: float):
+        """Which evicted pods found a node (or vanished) this pass —
+        shared by the sync pass tail and the async resolve. Returns
+        (rescheduled names, their times-to-reschedule, EvictedPodLost
+        trace records for the caller to place)."""
+        rescheduled: list[str] = []
+        times: list[float] = []
+        lost: list[dict] = []
+        for key in sorted(self._evicted_at):
+            pod = self.store.get("pods", key[1], key[0])
+            if pod is None:
+                # deleted while pending (preemption victim, node cascade)
+                del self._evicted_at[key]
+                self._lost += 1
+                lost.append(
+                    {
+                        "type": "EvictedPodLost",
+                        "t": round(float(t), 9),
+                        "pod": f"{key[0]}/{key[1]}",
+                    }
+                )
+                continue
+            if (pod.get("spec") or {}).get("nodeName"):
+                tts = t - self._evicted_at.pop(key)
+                self._tts.append(tts)
+                times.append(tts)
+                rescheduled.append(f"{key[0]}/{key[1]}")
+                self._rescheduled += 1
+        return rescheduled, times, lost
+
+    # -- async pipeline -----------------------------------------------------
+
+    def _dispatch_pass(self, t: float) -> None:
+        """Dispatch one scheduling pass and defer its tail. The
+        SchedulingPass trace record is appended NOW as a placeholder
+        slot (filled in place at resolve), so later event records land
+        after it and the total order matches the synchronous trace."""
+        t0 = time.perf_counter()
+        if self.spec.scheduler_mode == "gang":
+            handle = self.scheduler.begin_gang_pass(
+                record=False, window=self.spec.window
+            )
+        else:
+            handle = self.scheduler.begin_pass()
+        slot: dict = {}
+        self.trace.append(slot)
+        timing: dict = {"t": t}
+        self.timings.append(timing)
+        self._inflight = {
+            "handle": handle,
+            "t": t,
+            "t0": t0,
+            "slot": slot,
+            "slot_index": len(self.trace) - 1,
+            "timing": timing,
+            # counted BEFORE write-backs: resolve derives the post-pass
+            # pending count as (this - scheduled), exact under the
+            # pipeline's fences (no deletes/overwrites while in flight)
+            "pending_before": self.store.count_pending_pods(),
+        }
+
+    def _resolve_inflight(self) -> None:
+        """Finish the in-flight pass: deferred decode + write-backs
+        (handle.resolve), disruption accounting, and the placeholder
+        SchedulingPass record filled in place."""
+        fl = self._inflight
+        if fl is None:
+            return
+        self._inflight = None
+        scheduled = fl["handle"].resolve()
+        t = fl["t"]
+        rescheduled, times, lost = self._disruption_scan(t)
+        if lost:
+            # EvictedPodLost records precede the SchedulingPass record in
+            # the synchronous trace; the slot keeps its identity (filled
+            # by reference), later-appended event records keep theirs
+            idx = fl["slot_index"]
+            self.trace[idx:idx] = lost
+        if rescheduled:
+            self.scheduler.metrics.record_disruption(
+                rescheduled=len(rescheduled), times_to_reschedule_s=times
+            )
+        fl["slot"].update(
+            {
+                "type": "SchedulingPass",
+                "t": round(float(t), 9),
+                "mode": self.spec.scheduler_mode,
+                "scheduled": scheduled,
+                "pending": fl["pending_before"] - scheduled,
+                "rescheduled": rescheduled,
+            }
+        )
+        fl["timing"]["wallSeconds"] = round(
+            time.perf_counter() - fl["t0"], 6
+        )
+        info = fl["handle"].encode_info
+        if info:
+            fl["timing"]["encodeMode"] = info["mode"]
+
+    def _abandon_inflight(self) -> None:
+        """Error-path cleanup: release the pass lock without write-backs
+        and drop the unfilled placeholder slot/timing."""
+        fl = self._inflight
+        if fl is None:
+            return
+        self._inflight = None
+        fl["handle"].abandon()
+        self.trace = [e for e in self.trace if e is not fl["slot"]]
+        self.timings = [x for x in self.timings if x is not fl["timing"]]
+
+    def _arrival_conflicts(self, payload: dict) -> bool:
+        """True when an arrival must fence the in-flight pass: a pod
+        name already present in the store would OVERWRITE (racing the
+        deferred write-backs and the eviction bookkeeping)."""
+        for p in payload.get("pods", ()):
+            ns, name = _pod_key(p)
+            if self.store.contains("pods", name, ns):
+                return True
+        return False
 
     # -- the loop -----------------------------------------------------------
 
@@ -306,34 +448,52 @@ class LifecycleEngine:
             while heap:
                 t, _, kind, payload = heapq.heappop(heap)
                 end_t = max(end_t, t)
-                if kind == "arrival":
-                    self._apply_arrival(t, payload)
-                else:
-                    self._apply_fault(t, dict(payload))
                 # batch events sharing a timestamp into one convergence
                 # (they are simultaneous in simulated time)
+                batch = [(kind, payload)]
                 while heap and heap[0][0] == t:
                     _, _, kind2, payload2 = heapq.heappop(heap)
-                    if kind2 == "arrival":
-                        self._apply_arrival(t, payload2)
+                    batch.append((kind2, payload2))
+                for ev_kind, ev_payload in batch:
+                    if ev_kind == "arrival":
+                        # arrivals overlap the in-flight pass UNLESS the
+                        # pod name collides with an existing store pod
+                        # (an overwrite would race the deferred
+                        # write-backs) — the async pipeline's fence
+                        if self._inflight is not None and self._arrival_conflicts(
+                            ev_payload
+                        ):
+                            self._resolve_inflight()
+                        self._apply_arrival(t, ev_payload)
                     else:
-                        self._apply_fault(t, dict(payload2))
+                        # faults read binding state (pods_on_node,
+                        # cordon/taint interplay): always fence
+                        self._resolve_inflight()
+                        self._apply_fault(t, dict(ev_payload))
                 self._converge(t)
         except Exception as e:  # noqa: BLE001 — a chaos run's failure is a result
+            self._abandon_inflight()
+            # a resolve that failed mid-flight may leave an unfilled
+            # placeholder slot — drop it, the Abort record is the tail
+            self.trace = [ev for ev in self.trace if ev]
+            self.timings = [x for x in self.timings if "wallSeconds" in x]
             self._record("Abort", end_t, error=f"{type(e).__name__}: {e}")
             return self._result("Failed", end_t, message=f"{type(e).__name__}: {e}")
 
+        try:
+            self._resolve_inflight()
+        except Exception as e:  # noqa: BLE001
+            self.trace = [ev for ev in self.trace if ev]
+            self.timings = [x for x in self.timings if "wallSeconds" in x]
+            self._record("Abort", end_t, error=f"{type(e).__name__}: {e}")
+            return self._result("Failed", end_t, message=f"{type(e).__name__}: {e}")
         # pods still pending from an eviction are reported, never dropped
         unschedulable = sorted(
             f"{ns}/{name}" for ns, name in self._evicted_at
         )
         self._record(
             "End", end_t,
-            pending=sum(
-                1
-                for p in self.store.list("pods")
-                if not (p.get("spec") or {}).get("nodeName")
-            ),
+            pending=self.store.count_pending_pods(),
             unschedulableEvicted=unschedulable,
         )
         return self._result("Succeeded", end_t)

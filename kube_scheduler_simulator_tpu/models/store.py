@@ -255,6 +255,25 @@ class ResourceStore:
         with self._lock:
             return len(self._objs[kind])
 
+    def contains(self, kind: str, name: str, namespace: str = "default") -> bool:
+        """Existence probe without `get`'s deep copy — the async
+        lifecycle pipeline's arrival-collision check."""
+        if kind not in KINDS:
+            raise KeyError(f"unknown kind {kind}")
+        with self._lock:
+            return self.obj_key(kind, name, namespace) in self._objs[kind]
+
+    def count_pending_pods(self) -> int:
+        """Pods without a `spec.nodeName`, counted in place — the
+        lifecycle loop reads this once per event; `list("pods")` would
+        deep-copy the whole cluster for a scalar."""
+        with self._lock:
+            return sum(
+                1
+                for p in self._objs["pods"].values()
+                if not (p.get("spec") or {}).get("nodeName")
+            )
+
     def subscribe(self, fn: Callable[[WatchEvent], None]):
         with self._lock:
             self._subscribers.append(fn)

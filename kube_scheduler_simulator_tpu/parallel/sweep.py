@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..utils import broker as broker_mod
 from ..engine.encode import EncodedCluster
 from ..engine.engine import BatchedScheduler
 from .shard import shard_encoded
@@ -102,19 +103,19 @@ class WeightSweep:
         self.sched = BatchedScheduler(
             enc, record=record, strict=True, preempt_mode="masked"
         )
-        self._vrun = jax.jit(
+        self._vrun = broker_mod.jit(
             jax.vmap(self.sched.run_fn, in_axes=(None, None, None, 0))
         )
         if preempt == "phase":
             until, pre_one = self._build_event_programs()
             # first pass: shared state0/resume; resumes carry [V] state
-            self._vuntil0 = jax.jit(
+            self._vuntil0 = broker_mod.jit(
                 jax.vmap(until, in_axes=(None, None, None, 0, None))
             )
-            self._vuntil = jax.jit(
+            self._vuntil = broker_mod.jit(
                 jax.vmap(until, in_axes=(None, 0, None, 0, 0))
             )
-            self._vpreempt1 = jax.jit(
+            self._vpreempt1 = broker_mod.jit(
                 jax.vmap(pre_one, in_axes=(None, 0, 0, 0, 0, 0))
             )
         if mesh is not None:
@@ -309,15 +310,15 @@ class GangSweep:
             enc, chunk=chunk, compact=False, loop=loop,
             eval_window=eval_window,
         )
-        self._vrun = jax.jit(
+        self._vrun = broker_mod.jit(
             jax.vmap(self.gang.run_fn, in_axes=(None, None, None, 0))
         )
         # resume + phase programs carry per-variant state ([V, ...])
-        self._vrun_resume = jax.jit(
+        self._vrun_resume = broker_mod.jit(
             jax.vmap(self.gang.run_fn, in_axes=(None, 0, None, 0))
         )
         self._vphase = (
-            jax.jit(
+            broker_mod.jit(
                 jax.vmap(
                     self.gang.preempt_phase_fn, in_axes=(None, 0, 0, None, 0)
                 )

@@ -168,6 +168,11 @@ class ChaosSpec:
     scheduler_config: "dict | None" = None
     scheduler_mode: str = "gang"  # "gang" | "sequential"
     window: "int | None" = None  # gang eval_window passthrough
+    # "sync" runs each pass to completion inside its event; "async" is
+    # the double-buffered pipeline (lifecycle/engine.py): device
+    # execution of pass k overlaps host-side event application and trace
+    # emission for k+1. Byte-identical traces either way (parity-tested).
+    pipeline: str = "sync"
     name: str = "chaos"
     extra: dict = field(default_factory=dict, compare=False)
 
@@ -189,6 +194,9 @@ class ChaosSpec:
             not isinstance(window, int) or isinstance(window, bool) or window < 1
         ):
             raise ValueError(f"'window' must be an integer >= 1, got {window!r}")
+        pipeline = d.get("pipeline", "sync")
+        if pipeline not in ("sync", "async"):
+            raise ValueError(f"pipeline must be sync|async, got {pipeline!r}")
         arrivals = tuple(
             ArrivalProcess.from_dict(a, i)
             for i, a in enumerate(d.get("arrivals", []))
@@ -219,6 +227,7 @@ class ChaosSpec:
             scheduler_config=d.get("schedulerConfig"),
             scheduler_mode=mode,
             window=window,
+            pipeline=pipeline,
             name=str(d.get("name", "chaos")),
         )
 

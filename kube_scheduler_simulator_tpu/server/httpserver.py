@@ -219,9 +219,13 @@ def _make_handler(server: SimulatorServer):
                 if rest == ["listwatchresources"] and method == "GET":
                     return self._list_watch(parse_qs(url.query))
                 if rest == ["metrics"] and method == "GET":
-                    return self._json(
-                        200, service.scheduler.metrics.snapshot()
+                    doc = service.scheduler.metrics.snapshot()
+                    # serving-stack configuration alongside the counters:
+                    # the encoding-cache bound (KSS_ENCODING_CACHE_CAP)
+                    doc["encodingCacheCapacity"] = (
+                        service.scheduler.encoding_cache_capacity
                     )
+                    return self._json(200, doc)
                 if rest == ["schedule"] and method == "POST":
                     mode = parse_qs(url.query).get("mode", ["sequential"])[0]
                     if mode not in ("sequential", "gang"):

@@ -1,0 +1,321 @@
+"""CompileBroker — the one owner of engine compilation on the serving path.
+
+BENCH_r05 put the steady-state cost where the kernels no longer are: a
+full-default-set compile is ~30 s against a ~0.03 s warm pass, and every
+shape-bucket crossing in a churn run re-paid that compile *synchronously
+on the request thread*. The broker turns compilation into a managed,
+predictable resource with three jobs:
+
+  1. **Dedupe** — concurrent requests for the same (program, bucket) key
+     resolve to ONE build: the first caller compiles, everyone else
+     blocks on the in-flight build and shares the result (unit-tested:
+     two threads, one compile).
+  2. **Persistent-cache routing** — every engine jit in the repo goes
+     through `broker.jit`, which arms the repo-local persistent XLA
+     compile cache (utils/compilecache.py) before the first lowering, so
+     repeat compiles of identical programs are disk hits across
+     processes and sessions.
+  3. **Prediction** — `speculate()` runs compile work on a background
+     worker thread. The serving layer arms it when live object counts
+     drift past a watermark of the current shape bucket
+     (`adjacent_bucket_targets`, default 80%), so a bucket crossing
+     finds a warm executable in the broker instead of stalling the
+     request thread for the full XLA compile.
+
+Accounting (surfaced through `SchedulingMetrics.record_compile` into the
+`/api/v1/metrics` phases block and the bench headline):
+
+  * ``compileHits``           — requests served from the warm-engine map
+                                (including waits on an in-flight build:
+                                the caller did not compile);
+  * ``compileMisses``         — request-thread builds (the synchronous
+                                compile the tentpole eliminates from the
+                                steady state);
+  * ``speculativeCompiles``   — background builds completed;
+  * ``stallSeconds``          — request-thread seconds blocked on any
+                                compile (own miss builds + in-flight
+                                waits).
+
+``KSS_NO_SPECULATIVE_COMPILE=1`` disables the background worker for
+deterministic profiling (docs/performance.md); dedupe and the warm-engine
+map stay on.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from .compilecache import enable_compile_cache, shape_bucket
+
+_jit_cache_armed = False
+
+
+def jit(fn, **kw):
+    """`jax.jit` with the persistent compile cache armed first — the
+    single jit entry point for the engines (engine/engine.py,
+    engine/gang.py, parallel/sweep.py, engine/extender_loop.py), so every
+    program they lower is eligible for cross-process disk-cache hits."""
+    global _jit_cache_armed
+    import jax
+
+    if not _jit_cache_armed:
+        # respect an entry point that already armed the cache (conftest,
+        # bench) — re-arming would reset its min-compile-time threshold
+        if not jax.config.jax_compilation_cache_dir:
+            enable_compile_cache()
+        _jit_cache_armed = True
+    return jax.jit(fn, **kw)
+
+
+def speculation_enabled_default() -> bool:
+    """Speculative background compilation default: on, unless the
+    profiling kill switch KSS_NO_SPECULATIVE_COMPILE is set."""
+    return os.environ.get("KSS_NO_SPECULATIVE_COMPILE", "").lower() not in (
+        "1", "true", "yes",
+    )
+
+
+def adjacent_bucket_targets(
+    live: int, bucket: int, *, lo: int = 8, up_frac: float = 0.8
+) -> list[int]:
+    """The shape buckets worth pre-compiling for, given `live` objects in
+    the current `bucket`: the next power-of-two UP once occupancy passes
+    the watermark (default 80% — arrivals will cross soon), and the next
+    bucket DOWN once the live count would fit it with the same headroom
+    (shrink passes re-encode at the smaller bucket). Empty when the count
+    sits comfortably inside its bucket — the steady state arms nothing."""
+    if bucket <= 0 or live < 0:
+        return []
+    out: list[int] = []
+    if live >= up_frac * bucket:
+        out.append(bucket * 2)
+    half = bucket // 2
+    if half >= lo and live <= up_frac * half:
+        out.append(half)
+    return out
+
+
+class _Inflight:
+    """One in-progress build: waiters block on `ev`. When it fires,
+    `engine` is the built engine — or None, meaning the builder failed
+    and the waiter should retry the build itself (`get`'s loop)."""
+
+    __slots__ = ("ev", "engine")
+
+    def __init__(self):
+        self.ev = threading.Event()
+        self.engine = None
+
+
+class CompileBroker:
+    """Warm-engine map + in-flight dedupe + background speculation.
+
+    Keys are opaque tuples (the serving layer uses
+    ``(kind, compile_signature, ...)``); values are compiled engine
+    instances the caller `retarget`s onto fresh encodings. STRICTLY one
+    broker per `SchedulerService`: engines are stateful (`retarget`
+    mutates them), and only the owning service's pass lock serializes
+    their use — sharing a broker across services would let one service's
+    retarget corrupt another's in-flight pass.
+    """
+
+    def __init__(
+        self,
+        metrics=None,
+        capacity: int = 8,
+        speculative: "bool | None" = None,
+    ):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.metrics = metrics
+        self.capacity = capacity
+        self.speculative = (
+            speculation_enabled_default() if speculative is None else bool(speculative)
+        )
+        self._lock = threading.Lock()
+        self._idle = threading.Condition(self._lock)
+        self._engines: "dict[tuple, object]" = {}  # LRU via dict order
+        self._inflight: "dict[tuple, _Inflight]" = {}
+        self._tokens: set = set()  # speculation dedupe (queued/running)
+        self._tasks: list = []
+        self._worker: "threading.Thread | None" = None
+        self._busy = 0  # speculation tasks queued or running
+        # local counters (mirrored into self.metrics when present)
+        self.compile_hits = 0
+        self.compile_misses = 0
+        self.speculative_compiles = 0
+        self.stall_seconds = 0.0
+
+    # -- accounting ---------------------------------------------------------
+
+    def _note(self, hits=0, misses=0, speculative=0, stall_s=0.0) -> None:
+        with self._lock:
+            self.compile_hits += hits
+            self.compile_misses += misses
+            self.speculative_compiles += speculative
+            self.stall_seconds += stall_s
+        if self.metrics is not None:
+            self.metrics.record_compile(
+                hits=hits, misses=misses, speculative=speculative, stall_s=stall_s
+            )
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "compileHits": self.compile_hits,
+                "compileMisses": self.compile_misses,
+                "speculativeCompiles": self.speculative_compiles,
+                "stallSeconds": round(self.stall_seconds, 6),
+            }
+
+    # -- warm-engine map ----------------------------------------------------
+
+    def _store_locked(self, key: tuple, engine) -> None:
+        self._engines.pop(key, None)
+        self._engines[key] = engine
+        while len(self._engines) > self.capacity:
+            self._engines.pop(next(iter(self._engines)))
+
+    def peek(self, key: tuple):
+        """The cached engine for `key` (no build, no counters), or None."""
+        with self._lock:
+            return self._engines.get(key)
+
+    def get(self, key: tuple, build, info: "dict | None" = None):
+        """The engine for `key`: warm from the map (hit), shared from an
+        in-flight build (hit + stall), or built by THIS caller via
+        `build()` (miss + stall). `build` must return the engine fully
+        compiled — its wall time IS the stall being accounted.
+
+        `info`, when given, is filled with ``{"source": "hit" | "wait" |
+        "miss", "wait_s": seconds}`` — `wait_s` is the time THIS caller
+        spent blocked on someone else's in-flight compile, which callers
+        must exclude from their own execute-phase accounting (it is
+        already booked as stallSeconds)."""
+        while True:
+            with self._lock:
+                eng = self._engines.get(key)
+                if eng is not None:
+                    self._engines[key] = self._engines.pop(key)  # recency
+                    mine = None
+                else:
+                    fl = self._inflight.get(key)
+                    if fl is None:
+                        fl = _Inflight()
+                        self._inflight[key] = fl
+                        mine = True
+                    else:
+                        mine = False
+            if mine is None:
+                if info is not None:
+                    info.update(source="hit", wait_s=0.0)
+                self._note(hits=1)
+                return eng
+            if mine:
+                t0 = time.perf_counter()
+                try:
+                    eng = build()
+                except BaseException:
+                    with self._lock:
+                        self._inflight.pop(key, None)
+                    fl.ev.set()  # engine stays None: waiters retry
+                    raise
+                with self._lock:
+                    self._store_locked(key, eng)
+                    self._inflight.pop(key, None)
+                fl.engine = eng
+                fl.ev.set()
+                if info is not None:
+                    info.update(source="miss", wait_s=0.0)
+                self._note(misses=1, stall_s=time.perf_counter() - t0)
+                return eng
+            # someone else (request thread or speculation worker) is
+            # compiling this key: wait and share — no second compile
+            t0 = time.perf_counter()
+            fl.ev.wait()
+            if fl.engine is not None:
+                wait_s = time.perf_counter() - t0
+                if info is not None:
+                    info.update(source="wait", wait_s=wait_s)
+                self._note(hits=1, stall_s=wait_s)
+                return fl.engine
+            # the builder failed; loop — this caller may build it now
+
+    # -- speculation --------------------------------------------------------
+
+    def speculate(self, token, task) -> bool:
+        """Queue `task` for the background worker. `task()` runs off the
+        request thread and returns ``(key, build)`` — or None to skip —
+        after which the worker builds and stores the engine (skipping
+        keys already warm or in flight). `token` dedupes while the task
+        is queued/running. Returns False when speculation is disabled or
+        the token is already pending."""
+        if not self.speculative:
+            return False
+        with self._lock:
+            if token in self._tokens:
+                return False
+            self._tokens.add(token)
+            self._tasks.append((token, task))
+            self._busy += 1
+            if self._worker is None:
+                self._worker = threading.Thread(
+                    target=self._work, name="kss-compile-broker", daemon=True
+                )
+                self._worker.start()
+        return True
+
+    def _work(self) -> None:
+        while True:
+            with self._lock:
+                if not self._tasks:
+                    self._worker = None
+                    return
+                token, task = self._tasks.pop(0)
+            try:
+                res = task()
+                if res is not None:
+                    key, build = res
+                    self._background_build(key, build)
+            except BaseException:  # noqa: BLE001 — speculation never fails a run
+                pass
+            finally:
+                with self._lock:
+                    self._tokens.discard(token)
+                    self._busy -= 1
+                    self._idle.notify_all()
+
+    def _background_build(self, key: tuple, build) -> None:
+        with self._lock:
+            if key in self._engines or key in self._inflight:
+                return  # already warm / being compiled — nothing to do
+            fl = _Inflight()
+            self._inflight[key] = fl
+        try:
+            eng = build()
+        except BaseException:  # noqa: BLE001
+            with self._lock:
+                self._inflight.pop(key, None)
+            fl.ev.set()  # engine stays None: any waiter retries
+            return
+        with self._lock:
+            self._store_locked(key, eng)
+            self._inflight.pop(key, None)
+        fl.engine = eng
+        fl.ev.set()
+        self._note(speculative=1)
+
+    def drain(self, timeout: "float | None" = None) -> bool:
+        """Block until the speculation queue is empty and no task is
+        running; True on success, False on timeout. The 'after warm-up'
+        fence the perf-smoke crossing gate stands on."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while self._busy:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._idle.wait(remaining)
+        return True

@@ -70,6 +70,14 @@ class SchedulingMetrics:
         repr=False,
     )
     _engine_builds: int = 0
+    # compile-broker counters (utils/broker.py): warm-engine hits vs
+    # request-thread synchronous compiles, background speculative builds,
+    # and request-thread seconds blocked on ANY compile — the stall the
+    # predictive warm-up service exists to eliminate
+    _compile_hits: int = 0
+    _compile_misses: int = 0
+    _speculative_compiles: int = 0
+    _stall_s: float = 0.0
 
     def record(self, rec: PassRecord) -> None:
         with self._lock:
@@ -114,6 +122,24 @@ class SchedulingMetrics:
         with self._lock:
             self._engine_builds += 1
             self._phase_s["compile"] += float(seconds)
+
+    def record_compile(
+        self,
+        *,
+        hits: int = 0,
+        misses: int = 0,
+        speculative: int = 0,
+        stall_s: float = 0.0,
+    ) -> None:
+        """Compile-broker accounting: `hits` served warm (including waits
+        on an in-flight build), `misses` compiled synchronously on the
+        request thread, `speculative` background builds completed,
+        `stall_s` request-thread seconds blocked on compilation."""
+        with self._lock:
+            self._compile_hits += int(hits)
+            self._compile_misses += int(misses)
+            self._speculative_compiles += int(speculative)
+            self._stall_s += float(stall_s)
 
     def record_phase_seconds(
         self, execute: float = 0.0, decode: float = 0.0
@@ -185,6 +211,10 @@ class SchedulingMetrics:
                     "cachedEncodes": self._encode_counts.get("cached", 0),
                     "emptyEncodes": self._encode_counts.get("empty", 0),
                     "engineBuilds": self._engine_builds,
+                    "compileHits": self._compile_hits,
+                    "compileMisses": self._compile_misses,
+                    "speculativeCompiles": self._speculative_compiles,
+                    "stallSeconds": round(self._stall_s, 6),
                 },
             }
 
@@ -207,6 +237,10 @@ class SchedulingMetrics:
                 "delta": 0, "full": 0, "cached": 0, "empty": 0
             }
             self._engine_builds = 0
+            self._compile_hits = 0
+            self._compile_misses = 0
+            self._speculative_compiles = 0
+            self._stall_s = 0.0
 
 
 # process-wide shared registry for ad-hoc callers (benchmarks, scripts).

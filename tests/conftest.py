@@ -7,6 +7,12 @@ partitioning XLA applies on a real TPU slice.
 
 import os
 
+# The test suite builds hundreds of small services; ambient speculative
+# background compiles would add nondeterministic work (and wall time) to
+# every one of them. Tests that exercise the predictive-compile path opt
+# back in by constructing CompileBroker(speculative=True) explicitly.
+os.environ.setdefault("KSS_NO_SPECULATIVE_COMPILE", "1")
+
 # Force-set (not setdefault): the image's shell env pins JAX_PLATFORMS=axon
 # (the real TPU), which would silently move the whole suite onto the single
 # real chip — slow compiles and no 8-device mesh.

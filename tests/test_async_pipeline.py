@@ -1,0 +1,321 @@
+"""Stall-free serving path: CompileBroker semantics + async pipelined
+lifecycle parity (the perf_opt PR's acceptance criteria).
+
+* `CompileBroker.get` dedupes concurrent requests: two threads, ONE
+  compile; the loser shares the winner's engine and books a hit.
+* `speculate` builds on a background worker and the result serves later
+  `get`s warm; KSS_NO_SPECULATIVE_COMPILE=1 disables it.
+* `adjacent_bucket_targets` is the watermark policy: up past 80%
+  occupancy, down when the next bucket down has the same headroom.
+* The async pipelined lifecycle run emits a BYTE-IDENTICAL JSONL trace
+  and identical deterministic SchedulingMetrics counters vs the
+  synchronous path, across seeded chaos timelines with arrivals and
+  binding-reading faults (fail / drain / cordon) in both scheduler
+  modes — the tentpole's correctness contract.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from kube_scheduler_simulator_tpu.lifecycle.engine import LifecycleEngine
+from kube_scheduler_simulator_tpu.scenario.chaos import ChaosSpec
+from kube_scheduler_simulator_tpu.utils.broker import (
+    CompileBroker,
+    adjacent_bucket_targets,
+)
+from kube_scheduler_simulator_tpu.utils.metrics import SchedulingMetrics
+
+from helpers import node, pod
+
+
+class TestCompileBrokerDedupe:
+    def test_two_threads_one_compile(self):
+        broker = CompileBroker(speculative=False)
+        builds = []
+        release = threading.Event()
+
+        def build():
+            builds.append(threading.get_ident())
+            release.wait(timeout=10)
+            return object()
+
+        got = []
+
+        def worker():
+            got.append(broker.get(("k",), build))
+
+        threads = [threading.Thread(target=worker) for _ in range(2)]
+        threads[0].start()
+        # let thread 0 enter the build before thread 1 asks
+        for _ in range(200):
+            if builds:
+                break
+            time.sleep(0.005)
+        threads[1].start()
+        time.sleep(0.05)
+        release.set()
+        for th in threads:
+            th.join(timeout=10)
+        assert len(builds) == 1  # ONE compile
+        assert len(got) == 2 and got[0] is got[1]
+        assert broker.compile_misses == 1
+        assert broker.compile_hits == 1
+        assert broker.stall_seconds > 0
+
+    def test_failed_build_retried_by_waiter(self):
+        broker = CompileBroker(speculative=False)
+        calls = []
+
+        def failing():
+            calls.append(1)
+            raise RuntimeError("boom")
+
+        with pytest.raises(RuntimeError):
+            broker.get(("k",), failing)
+        # the key is not poisoned: the next caller builds fresh
+        eng = broker.get(("k",), lambda: "engine")
+        assert eng == "engine"
+        assert len(calls) == 1
+
+    def test_lru_capacity_bound(self):
+        broker = CompileBroker(speculative=False, capacity=2)
+        for i in range(4):
+            broker.get(("k", i), lambda i=i: f"e{i}")
+        assert len(broker._engines) == 2
+        # oldest evicted, newest retained
+        assert broker.peek(("k", 3)) == "e3"
+        assert broker.peek(("k", 0)) is None
+
+
+class TestSpeculation:
+    def test_background_build_serves_get_warm(self):
+        m = SchedulingMetrics()
+        broker = CompileBroker(metrics=m, speculative=True)
+        built = []
+
+        def task():
+            def build():
+                built.append(1)
+                return "warm-engine"
+
+            return ("key",), build
+
+        assert broker.speculate("token", task)
+        assert broker.drain(timeout=10)
+        assert built == [1]
+        assert broker.get(("key",), lambda: pytest.fail("should be warm")) == (
+            "warm-engine"
+        )
+        phases = m.snapshot()["phases"]
+        assert phases["speculativeCompiles"] == 1
+        assert phases["compileMisses"] == 0
+        assert phases["compileHits"] == 1
+
+    def test_token_dedupes_pending_tasks(self):
+        broker = CompileBroker(speculative=True)
+        ran = []
+        gate = threading.Event()
+
+        def task():
+            gate.wait(timeout=10)
+            ran.append(1)
+            return None
+
+        assert broker.speculate("t", task)
+        assert not broker.speculate("t", task)  # pending: deduped
+        gate.set()
+        assert broker.drain(timeout=10)
+        assert ran == [1]
+
+    def test_env_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("KSS_NO_SPECULATIVE_COMPILE", "1")
+        broker = CompileBroker()
+        assert broker.speculative is False
+        assert not broker.speculate("t", lambda: None)
+
+    def test_task_failure_is_contained(self):
+        broker = CompileBroker(speculative=True)
+
+        def bad_task():
+            raise RuntimeError("speculation must never take the run down")
+
+        assert broker.speculate("t", bad_task)
+        assert broker.drain(timeout=10)
+        assert broker.speculative_compiles == 0
+
+
+class TestWatermark:
+    def test_up_speculation_past_80_percent(self):
+        assert adjacent_bucket_targets(52, 64) == [128]
+        assert adjacent_bucket_targets(51, 64) == []  # 51 < 51.2
+        assert adjacent_bucket_targets(64, 64) == [128]
+
+    def test_down_speculation_with_headroom(self):
+        # 20 live in a 128-bucket: fits 64 with < 80% occupancy
+        assert adjacent_bucket_targets(20, 128) == [64]
+        # 60 live: would occupy 94% of 64 — stay put
+        assert adjacent_bucket_targets(60, 128) == []
+
+    def test_never_below_floor(self):
+        assert adjacent_bucket_targets(1, 8) == []
+        assert adjacent_bucket_targets(3, 16, lo=8) == [8]
+        assert adjacent_bucket_targets(3, 8, lo=8) == []
+
+    def test_steady_state_arms_nothing(self):
+        assert adjacent_bucket_targets(40, 64) == []
+
+
+# -- async pipelined lifecycle parity ---------------------------------------
+
+
+def _chaos_dict(mode: str, pipeline: str) -> dict:
+    nodes = [node(f"n{i}", cpu="16", mem="32Gi", pods="110") for i in range(6)]
+    # same shapes as tests/test_lifecycle_perf.py so the compiled
+    # programs come from the shared persistent cache
+    pods = [
+        pod(f"seed-{i}", cpu="100m", node_name=f"n{i % 6}") for i in range(33)
+    ]
+    return {
+        "name": "parity",
+        "seed": 11,
+        "horizon": 60.0,
+        "schedulerMode": mode,
+        "pipeline": pipeline,
+        "snapshot": {"nodes": nodes, "pods": pods},
+        "arrivals": [
+            {
+                "kind": "poisson",
+                "rate": 0.8,
+                "count": 18,
+                "template": {
+                    "metadata": {"name": "churn"},
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "c",
+                                "resources": {
+                                    "requests": {
+                                        "cpu": "100m",
+                                        "memory": "64Mi",
+                                    }
+                                },
+                            }
+                        ]
+                    },
+                },
+            }
+        ],
+        # binding-reading faults: each forces the async pipeline's
+        # resolve fence, covering eviction + re-enqueue mid-pipeline
+        "faults": [
+            {"at": 8.0, "action": "cordon", "node": "n0"},
+            {"at": 14.0, "action": "fail", "node": "n1"},
+            {"at": 20.0, "action": "recover", "node": "n1"},
+            {"at": 26.0, "action": "uncordon", "node": "n0"},
+            {"at": 32.0, "action": "drain", "node": "n2"},
+            {"at": 40.0, "action": "uncordon", "node": "n2"},
+        ],
+    }
+
+
+def _deterministic_counters(snapshot: dict) -> dict:
+    """The SchedulingMetrics fields the parity contract pins: everything
+    except wall-clock (which no two runs share)."""
+    phases = snapshot["phases"]
+    return {
+        "passes": snapshot["passes"],
+        "totalPods": snapshot["totalPods"],
+        "totalScheduled": snapshot["totalScheduled"],
+        "disruption": snapshot["disruption"],
+        "deltaEncodes": phases["deltaEncodes"],
+        "fullEncodes": phases["fullEncodes"],
+        "cachedEncodes": phases["cachedEncodes"],
+        "emptyEncodes": phases["emptyEncodes"],
+        "engineBuilds": phases["engineBuilds"],
+    }
+
+
+class TestAsyncPipelineParity:
+    @pytest.mark.parametrize("mode", ["gang", "sequential"])
+    def test_trace_byte_identical_and_counters_equal(self, mode):
+        sync_eng = LifecycleEngine(
+            ChaosSpec.from_dict(_chaos_dict(mode, "sync"))
+        )
+        sync_res = sync_eng.run()
+        async_eng = LifecycleEngine(
+            ChaosSpec.from_dict(_chaos_dict(mode, "async"))
+        )
+        async_res = async_eng.run()
+        assert sync_res["phase"] == "Succeeded"
+        assert async_res["phase"] == "Succeeded"
+        # the tentpole contract: byte-identical replayable JSONL
+        assert sync_eng.trace_jsonl() == async_eng.trace_jsonl()
+        assert _deterministic_counters(
+            sync_res["metrics"]
+        ) == _deterministic_counters(async_res["metrics"])
+        # the run did real work (faults evicted, churn re-bound)
+        assert async_res["pods"]["evicted"] > 0
+        assert async_res["pods"]["arrived"] >= 10
+
+    def test_async_timings_resolved_and_stamped(self):
+        eng = LifecycleEngine(
+            ChaosSpec.from_dict(_chaos_dict("gang", "async"))
+        )
+        res = eng.run()
+        assert res["phase"] == "Succeeded"
+        assert all("wallSeconds" in x for x in eng.timings)
+        assert any(x.get("encodeMode") == "delta" for x in eng.timings)
+        # no unresolved placeholder leaked into the trace
+        assert all(ev.get("type") for ev in eng.trace)
+
+    def test_spec_rejects_bad_pipeline(self):
+        with pytest.raises(ValueError, match="pipeline"):
+            ChaosSpec.from_dict(
+                dict(_chaos_dict("gang", "sync"), pipeline="turbo")
+            )
+        with pytest.raises(ValueError, match="pipeline"):
+            LifecycleEngine(
+                ChaosSpec.from_dict(_chaos_dict("gang", "sync")),
+                pipeline="turbo",
+            )
+
+
+class TestEncodingCacheCap:
+    def test_env_override(self, monkeypatch):
+        from kube_scheduler_simulator_tpu.models.store import ResourceStore
+        from kube_scheduler_simulator_tpu.server.service import SchedulerService
+
+        monkeypatch.setenv("KSS_ENCODING_CACHE_CAP", "3")
+        svc = SchedulerService(ResourceStore())
+        assert svc.encoding_cache_capacity == 3
+        assert svc._enc_cache.capacity == 3
+
+    def test_bad_values_fall_back_to_default(self, monkeypatch):
+        from kube_scheduler_simulator_tpu.models.store import ResourceStore
+        from kube_scheduler_simulator_tpu.server.service import SchedulerService
+
+        for bad in ("nope", "0", "-2"):
+            monkeypatch.setenv("KSS_ENCODING_CACHE_CAP", bad)
+            assert SchedulerService(ResourceStore()).encoding_cache_capacity == 8
+
+    def test_metrics_route_surfaces_capacity(self):
+        import json
+        import urllib.request
+
+        from kube_scheduler_simulator_tpu.server.httpserver import SimulatorServer
+        from kube_scheduler_simulator_tpu.server.service import SimulatorService
+
+        server = SimulatorServer(SimulatorService(), port=0).start()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/api/v1/metrics"
+            ) as resp:
+                doc = json.loads(resp.read())
+            assert doc["encodingCacheCapacity"] == 8
+            assert "stallSeconds" in doc["phases"]
+        finally:
+            server.shutdown()

@@ -127,6 +127,7 @@ class TestMetricsApiShape:
         m.record_encode("empty", 0.0)
         m.record_engine_build(1.5)
         m.record_phase_seconds(execute=0.5, decode=0.125)
+        m.record_compile(hits=3, misses=1, speculative=2, stall_s=4.5)
         snap = m.snapshot()["phases"]
         assert snap["fullEncodes"] == 1
         assert snap["deltaEncodes"] == 1
@@ -137,9 +138,14 @@ class TestMetricsApiShape:
         assert snap["compileSeconds"] == 1.5
         assert snap["executeSeconds"] == 0.5
         assert snap["decodeSeconds"] == 0.125
+        assert snap["compileHits"] == 3
+        assert snap["compileMisses"] == 1
+        assert snap["speculativeCompiles"] == 2
+        assert snap["stallSeconds"] == 4.5
         m.reset()
         snap = m.snapshot()["phases"]
         assert snap["fullEncodes"] == 0 and snap["encodeSeconds"] == 0.0
+        assert snap["compileMisses"] == 0 and snap["stallSeconds"] == 0.0
 
     def test_http_metrics_route_carries_phases(self):
         import json

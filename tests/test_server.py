@@ -314,13 +314,21 @@ class TestCompileReuse:
         _req(p, "PUT", "/api/v1/resources/pods", pod("a"))
         _req(p, "POST", "/api/v1/schedule")
         svc = server.service.scheduler
-        assert svc._engine_cache is not None
-        first = svc._engine_cache[1]
+
+        def seq_engines():
+            return [
+                e for k, e in svc.broker._engines.items() if k[0] == "seq"
+            ]
+
+        assert len(seq_engines()) == 1
+        first = seq_engines()[0]
         # same padded shapes: the cached engine must be retargeted, not
         # rebuilt (pow2 padding keeps shapes stable as the cluster grows)
         _req(p, "PUT", "/api/v1/resources/pods", pod("b"))
         _req(p, "POST", "/api/v1/schedule")
-        assert svc._engine_cache[1] is first
+        assert seq_engines() == [first]
+        assert svc.broker.compile_misses == 1
+        assert svc.broker.compile_hits >= 1
         code, got = _req(p, "GET", "/api/v1/resources/pods/default/b")
         assert got["spec"]["nodeName"] == "n0"
 

@@ -80,13 +80,20 @@ def test_gang_engine_cache_reused_across_passes():
     svc = SimulatorService()
     _fill(svc)
     svc.scheduler.schedule_gang()
-    cache = svc.scheduler._gang_engine_cache
-    assert len(cache) == 1
-    gang0 = next(iter(cache.values()))
+
+    def gang_engines():
+        return [
+            e
+            for k, e in svc.scheduler.broker._engines.items()
+            if k[0] == "gang"
+        ]
+
+    assert len(gang_engines()) == 1
+    gang0 = gang_engines()[0]
     # same shapes/config: second pass must reuse the compiled engine
     svc.store.apply("pods", pod("extra"))
     svc.scheduler.schedule_gang()
-    assert next(iter(svc.scheduler._gang_engine_cache.values())) is gang0
+    assert gang_engines() == [gang0]
     assert svc.store.get("pods", "extra", "default")["spec"].get("nodeName")
 
 
@@ -151,7 +158,11 @@ def test_gang_window_through_service_and_http():
     assert all(v for v in placements.values())
     assert results and len(results) >= 8
     def cached_windows():
-        return [k[1] for k in svc.scheduler._gang_engine_cache]
+        return [
+            k[2]
+            for k in svc.scheduler.broker._engines
+            if k[0] == "gang"
+        ]
 
     # window=2 on 8 pods with the default chunk never binds (WP rounds
     # past P) — the canonical key is None, shared with unwindowed
@@ -161,13 +172,14 @@ def test_gang_window_through_service_and_http():
     for i in range(8, 12):
         svc.store.apply("pods", pod(f"p{i}"))
     svc.scheduler.schedule_gang()
-    before = len(svc.scheduler._gang_engine_cache)
+    before = len(cached_windows())
     # P grew; the fresh encoding has its own signature — find a window
-    # that binds: chunk 256 >= P means none can, so assert the
-    # canonicalization instead: distinct raw windows share the key
+    # that binds: the serving chunk (service.GANG_CHUNK, 64) is >= P
+    # here so none can; assert the canonicalization instead: distinct
+    # raw windows share the key
     svc.scheduler.schedule_gang(window=3)
     svc.scheduler.schedule_gang(window=7)
-    assert len(svc.scheduler._gang_engine_cache) == before
+    assert len(cached_windows()) == before
     with pytest.raises(ValueError, match="window"):
         svc.scheduler.schedule_gang(window=0)
 

@@ -1,18 +1,25 @@
 """`make perf-smoke`: tiny CPU-only lifecycle throughput sanity check.
 
-Runs a small seeded churn timeline (Poisson arrivals + a cordon flap
-against a 6-node cluster) through the full service stack — store events,
-delta encoder, compiled engine — and asserts the wiring that makes churn
-O(Δ) actually engaged:
+Two gates, one JSON line:
 
-  * the run Succeeds and schedules pods;
-  * the delta encoder took over after warm-up (deltaEncodes > 0, and
-    fullEncodes stays at the warm-up handful);
-  * the phase-timing breakdown is populated (encode/execute seconds).
+1. **Churn is O(Δ)** — a small seeded churn timeline (Poisson arrivals +
+   a cordon flap against a 6-node cluster) through the full service
+   stack (async pipelined dispatch since the stall-free-serving PR):
+   the run Succeeds, the delta encoder carries it after warm-up
+   (deltaEncodes > 0, fullEncodes stays at the warm-up handful), and the
+   phase-timing breakdown is populated.
 
-One JSON line on stdout (the bench.py contract); exit 0 on pass. Small
-enough for tier-1 (seconds, CPU-only) — this is a sanity gate, not a
-measurement; `python bench.py` owns the numbers.
+2. **Bucket crossings are stall-free** — a cluster filled past the 80%
+   watermark of its pod-capacity bucket, scheduled once (the cold
+   compile), drained (the broker's background speculative compile for
+   the next bucket completes), then grown across the bucket boundary and
+   scheduled again: the crossing pass must record ZERO synchronous
+   compiles on the request thread (`compileMisses` stays at the cold
+   start's 1, the crossing served by the `speculativeCompiles == 1`
+   warm engine).
+
+Exit 0 on pass. Small enough for tier-1 (seconds, CPU-only) — this is a
+sanity gate, not a measurement; `python bench.py` owns the numbers.
 """
 
 from __future__ import annotations
@@ -20,6 +27,83 @@ from __future__ import annotations
 import json
 import os
 import sys
+
+
+def _crossing_gate() -> "tuple[dict, list[str]]":
+    """Gate 2: warm-up → watermark speculation → bucket crossing with
+    zero request-thread compiles. Returns (JSON fields, problems)."""
+    from kube_scheduler_simulator_tpu.models.store import ResourceStore
+    from kube_scheduler_simulator_tpu.server.service import SchedulerService
+    from kube_scheduler_simulator_tpu.utils.broker import CompileBroker
+    from kube_scheduler_simulator_tpu.utils.metrics import SchedulingMetrics
+
+    store = ResourceStore()
+    for i in range(6):
+        store.apply(
+            "nodes",
+            {
+                "metadata": {"name": f"x{i}"},
+                "status": {
+                    "allocatable": {"cpu": "64", "memory": "128Gi", "pods": "110"}
+                },
+            },
+        )
+
+    def churn_pod(name: str) -> dict:
+        return {
+            "metadata": {"name": name},
+            "spec": {
+                "containers": [
+                    {
+                        "name": "c",
+                        "resources": {
+                            "requests": {"cpu": "100m", "memory": "64Mi"}
+                        },
+                    }
+                ]
+            },
+        }
+
+    # 52 pods: bucket 64, occupancy 81% — past the speculation watermark
+    for i in range(52):
+        store.apply("pods", churn_pod(f"p{i}"))
+    metrics = SchedulingMetrics()
+    # speculation forced ON: the gate must hold regardless of ambient
+    # KSS_NO_SPECULATIVE_COMPILE (profiling) settings
+    svc = SchedulerService(
+        store,
+        metrics=metrics,
+        broker=CompileBroker(metrics=metrics, speculative=True),
+    )
+    svc.schedule_gang(record=False)  # cold start: the ONE allowed miss
+    drained = svc.broker.drain(timeout=600)
+    # cross the 64-pod bucket: 72 pods re-encode at capacity 128
+    for i in range(52, 72):
+        store.apply("pods", churn_pod(f"p{i}"))
+    placements, _, _ = svc.schedule_gang(record=False)
+    phases = metrics.snapshot()["phases"]
+    fields = {
+        "crossing_compile_misses": phases["compileMisses"],
+        "crossing_compile_hits": phases["compileHits"],
+        "crossing_speculative_compiles": phases["speculativeCompiles"],
+        "crossing_stall_seconds": phases["stallSeconds"],
+    }
+    problems = []
+    if not drained:
+        problems.append("speculative compile did not finish in its window")
+    if phases["speculativeCompiles"] < 1:
+        problems.append("watermark never armed a speculative compile")
+    if phases["compileMisses"] > 1:
+        problems.append(
+            f"bucket crossing paid a synchronous request-thread compile "
+            f"(compileMisses {phases['compileMisses']}, expected 1 = cold start)"
+        )
+    if phases["compileHits"] < 1:
+        problems.append("crossing pass was not served by the warm engine")
+    bound = sum(1 for v in placements.values() if v)
+    if bound < 20:
+        problems.append(f"crossing pass scheduled too little ({bound}/20)")
+    return fields, problems
 
 
 def main() -> int:
@@ -68,6 +152,7 @@ def main() -> int:
             "seed": 7,
             "horizon": 40.0,
             "schedulerMode": "gang",
+            "pipeline": "async",
             "snapshot": {"nodes": nodes, "pods": seed_pods},
             "arrivals": [
                 {
@@ -103,6 +188,7 @@ def main() -> int:
     snap = result["metrics"]
     phases = snap.get("phases", {})
     wall = result["wallSeconds"]
+    crossing_fields, crossing_problems = _crossing_gate()
     line = {
         "config": "perf_smoke",
         "phase": result["phase"],
@@ -115,9 +201,11 @@ def main() -> int:
         "engine_builds": phases.get("engineBuilds", 0),
         "encode_s": phases.get("encodeSeconds", 0.0),
         "execute_s": phases.get("executeSeconds", 0.0),
+        "pipeline": "async",
+        **crossing_fields,
     }
     print(json.dumps(line), flush=True)
-    problems = []
+    problems = list(crossing_problems)
     if result["phase"] != "Succeeded":
         problems.append(f"run phase {result['phase']!r}")
     if result["pods"]["arrived"] < 10:
