@@ -5,7 +5,7 @@
 PORT ?= 1212
 PY ?= python
 
-.PHONY: test test-fast lint start bench dryrun batch lifecycle-smoke perf-smoke docker docker-up clean
+.PHONY: test test-fast lint start bench dryrun batch lifecycle-smoke perf-smoke resilience-smoke docker docker-up clean
 
 # full suite on the 8-device virtual CPU mesh (tests/conftest.py pins it)
 test:
@@ -45,6 +45,13 @@ lifecycle-smoke:
 # one JSON line, fails non-zero when the O(Δ) wiring regresses
 perf-smoke:
 	env JAX_PLATFORMS=cpu $(PY) tools/perf_smoke.py
+
+# run-supervision smoke (docs/resilience.md): a short chaos run under
+# injected compile failures (must complete via the eager fallback with
+# a byte-identical trace) + a mid-run kill/checkpoint/resume through
+# the CLI (zero lost events, trace parity); one JSON line
+resilience-smoke:
+	env JAX_PLATFORMS=cpu $(PY) tools/resilience_smoke.py
 
 # containerized dev flow (reference `make docker_build_and_up`, one service)
 docker:

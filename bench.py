@@ -513,6 +513,13 @@ def _lifecycle_probe(events: int = 300, n_nodes: int = 64, seed_pods: int = 500)
         "compile_misses": phases["compileMisses"],
         "speculative_compiles": phases["speculativeCompiles"],
         "stall_seconds": phases["stallSeconds"],
+        # run-supervision counters (docs/resilience.md): a healthy bench
+        # reports zeros — any non-zero means the degradation ladder
+        # carried passes the compiled path could not serve
+        "compile_retries": phases["compileRetries"],
+        "eager_fallbacks": phases["eagerFallbacks"],
+        "degraded_passes": phases["degradedPasses"],
+        "broker_worker_crashes": phases["brokerWorkerCrashes"],
     }
     print(json.dumps(line), flush=True)
 

@@ -19,11 +19,17 @@ Three layers:
     parallel/sweep.py), so ONE compiled program evaluates a policy's
     disruption profile across hundreds of sampled failure scenarios.
 
+Run supervision (`checkpoint`, docs/resilience.md): atomic
+checkpoint/resume of a running timeline — periodic cadence + a final
+checkpoint on graceful interrupt, `LifecycleEngine.from_checkpoint`
+continuing the run with a byte-identical concatenated trace.
+
 Surfaces: `POST /api/v1/lifecycle` + `GET /api/v1/lifecycle/trace`
 (server/httpserver.py) and `python -m kube_scheduler_simulator_tpu.lifecycle`.
 """
 
 from ..scenario.chaos import ArrivalProcess, ChaosSpec, FaultEvent
+from .checkpoint import load_checkpoint, write_checkpoint
 from .engine import LifecycleEngine
 from .faultsweep import FaultSweep
 
@@ -33,4 +39,6 @@ __all__ = [
     "FaultEvent",
     "LifecycleEngine",
     "FaultSweep",
+    "load_checkpoint",
+    "write_checkpoint",
 ]

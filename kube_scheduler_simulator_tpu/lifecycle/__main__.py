@@ -1,13 +1,25 @@
 """CLI: ``python -m kube_scheduler_simulator_tpu.lifecycle``.
 
-Two modes over one ChaosSpec file (JSON or YAML):
+Modes over one ChaosSpec file (JSON or YAML):
 
   * default        — run the discrete-event timeline (engine.py); the
     result document prints to stdout, the replayable JSONL trace lands
     at ``--trace-out`` when given;
   * ``--sweep S``  — additionally run the vmapped fault sweep
     (faultsweep.py) over the spec's snapshot cluster: S sampled failure
-    scenarios at ``--fail-prob``, seeded from the spec.
+    scenarios at ``--fail-prob``, seeded from the spec;
+  * ``--resume CKPT`` — continue a run from a checkpoint written by
+    ``--checkpoint-to`` (docs/resilience.md): the trace written to
+    ``--trace-out`` is the FULL trace (checkpointed prefix + new
+    suffix), byte-identical to an uninterrupted run's.
+
+Run supervision: with ``--checkpoint-to`` the engine persists an atomic
+checkpoint every ``--checkpoint-every-events`` timeline events and/or
+``--checkpoint-every-sim-s`` simulated seconds, and SIGINT/SIGTERM stop
+the run gracefully at the next batch boundary with a FINAL checkpoint
+(phase ``Interrupted``, exit code 1) — a second signal falls through to
+the default handler for a hard kill. ``--stop-after-events K`` is the
+deterministic stand-in for that kill (tools/resilience_smoke.py).
 
 Exit code 0 on a Succeeded run, 1 otherwise (the KEP-184 runner's
 contract, same as scenario/batch.py).
@@ -16,6 +28,7 @@ contract, same as scenario/batch.py).
 from __future__ import annotations
 
 import json
+import signal
 import sys
 
 
@@ -36,9 +49,37 @@ def main(argv: "list[str] | None" = None) -> int:
         description="Cluster-lifecycle chaos runner (discrete-event churn, "
         "fault injection, vmapped failure sweeps).",
     )
-    ap.add_argument("--spec", required=True, help="ChaosSpec file (json/yaml)")
     ap.add_argument(
-        "--trace-out", help="write the replayable JSONL event trace here"
+        "--spec", help="ChaosSpec file (json/yaml); required unless --resume"
+    )
+    ap.add_argument(
+        "--resume", metavar="CKPT",
+        help="continue the run captured in this checkpoint file "
+        "(--checkpoint-to output); --spec is ignored — the checkpoint "
+        "carries its spec by value",
+    )
+    ap.add_argument(
+        "--trace-out", help="write the replayable JSONL event trace here "
+        "(on --resume: the FULL trace, checkpointed prefix included)"
+    )
+    ap.add_argument(
+        "--checkpoint-to", metavar="PATH",
+        help="persist atomic run checkpoints here (periodic per the "
+        "--checkpoint-every-* cadence; final on SIGINT/SIGTERM or "
+        "--stop-after-events)",
+    )
+    ap.add_argument(
+        "--checkpoint-every-events", type=int, default=0, metavar="K",
+        help="checkpoint every K timeline events (0 = off)",
+    )
+    ap.add_argument(
+        "--checkpoint-every-sim-s", type=float, default=0.0, metavar="N",
+        help="checkpoint every N simulated seconds (0 = off)",
+    )
+    ap.add_argument(
+        "--stop-after-events", type=int, default=0, metavar="K",
+        help="stop gracefully (final checkpoint, phase Interrupted) after "
+        "K timeline events — the deterministic mid-run-kill stand-in",
     )
     ap.add_argument(
         "--pipeline", choices=("sync", "async"), default=None,
@@ -54,13 +95,56 @@ def main(argv: "list[str] | None" = None) -> int:
         help="per-node failure probability for --sweep (default 0.1)",
     )
     args = ap.parse_args(argv)
+    if not args.spec and not args.resume:
+        ap.error("one of --spec / --resume is required")
+    if (
+        args.checkpoint_every_events or args.checkpoint_every_sim_s
+    ) and not args.checkpoint_to:
+        # a run the operator BELIEVES is checkpointing but isn't is the
+        # worst outcome of a flag typo — refuse up front
+        ap.error("--checkpoint-every-* requires --checkpoint-to")
 
     from ..scenario.chaos import ChaosSpec
+    from .checkpoint import load_checkpoint
     from .engine import LifecycleEngine
 
-    spec = ChaosSpec.from_dict(_load_spec(args.spec))
-    engine = LifecycleEngine(spec, pipeline=args.pipeline)
-    result = engine.run()
+    supervise = dict(
+        checkpoint_path=args.checkpoint_to,
+        checkpoint_every_events=args.checkpoint_every_events,
+        checkpoint_every_sim_s=args.checkpoint_every_sim_s,
+        stop_after_events=args.stop_after_events,
+    )
+    if args.resume:
+        engine = LifecycleEngine.from_checkpoint(
+            load_checkpoint(args.resume), pipeline=args.pipeline, **supervise
+        )
+        spec = engine.spec
+    else:
+        spec = ChaosSpec.from_dict(_load_spec(args.spec))
+        engine = LifecycleEngine(spec, pipeline=args.pipeline, **supervise)
+
+    # graceful shutdown: first SIGINT/SIGTERM stops at the next batch
+    # boundary (final checkpoint, nothing extra in the trace); a second
+    # one restores the default handler's hard behavior
+    def _graceful(signum, frame):
+        engine.request_stop()
+        signal.signal(signum, signal.SIG_DFL)
+
+    prev_handlers = {}
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            prev_handlers[sig] = signal.signal(sig, _graceful)
+        except ValueError:  # non-main thread (embedded use): skip
+            pass
+
+    try:
+        result = engine.run()
+    finally:
+        for sig, h in prev_handlers.items():
+            try:
+                signal.signal(sig, h)
+            except ValueError:
+                pass
     if args.trace_out:
         with open(args.trace_out, "w") as f:
             f.write(engine.trace_jsonl())

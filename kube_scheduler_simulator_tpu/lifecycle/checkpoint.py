@@ -1,0 +1,113 @@
+"""Lifecycle-run checkpoints — crash-safe run supervision's persistence.
+
+A checkpoint is ONE JSON document capturing everything a fresh process
+needs to continue a `LifecycleEngine` run such that the continued trace
+is byte-identical to an uninterrupted run (docs/resilience.md):
+
+  * ``spec``            — the ChaosSpec in wire shape (`ChaosSpec.to_dict`
+    round-trips exactly, so the resumed process re-derives the SAME
+    timeline: all chaos randomness is a pure function of the spec);
+  * ``cursor``          — timeline events consumed so far; resume slices
+    `spec.events()[cursor:]` (checkpoints land only at batch boundaries,
+    so a same-timestamp batch is never split);
+  * ``store``           — `ResourceStore.dump_state()`: objects verbatim
+    (rv/uid included, insertion order preserved) + the rv counter;
+  * ``rng``             — the derivation seed. There is NO runtime RNG
+    state to save: every draw in the chaos plane comes from streams
+    seeded on (seed, process index) at timeline derivation;
+  * ``trace``           — the replayable trace prefix, with
+    ``traceByteOffset`` = its JSONL byte length, so an interrupted
+    ``--trace-out`` file can be truncated at the checkpoint and
+    concatenated with the resumed run's suffix;
+  * ``engine``          — the disruption bookkeeping (_downed manifests,
+    evicted-at map, time-to-reschedule samples, arrival/eviction
+    counters) and the simulated clock;
+  * ``metrics``         — `SchedulingMetrics.state_dict()`: cumulative
+    counters, so the resumed run's final report covers the whole run.
+
+Writes are ATOMIC: the document lands in a same-directory temp file,
+fsynced, then `os.replace`d over the target — a kill mid-write leaves
+the previous checkpoint intact, never a torn one.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+
+CHECKPOINT_FORMAT = "kss-lifecycle-checkpoint/v1"
+
+
+def checkpoint_doc(engine) -> dict:
+    """Build the checkpoint document for `engine` (a `LifecycleEngine`
+    with NO in-flight async pass — callers resolve before snapshotting;
+    `LifecycleEngine.save_checkpoint` does)."""
+    # a SHALLOW list copy suffices: resolved trace entries are never
+    # mutated again (resolve fills/inserts only at the live pass's tail
+    # slot, and there is no in-flight pass here), so the doc is immune
+    # to the run continuing — without deep-copying every event dict
+    return {
+        "format": CHECKPOINT_FORMAT,
+        "spec": engine.spec.to_dict(),
+        "pipeline": engine.pipeline,
+        "cursor": engine.events_consumed,
+        "simTime": round(float(engine.sim_time), 9),
+        "rng": {
+            "seed": engine.spec.seed,
+            "note": "all chaos randomness derives from (seed, process "
+            "index) at timeline derivation; no runtime RNG state exists",
+        },
+        "store": engine.store.dump_state(),
+        "trace": list(engine.trace),
+        "traceByteOffset": engine._trace_byte_len(),
+        "engine": {
+            "downed": copy.deepcopy(engine._downed),
+            "evictedAt": [
+                [ns, name, t]
+                for (ns, name), t in sorted(engine._evicted_at.items())
+            ],
+            "tts": list(engine._tts),
+            "arrived": engine._arrived,
+            "evicted": engine._evicted,
+            "rescheduled": engine._rescheduled,
+            "lost": engine._lost,
+        },
+        "metrics": engine.scheduler.metrics.state_dict(),
+    }
+
+
+def write_checkpoint(doc: dict, path: str) -> str:
+    """Atomically persist `doc` at `path` (tmp + fsync + os.replace: a
+    kill mid-write can only ever leave the PREVIOUS checkpoint)."""
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(directory, exist_ok=True)
+    tmp = os.path.join(
+        directory, f".{os.path.basename(path)}.tmp-{os.getpid()}"
+    )
+    try:
+        with open(tmp, "w") as f:
+            json.dump(doc, f, separators=(",", ":"), sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return path
+
+
+def load_checkpoint(path: str) -> dict:
+    """Load + validate a checkpoint document."""
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or doc.get("format") != CHECKPOINT_FORMAT:
+        raise ValueError(
+            f"{path}: not a lifecycle checkpoint "
+            f"(format {doc.get('format') if isinstance(doc, dict) else None!r}, "
+            f"expected {CHECKPOINT_FORMAT!r})"
+        )
+    for key in ("spec", "cursor", "store", "trace", "engine"):
+        if key not in doc:
+            raise ValueError(f"{path}: checkpoint missing {key!r}")
+    return doc

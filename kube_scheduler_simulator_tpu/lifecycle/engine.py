@@ -115,6 +115,11 @@ class LifecycleEngine:
         metrics: "metrics_mod.SchedulingMetrics | None" = None,
         max_controller_rounds: int = 100,
         pipeline: "str | None" = None,
+        checkpoint_path: "str | None" = None,
+        checkpoint_every_events: int = 0,
+        checkpoint_every_sim_s: float = 0.0,
+        stop_after_events: int = 0,
+        _restore: "dict | None" = None,
     ):
         self.spec = spec
         # "sync" | "async" (None → the spec's choice): see module
@@ -128,7 +133,7 @@ class LifecycleEngine:
         # the in-flight dispatched pass (async mode; at most one)
         self._inflight: "dict | None" = None
         self.store = store or ResourceStore()
-        if spec.snapshot:
+        if spec.snapshot and _restore is None:
             _, errors = import_snapshot(self.store, spec.snapshot)
             if errors:
                 raise ValueError(f"chaos snapshot import: {errors}")
@@ -150,6 +155,144 @@ class LifecycleEngine:
         self._evicted = 0
         self._rescheduled = 0
         self._lost = 0  # evicted pods later deleted (e.g. preemption)
+        # -- run supervision (docs/resilience.md) ---------------------------
+        # checkpoint cadence: every K timeline events and/or N simulated
+        # seconds (either 0 disables that trigger); checkpoints land only
+        # at batch boundaries, AFTER the batch's convergence, with any
+        # in-flight async pass resolved first — the one moment the whole
+        # run state is serializable
+        self.checkpoint_path = checkpoint_path
+        self.checkpoint_every_events = int(checkpoint_every_events)
+        self.checkpoint_every_sim_s = float(checkpoint_every_sim_s)
+        # deterministic interrupt: behave like a SIGTERM once this many
+        # timeline events have been consumed (0 = never) — the testable
+        # stand-in for a mid-run kill
+        self.stop_after_events = int(stop_after_events)
+        self._stop_requested = False
+        self.events_consumed = 0  # timeline cursor (checkpoint "cursor")
+        self.sim_time = 0.0  # latest simulated time reached
+        self.checkpoints_written = 0
+        self.last_checkpoint_doc: "dict | None" = None
+        self._ckpt_marker_events = 0
+        self._ckpt_marker_t = 0.0
+        # incremental trace-byte accounting: (entries measured, bytes).
+        # Entries below the mark are final — resolve only fills/inserts
+        # at the live pass's tail slot and checkpoints land post-resolve
+        # — so each checkpoint serializes only the new suffix instead of
+        # re-measuring the whole prefix (O(delta), not O(run-so-far))
+        self._trace_mark = (0, 0)
+        self._resumed = False
+        self._resume_cursor = 0
+        # index into self.trace where THIS process's emission began
+        # (resume: the restored prefix ends here)
+        self.resume_trace_index = 0
+        if _restore is not None:
+            self._load_restore(_restore)
+
+    # -- checkpoint / resume ------------------------------------------------
+
+    @classmethod
+    def from_checkpoint(
+        cls,
+        doc: dict,
+        *,
+        metrics: "metrics_mod.SchedulingMetrics | None" = None,
+        max_controller_rounds: int = 100,
+        pipeline: "str | None" = None,
+        checkpoint_path: "str | None" = None,
+        checkpoint_every_events: int = 0,
+        checkpoint_every_sim_s: float = 0.0,
+        stop_after_events: int = 0,
+    ) -> "LifecycleEngine":
+        """Rebuild an engine from a checkpoint document
+        (lifecycle/checkpoint.py `load_checkpoint`): the store restored
+        verbatim, the timeline cursor advanced past consumed events, the
+        trace prefix pre-loaded. `run()` then continues the run; the
+        full trace (prefix + new suffix) is byte-identical to an
+        uninterrupted run of the same spec. `pipeline` defaults to the
+        checkpointed run's pipeline."""
+        spec = ChaosSpec.from_dict(doc["spec"])
+        store = ResourceStore()
+        store.load_state(doc["store"])
+        return cls(
+            spec,
+            store=store,
+            metrics=metrics,
+            max_controller_rounds=max_controller_rounds,
+            pipeline=pipeline if pipeline is not None else doc.get("pipeline"),
+            checkpoint_path=checkpoint_path,
+            checkpoint_every_events=checkpoint_every_events,
+            checkpoint_every_sim_s=checkpoint_every_sim_s,
+            stop_after_events=stop_after_events,
+            _restore=doc,
+        )
+
+    def _load_restore(self, doc: dict) -> None:
+        eng = doc["engine"]
+        self._downed = copy.deepcopy(eng.get("downed") or {})
+        self._evicted_at = {
+            (ns, name): float(t)
+            for ns, name, t in (eng.get("evictedAt") or [])
+        }
+        self._tts = [float(x) for x in (eng.get("tts") or [])]
+        self._arrived = int(eng.get("arrived", 0))
+        self._evicted = int(eng.get("evicted", 0))
+        self._rescheduled = int(eng.get("rescheduled", 0))
+        self._lost = int(eng.get("lost", 0))
+        self.trace = copy.deepcopy(doc["trace"])
+        self.resume_trace_index = len(self.trace)
+        self._trace_mark = (
+            len(self.trace),
+            int(doc["traceByteOffset"]) if "traceByteOffset" in doc
+            else len(trace_jsonl(self.trace).encode()),
+        )
+        self.events_consumed = int(doc["cursor"])
+        self._resume_cursor = self.events_consumed
+        self.sim_time = float(doc.get("simTime", 0.0))
+        self._ckpt_marker_events = self.events_consumed
+        self._ckpt_marker_t = self.sim_time
+        self._resumed = True
+        self.scheduler.metrics.load_state(doc.get("metrics") or {})
+
+    def request_stop(self) -> None:
+        """Ask the run to stop at the next batch boundary (the graceful
+        SIGINT/SIGTERM path the CLI wires up): the in-flight pass
+        resolves, a final checkpoint is written when a path is
+        configured, and `run` returns phase ``Interrupted`` — with
+        NOTHING extra in the trace, so the emitted prefix stays an exact
+        prefix of the uninterrupted run's trace."""
+        self._stop_requested = True
+
+    def save_checkpoint(self, path: "str | None" = None) -> str:
+        """Resolve any in-flight pass and atomically persist a
+        checkpoint at `path` (default: the configured checkpoint_path)."""
+        from .checkpoint import checkpoint_doc, write_checkpoint
+
+        path = path or self.checkpoint_path
+        if not path:
+            raise ValueError("no checkpoint path configured")
+        self._resolve_inflight()  # an in-flight pass is not serializable
+        doc = checkpoint_doc(self)
+        write_checkpoint(doc, path)
+        self.checkpoints_written += 1
+        self.last_checkpoint_doc = doc
+        self._ckpt_marker_events = self.events_consumed
+        self._ckpt_marker_t = self.sim_time
+        return path
+
+    def _maybe_checkpoint(self, t: float) -> None:
+        if not self.checkpoint_path:
+            return
+        due = (
+            self.checkpoint_every_events > 0
+            and self.events_consumed - self._ckpt_marker_events
+            >= self.checkpoint_every_events
+        ) or (
+            self.checkpoint_every_sim_s > 0
+            and t - self._ckpt_marker_t >= self.checkpoint_every_sim_s
+        )
+        if due:
+            self.save_checkpoint()
 
     # -- trace --------------------------------------------------------------
 
@@ -159,6 +302,23 @@ class LifecycleEngine:
     def trace_jsonl(self) -> str:
         """The trace as replayable JSONL (sorted keys: byte-stable)."""
         return trace_jsonl(self.trace)
+
+    def trace_jsonl_since(self, index: int) -> str:
+        """The trace SUFFIX from `index` as JSONL — a resumed run's new
+        events are `trace_jsonl_since(engine.resume_trace_index)`, and
+        concatenating the checkpoint's prefix bytes with this suffix
+        reproduces the uninterrupted run's bytes exactly."""
+        return trace_jsonl(self.trace[index:])
+
+    def _trace_byte_len(self) -> int:
+        """Byte length of `trace_jsonl(self.trace)`, measured
+        incrementally from `_trace_mark` (call only with no in-flight
+        pass — i.e. where checkpoints happen)."""
+        n, nbytes = self._trace_mark
+        if len(self.trace) > n:
+            nbytes += len(trace_jsonl(self.trace[n:]).encode())
+            self._trace_mark = (len(self.trace), nbytes)
+        return nbytes
 
     # -- event application --------------------------------------------------
 
@@ -430,24 +590,35 @@ class LifecycleEngine:
     # -- the loop -----------------------------------------------------------
 
     def run(self) -> dict:
-        """Execute the timeline; returns the result document (phase,
-        counts, disruption summary, metrics). `self.trace` holds the
-        replayable event log afterwards."""
+        """Execute the timeline (or, after `from_checkpoint`, its
+        remainder); returns the result document (phase, counts,
+        disruption summary, metrics). `self.trace` holds the replayable
+        event log afterwards — for a resumed run, prefix included.
+
+        With a `checkpoint_path` configured, the run persists an atomic
+        checkpoint every `checkpoint_every_events` timeline events /
+        `checkpoint_every_sim_s` simulated seconds, and a FINAL one when
+        stopped via `request_stop` (the CLI's SIGINT/SIGTERM path) or
+        `stop_after_events` — phase ``Interrupted``, trace untouched, so
+        resume + concatenation is byte-identical (docs/resilience.md)."""
         spec = self.spec
-        heap = list(spec.events())
+        timeline = spec.events()
+        # the checkpoint cursor counts consumed events; batches never
+        # straddle a checkpoint, so the slice is exact
+        heap = timeline[self.events_consumed :]
         heapq.heapify(heap)
-        self._record(
-            "Start", 0.0,
-            spec=spec.name, seed=spec.seed, horizon=spec.horizon,
-            nodes=self.store.count("nodes"), pods=self.store.count("pods"),
-        )
-        # settle the initial cluster (imported pending pods schedule at t=0)
-        self._converge(0.0)
-        end_t = 0.0
+        if not self._resumed:
+            self._record(
+                "Start", 0.0,
+                spec=spec.name, seed=spec.seed, horizon=spec.horizon,
+                nodes=self.store.count("nodes"), pods=self.store.count("pods"),
+            )
+            # settle the initial cluster (imported pending pods schedule at t=0)
+            self._converge(0.0)
         try:
             while heap:
                 t, _, kind, payload = heapq.heappop(heap)
-                end_t = max(end_t, t)
+                self.sim_time = max(self.sim_time, t)
                 # batch events sharing a timestamp into one convergence
                 # (they are simultaneous in simulated time)
                 batch = [(kind, payload)]
@@ -471,32 +642,65 @@ class LifecycleEngine:
                         self._resolve_inflight()
                         self._apply_fault(t, dict(ev_payload))
                 self._converge(t)
+                self.events_consumed += len(batch)
+                self._maybe_checkpoint(t)
+                if self._stop_requested or (
+                    self.stop_after_events
+                    and self.events_consumed >= self.stop_after_events
+                ):
+                    return self._interrupt()
+        except KeyboardInterrupt:
+            # a hard ^C can land mid-batch, where the store is not
+            # checkpointable — release the pass lock and unwind; the
+            # graceful path is request_stop (the CLI's signal handlers)
+            self._abandon_inflight()
+            raise
         except Exception as e:  # noqa: BLE001 — a chaos run's failure is a result
             self._abandon_inflight()
             # a resolve that failed mid-flight may leave an unfilled
             # placeholder slot — drop it, the Abort record is the tail
             self.trace = [ev for ev in self.trace if ev]
             self.timings = [x for x in self.timings if "wallSeconds" in x]
-            self._record("Abort", end_t, error=f"{type(e).__name__}: {e}")
-            return self._result("Failed", end_t, message=f"{type(e).__name__}: {e}")
+            self._record("Abort", self.sim_time, error=f"{type(e).__name__}: {e}")
+            return self._result(
+                "Failed", self.sim_time, message=f"{type(e).__name__}: {e}"
+            )
 
         try:
             self._resolve_inflight()
         except Exception as e:  # noqa: BLE001
             self.trace = [ev for ev in self.trace if ev]
             self.timings = [x for x in self.timings if "wallSeconds" in x]
-            self._record("Abort", end_t, error=f"{type(e).__name__}: {e}")
-            return self._result("Failed", end_t, message=f"{type(e).__name__}: {e}")
+            self._record("Abort", self.sim_time, error=f"{type(e).__name__}: {e}")
+            return self._result(
+                "Failed", self.sim_time, message=f"{type(e).__name__}: {e}"
+            )
         # pods still pending from an eviction are reported, never dropped
         unschedulable = sorted(
             f"{ns}/{name}" for ns, name in self._evicted_at
         )
         self._record(
-            "End", end_t,
+            "End", self.sim_time,
             pending=self.store.count_pending_pods(),
             unschedulableEvicted=unschedulable,
         )
-        return self._result("Succeeded", end_t)
+        return self._result("Succeeded", self.sim_time)
+
+    def _interrupt(self) -> dict:
+        """The graceful-stop tail: resolve the in-flight pass, write the
+        final checkpoint (when configured), report ``Interrupted``. The
+        trace gets NO extra record — what was emitted stays an exact
+        prefix of the uninterrupted run's trace."""
+        self._resolve_inflight()
+        message = f"stopped after {self.events_consumed} timeline events"
+        out_path = None
+        if self.checkpoint_path:
+            out_path = self.save_checkpoint()
+            message += f"; checkpoint at {out_path}"
+        res = self._result("Interrupted", self.sim_time, message=message)
+        if out_path:
+            res["checkpoint"] = out_path
+        return res
 
     def _result(self, phase: str, end_t: float, message: str = "") -> dict:
         out = {
@@ -529,4 +733,13 @@ class LifecycleEngine:
         }
         if message:
             out["message"] = message
+        if self._resumed:
+            # provenance of a resumed run: where the checkpoint left off
+            # (passes/wallSeconds above cover only the post-resume
+            # suffix — wall-clock did not survive the process; the
+            # cumulative metrics block DID, via the checkpoint)
+            out["resumed"] = {
+                "cursor": self._resume_cursor,
+                "traceEvents": self.resume_trace_index,
+            }
         return out

@@ -370,8 +370,15 @@ class ResourceStore:
             ]
 
     def latest_rv(self) -> int:
+        # empty log: fall back to the prune high-water mark, so a store
+        # restored from a checkpoint (load_state empties the log) still
+        # reports its true resourceVersion position
         with self._lock:
-            return self._events[-1].resource_version if self._events else 0
+            return (
+                self._events[-1].resource_version
+                if self._events
+                else self._pruned_through
+            )
 
     def _emit(self, ev: WatchEvent):
         """Append to the event log (under self._lock) and queue for
@@ -399,6 +406,59 @@ class ResourceStore:
                     subs = list(self._subscribers)
                 for fn in subs:
                     fn(ev)
+
+    # -- checkpointing (lifecycle/checkpoint.py) ----------------------------
+
+    def dump_state(self) -> dict:
+        """Checkpoint-grade state dump: every object VERBATIM (metadata
+        resourceVersion/uid included) in its insertion order, plus the
+        resourceVersion counter's position.
+
+        This is deliberately NOT `export_snapshot` (models/snapshot.py):
+        the export wire shape strips server-stamped metadata and filters
+        system objects — lossy in ways that would shift encoding inputs
+        after a restore. A resumed lifecycle run must see the store
+        byte-for-byte as the interrupted run left it (the byte-identical
+        trace contract, docs/resilience.md)."""
+        with self._lock:
+            rv = self._pruned_through
+            for objs in self._objs.values():
+                for o in objs.values():
+                    try:
+                        rv = max(rv, int(o["metadata"]["resourceVersion"]))
+                    except (KeyError, ValueError, TypeError):
+                        pass
+            if self._events:
+                rv = max(rv, self._events[-1].resource_version)
+            return {
+                "rv": rv,
+                "objects": {
+                    kind: [copy.deepcopy(o) for o in objs.values()]
+                    for kind, objs in self._objs.items()
+                },
+            }
+
+    def load_state(self, state: dict) -> None:
+        """Restore a `dump_state` dump: objects land verbatim in their
+        dumped (= insertion) order and the rv counter resumes past the
+        dump's high-water mark. The event log starts empty with
+        `_pruned_through` at the restored rv — watchers and the delta
+        encoder see the restore as a 410-Gone boundary and relist /
+        full-encode, which is exactly right (their incremental state
+        did not survive the process)."""
+        with self._lock:
+            rv = int(state.get("rv", 0))
+            self._objs = {k: {} for k in KINDS}
+            for kind, objs in (state.get("objects") or {}).items():
+                if kind not in KINDS:
+                    continue
+                for obj in objs:
+                    self._objs[kind][self.key(kind, obj)] = copy.deepcopy(obj)
+            self._rv = itertools.count(rv + 1)
+            self._events = []
+            self._event_rvs = []
+            self._pruned_through = rv
+            self._delivery.clear()
 
     # -- reset --------------------------------------------------------------
 

@@ -79,6 +79,14 @@ class FaultEvent:
             raise ValueError(f"faults[{idx}]: 'taint' only valid for taint/untaint")
         return cls(at=float(at), action=action, node=node, taint=taint)
 
+    def to_dict(self) -> dict:
+        """The `from_dict` wire shape back — checkpoint round-tripping
+        (lifecycle/checkpoint.py) persists specs through this."""
+        out: dict = {"at": self.at, "action": self.action, "node": self.node}
+        if self.taint is not None:
+            out["taint"] = dict(self.taint)
+        return out
+
 
 @dataclass(frozen=True)
 class ArrivalProcess:
@@ -141,6 +149,21 @@ class ArrivalProcess:
             at=float(at or 0.0),
             replicas=int(replicas or 1),
         )
+
+    def to_dict(self) -> dict:
+        """The `from_dict` wire shape back: only the fields this kind
+        reads, so a round-trip re-parses to an identical process (and an
+        identical derived timeline)."""
+        out: dict = {"kind": self.kind, "template": copy.deepcopy(self.template)}
+        if self.kind == "poisson":
+            out["rate"] = self.rate
+            out["count"] = self.count
+        elif self.kind == "trace":
+            out["times"] = list(self.times)
+        else:  # gang
+            out["at"] = self.at
+            out["replicas"] = self.replicas
+        return out
 
     @property
     def prefix(self) -> str:
@@ -230,6 +253,28 @@ class ChaosSpec:
             pipeline=pipeline,
             name=str(d.get("name", "chaos")),
         )
+
+    def to_dict(self) -> dict:
+        """The spec back in its `from_dict` wire shape — a round trip
+        re-parses to an equal spec (events() identical), which is what
+        lets a lifecycle checkpoint carry its spec by value
+        (docs/resilience.md checkpoint format)."""
+        out: dict = {
+            "name": self.name,
+            "seed": self.seed,
+            "horizon": self.horizon,
+            "schedulerMode": self.scheduler_mode,
+            "pipeline": self.pipeline,
+            "arrivals": [p.to_dict() for p in self.arrivals],
+            "faults": [f.to_dict() for f in self.faults],
+        }
+        if self.window is not None:
+            out["window"] = self.window
+        if self.snapshot is not None:
+            out["snapshot"] = copy.deepcopy(self.snapshot)
+        if self.scheduler_config is not None:
+            out["schedulerConfig"] = copy.deepcopy(self.scheduler_config)
+        return out
 
     # -- deterministic timeline derivation ---------------------------------
 

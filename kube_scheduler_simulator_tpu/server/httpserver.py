@@ -44,11 +44,18 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from ..models.store import KINDS, NAMESPACED, StaleResourceVersion
+from ..utils.broker import CompileDeadlineExceeded, CompileUnavailable
 from .service import (
+    EngineDegraded,
     InvalidSchedulerConfiguration,
     SchedulerServiceDisabled,
     SimulatorService,
 )
+
+# Retry-After hint (seconds) on 503 degradation responses: long enough
+# for a compile cooldown window to elapse, short enough that a client
+# retry lands while the engine is probably healthy again.
+DEGRADED_RETRY_AFTER_S = 2
 
 # kind → (watch wire name, lastResourceVersion query param); reference
 # resourcewatcher.go:22-30 + handler/watcher.go:27-34 (note the singular
@@ -135,18 +142,54 @@ def _make_handler(server: SimulatorServer):
                 self.send_header("Access-Control-Allow-Origin", origin)
                 self.send_header("Access-Control-Allow-Credentials", "true")
 
-        def _json(self, code: int, payload=None):
+        def _json(self, code: int, payload=None, headers: "dict | None" = None):
             body = b"" if payload is None else json.dumps(payload).encode()
             self.send_response(code)
             self._cors_headers()
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for name, value in (headers or {}).items():
+                self.send_header(name, value)
             self.end_headers()
             if body:
                 self.wfile.write(body)
 
-        def _error(self, code: int, msg: str):
-            self._json(code, {"message": msg})
+        def _error(
+            self,
+            code: int,
+            msg: str,
+            kind: str = "",
+            detail: str = "",
+            headers: "dict | None" = None,
+        ):
+            """Structured JSON error: `error` is the human line, `kind`
+            the machine-matchable class (exception name or an HTTP-ish
+            label), `detail` optional context. `message` mirrors `error`
+            for pre-existing clients of the old single-key shape."""
+            self._json(
+                code,
+                {
+                    "error": msg,
+                    "kind": kind or ("client-error" if code < 500 else "server-error"),
+                    "detail": detail,
+                    "message": msg,
+                },
+                headers=headers,
+            )
+
+        def _degraded(self, e: Exception):
+            """Engine-degradation failures (compile deadline exhausted
+            with the eager rung unable to serve) map to 503 + a
+            Retry-After hint: the condition is load/compile-shaped and
+            retryable, not a client error (docs/resilience.md)."""
+            return self._error(
+                503,
+                str(e),
+                kind=type(e).__name__,
+                detail="engine degraded: compile ladder exhausted; retry "
+                "after the cooldown",
+                headers={"Retry-After": str(DEGRADED_RETRY_AFTER_S)},
+            )
 
         def _body(self):
             """Parse the request body: JSON first, YAML fallback — the
@@ -378,11 +421,20 @@ def _make_handler(server: SimulatorServer):
             except SchedulerServiceDisabled as e:
                 # reference schedulerconfig.go:32-34: external-scheduler
                 # mode answers config/scheduling calls with 400
-                return self._error(400, str(e))
+                return self._error(400, str(e), kind="SchedulerServiceDisabled")
             except InvalidSchedulerConfiguration as e:
-                return self._error(500, str(e))
+                return self._error(500, str(e), kind="InvalidSchedulerConfiguration")
+            except (EngineDegraded, CompileUnavailable, CompileDeadlineExceeded) as e:
+                # the degradation ladder's terminal failures are
+                # retryable service conditions, not server bugs: 503
+                return self._degraded(e)
             except Exception as e:  # noqa: BLE001 — boundary
-                return self._error(500, f"{type(e).__name__}: {e}")
+                return self._error(
+                    500,
+                    f"{type(e).__name__}: {e}",
+                    kind=type(e).__name__,
+                    detail="unhandled error at the API boundary",
+                )
 
         # -- handlers -------------------------------------------------------
 

@@ -45,12 +45,24 @@ from ..models.store import ResourceStore
 from ..sched.config import SchedulerConfiguration
 from ..sched.extender import ExtenderService
 from ..sched.results import PodSchedulingResult
+from ..utils import faultinject
 from ..utils import metrics as metrics_mod
-from ..utils.broker import CompileBroker, adjacent_bucket_targets
+from ..utils.broker import (
+    CompileBroker,
+    CompileUnavailable,
+    adjacent_bucket_targets,
+    eager_execution,
+)
 
 
 class InvalidSchedulerConfiguration(ValueError):
     pass
+
+
+class EngineDegraded(RuntimeError):
+    """The degradation ladder is fully exhausted: compilation kept
+    failing AND the un-jitted eager fallback failed too. The HTTP layer
+    maps this to 503 + Retry-After (docs/resilience.md)."""
 
 
 # The gang engine's evaluation-chunk size on the SERVING path. Placements
@@ -310,6 +322,41 @@ class SchedulerService:
             return {}, 0, ([] if record else None)
         return self._gang_finish(disp, record)
 
+    @staticmethod
+    def _fire_device_dispatch() -> None:
+        """The fault plane's device-dispatch site (``device_error``,
+        utils/faultinject.py): fired once per pass dispatch, upstream of
+        engine acquisition. An injected device error propagates — it is
+        not a compile problem, so the eager rung can't help; the
+        lifecycle engine's Abort path / the HTTP 500 boundary own it."""
+        plane = faultinject.active()
+        if plane is not None:
+            plane.maybe_raise("device_error")
+
+    def _eager_fallback(self, build, err: Exception):
+        """The degradation ladder's last rung (docs/resilience.md): run
+        the SAME engine pass un-jitted. Inside `eager_execution`,
+        `broker.jit` is a pass-through, so `build()` constructs an engine
+        whose programs execute eagerly — no XLA compile to fail or wedge.
+        The engine is NOT stored in the broker's warm map (it is not
+        compiled); the pass completes slowly instead of not at all."""
+        t0 = time.perf_counter()
+        try:
+            with eager_execution():
+                engine = build()
+        except Exception as e:
+            self.metrics.record_resilience(degraded_passes=1)
+            raise EngineDegraded(
+                f"compile ladder exhausted ({err}) and eager fallback "
+                f"failed: {type(e).__name__}: {e}"
+            ) from e
+        self.metrics.record_resilience(degraded_passes=1, eager_fallbacks=1)
+        self.metrics.record_phase_seconds(execute=time.perf_counter() - t0)
+        # downstream finish steps (the gang record decode) lazily create
+        # MORE jits on this engine — they must stay on the eager rung too
+        engine._kss_eager_fallback = True
+        return engine
+
     def _gang_dispatch(self, config, record: bool, window=None):
         """Encode + execute one gang pass, engine served by the broker;
         returns an opaque tuple for `_gang_finish`, or None when nothing
@@ -320,6 +367,7 @@ class SchedulerService:
         enc = self._encode_current(config)
         if enc is None:
             return None
+        self._fire_device_dispatch()
         # the window joins the broker key as the CANONICAL chunk-rounded
         # value program identity actually depends on (raw windows that
         # round to the same WP share one compilation)
@@ -345,7 +393,12 @@ class SchedulerService:
             return g
 
         broker_info: dict = {}
-        gang = self.broker.get(sig, build, info=broker_info)
+        try:
+            gang = self.broker.get_resilient(sig, build, info=broker_info)
+        except CompileUnavailable as e:
+            # the ladder's last rung: the SAME pass, un-jitted (build
+            # runs the engine, so the finish path is identical)
+            return (enc, self._eager_fallback(build, e))
         if not holder.get("ran"):
             gang.retarget(enc)
             if record:
@@ -372,7 +425,15 @@ class SchedulerService:
 
         enc, gang = disp
         t_decode = time.perf_counter()
-        results = gang.results() if record else None
+        if record and getattr(gang, "_kss_eager_fallback", False):
+            # a degraded pass's record decode lazily builds its replay
+            # programs (_recorder/_assemble_trace) — those compiles must
+            # run un-jitted too, or the "slow but completes" guarantee
+            # dies right here on the same wedged compiler
+            with eager_execution():
+                results = gang.results()
+        else:
+            results = gang.results() if record else None
         # preemption victims: pre-bound pods the preempt phase evicted.
         # They are NOT in placements (decode covers queued pods only), so
         # diff the full [P] assignment exactly like the sequential path —
@@ -654,6 +715,7 @@ class SchedulerService:
         enc = self._encode_current(config)
         if enc is None:
             return None
+        self._fire_device_dispatch()
         if config.extenders:
             # host-callback loop: device segments + extender HTTP calls,
             # with the same compiled-program reuse as the batch path.
@@ -670,11 +732,15 @@ class SchedulerService:
                 holder["built_s"] = time.perf_counter() - t0
                 return es
 
-            ext_sched = self.broker.get(sig, build)
-            if "built_s" in holder:
-                self.metrics.record_engine_build(holder["built_s"])
+            try:
+                ext_sched = self.broker.get_resilient(sig, build)
+            except CompileUnavailable as e:
+                ext_sched = self._eager_fallback(build, e)
             else:
-                ext_sched.retarget(enc, self.extender_service)
+                if "built_s" in holder:
+                    self.metrics.record_engine_build(holder["built_s"])
+                else:
+                    ext_sched.retarget(enc, self.extender_service)
             t0 = time.perf_counter()
             results = ext_sched.run()
             self.metrics.record_phase_seconds(execute=time.perf_counter() - t0)
@@ -694,7 +760,10 @@ class SchedulerService:
             return s
 
         broker_info: dict = {}
-        sched = self.broker.get(sig, build, info=broker_info)
+        try:
+            sched = self.broker.get_resilient(sig, build, info=broker_info)
+        except CompileUnavailable as e:
+            return ("batch", enc, self._eager_fallback(build, e), None)
         if not holder.get("ran"):
             sched.retarget(enc)
             sched.run()
@@ -720,6 +789,14 @@ class SchedulerService:
         t0 = time.perf_counter()
         if kind == "ext":
             final_assignment = engine.final_state.assignment
+        elif getattr(engine, "_kss_eager_fallback", False):
+            # same trap the gang record decode has: any jit `results()`
+            # creates lazily must stay on a degraded pass's eager rung
+            # (today the sequential engine jits everything in __init__,
+            # but this guard keeps that an implementation detail)
+            with eager_execution():
+                results = engine.results()
+            final_assignment = engine._final_state.assignment
         else:
             results = engine.results()
             final_assignment = engine._final_state.assignment

@@ -39,25 +39,87 @@ Accounting (surfaced through `SchedulingMetrics.record_compile` into the
 ``KSS_NO_SPECULATIVE_COMPILE=1`` disables the background worker for
 deterministic profiling (docs/performance.md); dedupe and the warm-engine
 map stay on.
+
+Run supervision (the robustness PR, docs/resilience.md): the serving
+layer reaches the broker through `get_resilient`, which adds the compile
+WATCHDOG + DEGRADATION LADDER on top of `get`'s dedupe:
+
+  * each build attempt runs under a deadline (``KSS_COMPILE_DEADLINE_S``;
+    0/unset = no watchdog) — a wedged XLA compile can't be interrupted
+    from Python, so the watchdog abandons the compile thread and treats
+    the attempt as failed (the detached thread's late result is
+    discarded);
+  * failed/timed-out attempts retry with exponential backoff
+    (``KSS_COMPILE_RETRIES`` more attempts, base ``KSS_COMPILE_BACKOFF_S``),
+    each retry counted as ``compileRetries``;
+  * a key whose ladder is exhausted enters a COOLDOWN
+    (``KSS_COMPILE_COOLDOWN_PASSES`` calls served degraded without
+    re-paying the deadline+retry storm), and `CompileUnavailable` tells
+    the caller to run the pass eagerly (`eager_execution` makes
+    `broker.jit` a pass-through, so the same engine pass executes
+    un-jitted — slow, but it completes);
+  * the fault plane (utils/faultinject.py) wires into the build attempt
+    (``compile_slow`` / ``compile_fail``) and the speculative worker
+    (``worker_crash``) so every rung is testable on CPU.
+
+A crashed speculative worker no longer dies silently: the crash is
+logged once, counted (``brokerWorkerCrashes``), and speculation
+self-disables for the broker.
 """
 
 from __future__ import annotations
 
+import atexit
+import logging
 import os
 import threading
 import time
+import weakref
+from contextlib import contextmanager
 
+from . import faultinject
 from .compilecache import enable_compile_cache, shape_bucket
 
+_log = logging.getLogger("kube_scheduler_simulator_tpu.broker")
+
 _jit_cache_armed = False
+
+# thread-local eager-execution switch: inside `eager_execution()`, `jit`
+# returns the raw function — the degradation ladder's last rung builds
+# engines whose every "compiled" program is plain eager JAX
+_eager = threading.local()
+
+
+@contextmanager
+def eager_execution():
+    """Make `broker.jit` a pass-through on THIS thread for the block:
+    engines constructed inside run un-jitted (no XLA compile to fail or
+    wedge). Thread-local, so a degraded request never leaks eagerness
+    into concurrent passes or the speculation worker."""
+    prev = getattr(_eager, "on", False)
+    _eager.on = True
+    try:
+        yield
+    finally:
+        _eager.on = prev
+
+
+def eager_active() -> bool:
+    return getattr(_eager, "on", False)
 
 
 def jit(fn, **kw):
     """`jax.jit` with the persistent compile cache armed first — the
     single jit entry point for the engines (engine/engine.py,
     engine/gang.py, parallel/sweep.py, engine/extender_loop.py), so every
-    program they lower is eligible for cross-process disk-cache hits."""
+    program they lower is eligible for cross-process disk-cache hits.
+
+    Inside `eager_execution()` this returns `fn` itself (jit kwargs like
+    donate_argnums are compile-time hints with no eager meaning): the
+    degradation ladder's eager rung."""
     global _jit_cache_armed
+    if eager_active():
+        return fn
     import jax
 
     if not _jit_cache_armed:
@@ -67,6 +129,91 @@ def jit(fn, **kw):
             enable_compile_cache()
         _jit_cache_armed = True
     return jax.jit(fn, **kw)
+
+
+class CompileDeadlineExceeded(RuntimeError):
+    """One build attempt overran KSS_COMPILE_DEADLINE_S (the compile
+    thread is abandoned; its late result, if any, is discarded).
+    `thread` is the abandoned builder, so the broker can refuse to
+    re-probe a key while a previous probe is still stuck in XLA."""
+
+    def __init__(self, msg: str, thread: "threading.Thread | None" = None):
+        super().__init__(msg)
+        self.thread = thread
+
+
+class CompileUnavailable(RuntimeError):
+    """The compile ladder is exhausted (retries spent or cooldown
+    active): the caller must serve the pass another way — the serving
+    layer's eager fallback (server/service.py)."""
+
+
+def _env_number(name: str, default, convert, minimum):
+    """A ladder knob from the environment: malformed or out-of-range
+    values fall back to the default — a typo must never disarm the
+    degradation ladder."""
+    raw = os.environ.get(name, "")
+    try:
+        v = convert(raw) if raw else default
+    except ValueError:
+        return default
+    return v if v >= minimum else default
+
+
+def compile_deadline_s() -> float:
+    """Per-attempt compile deadline from KSS_COMPILE_DEADLINE_S; 0 (the
+    default) disables the watchdog — no extra thread per compile."""
+    return _env_number("KSS_COMPILE_DEADLINE_S", 0.0, float, 0.0)
+
+
+def compile_retry_limit() -> int:
+    """Extra build attempts after the first failure
+    (KSS_COMPILE_RETRIES, default 2)."""
+    return _env_number("KSS_COMPILE_RETRIES", 2, int, 0)
+
+
+def compile_backoff_s() -> float:
+    """Base of the exponential retry backoff (KSS_COMPILE_BACKOFF_S,
+    default 0.05): retry i sleeps base * 2**(i-1)."""
+    return _env_number("KSS_COMPILE_BACKOFF_S", 0.05, float, 0.0)
+
+
+def compile_cooldown_passes() -> int:
+    """How many `get_resilient` calls a ladder-exhausted key serves
+    degraded before re-probing compilation (KSS_COMPILE_COOLDOWN_PASSES,
+    default 3)."""
+    return _env_number("KSS_COMPILE_COOLDOWN_PASSES", 3, int, 1)
+
+
+def _call_with_deadline(build, deadline_s: float):
+    """Run `build()` with a watchdog: on timeout the builder thread is
+    abandoned (a wedged XLA compile cannot be interrupted from Python)
+    and `CompileDeadlineExceeded` raises on the caller. The abandoned
+    thread's result — engine or exception — is discarded."""
+    if deadline_s <= 0:
+        return build()
+    box: dict = {}
+    done = threading.Event()
+
+    def runner():
+        try:
+            box["engine"] = build()
+        except BaseException as e:  # noqa: BLE001 — relayed to the caller
+            box["error"] = e
+        done.set()
+
+    th = threading.Thread(
+        target=runner, name="kss-compile-attempt", daemon=True
+    )
+    th.start()
+    if not done.wait(deadline_s):
+        raise CompileDeadlineExceeded(
+            f"compile exceeded KSS_COMPILE_DEADLINE_S={deadline_s}s",
+            thread=th,
+        )
+    if "error" in box:
+        raise box["error"]
+    return box["engine"]
 
 
 def speculation_enabled_default() -> bool:
@@ -142,24 +289,47 @@ class CompileBroker:
         self._tasks: list = []
         self._worker: "threading.Thread | None" = None
         self._busy = 0  # speculation tasks queued or running
+        # degradation ladder: keys whose compile ladder is exhausted →
+        # remaining get_resilient calls served degraded without retrying
+        self._cooldown: "dict[tuple, int]" = {}
+        # watchdog-abandoned builder threads per key: while any is still
+        # alive (a truly wedged XLA compile), re-probing the key would
+        # leak ANOTHER stuck thread every cooldown cycle — the probe is
+        # refused instead, bounding the leak at one batch per key
+        self._abandoned: "dict[tuple, list[threading.Thread]]" = {}
+        self._crash_logged = False
         # local counters (mirrored into self.metrics when present)
         self.compile_hits = 0
         self.compile_misses = 0
         self.speculative_compiles = 0
         self.stall_seconds = 0.0
+        self.compile_retries = 0
+        self.worker_crashes = 0
+        _live_brokers.add(self)
 
     # -- accounting ---------------------------------------------------------
 
-    def _note(self, hits=0, misses=0, speculative=0, stall_s=0.0) -> None:
+    def _note(
+        self, hits=0, misses=0, speculative=0, stall_s=0.0,
+        retries=0, worker_crashes=0,
+    ) -> None:
         with self._lock:
             self.compile_hits += hits
             self.compile_misses += misses
             self.speculative_compiles += speculative
             self.stall_seconds += stall_s
+            self.compile_retries += retries
+            self.worker_crashes += worker_crashes
         if self.metrics is not None:
-            self.metrics.record_compile(
-                hits=hits, misses=misses, speculative=speculative, stall_s=stall_s
-            )
+            if hits or misses or speculative or stall_s:
+                self.metrics.record_compile(
+                    hits=hits, misses=misses, speculative=speculative,
+                    stall_s=stall_s,
+                )
+            if retries or worker_crashes:
+                self.metrics.record_resilience(
+                    retries=retries, worker_crashes=worker_crashes
+                )
 
     def stats(self) -> dict:
         with self._lock:
@@ -168,6 +338,8 @@ class CompileBroker:
                 "compileMisses": self.compile_misses,
                 "speculativeCompiles": self.speculative_compiles,
                 "stallSeconds": round(self.stall_seconds, 6),
+                "compileRetries": self.compile_retries,
+                "brokerWorkerCrashes": self.worker_crashes,
             }
 
     # -- warm-engine map ----------------------------------------------------
@@ -243,6 +415,156 @@ class CompileBroker:
                 return fl.engine
             # the builder failed; loop — this caller may build it now
 
+    # -- run supervision (watchdog + degradation ladder) --------------------
+
+    def _attempt_build(self, build):
+        """One supervised build attempt: the fault plane's compile sites
+        fire inside the watchdog window (an injected compile_slow must
+        be able to trip the deadline, exactly like a wedged XLA compile)."""
+
+        def attempt():
+            plane = faultinject.active()
+            if plane is not None:
+                plane.delay("compile_slow")
+                plane.maybe_raise("compile_fail")
+            return build()
+
+        return _call_with_deadline(attempt, compile_deadline_s())
+
+    def get_resilient(self, key: tuple, build, info: "dict | None" = None):
+        """`get` under run supervision — the serving path's entry point
+        (docs/resilience.md). Semantics on top of `get`:
+
+          * each request-thread build attempt runs under the
+            KSS_COMPILE_DEADLINE_S watchdog and the fault plane's
+            compile sites;
+          * a failed/timed-out attempt retries with exponential backoff,
+            up to KSS_COMPILE_RETRIES extra attempts (each counted as a
+            compileRetry);
+          * when the ladder is exhausted the key enters a cooldown of
+            KSS_COMPILE_COOLDOWN_PASSES calls and `CompileUnavailable`
+            raises — the caller serves the pass eagerly instead
+            (`eager_execution`). A speculative background build landing
+            the key warm ends the cooldown early.
+
+        Without a deadline, retries, faults, or failures this is exactly
+        `get` (same dedupe, same counters)."""
+        while True:
+            cooled = False
+            with self._lock:
+                eng = self._engines.get(key)
+                if eng is not None:
+                    self._engines[key] = self._engines.pop(key)  # recency
+                    self._cooldown.pop(key, None)  # warm ends the cooldown
+                    mine = None
+                else:
+                    remaining = self._cooldown.get(key, 0)
+                    if remaining > 0:
+                        if remaining > 1:
+                            self._cooldown[key] = remaining - 1
+                        else:
+                            # cooldown spent: the NEXT call re-probes
+                            self._cooldown.pop(key, None)
+                        cooled = True
+                        mine = False
+                    elif self._stuck_locked(key):
+                        # an abandoned builder is STILL inside XLA: a
+                        # re-probe would leak another thread — stay
+                        # degraded until the stuck compile dies
+                        self._cooldown[key] = compile_cooldown_passes()
+                        cooled = True
+                        mine = False
+                    else:
+                        fl = self._inflight.get(key)
+                        if fl is None:
+                            fl = _Inflight()
+                            self._inflight[key] = fl
+                            mine = True
+                        else:
+                            mine = False
+            if mine is None:
+                if info is not None:
+                    info.update(source="hit", wait_s=0.0)
+                self._note(hits=1)
+                return eng
+            if cooled:
+                raise CompileUnavailable(
+                    f"compile for {key!r} is cooling down after ladder "
+                    f"exhaustion; serve degraded"
+                )
+            if mine:
+                return self._build_resilient(key, fl, build, info)
+            # share someone else's in-flight build, like `get`
+            t0 = time.perf_counter()
+            fl.ev.wait()
+            if fl.engine is not None:
+                wait_s = time.perf_counter() - t0
+                if info is not None:
+                    info.update(source="wait", wait_s=wait_s)
+                self._note(hits=1, stall_s=wait_s)
+                return fl.engine
+            # builder failed: loop — the cooldown it set (or a free
+            # slot) decides this caller's fate
+
+    def _stuck_locked(self, key: tuple) -> bool:
+        """Under self._lock: prune dead abandoned builders for `key`;
+        True when one is still running (the wedged compile persists)."""
+        alive = [t for t in self._abandoned.get(key, ()) if t.is_alive()]
+        if alive:
+            self._abandoned[key] = alive
+            return True
+        self._abandoned.pop(key, None)
+        return False
+
+    def _build_resilient(self, key: tuple, fl: _Inflight, build, info):
+        """The retry ladder for the caller that owns the in-flight slot."""
+        t0 = time.perf_counter()
+        attempts = 1 + compile_retry_limit()
+        backoff = compile_backoff_s()
+        eng = None
+        err: "Exception | None" = None
+        try:
+            for i in range(attempts):
+                if i:
+                    self._note(retries=1)
+                    if backoff > 0:
+                        time.sleep(backoff * (2 ** (i - 1)))
+                try:
+                    eng = self._attempt_build(build)
+                    break
+                except Exception as e:  # noqa: BLE001 — each rung retries
+                    err = e
+                    th = getattr(e, "thread", None)
+                    if th is not None:
+                        with self._lock:
+                            self._abandoned.setdefault(key, []).append(th)
+        except BaseException:
+            # non-Exception escape (KeyboardInterrupt, SystemExit):
+            # release the slot exactly like `get`'s miss path
+            with self._lock:
+                self._inflight.pop(key, None)
+            fl.ev.set()
+            raise
+        if eng is None:
+            with self._lock:
+                self._inflight.pop(key, None)
+                self._cooldown[key] = compile_cooldown_passes()
+            fl.ev.set()  # engine stays None: waiters re-enter the ladder
+            self._note(stall_s=time.perf_counter() - t0)
+            raise CompileUnavailable(
+                f"compile ladder exhausted for {key!r} after {attempts} "
+                f"attempts: {type(err).__name__}: {err}"
+            ) from err
+        with self._lock:
+            self._store_locked(key, eng)
+            self._inflight.pop(key, None)
+        fl.engine = eng
+        fl.ev.set()
+        if info is not None:
+            info.update(source="miss", wait_s=0.0)
+        self._note(misses=1, stall_s=time.perf_counter() - t0)
+        return eng
+
     # -- speculation --------------------------------------------------------
 
     def speculate(self, token, task) -> bool:
@@ -275,17 +597,36 @@ class CompileBroker:
                     return
                 token, task = self._tasks.pop(0)
             try:
+                plane = faultinject.active()
+                if plane is not None:
+                    plane.maybe_raise("worker_crash")
                 res = task()
                 if res is not None:
                     key, build = res
                     self._background_build(key, build)
-            except BaseException:  # noqa: BLE001 — speculation never fails a run
-                pass
+            except BaseException as e:  # noqa: BLE001 — speculation never fails a run
+                self._contain_worker_crash(e)
             finally:
                 with self._lock:
                     self._tokens.discard(token)
                     self._busy -= 1
                     self._idle.notify_all()
+
+    def _contain_worker_crash(self, exc: BaseException) -> None:
+        """A crashed speculative task/worker: logged ONCE per broker,
+        counted (brokerWorkerCrashes), and speculation self-disables —
+        the worker must degrade visibly, never die silently. Dedupe and
+        the warm-engine map stay on; already-queued tasks still drain
+        (their tokens must clear) but no new speculation is accepted."""
+        if not self._crash_logged:
+            self._crash_logged = True
+            _log.warning(
+                "speculative compile worker crashed (%s: %s); "
+                "disabling speculation for this broker",
+                type(exc).__name__, exc,
+            )
+        self.speculative = False
+        self._note(worker_crashes=1)
 
     def _background_build(self, key: tuple, build) -> None:
         with self._lock:
@@ -294,6 +635,13 @@ class CompileBroker:
             fl = _Inflight()
             self._inflight[key] = fl
         try:
+            # the fault plane's compile sites cover background builds
+            # too (a failed speculative compile is a NORMAL outcome —
+            # contained here, not a worker crash)
+            plane = faultinject.active()
+            if plane is not None:
+                plane.delay("compile_slow")
+                plane.maybe_raise("compile_fail")
             eng = build()
         except BaseException:  # noqa: BLE001
             with self._lock:
@@ -319,3 +667,21 @@ class CompileBroker:
                     return False
                 self._idle.wait(remaining)
         return True
+
+
+# Every live broker, so interpreter exit can quiesce speculation first:
+# a speculative compile still inside XLA when Python tears down dies as
+# std::terminate / a segfault from the C++ compiler threads — the
+# process "crashes" on a run that SUCCEEDED. Exit must out-wait any
+# in-flight background build (bounded: a truly wedged compile must not
+# turn exit into a hang — past the timeout we accept the teardown race
+# rather than never exiting).
+_live_brokers: "weakref.WeakSet" = weakref.WeakSet()
+_ATEXIT_DRAIN_TIMEOUT_S = 30.0
+
+
+@atexit.register
+def _drain_live_brokers() -> None:
+    for broker in list(_live_brokers):
+        broker.speculative = False  # no new work while exiting
+        broker.drain(timeout=_ATEXIT_DRAIN_TIMEOUT_S)

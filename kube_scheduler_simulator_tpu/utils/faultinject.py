@@ -1,0 +1,224 @@
+"""Engine-internal fault injection — the deterministic fault plane.
+
+PR 1 injects faults *into the cluster* (node fail/drain/cordon timelines,
+scenario/chaos.py); this module injects faults *into the engine itself*,
+so the run-supervision ladder (docs/resilience.md) — compile retry with
+backoff, the compile watchdog, eager fallback, speculative-worker crash
+containment, checkpoint/resume after a kill — is exercisable by ordinary
+CPU pytest instead of waiting for a real wedged XLA compile or a dying
+background thread.
+
+Grammar (env ``KSS_FAULT_INJECT``, comma-separated ``site:value``):
+
+    KSS_FAULT_INJECT=compile_fail:0.3,compile_slow:5s,device_error:0.1
+
+  * probability sites — ``value`` is a float in [0, 1]: each time the
+    site fires, a seeded draw decides whether to raise `InjectedFault`:
+      - ``compile_fail``  — the broker's compile point (request-thread
+        builds in `CompileBroker.get_resilient` AND background
+        speculative builds);
+      - ``device_error``  — the serving layer's device-dispatch point
+        (the top of a scheduling pass dispatch);
+      - ``worker_crash``  — the broker's speculative worker loop (the
+        crash the hardened worker must contain);
+  * duration sites — ``value`` is a duration (``5s``, ``250ms``): the
+    site sleeps that long every time it fires:
+      - ``compile_slow``  — injected compile latency, the wedged-compile
+        stand-in the KSS_COMPILE_DEADLINE_S watchdog trips on.
+
+Determinism: every probability site draws from its own
+``random.Random(f"kss-fault:{seed}:{site}")`` stream (seed from
+``KSS_FAULT_INJECT_SEED``, default 0) — no global RNG, no wall clock, so
+a single-threaded call sequence draws identically across runs. Sites are
+independent streams: adding one never reshuffles another. NOTE: draws
+from concurrent threads (request thread vs speculation worker) interleave
+nondeterministically — specs that need strict determinism use 0/1
+probabilities, which are interleaving-proof.
+
+The plane is process-global and read per fire point from the
+environment, cached on the raw env string — tests flip it with
+``monkeypatch.setenv`` and the next fire sees the new plane; `activate`
+overrides the environment entirely (unit tests, embedded drivers).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+
+PROBABILITY_SITES = ("compile_fail", "device_error", "worker_crash")
+DURATION_SITES = ("compile_slow",)
+
+ENV_VAR = "KSS_FAULT_INJECT"
+SEED_VAR = "KSS_FAULT_INJECT_SEED"
+
+
+class InjectedFault(RuntimeError):
+    """A fault raised by the fault plane, never by real engine state."""
+
+    def __init__(self, site: str):
+        super().__init__(f"injected fault: {site}")
+        self.site = site
+
+
+class FaultPlane:
+    """One parsed fault-injection spec: per-site rules + seeded streams."""
+
+    def __init__(self, rules: "dict[str, float]", seed: int = 0):
+        for site in rules:
+            if site not in PROBABILITY_SITES + DURATION_SITES:
+                raise ValueError(
+                    f"unknown fault site {site!r} (one of "
+                    f"{'/'.join(PROBABILITY_SITES + DURATION_SITES)})"
+                )
+        self.rules = dict(rules)
+        self.seed = int(seed)
+        self._lock = threading.Lock()
+        self._rng = {
+            site: random.Random(f"kss-fault:{self.seed}:{site}")
+            for site in PROBABILITY_SITES
+        }
+        # how many faults each site actually injected (raises + sleeps)
+        self.injected: dict[str, int] = {site: 0 for site in self.rules}
+
+    @classmethod
+    def parse(cls, text: str, seed: int = 0) -> "FaultPlane":
+        """Parse the ``site:value,site:value`` grammar. Strict, like
+        ChaosSpec: a typo'd spec raises at parse time, not as a silently
+        fault-free run."""
+        rules: dict[str, float] = {}
+        for part in text.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            site, sep, raw = part.partition(":")
+            site = site.strip()
+            raw = raw.strip()
+            if not sep or not raw:
+                raise ValueError(
+                    f"fault-inject entry {part!r}: expected site:value"
+                )
+            if site in DURATION_SITES:
+                rules[site] = _parse_duration_s(site, raw)
+            elif site in PROBABILITY_SITES:
+                try:
+                    p = float(raw)
+                except ValueError:
+                    raise ValueError(
+                        f"fault site {site}: probability {raw!r} is not a number"
+                    ) from None
+                if not 0.0 <= p <= 1.0:
+                    raise ValueError(
+                        f"fault site {site}: probability {p} outside [0, 1]"
+                    )
+                rules[site] = p
+            else:
+                raise ValueError(
+                    f"unknown fault site {site!r} (one of "
+                    f"{'/'.join(PROBABILITY_SITES + DURATION_SITES)})"
+                )
+        return cls(rules, seed=seed)
+
+    # -- fire points --------------------------------------------------------
+
+    def maybe_raise(self, site: str) -> None:
+        """Raise `InjectedFault` when the site's seeded draw says so."""
+        p = self.rules.get(site, 0.0)
+        if p <= 0.0:
+            return
+        with self._lock:
+            hit = p >= 1.0 or self._rng[site].random() < p
+            if hit:
+                self.injected[site] = self.injected.get(site, 0) + 1
+        if hit:
+            raise InjectedFault(site)
+
+    def delay(self, site: str) -> float:
+        """Sleep the site's configured duration; returns seconds slept."""
+        d = self.rules.get(site, 0.0)
+        if d <= 0.0:
+            return 0.0
+        with self._lock:
+            self.injected[site] = self.injected.get(site, 0) + 1
+        time.sleep(d)
+        return d
+
+    def counts(self) -> dict:
+        with self._lock:
+            return {k: v for k, v in self.injected.items() if v}
+
+
+def _parse_duration_s(site: str, raw: str) -> float:
+    for suffix, scale in (("ms", 1e-3), ("s", 1.0)):
+        if raw.endswith(suffix):
+            body = raw[: -len(suffix)]
+            try:
+                d = float(body)
+            except ValueError:
+                break
+            if d < 0:
+                break
+            return d * scale
+    raise ValueError(
+        f"fault site {site}: duration {raw!r} must be like '5s' or '250ms'"
+    )
+
+
+# -- the process-global active plane ----------------------------------------
+
+_lock = threading.Lock()
+# (raw env string, seed string) -> plane parsed from them; an explicit
+# `activate` overrides the environment until `deactivate`
+_cached: "tuple[tuple[str, str], FaultPlane | None] | None" = None
+_override: "FaultPlane | None" = None
+_overridden = False
+
+
+def active() -> "FaultPlane | None":
+    """The currently active plane, or None (the default: no injection).
+
+    Reads KSS_FAULT_INJECT / KSS_FAULT_INJECT_SEED each call but reparses
+    only when they change, so fire points are cheap enough for compile
+    and dispatch paths. A malformed env spec raises here — at the first
+    fire point — rather than being silently ignored: a fault-injection
+    run that injects nothing is the worst failure mode this module has.
+    """
+    global _cached
+    with _lock:
+        if _overridden:
+            return _override
+        raw = os.environ.get(ENV_VAR, "")
+        seed_raw = os.environ.get(SEED_VAR, "0")
+        key = (raw, seed_raw)
+        if _cached is not None and _cached[0] == key:
+            return _cached[1]
+        if not raw.strip():
+            plane = None
+        else:
+            try:
+                seed = int(seed_raw)
+            except ValueError:
+                seed = 0
+            plane = FaultPlane.parse(raw, seed=seed)
+        _cached = (key, plane)
+        return plane
+
+
+def activate(plane: "FaultPlane | None") -> None:
+    """Install `plane` as the active plane regardless of the environment
+    (None = injection explicitly off). Until `deactivate`, the env vars
+    are not consulted."""
+    global _override, _overridden
+    with _lock:
+        _override = plane
+        _overridden = True
+
+
+def deactivate() -> None:
+    """Drop any `activate` override; the environment rules again."""
+    global _override, _overridden
+    with _lock:
+        _override = None
+        _overridden = False

@@ -78,6 +78,14 @@ class SchedulingMetrics:
     _compile_misses: int = 0
     _speculative_compiles: int = 0
     _stall_s: float = 0.0
+    # run-supervision / degradation-ladder counters (docs/resilience.md):
+    # compile retries after a failed/timed-out build, passes served by
+    # the un-jitted eager fallback, passes that ran degraded at all, and
+    # speculative-worker crashes contained by the hardened broker loop
+    _compile_retries: int = 0
+    _eager_fallbacks: int = 0
+    _degraded_passes: int = 0
+    _worker_crashes: int = 0
 
     def record(self, rec: PassRecord) -> None:
         with self._lock:
@@ -140,6 +148,25 @@ class SchedulingMetrics:
             self._compile_misses += int(misses)
             self._speculative_compiles += int(speculative)
             self._stall_s += float(stall_s)
+
+    def record_resilience(
+        self,
+        *,
+        retries: int = 0,
+        eager_fallbacks: int = 0,
+        degraded_passes: int = 0,
+        worker_crashes: int = 0,
+    ) -> None:
+        """Degradation-ladder accounting (docs/resilience.md): `retries`
+        compile attempts re-run after a failure or deadline, `degraded_passes`
+        passes that could not be served by a compiled engine,
+        `eager_fallbacks` of those that the un-jitted eager rung served,
+        `worker_crashes` speculative-worker crashes the broker contained."""
+        with self._lock:
+            self._compile_retries += int(retries)
+            self._eager_fallbacks += int(eager_fallbacks)
+            self._degraded_passes += int(degraded_passes)
+            self._worker_crashes += int(worker_crashes)
 
     def record_phase_seconds(
         self, execute: float = 0.0, decode: float = 0.0
@@ -215,6 +242,10 @@ class SchedulingMetrics:
                     "compileMisses": self._compile_misses,
                     "speculativeCompiles": self._speculative_compiles,
                     "stallSeconds": round(self._stall_s, 6),
+                    "compileRetries": self._compile_retries,
+                    "eagerFallbacks": self._eager_fallbacks,
+                    "degradedPasses": self._degraded_passes,
+                    "brokerWorkerCrashes": self._worker_crashes,
                 },
             }
 
@@ -241,6 +272,43 @@ class SchedulingMetrics:
             self._compile_misses = 0
             self._speculative_compiles = 0
             self._stall_s = 0.0
+            self._compile_retries = 0
+            self._eager_fallbacks = 0
+            self._degraded_passes = 0
+            self._worker_crashes = 0
+
+    # -- checkpointing (lifecycle/checkpoint.py) -----------------------------
+
+    # counter fields a lifecycle checkpoint carries: everything cumulative
+    # (the bounded `recent` pass window is cosmetic and stays out)
+    _STATE_FIELDS = (
+        "_pass_count", "_total_pods", "_total_scheduled", "_total_wall_s",
+        "_evicted", "_rescheduled", "_tts_sum_s", "_tts_max_s", "_tts_count",
+        "_engine_builds", "_compile_hits", "_compile_misses",
+        "_speculative_compiles", "_stall_s", "_compile_retries",
+        "_eager_fallbacks", "_degraded_passes", "_worker_crashes",
+    )
+
+    def state_dict(self) -> dict:
+        """The cumulative counters as one JSON-able dict — what a
+        lifecycle checkpoint persists so a resumed run's final metrics
+        report the WHOLE run, not just the post-resume suffix."""
+        with self._lock:
+            out = {f: getattr(self, f) for f in self._STATE_FIELDS}
+            out["_phase_s"] = dict(self._phase_s)
+            out["_encode_counts"] = dict(self._encode_counts)
+            return out
+
+    def load_state(self, state: dict) -> None:
+        """Restore counters written by `state_dict` (unknown keys are
+        ignored so old checkpoints stay loadable across counter growth)."""
+        with self._lock:
+            for f in self._STATE_FIELDS:
+                if f in state:
+                    setattr(self, f, state[f])
+            for key in ("_phase_s", "_encode_counts"):
+                if isinstance(state.get(key), dict):
+                    getattr(self, key).update(state[key])
 
 
 # process-wide shared registry for ad-hoc callers (benchmarks, scripts).

@@ -13,6 +13,19 @@ import os
 # back in by constructing CompileBroker(speculative=True) explicitly.
 os.environ.setdefault("KSS_NO_SPECULATIVE_COMPILE", "1")
 
+# Ambient run-supervision settings must not leak into the suite: a shell
+# with fault injection or a compile deadline exported would skew every
+# test. Tests that exercise the ladder set these with monkeypatch.
+for _var in (
+    "KSS_FAULT_INJECT",
+    "KSS_FAULT_INJECT_SEED",
+    "KSS_COMPILE_DEADLINE_S",
+    "KSS_COMPILE_RETRIES",
+    "KSS_COMPILE_BACKOFF_S",
+    "KSS_COMPILE_COOLDOWN_PASSES",
+):
+    os.environ.pop(_var, None)
+
 # Force-set (not setdefault): the image's shell env pins JAX_PLATFORMS=axon
 # (the real TPU), which would silently move the whole suite onto the single
 # real chip — slow compiles and no 8-device mesh.

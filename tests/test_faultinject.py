@@ -1,0 +1,142 @@
+"""The engine-internal fault plane (utils/faultinject.py): grammar,
+determinism, and the env-driven activation cache."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from kube_scheduler_simulator_tpu.utils import faultinject
+from kube_scheduler_simulator_tpu.utils.faultinject import (
+    FaultPlane,
+    InjectedFault,
+)
+
+
+class TestGrammar:
+    def test_probability_and_duration_sites(self):
+        plane = FaultPlane.parse("compile_fail:0.3,compile_slow:250ms")
+        assert plane.rules == {"compile_fail": 0.3, "compile_slow": 0.25}
+
+    def test_seconds_and_millis(self):
+        assert FaultPlane.parse("compile_slow:5s").rules["compile_slow"] == 5.0
+        assert FaultPlane.parse("compile_slow:50ms").rules["compile_slow"] == 0.05
+
+    def test_whitespace_and_empty_entries_tolerated(self):
+        plane = FaultPlane.parse(" compile_fail : 1.0 , ,device_error:0.5,")
+        assert plane.rules == {"compile_fail": 1.0, "device_error": 0.5}
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            "nonsense:0.5",  # unknown site
+            "compile_fail",  # no value
+            "compile_fail:maybe",  # not a number
+            "compile_fail:1.5",  # probability outside [0, 1]
+            "compile_slow:5",  # duration without unit
+            "compile_slow:-1s",  # negative duration
+        ],
+    )
+    def test_strict_parse_rejects(self, bad):
+        with pytest.raises(ValueError):
+            FaultPlane.parse(bad)
+
+
+class TestDraws:
+    def test_probability_one_always_raises_and_counts(self):
+        plane = FaultPlane.parse("compile_fail:1.0")
+        for _ in range(3):
+            with pytest.raises(InjectedFault) as exc:
+                plane.maybe_raise("compile_fail")
+            assert exc.value.site == "compile_fail"
+        assert plane.counts() == {"compile_fail": 3}
+
+    def test_probability_zero_never_raises(self):
+        plane = FaultPlane.parse("compile_fail:0.0")
+        for _ in range(50):
+            plane.maybe_raise("compile_fail")
+        assert plane.counts() == {}
+
+    def test_unconfigured_site_is_silent(self):
+        plane = FaultPlane.parse("compile_fail:1.0")
+        plane.maybe_raise("device_error")  # not in the spec: no fault
+
+    def test_seeded_draws_are_deterministic(self):
+        def outcomes(seed):
+            plane = FaultPlane.parse("device_error:0.5", seed=seed)
+            out = []
+            for _ in range(32):
+                try:
+                    plane.maybe_raise("device_error")
+                    out.append(0)
+                except InjectedFault:
+                    out.append(1)
+            return out
+
+        assert outcomes(7) == outcomes(7)
+        assert outcomes(7) != outcomes(8)  # different stream
+
+    def test_sites_draw_independent_streams(self):
+        """Adding a site never reshuffles another's draws."""
+
+        def device_outcomes(spec):
+            plane = FaultPlane.parse(spec, seed=3)
+            out = []
+            for _ in range(16):
+                try:
+                    plane.maybe_raise("device_error")
+                    out.append(0)
+                except InjectedFault:
+                    out.append(1)
+            return out
+
+        assert device_outcomes("device_error:0.5") == device_outcomes(
+            "device_error:0.5,compile_fail:0.5"
+        )
+
+    def test_delay_sleeps_and_counts(self):
+        plane = FaultPlane.parse("compile_slow:30ms")
+        t0 = time.perf_counter()
+        slept = plane.delay("compile_slow")
+        assert slept == pytest.approx(0.03)
+        assert time.perf_counter() - t0 >= 0.025
+        assert plane.counts() == {"compile_slow": 1}
+        assert plane.delay("compile_fail") == 0.0  # unconfigured: no sleep
+
+
+class TestActivePlane:
+    def test_env_activation_and_cache_invalidation(self, monkeypatch):
+        monkeypatch.delenv(faultinject.ENV_VAR, raising=False)
+        assert faultinject.active() is None
+        monkeypatch.setenv(faultinject.ENV_VAR, "compile_fail:1.0")
+        plane = faultinject.active()
+        assert plane is not None and plane.rules == {"compile_fail": 1.0}
+        # same env string: the SAME parsed plane (stream state persists)
+        assert faultinject.active() is plane
+        monkeypatch.setenv(faultinject.ENV_VAR, "device_error:0.5")
+        assert faultinject.active().rules == {"device_error": 0.5}
+        monkeypatch.setenv(faultinject.ENV_VAR, "")
+        assert faultinject.active() is None
+
+    def test_seed_env_feeds_streams(self, monkeypatch):
+        monkeypatch.setenv(faultinject.ENV_VAR, "device_error:0.5")
+        monkeypatch.setenv(faultinject.SEED_VAR, "17")
+        assert faultinject.active().seed == 17
+
+    def test_malformed_env_raises_at_fire_point(self, monkeypatch):
+        monkeypatch.setenv(faultinject.ENV_VAR, "compile_fail:bogus")
+        with pytest.raises(ValueError):
+            faultinject.active()
+
+    def test_activate_overrides_env(self, monkeypatch):
+        monkeypatch.setenv(faultinject.ENV_VAR, "compile_fail:1.0")
+        try:
+            faultinject.activate(None)
+            assert faultinject.active() is None
+            plane = FaultPlane.parse("worker_crash:1.0")
+            faultinject.activate(plane)
+            assert faultinject.active() is plane
+        finally:
+            faultinject.deactivate()
+        assert faultinject.active().rules == {"compile_fail": 1.0}

@@ -1,0 +1,473 @@
+"""Run supervision (the robustness PR): the compile watchdog +
+degradation ladder in `CompileBroker.get_resilient`, the hardened
+speculative worker, the serving layer's eager fallback, and the HTTP
+surface's structured-error / 503 mapping (docs/resilience.md)."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kube_scheduler_simulator_tpu.models.store import ResourceStore
+from kube_scheduler_simulator_tpu.server.httpserver import SimulatorServer
+from kube_scheduler_simulator_tpu.server.service import (
+    EngineDegraded,
+    SchedulerService,
+    SimulatorService,
+)
+from kube_scheduler_simulator_tpu.utils import faultinject
+from kube_scheduler_simulator_tpu.utils.broker import (
+    CompileBroker,
+    CompileDeadlineExceeded,
+    CompileUnavailable,
+    _call_with_deadline,
+    eager_active,
+    eager_execution,
+    jit as broker_jit,
+)
+from kube_scheduler_simulator_tpu.utils.metrics import SchedulingMetrics
+
+from helpers import node, pod
+
+
+class TestWatchdog:
+    def test_no_deadline_runs_inline(self):
+        tid = threading.get_ident()
+        assert _call_with_deadline(threading.get_ident, 0.0) == tid
+
+    def test_deadline_met(self):
+        assert _call_with_deadline(lambda: "engine", 5.0) == "engine"
+
+    def test_deadline_exceeded(self):
+        with pytest.raises(CompileDeadlineExceeded):
+            _call_with_deadline(lambda: time.sleep(2.0), 0.05)
+
+    def test_builder_exception_relayed(self):
+        with pytest.raises(RuntimeError, match="boom"):
+            _call_with_deadline(
+                lambda: (_ for _ in ()).throw(RuntimeError("boom")), 5.0
+            )
+
+
+class TestEagerExecution:
+    def test_jit_passthrough_inside_context(self):
+        def fn(x):
+            return x + 1
+
+        with eager_execution():
+            assert eager_active()
+            assert broker_jit(fn) is fn
+        assert not eager_active()
+
+    def test_thread_local(self):
+        seen = {}
+
+        def other():
+            seen["eager"] = eager_active()
+
+        with eager_execution():
+            th = threading.Thread(target=other)
+            th.start()
+            th.join()
+        assert seen["eager"] is False
+
+
+class TestResilientLadder:
+    def test_plain_path_behaves_like_get(self):
+        broker = CompileBroker(speculative=False)
+        info: dict = {}
+        assert broker.get_resilient(("k",), lambda: "engine", info=info) == "engine"
+        assert info["source"] == "miss"
+        info = {}
+        assert broker.get_resilient(("k",), lambda: pytest.fail("warm"), info=info) == (
+            "engine"
+        )
+        assert info["source"] == "hit"
+        assert broker.compile_misses == 1 and broker.compile_hits == 1
+
+    def test_retry_then_success(self, monkeypatch):
+        monkeypatch.setenv("KSS_COMPILE_BACKOFF_S", "0.001")
+        m = SchedulingMetrics()
+        broker = CompileBroker(metrics=m, speculative=False)
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise RuntimeError("transient")
+            return "engine"
+
+        assert broker.get_resilient(("k",), flaky) == "engine"
+        assert len(calls) == 3
+        assert broker.compile_retries == 2
+        phases = m.snapshot()["phases"]
+        assert phases["compileRetries"] == 2
+        assert phases["compileMisses"] == 1  # the eventual success
+
+    def test_ladder_exhaustion_sets_cooldown(self, monkeypatch):
+        monkeypatch.setenv("KSS_COMPILE_BACKOFF_S", "0.001")
+        monkeypatch.setenv("KSS_COMPILE_RETRIES", "1")
+        monkeypatch.setenv("KSS_COMPILE_COOLDOWN_PASSES", "2")
+        broker = CompileBroker(speculative=False)
+        calls = []
+
+        def failing():
+            calls.append(1)
+            raise RuntimeError("persistent")
+
+        with pytest.raises(CompileUnavailable):
+            broker.get_resilient(("k",), failing)
+        assert len(calls) == 2  # 1 + KSS_COMPILE_RETRIES
+        # cooldown: the next 2 calls degrade INSTANTLY (no build attempt)
+        for _ in range(2):
+            with pytest.raises(CompileUnavailable):
+                broker.get_resilient(("k",), failing)
+        assert len(calls) == 2
+        # cooldown spent: the ladder re-probes — and can succeed
+        assert broker.get_resilient(("k",), lambda: "healed") == "healed"
+
+    def test_deadline_timeout_walks_the_ladder(self, monkeypatch):
+        monkeypatch.setenv("KSS_COMPILE_DEADLINE_S", "0.05")
+        monkeypatch.setenv("KSS_COMPILE_RETRIES", "1")
+        monkeypatch.setenv("KSS_COMPILE_BACKOFF_S", "0.001")
+        broker = CompileBroker(speculative=False)
+        with pytest.raises(CompileUnavailable, match="CompileDeadlineExceeded"):
+            broker.get_resilient(("wedged",), lambda: time.sleep(1.0))
+        assert broker.compile_retries == 1
+
+    def test_stuck_abandoned_compile_blocks_reprobe(self, monkeypatch):
+        """A watchdog-abandoned builder still inside XLA must block
+        re-probing its key (each re-probe would leak another stuck
+        thread); the key serves degraded until the old thread dies."""
+        monkeypatch.setenv("KSS_COMPILE_DEADLINE_S", "0.05")
+        monkeypatch.setenv("KSS_COMPILE_RETRIES", "0")
+        monkeypatch.setenv("KSS_COMPILE_COOLDOWN_PASSES", "1")
+        broker = CompileBroker(speculative=False)
+        release = threading.Event()
+        builds = []
+
+        def wedged():
+            builds.append(1)
+            release.wait(10)
+            return "late"
+
+        with pytest.raises(CompileUnavailable):
+            broker.get_resilient(("k",), wedged)
+        assert len(builds) == 1
+        th = broker._abandoned[("k",)][0]
+        with pytest.raises(CompileUnavailable):
+            broker.get_resilient(("k",), wedged)  # consumes the cooldown
+        # the re-probe slot: refused — the abandoned builder is alive
+        with pytest.raises(CompileUnavailable):
+            broker.get_resilient(("k",), wedged)
+        assert len(builds) == 1  # no second leaked thread
+        release.set()
+        th.join(5)
+        with pytest.raises(CompileUnavailable):
+            broker.get_resilient(("k",), wedged)  # the refusal's cooldown
+        # stuck thread gone: the ladder re-probes — and can heal
+        assert broker.get_resilient(("k",), lambda: "healed") == "healed"
+
+    def test_injected_compile_slow_trips_watchdog(self, monkeypatch):
+        monkeypatch.setenv("KSS_FAULT_INJECT", "compile_slow:200ms")
+        monkeypatch.setenv("KSS_COMPILE_DEADLINE_S", "0.05")
+        monkeypatch.setenv("KSS_COMPILE_RETRIES", "0")
+        broker = CompileBroker(speculative=False)
+        with pytest.raises(CompileUnavailable):
+            broker.get_resilient(("k",), lambda: "engine")
+
+    def test_warm_hit_ends_cooldown(self, monkeypatch):
+        monkeypatch.setenv("KSS_COMPILE_RETRIES", "0")
+        monkeypatch.setenv("KSS_COMPILE_COOLDOWN_PASSES", "5")
+        broker = CompileBroker(speculative=False)
+        with pytest.raises(CompileUnavailable):
+            broker.get_resilient(
+                ("k",), lambda: (_ for _ in ()).throw(RuntimeError("x"))
+            )
+        # a background build lands the key warm mid-cooldown
+        broker._background_build(("k",), lambda: "warm")
+        assert broker.get_resilient(("k",), lambda: pytest.fail("warm")) == "warm"
+        assert ("k",) not in broker._cooldown
+
+
+class TestHardenedWorker:
+    def test_crashed_task_disables_speculation_and_counts(self):
+        m = SchedulingMetrics()
+        broker = CompileBroker(metrics=m, speculative=True)
+
+        def bad_task():
+            raise RuntimeError("worker must not die silently")
+
+        assert broker.speculate("t", bad_task)
+        assert broker.drain(timeout=10)
+        assert broker.worker_crashes == 1
+        assert broker.speculative is False  # self-disabled
+        assert not broker.speculate("t2", lambda: None)  # no new speculation
+        assert m.snapshot()["phases"]["brokerWorkerCrashes"] == 1
+        assert broker.stats()["brokerWorkerCrashes"] == 1
+
+    def test_injected_worker_crash(self, monkeypatch):
+        broker = CompileBroker(speculative=True)
+        monkeypatch.setenv("KSS_FAULT_INJECT", "worker_crash:1.0")
+        assert broker.speculate("t", lambda: pytest.fail("crashed before task"))
+        assert broker.drain(timeout=10)
+        assert broker.worker_crashes == 1
+        assert broker.speculative is False
+
+    def test_failed_background_build_is_not_a_crash(self):
+        broker = CompileBroker(speculative=True)
+
+        def task():
+            return ("k",), lambda: (_ for _ in ()).throw(RuntimeError("compile"))
+
+        assert broker.speculate("t", task)
+        assert broker.drain(timeout=10)
+        # a failed speculative COMPILE is a normal outcome: no crash,
+        # speculation stays on
+        assert broker.worker_crashes == 0
+        assert broker.speculative is True
+
+    def test_interpreter_exit_drains_inflight_speculation(self):
+        """A speculative compile still inside XLA when the interpreter
+        tears down aborts the process from XLA's C++ threads — the
+        broker's atexit hook must out-wait it, so a SUCCEEDED run's
+        process exits 0 (seen live as `--resume` exiting 134)."""
+        import subprocess
+        import sys
+        import textwrap
+
+        script = textwrap.dedent(
+            """
+            import jax, jax.numpy as jnp
+            from kube_scheduler_simulator_tpu.utils.broker import CompileBroker
+
+            broker = CompileBroker(speculative=True)
+
+            def task():
+                # a real lowering, large enough to still be compiling
+                # when the main thread falls off the end of the script
+                def build():
+                    f = jax.jit(lambda x: jnp.linalg.matrix_power(x @ x.T, 8))
+                    f(jnp.ones((200, 200))).block_until_ready()
+                    return f
+                return ("k",), build
+
+            broker.speculate("t", task)
+            """
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", script],
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 0, proc.stderr
+
+
+def _cluster_service(metrics=None):
+    store = ResourceStore()
+    for i in range(4):
+        store.apply("nodes", node(f"n{i}", cpu="16", mem="32Gi"))
+    for i in range(5):
+        store.apply("pods", pod(f"p{i}", cpu="100m"))
+    metrics = metrics or SchedulingMetrics()
+    return store, SchedulerService(store, metrics=metrics), metrics
+
+
+class TestServiceEagerFallback:
+    @pytest.mark.parametrize("mode", ["gang", "sequential"])
+    def test_pass_completes_eagerly_under_persistent_compile_failure(
+        self, monkeypatch, mode
+    ):
+        monkeypatch.setenv("KSS_COMPILE_BACKOFF_S", "0.001")
+        # healthy run first: the reference placements
+        _, svc_ok, _ = _cluster_service()
+        if mode == "gang":
+            ok_placements, _, _ = svc_ok.schedule_gang(record=False)
+        else:
+            ok_placements = {
+                (r.pod_namespace, r.pod_name): r.selected_node
+                for r in svc_ok.schedule()
+            }
+        monkeypatch.setenv("KSS_FAULT_INJECT", "compile_fail:1.0")
+        _, svc, metrics = _cluster_service()
+        if mode == "gang":
+            placements, _, _ = svc.schedule_gang(record=False)
+        else:
+            placements = {
+                (r.pod_namespace, r.pod_name): r.selected_node
+                for r in svc.schedule()
+            }
+        assert placements == ok_placements  # same pass, same answer
+        phases = metrics.snapshot()["phases"]
+        assert phases["degradedPasses"] >= 1
+        assert phases["eagerFallbacks"] >= 1
+        assert phases["compileRetries"] >= 1
+        assert phases["compileMisses"] == 0  # nothing compiled
+
+    def test_device_error_propagates(self, monkeypatch):
+        monkeypatch.setenv("KSS_FAULT_INJECT", "device_error:1.0")
+        _, svc, _ = _cluster_service()
+        with pytest.raises(faultinject.InjectedFault):
+            svc.schedule_gang(record=False)
+
+    def test_record_mode_finish_stays_on_the_eager_rung(self, monkeypatch):
+        """The gang record decode lazily jits its replay programs in
+        `results()` — AFTER the eager-fallback build. With the compiler
+        genuinely broken (jax.jit itself raises), the whole degraded
+        pass, decode included, must still complete eagerly."""
+        import jax
+
+        monkeypatch.setenv("KSS_COMPILE_BACKOFF_S", "0.001")
+        monkeypatch.setenv("KSS_COMPILE_RETRIES", "0")
+        _, svc_ok, _ = _cluster_service()
+        ok_placements, ok_rounds, ok_results = svc_ok.schedule_gang(record=True)
+
+        def broken_compiler(*_a, **_k):
+            raise RuntimeError("XLA is down")
+
+        monkeypatch.setattr(jax, "jit", broken_compiler)
+        _, svc, metrics = _cluster_service()
+        placements, rounds, results = svc.schedule_gang(record=True)
+        assert placements == ok_placements
+        assert rounds == ok_rounds
+        assert [(r.pod_name, r.selected_node) for r in results] == [
+            (r.pod_name, r.selected_node) for r in ok_results
+        ]
+        phases = metrics.snapshot()["phases"]
+        assert phases["degradedPasses"] >= 1
+        assert phases["eagerFallbacks"] >= 1
+
+    def test_eager_failure_raises_engine_degraded(self, monkeypatch):
+        monkeypatch.setenv("KSS_COMPILE_RETRIES", "0")
+        _, svc, metrics = _cluster_service()
+
+        def doomed():
+            raise RuntimeError("no engine for you")
+
+        with pytest.raises(EngineDegraded):
+            try:
+                svc.broker.get_resilient(("k",), doomed)
+            except CompileUnavailable as e:
+                svc._eager_fallback(doomed, e)
+        assert metrics.snapshot()["phases"]["degradedPasses"] == 1
+        assert metrics.snapshot()["phases"]["eagerFallbacks"] == 0
+
+
+class TestHttpDegradation:
+    @pytest.fixture()
+    def server(self):
+        server = SimulatorServer(SimulatorService(), port=0).start()
+        yield server
+        server.shutdown()
+
+    def test_metrics_route_surfaces_resilience_counters(self, server):
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/api/v1/metrics"
+        ) as resp:
+            doc = json.loads(resp.read())
+        for key in (
+            "degradedPasses",
+            "compileRetries",
+            "eagerFallbacks",
+            "brokerWorkerCrashes",
+        ):
+            assert key in doc["phases"]
+
+    def test_degradation_maps_to_503_with_retry_after(self, server, monkeypatch):
+        monkeypatch.setattr(
+            server.service.scheduler,
+            "schedule",
+            lambda: (_ for _ in ()).throw(
+                EngineDegraded("compile ladder exhausted; eager failed")
+            ),
+        )
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/api/v1/schedule", method="POST"
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req)
+        assert exc.value.code == 503
+        assert exc.value.headers["Retry-After"]
+        body = json.loads(exc.value.read())
+        assert body["kind"] == "EngineDegraded"
+        assert "error" in body and "detail" in body
+
+    def test_faulted_lifecycle_run_reports_degradation_in_metrics(
+        self, server, monkeypatch
+    ):
+        """The acceptance criterion end-to-end: a chaos run POSTed with
+        KSS_FAULT_INJECT forcing persistent compile failure still
+        completes (eager fallback), and /api/v1/metrics reports
+        degradedPasses > 0."""
+        monkeypatch.setenv("KSS_FAULT_INJECT", "compile_fail:1.0")
+        monkeypatch.setenv("KSS_COMPILE_BACKOFF_S", "0.001")
+        spec = {
+            "name": "http-faulted",
+            "seed": 3,
+            "horizon": 6.0,
+            "schedulerMode": "gang",
+            "snapshot": {
+                "nodes": [node(f"hn{i}", cpu="16", mem="32Gi") for i in range(2)]
+            },
+            "arrivals": [
+                {
+                    "kind": "trace",
+                    "times": [1.0, 2.0, 3.0],
+                    "template": {
+                        "metadata": {"name": "hp"},
+                        "spec": {
+                            "containers": [
+                                {
+                                    "name": "c",
+                                    "resources": {
+                                        "requests": {
+                                            "cpu": "100m", "memory": "64Mi",
+                                        }
+                                    },
+                                }
+                            ]
+                        },
+                    },
+                }
+            ],
+        }
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/api/v1/lifecycle",
+            data=json.dumps(spec).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req) as resp:
+            result = json.loads(resp.read())
+        assert result["phase"] == "Succeeded"
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/api/v1/metrics"
+        ) as resp:
+            doc = json.loads(resp.read())
+        assert doc["phases"]["degradedPasses"] > 0
+        assert doc["phases"]["eagerFallbacks"] > 0
+
+    def test_generic_500_is_structured(self, server, monkeypatch):
+        monkeypatch.setattr(
+            server.service.scheduler,
+            "schedule",
+            lambda: (_ for _ in ()).throw(RuntimeError("kaboom")),
+        )
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/api/v1/schedule", method="POST"
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req)
+        assert exc.value.code == 500
+        body = json.loads(exc.value.read())
+        assert body["kind"] == "RuntimeError"
+        assert "kaboom" in body["error"]
+        assert body["message"] == body["error"]  # back-compat mirror

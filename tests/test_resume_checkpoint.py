@@ -1,0 +1,327 @@
+"""Crash-safe run supervision: checkpoint/resume parity (the robustness
+PR's tentpole acceptance criteria).
+
+* A lifecycle chaos run stopped mid-horizon (the deterministic
+  mid-run-kill stand-in `stop_after_events`) and resumed from its
+  checkpoint produces a JSONL trace byte-identical to the uninterrupted
+  run — asserted for gang + sequential modes and for BOTH the sync and
+  async pipelines (concatenated prefix+suffix AND the resumed engine's
+  full trace).
+* Periodic checkpoints fire on the events/sim-seconds cadence, land
+  atomically, and any of them resumes correctly.
+* `ResourceStore.dump_state`/`load_state` and `ChaosSpec.to_dict` are
+  exact round trips — the two legs the checkpoint format stands on.
+* A run resumed under `KSS_FAULT_INJECT` compile failure still converges
+  byte-identically via the eager fallback (resume-after-kill × the
+  degradation ladder).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from kube_scheduler_simulator_tpu.lifecycle.checkpoint import (
+    CHECKPOINT_FORMAT,
+    load_checkpoint,
+    write_checkpoint,
+)
+from kube_scheduler_simulator_tpu.lifecycle.engine import (
+    LifecycleEngine,
+    trace_jsonl,
+)
+from kube_scheduler_simulator_tpu.models.store import ResourceStore
+from kube_scheduler_simulator_tpu.scenario.chaos import ChaosSpec
+
+from helpers import node, pod
+
+
+def _chaos_dict(mode: str, pipeline: str) -> dict:
+    # same snapshot shapes as tests/test_async_pipeline.py so the
+    # compiled programs come warm from the shared persistent cache
+    nodes = [node(f"n{i}", cpu="16", mem="32Gi", pods="110") for i in range(6)]
+    pods = [
+        pod(f"seed-{i}", cpu="100m", node_name=f"n{i % 6}") for i in range(33)
+    ]
+    return {
+        "name": "ckpt",
+        "seed": 11,
+        "horizon": 30.0,
+        "schedulerMode": mode,
+        "pipeline": pipeline,
+        "snapshot": {"nodes": nodes, "pods": pods},
+        "arrivals": [
+            {
+                "kind": "poisson",
+                "rate": 0.5,
+                "count": 10,
+                "template": {
+                    "metadata": {"name": "churn"},
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "c",
+                                "resources": {
+                                    "requests": {
+                                        "cpu": "100m",
+                                        "memory": "64Mi",
+                                    }
+                                },
+                            }
+                        ]
+                    },
+                },
+            }
+        ],
+        # binding-reading faults: evictions + re-enqueues live across
+        # the checkpoint boundary (the _downed/_evicted_at state legs)
+        "faults": [
+            {"at": 8.0, "action": "cordon", "node": "n0"},
+            {"at": 14.0, "action": "fail", "node": "n1"},
+            {"at": 20.0, "action": "recover", "node": "n1"},
+            {"at": 26.0, "action": "uncordon", "node": "n0"},
+        ],
+    }
+
+
+def _spec(mode: str, pipeline: str) -> ChaosSpec:
+    return ChaosSpec.from_dict(_chaos_dict(mode, pipeline))
+
+
+# one uninterrupted baseline trace per scheduler mode (sync/async traces
+# are already pinned byte-identical by tests/test_async_pipeline.py)
+_BASELINES: dict = {}
+
+
+def _baseline_trace(mode: str) -> str:
+    if mode not in _BASELINES:
+        eng = LifecycleEngine(_spec(mode, "sync"))
+        res = eng.run()
+        assert res["phase"] == "Succeeded"
+        _BASELINES[mode] = eng.trace_jsonl()
+    return _BASELINES[mode]
+
+
+class TestKillAndResumeParity:
+    @pytest.mark.parametrize("mode", ["gang", "sequential"])
+    @pytest.mark.parametrize("pipeline", ["sync", "async"])
+    def test_concatenated_trace_byte_identical(self, tmp_path, mode, pipeline):
+        baseline = _baseline_trace(mode)
+        ckpt = str(tmp_path / "run.ckpt.json")
+
+        eng = LifecycleEngine(
+            _spec(mode, pipeline), checkpoint_path=ckpt, stop_after_events=7
+        )
+        res = eng.run()
+        assert res["phase"] == "Interrupted"
+        assert res["checkpoint"] == ckpt
+        assert eng.events_consumed == 7
+        # the interrupted trace is an exact PREFIX (nothing extra emitted)
+        assert baseline.startswith(eng.trace_jsonl())
+
+        doc = load_checkpoint(ckpt)
+        assert doc["format"] == CHECKPOINT_FORMAT
+        assert doc["cursor"] == 7
+        # the checkpointed prefix and its advertised byte offset agree
+        prefix = trace_jsonl(doc["trace"])
+        assert len(prefix.encode()) == doc["traceByteOffset"]
+
+        resumed = LifecycleEngine.from_checkpoint(doc)
+        assert resumed.pipeline == pipeline  # sticky across resume
+        res2 = resumed.run()
+        assert res2["phase"] == "Succeeded"
+        assert res2["resumed"] == {
+            "cursor": 7,
+            "traceEvents": len(doc["trace"]),
+        }
+        # the tentpole contract, both ways of reading it: checkpointed
+        # prefix + resumed suffix, and the resumed engine's full trace
+        suffix = resumed.trace_jsonl_since(resumed.resume_trace_index)
+        assert prefix + suffix == baseline
+        assert resumed.trace_jsonl() == baseline
+
+    def test_resumed_metrics_cover_the_whole_run(self, tmp_path):
+        ckpt = str(tmp_path / "run.ckpt.json")
+        full = LifecycleEngine(_spec("gang", "sync"))
+        rf = full.run()
+        assert rf["phase"] == "Succeeded"
+
+        eng = LifecycleEngine(
+            _spec("gang", "sync"), checkpoint_path=ckpt, stop_after_events=7
+        )
+        eng.run()
+        resumed = LifecycleEngine.from_checkpoint(load_checkpoint(ckpt))
+        r2 = resumed.run()
+        # cumulative deterministic counters carried through the
+        # checkpoint: the resumed run reports the WHOLE run
+        for key in ("totalPods", "totalScheduled", "passes"):
+            assert r2["metrics"][key] == rf["metrics"][key]
+        assert r2["metrics"]["disruption"] == rf["metrics"]["disruption"]
+        assert r2["pods"] == rf["pods"]
+
+
+class TestPeriodicCheckpoints:
+    def test_event_cadence_and_any_checkpoint_resumes(self, tmp_path):
+        baseline = _baseline_trace("gang")
+        ckpt = str(tmp_path / "periodic.ckpt.json")
+        eng = LifecycleEngine(
+            _spec("gang", "sync"), checkpoint_path=ckpt,
+            checkpoint_every_events=4,
+        )
+        res = eng.run()
+        assert res["phase"] == "Succeeded"
+        assert eng.checkpoints_written >= 2
+        # the last periodic checkpoint (whatever batch boundary it hit)
+        # resumes to the same bytes
+        doc = eng.last_checkpoint_doc
+        assert 0 < doc["cursor"] <= eng.events_consumed
+        resumed = LifecycleEngine.from_checkpoint(doc)
+        assert resumed.run()["phase"] == "Succeeded"
+        assert resumed.trace_jsonl() == baseline
+
+    def test_sim_seconds_cadence(self, tmp_path):
+        ckpt = str(tmp_path / "simcadence.ckpt.json")
+        eng = LifecycleEngine(
+            _spec("gang", "sync"), checkpoint_path=ckpt,
+            checkpoint_every_sim_s=10.0,
+        )
+        assert eng.run()["phase"] == "Succeeded"
+        # 30s horizon / 10s cadence: at least two fired
+        assert eng.checkpoints_written >= 2
+
+    def test_request_stop_is_graceful(self, tmp_path):
+        """The SIGINT/SIGTERM path: stop lands at a batch boundary with
+        a final checkpoint and an exactly-prefix trace."""
+        baseline = _baseline_trace("gang")
+        ckpt = str(tmp_path / "stop.ckpt.json")
+        eng = LifecycleEngine(_spec("gang", "sync"), checkpoint_path=ckpt)
+        eng.request_stop()  # before run: stops after the FIRST batch
+        res = eng.run()
+        assert res["phase"] == "Interrupted"
+        assert os.path.exists(ckpt)
+        assert baseline.startswith(eng.trace_jsonl())
+        resumed = LifecycleEngine.from_checkpoint(load_checkpoint(ckpt))
+        assert resumed.run()["phase"] == "Succeeded"
+        assert resumed.trace_jsonl() == baseline
+
+
+class TestCheckpointFormat:
+    def test_atomic_write_and_validation(self, tmp_path):
+        path = str(tmp_path / "x.json")
+        with pytest.raises(FileNotFoundError):
+            load_checkpoint(path)
+        write_checkpoint({"format": "wrong"}, path)
+        with pytest.raises(ValueError, match="not a lifecycle checkpoint"):
+            load_checkpoint(path)
+        # no torn temp files left behind
+        assert os.listdir(tmp_path) == ["x.json"]
+
+    def test_checkpoint_is_json_serializable_end_to_end(self, tmp_path):
+        ckpt = str(tmp_path / "roundtrip.ckpt.json")
+        eng = LifecycleEngine(
+            _spec("gang", "sync"), checkpoint_path=ckpt, stop_after_events=7
+        )
+        eng.run()
+        # a full JSON round trip (what a real kill/restart does) loses
+        # nothing the resume needs
+        doc = json.loads(json.dumps(load_checkpoint(ckpt)))
+        resumed = LifecycleEngine.from_checkpoint(doc)
+        assert resumed.run()["phase"] == "Succeeded"
+        assert resumed.trace_jsonl() == _baseline_trace("gang")
+
+
+class TestStoreStateRoundtrip:
+    def test_dump_load_preserves_objects_and_order(self):
+        store = ResourceStore()
+        store.apply("nodes", node("b"))
+        store.apply("nodes", node("a"))
+        store.apply("pods", pod("p1", node_name="b"))
+        store.delete("nodes", "b")  # cascades p1 away
+        store.apply("nodes", node("b"))  # re-added: moves to the END
+        dump = json.loads(json.dumps(store.dump_state()))
+
+        restored = ResourceStore()
+        restored.load_state(dump)
+        # objects verbatim (rv/uid included), iteration order preserved
+        assert [n["metadata"]["name"] for n in restored.list("nodes")] == [
+            "a", "b",
+        ]
+        assert restored.list("nodes") == store.list("nodes")
+        assert restored.count("pods") == 0
+        # the rv counter resumes PAST the dump: no rv reuse
+        before = store.latest_rv()
+        assert restored.latest_rv() == before
+        obj = restored.apply("nodes", node("c"))
+        assert int(obj["metadata"]["resourceVersion"]) == before + 1
+
+    def test_restore_is_a_relist_boundary(self):
+        from kube_scheduler_simulator_tpu.models.store import (
+            StaleResourceVersion,
+        )
+
+        store = ResourceStore()
+        store.apply("nodes", node("a"))
+        restored = ResourceStore()
+        restored.load_state(store.dump_state())
+        # incremental consumers must relist: their window predates the
+        # restored log (which starts empty at the dump's high-water rv)
+        with pytest.raises(StaleResourceVersion):
+            restored.events_since("nodes", 0)
+
+
+class TestSpecRoundtrip:
+    @pytest.mark.parametrize("mode", ["gang", "sequential"])
+    def test_to_dict_reparses_to_the_same_timeline(self, mode):
+        spec = _spec(mode, "async")
+        again = ChaosSpec.from_dict(spec.to_dict())
+        assert again == spec
+        assert again.events() == spec.events()
+
+    def test_all_arrival_kinds_and_taints_roundtrip(self):
+        d = {
+            "seed": 3,
+            "horizon": 50.0,
+            "window": 4,
+            "arrivals": [
+                {"kind": "poisson", "rate": 1.0, "count": 5,
+                 "template": {"metadata": {"name": "poi"}}},
+                {"kind": "trace", "times": [1.0, 2.5],
+                 "template": {"metadata": {"name": "tra"}}},
+                {"kind": "gang", "at": 3.0, "replicas": 4,
+                 "template": {"metadata": {"name": "gan"}}},
+            ],
+            "faults": [
+                {"at": 5.0, "action": "taint", "node": "n0",
+                 "taint": {"key": "k", "value": "v", "effect": "NoSchedule"}},
+            ],
+        }
+        spec = ChaosSpec.from_dict(d)
+        again = ChaosSpec.from_dict(json.loads(json.dumps(spec.to_dict())))
+        assert again == spec
+        assert again.events() == spec.events()
+
+
+class TestResumeUnderFaultInjection:
+    def test_resume_after_kill_with_persistent_compile_failure(
+        self, tmp_path, monkeypatch
+    ):
+        """Resume-after-kill × the degradation ladder (the acceptance
+        criterion): with KSS_FAULT_INJECT forcing every compile to fail,
+        the interrupted-and-resumed run still completes via the eager
+        fallback, byte-identical, with degradedPasses > 0."""
+        baseline = _baseline_trace("gang")
+        monkeypatch.setenv("KSS_FAULT_INJECT", "compile_fail:1.0")
+        monkeypatch.setenv("KSS_COMPILE_BACKOFF_S", "0.001")
+        ckpt = str(tmp_path / "faulted.ckpt.json")
+        eng = LifecycleEngine(
+            _spec("gang", "sync"), checkpoint_path=ckpt, stop_after_events=7
+        )
+        assert eng.run()["phase"] == "Interrupted"
+        resumed = LifecycleEngine.from_checkpoint(load_checkpoint(ckpt))
+        res = resumed.run()
+        assert res["phase"] == "Succeeded"
+        assert resumed.trace_jsonl() == baseline
+        assert res["metrics"]["phases"]["degradedPasses"] > 0
+        assert res["metrics"]["phases"]["eagerFallbacks"] > 0

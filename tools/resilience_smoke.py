@@ -1,0 +1,226 @@
+"""`make resilience-smoke`: CPU-only run-supervision sanity gate.
+
+Three gates, one JSON line (docs/resilience.md):
+
+1. **The ladder completes the run** — a short seeded chaos timeline run
+   under ``KSS_FAULT_INJECT=compile_fail:1.0`` (every compile attempt
+   fails) must still Succeed via the eager fallback, with
+   ``eagerFallbacks > 0`` and ``degradedPasses > 0``, and its replayable
+   trace must be BYTE-IDENTICAL to the clean run's — degradation changes
+   latency, never results.
+
+2. **Kill/resume loses nothing** — the same timeline driven through the
+   real CLI (`python -m kube_scheduler_simulator_tpu.lifecycle`): a run
+   stopped mid-horizon (``--stop-after-events``, the deterministic
+   SIGTERM stand-in) with ``--checkpoint-to``, then ``--resume``d in a
+   second CLI invocation, must produce a ``--trace-out`` file
+   byte-identical to the uninterrupted run's — zero lost events, zero
+   duplicates.
+
+3. **Interrupted prefix is exact** — the killed run's trace file is a
+   byte prefix of the uninterrupted trace, truncatable at the
+   checkpoint's advertised ``traceByteOffset``.
+
+Exit 0 on pass. Small enough for tier-1 wiring (seconds, CPU-only);
+this is a sanity gate, not a measurement.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import sys
+import tempfile
+
+
+def _chaos_dict() -> dict:
+    nodes = [
+        {
+            "metadata": {"name": f"n{i}"},
+            "status": {
+                "allocatable": {"cpu": "16", "memory": "32Gi", "pods": "110"}
+            },
+        }
+        for i in range(6)
+    ]
+    pods = [
+        {
+            "metadata": {"name": f"seed-{i}"},
+            "spec": {
+                "nodeName": f"n{i % 6}",
+                "containers": [
+                    {
+                        "name": "c",
+                        "resources": {
+                            "requests": {"cpu": "100m", "memory": "128Mi"}
+                        },
+                    }
+                ],
+            },
+        }
+        for i in range(33)
+    ]
+    return {
+        "name": "resilience-smoke",
+        "seed": 11,
+        "horizon": 30.0,
+        "schedulerMode": "gang",
+        "pipeline": "async",
+        "snapshot": {"nodes": nodes, "pods": pods},
+        "arrivals": [
+            {
+                "kind": "poisson",
+                "rate": 0.5,
+                "count": 10,
+                "template": {
+                    "metadata": {"name": "churn"},
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "c",
+                                "resources": {
+                                    "requests": {
+                                        "cpu": "100m",
+                                        "memory": "64Mi",
+                                    }
+                                },
+                            }
+                        ]
+                    },
+                },
+            }
+        ],
+        "faults": [
+            {"at": 8.0, "action": "cordon", "node": "n0"},
+            {"at": 14.0, "action": "fail", "node": "n1"},
+            {"at": 20.0, "action": "recover", "node": "n1"},
+            {"at": 26.0, "action": "uncordon", "node": "n0"},
+        ],
+    }
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # deterministic gates: no ambient supervision settings, no
+    # speculative compiles competing with the measurement
+    for var in ("KSS_FAULT_INJECT", "KSS_COMPILE_DEADLINE_S"):
+        os.environ.pop(var, None)
+    os.environ.setdefault("KSS_NO_SPECULATIVE_COMPILE", "1")
+    # runnable from a bare checkout: the package lives at the repo root
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    from kube_scheduler_simulator_tpu.lifecycle.__main__ import (
+        main as lifecycle_cli,
+    )
+    from kube_scheduler_simulator_tpu.lifecycle.checkpoint import (
+        load_checkpoint,
+    )
+    from kube_scheduler_simulator_tpu.lifecycle.engine import LifecycleEngine
+    from kube_scheduler_simulator_tpu.scenario.chaos import ChaosSpec
+    from kube_scheduler_simulator_tpu.utils.compilecache import (
+        enable_compile_cache,
+    )
+
+    enable_compile_cache()
+    problems: list[str] = []
+
+    # -- gate 1: persistent compile failure still completes, eagerly ----
+    clean = LifecycleEngine(ChaosSpec.from_dict(_chaos_dict()))
+    clean_res = clean.run()
+    clean_trace = clean.trace_jsonl()
+    if clean_res["phase"] != "Succeeded":
+        problems.append(f"clean run phase {clean_res['phase']!r}")
+
+    os.environ["KSS_FAULT_INJECT"] = "compile_fail:1.0"
+    os.environ["KSS_COMPILE_BACKOFF_S"] = "0.01"
+    try:
+        faulted = LifecycleEngine(ChaosSpec.from_dict(_chaos_dict()))
+        faulted_res = faulted.run()
+    finally:
+        os.environ.pop("KSS_FAULT_INJECT", None)
+        os.environ.pop("KSS_COMPILE_BACKOFF_S", None)
+    phases = faulted_res["metrics"]["phases"]
+    if faulted_res["phase"] != "Succeeded":
+        problems.append(
+            f"faulted run phase {faulted_res['phase']!r} "
+            f"({faulted_res.get('message', '')})"
+        )
+    if phases.get("eagerFallbacks", 0) < 1:
+        problems.append("eager fallback never engaged under compile_fail:1.0")
+    if phases.get("degradedPasses", 0) < 1:
+        problems.append("no pass reported degraded under compile_fail:1.0")
+    if faulted.trace_jsonl() != clean_trace:
+        problems.append("degraded run's trace differs from the clean run's")
+
+    # -- gates 2+3: CLI kill → checkpoint → resume, byte parity ---------
+    tmp = tempfile.mkdtemp(prefix="kss-resilience-")
+    spec_path = os.path.join(tmp, "spec.json")
+    ckpt = os.path.join(tmp, "run.ckpt.json")
+    killed_trace = os.path.join(tmp, "killed.jsonl")
+    resumed_trace = os.path.join(tmp, "resumed.jsonl")
+    with open(spec_path, "w") as f:
+        json.dump(_chaos_dict(), f)
+    # the CLI prints its result document; keep this tool's stdout to the
+    # one-JSON-line contract by capturing the inner runs' output
+    with contextlib.redirect_stdout(io.StringIO()):
+        rc_kill = lifecycle_cli(
+            [
+                "--spec", spec_path,
+                "--checkpoint-to", ckpt,
+                "--stop-after-events", "7",
+                "--trace-out", killed_trace,
+            ]
+        )
+    if rc_kill == 0:
+        problems.append("interrupted run exited 0 (should be non-Succeeded)")
+    with contextlib.redirect_stdout(io.StringIO()):
+        rc_resume = lifecycle_cli(
+            ["--resume", ckpt, "--trace-out", resumed_trace]
+        )
+    if rc_resume != 0:
+        problems.append(f"resumed run exited {rc_resume}")
+    with open(killed_trace, "rb") as f:
+        killed_bytes = f.read()
+    with open(resumed_trace, "rb") as f:
+        resumed_bytes = f.read()
+    clean_bytes = clean_trace.encode()
+    if resumed_bytes != clean_bytes:
+        problems.append("resumed trace is not byte-identical to uninterrupted")
+    if not clean_bytes.startswith(killed_bytes):
+        problems.append("killed run's trace is not a prefix of uninterrupted")
+    doc = load_checkpoint(ckpt)
+    if doc["traceByteOffset"] != len(killed_bytes):
+        problems.append(
+            f"checkpoint traceByteOffset {doc['traceByteOffset']} != killed "
+            f"trace length {len(killed_bytes)}"
+        )
+    lost = clean_trace.count("\n") - resumed_bytes.decode().count("\n")
+
+    line = {
+        "config": "resilience_smoke",
+        "clean_phase": clean_res["phase"],
+        "faulted_phase": faulted_res["phase"],
+        "eager_fallbacks": phases.get("eagerFallbacks", 0),
+        "degraded_passes": phases.get("degradedPasses", 0),
+        "compile_retries": phases.get("compileRetries", 0),
+        "trace_events": clean_res["events"],
+        "killed_at_events": 7,
+        "lost_events": lost,
+        "trace_parity": resumed_bytes == clean_bytes,
+    }
+    print(json.dumps(line), flush=True)
+    if lost != 0:
+        problems.append(f"{lost} trace events lost across kill/resume")
+    if problems:
+        print(
+            "resilience-smoke FAILED: " + "; ".join(problems), file=sys.stderr
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
