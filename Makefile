@@ -5,7 +5,7 @@
 PORT ?= 1212
 PY ?= python
 
-.PHONY: test test-fast lint start bench dryrun batch lifecycle-smoke perf-smoke resilience-smoke docker docker-up clean
+.PHONY: test test-fast lint start bench dryrun batch lifecycle-smoke perf-smoke resilience-smoke observability-smoke docker docker-up clean
 
 # full suite on the 8-device virtual CPU mesh (tests/conftest.py pins it)
 test:
@@ -52,6 +52,14 @@ perf-smoke:
 # the CLI (zero lost events, trace parity); one JSON line
 resilience-smoke:
 	env JAX_PLATFORMS=cpu $(PY) tools/resilience_smoke.py
+
+# telemetry-plane smoke (docs/observability.md): a traced async chaos
+# run must export a well-formed Chrome/Perfetto trace with balanced
+# spans and visible pipeline overlap, the Prometheus endpoint must
+# survive a real text-format parse, and the SSE stream must yield an
+# event; one JSON line
+observability-smoke:
+	env JAX_PLATFORMS=cpu $(PY) tools/observability_smoke.py
 
 # containerized dev flow (reference `make docker_build_and_up`, one service)
 docker:

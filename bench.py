@@ -521,6 +521,15 @@ def _lifecycle_probe(events: int = 300, n_nodes: int = 64, seed_pods: int = 500)
         "degraded_passes": phases["degradedPasses"],
         "broker_worker_crashes": phases["brokerWorkerCrashes"],
     }
+    # flight-recorder accounting when the probe ran under KSS_TRACE=1
+    # (off by default: the headline number must measure the untraced
+    # serving path — docs/observability.md)
+    from kube_scheduler_simulator_tpu.utils import telemetry
+
+    rec = telemetry.active()
+    if rec is not None:
+        line["trace_events"] = rec.emitted
+        line["trace_dropped"] = rec.dropped
     print(json.dumps(line), flush=True)
 
 

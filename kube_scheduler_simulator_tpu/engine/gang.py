@@ -158,7 +158,14 @@ class GangScheduler:
         COMMIT rounds (the unit it caps unwindowed, where every counted
         round commits): no-commit window-sweep rounds don't burn it, so
         the cap can never exhaust the loop mid-sweep and strand
-        feasible pods (ADVICE r5).
+        feasible pods (ADVICE r5). Constraint: such an explicit
+        `max_rounds` must still cover one full window sweep
+        (`max_rounds >= ceil(P/WP)`) — commits reset the window offset
+        to 0, so a smaller cap can spend itself entirely on the
+        earliest windows and end the pass before later windows were
+        ever evaluated; the combination raises `ValueError` (mirroring
+        the static-mode validation) instead of silently stranding
+        feasible pods.
 
         With equal `inner_iters` the two modes place identically (the
         extra static iterations/rounds are provably no-ops); a SMALLER
@@ -358,6 +365,27 @@ class GangScheduler:
                         f" cannot cover a full eval_window sweep"
                         f" (ceil(P/WP) = {n_win}): raise"
                         f" static_rounds/max_rounds or eval_window"
+                    )
+                # same rule for the dynamic loop (ADVICE r5 residue):
+                # its cap is denominated in COMMIT rounds, and every
+                # commit resets the window offset to 0, so a cap below
+                # the sweep width can spend itself entirely on the
+                # earliest windows and end the pass before later
+                # windows were ever evaluated against settled state —
+                # feasible pods stranded with no auto-resume backstop.
+                # A cap that covers one full sweep is the floor at
+                # which "budget exhausted" can't masquerade as
+                # "remainder infeasible".
+                if (
+                    loop == "dynamic"
+                    and max_rounds is not None
+                    and max_rounds < n_win
+                ):
+                    raise ValueError(
+                        f"dynamic per-pass commit budget"
+                        f" max_rounds={max_rounds} cannot cover a full"
+                        f" eval_window sweep (ceil(P/WP) = {n_win}):"
+                        f" raise max_rounds or eval_window"
                     )
             else:
                 self.static_rounds = max(self.static_rounds, n_win)

@@ -4,7 +4,9 @@ Modes over one ChaosSpec file (JSON or YAML):
 
   * default        — run the discrete-event timeline (engine.py); the
     result document prints to stdout, the replayable JSONL trace lands
-    at ``--trace-out`` when given;
+    at ``--trace-out`` when given, and ``--perfetto-out`` exports the
+    run's span flight recording as Chrome trace-event JSON
+    (docs/observability.md);
   * ``--sweep S``  — additionally run the vmapped fault sweep
     (faultsweep.py) over the spec's snapshot cluster: S sampled failure
     scenarios at ``--fail-prob``, seeded from the spec;
@@ -63,6 +65,13 @@ def main(argv: "list[str] | None" = None) -> int:
         "(on --resume: the FULL trace, checkpointed prefix included)"
     )
     ap.add_argument(
+        "--perfetto-out", metavar="FILE",
+        help="write the run's span flight recording here as Chrome "
+        "trace-event JSON, loadable in https://ui.perfetto.dev "
+        "(docs/observability.md); forces tracing ON for the run even "
+        "without KSS_TRACE=1",
+    )
+    ap.add_argument(
         "--checkpoint-to", metavar="PATH",
         help="persist atomic run checkpoints here (periodic per the "
         "--checkpoint-every-* cadence; final on SIGINT/SIGTERM or "
@@ -105,8 +114,17 @@ def main(argv: "list[str] | None" = None) -> int:
         ap.error("--checkpoint-every-* requires --checkpoint-to")
 
     from ..scenario.chaos import ChaosSpec
+    from ..utils import telemetry
     from .checkpoint import load_checkpoint
     from .engine import LifecycleEngine
+
+    # --perfetto-out forces the flight recorder on for this run; an
+    # env-armed recorder (KSS_TRACE=1) is reused so the export carries
+    # whatever was already recorded
+    recorder = telemetry.active()
+    if args.perfetto_out and recorder is None:
+        recorder = telemetry.SpanRecorder()
+        telemetry.activate(recorder)
 
     supervise = dict(
         checkpoint_path=args.checkpoint_to,
@@ -149,6 +167,10 @@ def main(argv: "list[str] | None" = None) -> int:
         with open(args.trace_out, "w") as f:
             f.write(engine.trace_jsonl())
         result["traceFile"] = args.trace_out
+    if args.perfetto_out:
+        n = telemetry.dump_chrome_trace(args.perfetto_out, recorder)
+        result["perfettoFile"] = args.perfetto_out
+        result["perfettoEvents"] = n
 
     if args.sweep > 0:
         from ..sched.config import SchedulerConfiguration

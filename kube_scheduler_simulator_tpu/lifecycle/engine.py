@@ -69,6 +69,7 @@ from ..sched.config import SchedulerConfiguration
 from ..sched.results import ANNOTATION_KEYS
 from ..server.service import SchedulerService
 from ..utils import metrics as metrics_mod
+from ..utils import telemetry
 
 
 def _pod_key(pod: dict) -> tuple[str, str]:
@@ -272,8 +273,11 @@ class LifecycleEngine:
         if not path:
             raise ValueError("no checkpoint path configured")
         self._resolve_inflight()  # an in-flight pass is not serializable
-        doc = checkpoint_doc(self)
-        write_checkpoint(doc, path)
+        with telemetry.span(
+            "lifecycle.checkpoint", sim_t=self.sim_time, path=path
+        ):
+            doc = checkpoint_doc(self)
+            write_checkpoint(doc, path)
         self.checkpoints_written += 1
         self.last_checkpoint_doc = doc
         self._ckpt_marker_events = self.events_consumed
@@ -345,6 +349,13 @@ class LifecycleEngine:
 
     def _apply_fault(self, t: float, payload: dict) -> None:
         action, name = payload["action"], payload["node"]
+        # a point mark on the flight recorder's timeline: injected
+        # cluster faults correlate with the surrounding pass spans by
+        # wall time AND by sim_t
+        telemetry.instant(
+            "lifecycle.fault", sim_t=round(float(t), 9),
+            action=action, node=name,
+        )
         node = self.store.get("nodes", name)
         if action == "recover":
             manifest = self._downed.pop(name, None)
@@ -427,7 +438,14 @@ class LifecycleEngine:
         is DISPATCHED here (after resolving any in-flight predecessor)
         and resolved later — at the next fence or the next converge."""
         self._resolve_inflight()  # controllers + encode need its bindings
-        run_to_fixpoint(self.store, CONTROLLERS, self.max_controller_rounds)
+        with telemetry.span(
+            "lifecycle.controllers",
+            pass_id=self.scheduler.next_pass_id_hint(),
+            sim_t=round(float(t), 9),
+        ):
+            run_to_fixpoint(
+                self.store, CONTROLLERS, self.max_controller_rounds
+            )
         if self.pipeline == "async":
             self._dispatch_pass(t)
             return
@@ -625,22 +643,35 @@ class LifecycleEngine:
                 while heap and heap[0][0] == t:
                     _, _, kind2, payload2 = heapq.heappop(heap)
                     batch.append((kind2, payload2))
-                for ev_kind, ev_payload in batch:
-                    if ev_kind == "arrival":
-                        # arrivals overlap the in-flight pass UNLESS the
-                        # pod name collides with an existing store pod
-                        # (an overwrite would race the deferred
-                        # write-backs) — the async pipeline's fence
-                        if self._inflight is not None and self._arrival_conflicts(
-                            ev_payload
-                        ):
+                # host-side event application, stamped with the pass id
+                # it FEEDS (the next dispatch): under the async pipeline
+                # this span runs while the previous pass's device window
+                # is still open — the overlap Perfetto shows as parallel
+                # tracks and tests/test_async_pipeline.py asserts
+                with telemetry.span(
+                    "lifecycle.events",
+                    pass_id=self.scheduler.next_pass_id_hint(),
+                    sim_t=round(float(t), 9),
+                    batch=len(batch),
+                ):
+                    for ev_kind, ev_payload in batch:
+                        if ev_kind == "arrival":
+                            # arrivals overlap the in-flight pass UNLESS
+                            # the pod name collides with an existing
+                            # store pod (an overwrite would race the
+                            # deferred write-backs) — the async
+                            # pipeline's fence
+                            if (
+                                self._inflight is not None
+                                and self._arrival_conflicts(ev_payload)
+                            ):
+                                self._resolve_inflight()
+                            self._apply_arrival(t, ev_payload)
+                        else:
+                            # faults read binding state (pods_on_node,
+                            # cordon/taint interplay): always fence
                             self._resolve_inflight()
-                        self._apply_arrival(t, ev_payload)
-                    else:
-                        # faults read binding state (pods_on_node,
-                        # cordon/taint interplay): always fence
-                        self._resolve_inflight()
-                        self._apply_fault(t, dict(ev_payload))
+                            self._apply_fault(t, dict(ev_payload))
                 self._converge(t)
                 self.events_consumed += len(batch)
                 self._maybe_checkpoint(t)

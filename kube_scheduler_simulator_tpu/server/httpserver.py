@@ -18,7 +18,14 @@ typed store — SURVEY.md §2 #3):
     GET/DELETE         /api/v1/resources/<kind>/<ns>/<name>  (or /<name>)
     POST               /api/v1/schedule      run one batched scheduling pass
     GET                /api/v1/metrics       scheduling-pass counters
-                                             (decisions/sec, utils/metrics.py)
+                                             (decisions/sec, utils/metrics.py;
+                                             ?format=prometheus for text
+                                             exposition)
+    GET                /api/v1/debug/trace   flight-recorder window as Chrome
+                                             trace-event JSON (Perfetto)
+    POST               /api/v1/debug/profile arm/disarm a jax.profiler capture
+    GET                /api/v1/events        live telemetry SSE stream
+                                             (docs/observability.md)
     POST               /api/v1/lifecycle     run a ChaosSpec chaos timeline
                                              (lifecycle/engine.py, isolated store)
     GET                /api/v1/lifecycle/trace   last run's JSONL event trace
@@ -40,10 +47,13 @@ from __future__ import annotations
 import json
 import queue
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from ..models.store import KINDS, NAMESPACED, StaleResourceVersion
+from ..utils import metrics as metrics_mod
+from ..utils import telemetry
 from ..utils.broker import CompileDeadlineExceeded, CompileUnavailable
 from .service import (
     EngineDegraded,
@@ -95,6 +105,11 @@ class SimulatorServer:
         # one-scenario-at-a-time; each request thread would otherwise
         # drive the device concurrently)
         self._scenario_lock = threading.Lock()
+        # POST /api/v1/debug/profile arming state: the active jax
+        # profiler capture's log dir, or None (at most one per process —
+        # jax.profiler is a process-wide singleton)
+        self._profile_lock = threading.Lock()
+        self._profile_dir: "str | None" = None
 
     @property
     def port(self) -> int:
@@ -268,7 +283,50 @@ def _make_handler(server: SimulatorServer):
                     doc["encodingCacheCapacity"] = (
                         service.scheduler.encoding_cache_capacity
                     )
+                    fmt = parse_qs(url.query).get("format", ["json"])[0]
+                    if fmt == "prometheus":
+                        body = metrics_mod.render_prometheus(
+                            doc,
+                            extra_gauges={
+                                "kss_encoding_cache_capacity": (
+                                    "Capacity of the per-service encoding "
+                                    "cache (KSS_ENCODING_CACHE_CAP).",
+                                    doc["encodingCacheCapacity"],
+                                )
+                            },
+                        ).encode()
+                        self.send_response(200)
+                        self._cors_headers()
+                        self.send_header(
+                            "Content-Type",
+                            "text/plain; version=0.0.4; charset=utf-8",
+                        )
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return None
+                    if fmt != "json":
+                        return self._error(
+                            400, f"unknown metrics format {fmt!r}"
+                        )
                     return self._json(200, doc)
+                if rest == ["debug", "trace"] and method == "GET":
+                    # the flight recorder's retained window as Chrome
+                    # trace-event JSON — loadable as-is in Perfetto
+                    # (docs/observability.md). With tracing off the
+                    # document is empty but still loadable, and
+                    # otherData.tracingEnabled says why.
+                    rec = telemetry.active()
+                    events = rec.snapshot() if rec is not None else []
+                    doc = telemetry.chrome_trace(
+                        events, dropped=rec.dropped if rec is not None else 0
+                    )
+                    doc["otherData"]["tracingEnabled"] = rec is not None
+                    return self._json(200, doc)
+                if rest == ["debug", "profile"] and method == "POST":
+                    return self._debug_profile(self._body() or {})
+                if rest == ["events"] and method == "GET":
+                    return self._events_stream(parse_qs(url.query))
                 if rest == ["schedule"] and method == "POST":
                     mode = parse_qs(url.query).get("mode", ["sequential"])[0]
                     if mode not in ("sequential", "gang"):
@@ -530,6 +588,134 @@ def _make_handler(server: SimulatorServer):
             verb, id_str = rest
             out = ext.handle(verb, int(id_str), self._body())
             return self._json(200, out)
+
+        # -- telemetry plane ------------------------------------------------
+
+        def _debug_profile(self, body: dict):
+            """Arm / disarm a `jax.profiler` trace capture over HTTP
+            (docs/observability.md): ``{"action": "start", "logDir":
+            optional}`` begins a TensorBoard/XProf capture of everything
+            the process runs next; ``{"action": "stop"}`` ends it. One
+            capture at a time — jax.profiler is process-global."""
+            import jax
+
+            action = body.get("action")
+            if action == "start":
+                with server._profile_lock:
+                    if server._profile_dir is not None:
+                        return self._error(
+                            409,
+                            f"profile already running into "
+                            f"{server._profile_dir!r}; stop it first",
+                        )
+                    log_dir = body.get("logDir")
+                    if not log_dir:
+                        import tempfile
+
+                        log_dir = tempfile.mkdtemp(prefix="kss-profile-")
+                    jax.profiler.start_trace(log_dir)
+                    server._profile_dir = log_dir
+                return self._json(
+                    200, {"profiling": True, "logDir": log_dir}
+                )
+            if action == "stop":
+                with server._profile_lock:
+                    if server._profile_dir is None:
+                        return self._error(409, "no profile running")
+                    log_dir, server._profile_dir = server._profile_dir, None
+                    jax.profiler.stop_trace()
+                return self._json(
+                    200, {"profiling": False, "logDir": log_dir}
+                )
+            return self._error(
+                400, f"action must be start|stop, got {action!r}"
+            )
+
+        def _events_stream(self, q: dict):
+            """GET /api/v1/events: live telemetry over SSE
+            (text/event-stream), reusing the listwatch chunked plumbing.
+            Two event types (docs/observability.md):
+
+              * ``metrics`` — a full `SchedulingMetrics` snapshot; one is
+                sent immediately on connect (the stream always yields at
+                least one event) and again whenever the counters change;
+              * ``span`` — each flight-recorder event as it is emitted
+                (requires `KSS_TRACE=1`; without it the stream carries
+                metrics events only).
+
+            A comment heartbeat (``:``) flows on idle so a vanished
+            client is detected and the subscription reclaimed."""
+            rec = telemetry.active()
+            # bounded feed: a slow/stalled client must not accumulate
+            # every span the process emits (the unbounded growth the
+            # ring buffer exists to prevent) — past the bound, spans
+            # are dropped for THIS subscriber, flight-recorder style
+            events: "queue.Queue" = queue.Queue(maxsize=4096)
+
+            def feed(ev: dict) -> None:
+                try:
+                    events.put_nowait(ev)
+                except queue.Full:
+                    pass
+
+            if rec is not None:
+                rec.subscribe(feed)
+            try:
+                self.send_response(200)
+                self._cors_headers()
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-store")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def push(event: str, payload) -> None:
+                    data = (
+                        f"event: {event}\n"
+                        f"data: {json.dumps(payload)}\n\n"
+                    ).encode()
+                    self.wfile.write(b"%x\r\n%s\r\n" % (len(data), data))
+                    self.wfile.flush()
+
+                def counters():
+                    snap = service.scheduler.metrics.snapshot()
+                    snap.pop("uptimeSeconds", None)  # changes every read
+                    return snap
+
+                last = counters()
+                push("metrics", last)
+                idle = 0
+                checked = time.monotonic()
+                while True:
+                    try:
+                        ev = events.get(timeout=1.0)
+                    except queue.Empty:
+                        ev = None
+                    # counters are re-checked on a wall-clock cadence in
+                    # BOTH branches: continuous span traffic must not
+                    # starve the metrics feed
+                    now_t = time.monotonic()
+                    if now_t - checked >= 1.0:
+                        checked = now_t
+                        now = counters()
+                        if now != last:
+                            last = now
+                            push("metrics", now)
+                            idle = 0
+                    if ev is not None:
+                        idle = 0
+                        push("span", ev)
+                        continue
+                    idle += 1
+                    if idle >= 15:
+                        idle = 0
+                        # SSE comment line: a spec-legal heartbeat
+                        self.wfile.write(b"3\r\n:\n\n\r\n")
+                        self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+            finally:
+                if rec is not None:
+                    rec.unsubscribe(feed)
 
         # -- watch stream ---------------------------------------------------
 

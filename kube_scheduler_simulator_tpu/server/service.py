@@ -47,6 +47,7 @@ from ..sched.extender import ExtenderService
 from ..sched.results import PodSchedulingResult
 from ..utils import faultinject
 from ..utils import metrics as metrics_mod
+from ..utils import telemetry
 from ..utils.broker import (
     CompileBroker,
     CompileUnavailable,
@@ -102,13 +103,17 @@ class SchedulingPassHandle:
     (or `abandon`) exactly once before starting another pass; the
     lifecycle engine's async pipeline is the canonical driver."""
 
-    def __init__(self, service, mode: str, finish, encode_info):
+    def __init__(self, service, mode: str, finish, encode_info, pass_id=None):
         self._service = service
         self._finish = finish
         self._done = False
         self.mode = mode
         # the encode path that served the dispatch (delta/full/cached/…)
         self.encode_info = encode_info
+        # causal id of this pass in the service's monotonic sequence —
+        # every telemetry span of the pass (including the broker's
+        # speculative builds it arms) carries it (utils/telemetry.py)
+        self.pass_id = pass_id
         self.scheduled: "int | None" = None
 
     def resolve(self) -> int:
@@ -188,7 +193,24 @@ class SchedulerService:
         # the last _encode_current outcome ({"mode": ..., ...}) — read
         # by the lifecycle engine to stamp per-pass encode modes
         self.last_encode_info: "dict | None" = None
+        # monotonic pass sequence (telemetry causality): advanced under
+        # the schedule lock, so ids order exactly like passes do
+        self._pass_seq = 0
         self.extender_service = ExtenderService(self._config.extenders)
+
+    def _next_pass_id(self) -> int:
+        """The next causal pass id — call only with `_schedule_lock`
+        held (passes are serialized, so a plain increment is exact)."""
+        self._pass_seq += 1
+        return self._pass_seq
+
+    def next_pass_id_hint(self) -> int:
+        """The pass id the NEXT pass will carry — exact only while the
+        caller is the sole driver of this service (the lifecycle engine
+        is: it owns its service and runs single-threaded). Used to stamp
+        host-side work that FEEDS the next pass (event application under
+        the async pipeline) with that pass's causal id."""
+        return self._pass_seq + 1
 
     @staticmethod
     def _encoding_cache_cap_from_env() -> int:
@@ -260,18 +282,24 @@ class SchedulerService:
             # mid-pass
             with self._lock:
                 config = self._config
-            with self.metrics.time_pass(
-                "extender" if config.extenders else "sequential"
-            ) as ctx:
-                results = self._schedule_locked(config)
-                # a preempting pod yields two records (Nominated + retry):
-                # count distinct pods so decisions/sec isn't inflated
-                ctx.done(
-                    pods=len({(r.pod_namespace, r.pod_name) for r in results}),
-                    scheduled=sum(
-                        1 for r in results if r.status == "Scheduled"
-                    ),
-                )
+            mode = "extender" if config.extenders else "sequential"
+            pass_id = self._next_pass_id()
+            with telemetry.pass_context(pass_id), telemetry.span(
+                f"pass.{mode}", pass_id=pass_id
+            ):
+                with self.metrics.time_pass(mode) as ctx:
+                    results = self._schedule_locked(config)
+                    # a preempting pod yields two records (Nominated +
+                    # retry): count distinct pods so decisions/sec isn't
+                    # inflated
+                    ctx.done(
+                        pods=len(
+                            {(r.pod_namespace, r.pod_name) for r in results}
+                        ),
+                        scheduled=sum(
+                            1 for r in results if r.status == "Scheduled"
+                        ),
+                    )
             return results
 
     def schedule_gang(
@@ -304,15 +332,19 @@ class SchedulerService:
             raise ValueError(
                 "gang mode does not support extenders; use sequential mode"
             )
-        with self.metrics.time_pass("gang") as ctx:
-            placements, rounds, results = self._schedule_gang_locked(
-                config, record, window
-            )
-            ctx.done(
-                pods=len(placements),
-                scheduled=sum(1 for v in placements.values() if v),
-                rounds=rounds,
-            )
+        pass_id = self._next_pass_id()
+        with telemetry.pass_context(pass_id), telemetry.span(
+            "pass.gang", pass_id=pass_id
+        ):
+            with self.metrics.time_pass("gang") as ctx:
+                placements, rounds, results = self._schedule_gang_locked(
+                    config, record, window
+                )
+                ctx.done(
+                    pods=len(placements),
+                    scheduled=sum(1 for v in placements.values() if v),
+                    rounds=rounds,
+                )
         return placements, rounds, results
 
     def _schedule_gang_locked(self, config, record: bool, window=None):
@@ -342,8 +374,9 @@ class SchedulerService:
         compiled); the pass completes slowly instead of not at all."""
         t0 = time.perf_counter()
         try:
-            with eager_execution():
-                engine = build()
+            with telemetry.span("pass.eager_fallback", reason=str(err)):
+                with eager_execution():
+                    engine = build()
         except Exception as e:
             self.metrics.record_resilience(degraded_passes=1)
             raise EngineDegraded(
@@ -503,11 +536,17 @@ class SchedulerService:
         if cached is not EncodingCache.MISS:
             self.last_encode_info = {"mode": "cached"}
             self.metrics.record_encode("cached", time.perf_counter() - t0)
+            telemetry.complete(
+                "pass.encode", t0, time.perf_counter(), mode="cached"
+            )
             return cached
         enc, info = self._delta.encode(self.store, config)
         self._enc_cache.put(cache_key, config, enc)
         self.last_encode_info = info
         self.metrics.record_encode(info["mode"], time.perf_counter() - t0)
+        telemetry.complete(
+            "pass.encode", t0, time.perf_counter(), mode=info["mode"]
+        )
         return enc
 
     # -- predictive compilation --------------------------------------------
@@ -630,16 +669,37 @@ class SchedulerService:
             with self._lock:
                 config = self._config
             mode = "extender" if config.extenders else "sequential"
+            pass_id = self._next_pass_id()
             t0 = time.perf_counter()
-            disp = self._seq_dispatch(config)
+            with telemetry.pass_context(pass_id), telemetry.span(
+                f"pass.{mode}.dispatch", pass_id=pass_id
+            ):
+                disp = self._seq_dispatch(config)
             info = self.last_encode_info
         except BaseException:
             self._schedule_lock.release()
             raise
 
         def finish() -> int:
-            results = [] if disp is None else self._seq_finish(disp)
-            scheduled = sum(1 for r in results if r.status == "Scheduled")
+            # the in-flight window: device execution of THIS pass ran
+            # from dispatch until now, overlapping whatever host work
+            # the caller did in between — the one span shape that lands
+            # on the synthetic device track and can OVERLAP host spans
+            telemetry.complete(
+                "device.execute",
+                t0,
+                time.perf_counter(),
+                tid=telemetry.DEVICE_TID,
+                pass_id=pass_id,
+                mode=mode,
+            )
+            with telemetry.pass_context(pass_id), telemetry.span(
+                f"pass.{mode}.resolve", pass_id=pass_id
+            ):
+                results = [] if disp is None else self._seq_finish(disp)
+                scheduled = sum(
+                    1 for r in results if r.status == "Scheduled"
+                )
             # distinct pods, like the synchronous pass (a preempting pod
             # yields two records)
             self.metrics.record(
@@ -652,7 +712,7 @@ class SchedulerService:
             )
             return scheduled
 
-        return SchedulingPassHandle(self, mode, finish, info)
+        return SchedulingPassHandle(self, mode, finish, info, pass_id=pass_id)
 
     def begin_gang_pass(
         self, record: bool = False, window: "int | None" = None
@@ -671,14 +731,26 @@ class SchedulerService:
                 raise ValueError(
                     "gang mode does not support extenders; use sequential mode"
                 )
+            pass_id = self._next_pass_id()
             t0 = time.perf_counter()
-            disp = self._gang_dispatch(config, record, window)
+            with telemetry.pass_context(pass_id), telemetry.span(
+                "pass.gang.dispatch", pass_id=pass_id
+            ):
+                disp = self._gang_dispatch(config, record, window)
             info = self.last_encode_info
         except BaseException:
             self._schedule_lock.release()
             raise
 
         def finish() -> int:
+            telemetry.complete(
+                "device.execute",
+                t0,
+                time.perf_counter(),
+                tid=telemetry.DEVICE_TID,
+                pass_id=pass_id,
+                mode="gang",
+            )
             if disp is None:
                 self.metrics.record(
                     metrics_mod.PassRecord(
@@ -686,7 +758,10 @@ class SchedulerService:
                     )
                 )
                 return 0
-            placements, rounds, _results = self._gang_finish(disp, record)
+            with telemetry.pass_context(pass_id), telemetry.span(
+                "pass.gang.resolve", pass_id=pass_id
+            ):
+                placements, rounds, _results = self._gang_finish(disp, record)
             scheduled = sum(1 for v in placements.values() if v)
             self.metrics.record(
                 metrics_mod.PassRecord(
@@ -699,7 +774,7 @@ class SchedulerService:
             )
             return scheduled
 
-        return SchedulingPassHandle(self, "gang", finish, info)
+        return SchedulingPassHandle(self, "gang", finish, info, pass_id=pass_id)
 
     def _schedule_locked(self, config) -> list[PodSchedulingResult]:
         disp = self._seq_dispatch(config)

@@ -77,7 +77,7 @@ import time
 import weakref
 from contextlib import contextmanager
 
-from . import faultinject
+from . import faultinject, telemetry
 from .compilecache import enable_compile_cache, shape_bucket
 
 _log = logging.getLogger("kube_scheduler_simulator_tpu.broker")
@@ -388,7 +388,8 @@ class CompileBroker:
             if mine:
                 t0 = time.perf_counter()
                 try:
-                    eng = build()
+                    with telemetry.span("compile.build", key=str(key)):
+                        eng = build()
                 except BaseException:
                     with self._lock:
                         self._inflight.pop(key, None)
@@ -406,7 +407,8 @@ class CompileBroker:
             # someone else (request thread or speculation worker) is
             # compiling this key: wait and share — no second compile
             t0 = time.perf_counter()
-            fl.ev.wait()
+            with telemetry.span("compile.wait", key=str(key)):
+                fl.ev.wait()
             if fl.engine is not None:
                 wait_s = time.perf_counter() - t0
                 if info is not None:
@@ -496,7 +498,8 @@ class CompileBroker:
                 return self._build_resilient(key, fl, build, info)
             # share someone else's in-flight build, like `get`
             t0 = time.perf_counter()
-            fl.ev.wait()
+            with telemetry.span("compile.wait", key=str(key)):
+                fl.ev.wait()
             if fl.engine is not None:
                 wait_s = time.perf_counter() - t0
                 if info is not None:
@@ -527,10 +530,16 @@ class CompileBroker:
             for i in range(attempts):
                 if i:
                     self._note(retries=1)
+                    telemetry.instant(
+                        "compile.retry", key=str(key), attempt=i + 1
+                    )
                     if backoff > 0:
                         time.sleep(backoff * (2 ** (i - 1)))
                 try:
-                    eng = self._attempt_build(build)
+                    with telemetry.span(
+                        "compile.build", key=str(key), attempt=i + 1
+                    ):
+                        eng = self._attempt_build(build)
                     break
                 except Exception as e:  # noqa: BLE001 — each rung retries
                     err = e
@@ -538,6 +547,9 @@ class CompileBroker:
                     if th is not None:
                         with self._lock:
                             self._abandoned.setdefault(key, []).append(th)
+                        telemetry.instant(
+                            "compile.deadline_abandoned", key=str(key)
+                        )
         except BaseException:
             # non-Exception escape (KeyboardInterrupt, SystemExit):
             # release the slot exactly like `get`'s miss path
@@ -551,6 +563,7 @@ class CompileBroker:
                 self._cooldown[key] = compile_cooldown_passes()
             fl.ev.set()  # engine stays None: waiters re-enter the ladder
             self._note(stall_s=time.perf_counter() - t0)
+            telemetry.instant("compile.ladder_exhausted", key=str(key))
             raise CompileUnavailable(
                 f"compile ladder exhausted for {key!r} after {attempts} "
                 f"attempts: {type(err).__name__}: {err}"
@@ -576,11 +589,15 @@ class CompileBroker:
         the token is already pending."""
         if not self.speculative:
             return False
+        # the causal pass id of the ARMING request thread travels with
+        # the task: the worker re-enters it, so a speculative build's
+        # telemetry spans name the pass that armed it (utils/telemetry.py)
+        armed_by = telemetry.current_pass_id()
         with self._lock:
             if token in self._tokens:
                 return False
             self._tokens.add(token)
-            self._tasks.append((token, task))
+            self._tasks.append((token, task, armed_by))
             self._busy += 1
             if self._worker is None:
                 self._worker = threading.Thread(
@@ -595,15 +612,18 @@ class CompileBroker:
                 if not self._tasks:
                     self._worker = None
                     return
-                token, task = self._tasks.pop(0)
+                token, task, armed_by = self._tasks.pop(0)
             try:
-                plane = faultinject.active()
-                if plane is not None:
-                    plane.maybe_raise("worker_crash")
-                res = task()
-                if res is not None:
-                    key, build = res
-                    self._background_build(key, build)
+                with telemetry.pass_context(armed_by), telemetry.span(
+                    "compile.speculative", token=str(token)
+                ):
+                    plane = faultinject.active()
+                    if plane is not None:
+                        plane.maybe_raise("worker_crash")
+                    res = task()
+                    if res is not None:
+                        key, build = res
+                        self._background_build(key, build)
             except BaseException as e:  # noqa: BLE001 — speculation never fails a run
                 self._contain_worker_crash(e)
             finally:

@@ -48,6 +48,8 @@ import random
 import threading
 import time
 
+from . import telemetry
+
 PROBABILITY_SITES = ("compile_fail", "device_error", "worker_crash")
 DURATION_SITES = ("compile_slow",)
 
@@ -133,6 +135,10 @@ class FaultPlane:
             if hit:
                 self.injected[site] = self.injected.get(site, 0) + 1
         if hit:
+            # the injected fault lands on the flight recorder's timeline
+            # (carrying the current pass id) so a chaos run's trace shows
+            # WHERE each fault bit, not just that it did
+            telemetry.instant("fault.injected", site=site)
             raise InjectedFault(site)
 
     def delay(self, site: str) -> float:
@@ -142,6 +148,7 @@ class FaultPlane:
             return 0.0
         with self._lock:
             self.injected[site] = self.injected.get(site, 0) + 1
+        telemetry.instant("fault.delay", site=site, seconds=d)
         time.sleep(d)
         return d
 

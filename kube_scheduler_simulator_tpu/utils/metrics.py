@@ -13,10 +13,125 @@ TensorBoard/XProf without the caller importing jax.
 
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
+
+# The /api/v1/metrics JSON document's schema version: bumped whenever a
+# field changes meaning or disappears (additions don't bump it). v2
+# introduced the version stamp itself, uptimeSeconds, and the
+# histograms block (docs/observability.md).
+METRICS_SCHEMA_VERSION = 2
+
+
+class Histogram:
+    """A fixed-bucket histogram in the Prometheus style: per-bucket
+    observation counts over strictly increasing upper bounds plus an
+    implicit +Inf overflow, a running sum, and a total count. NOT
+    itself thread-safe — `SchedulingMetrics` guards every observation
+    and read with its own lock."""
+
+    __slots__ = ("bounds", "counts", "sum", "count")
+
+    def __init__(self, bounds: "tuple[float, ...]"):
+        bounds = tuple(float(b) for b in bounds)
+        if not bounds or any(
+            b2 <= b1 for b1, b2 in zip(bounds, bounds[1:])
+        ):
+            raise ValueError(
+                f"histogram bounds must be strictly increasing: {bounds}"
+            )
+        self.bounds = bounds
+        self.counts = [0] * (len(bounds) + 1)  # [-1] is the +Inf bucket
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        self.counts[bisect.bisect_left(self.bounds, v)] += 1
+        self.sum += v
+        self.count += 1
+
+    def snapshot(self) -> dict:
+        """JSON shape (the /api/v1/metrics histograms block): CUMULATIVE
+        bucket counts keyed by upper bound, Prometheus-style."""
+        cum = 0
+        buckets = {}
+        for bound, n in zip(self.bounds, self.counts):
+            cum += n
+            buckets[repr(bound)] = cum
+        buckets["+Inf"] = self.count
+        return {
+            "buckets": buckets,
+            "sum": round(self.sum, 9),
+            "count": self.count,
+        }
+
+    def state_dict(self) -> dict:
+        return {
+            "bounds": list(self.bounds),
+            "counts": list(self.counts),
+            "sum": self.sum,
+            "count": self.count,
+        }
+
+    def load_state(self, state: dict) -> None:
+        """Restore `state_dict` output. A checkpoint written with
+        different bucket bounds cannot be re-bucketed exactly — it is
+        ignored (fresh histogram) rather than loaded wrong."""
+        if tuple(float(b) for b in state.get("bounds", ())) != self.bounds:
+            return
+        counts = state.get("counts")
+        if not isinstance(counts, list) or len(counts) != len(self.counts):
+            return
+        self.counts = [int(c) for c in counts]
+        self.sum = float(state.get("sum", 0.0))
+        self.count = int(state.get("count", 0))
+
+
+# Default bucket bounds. Pass latency and compile stalls are wall-clock
+# host seconds (sub-ms warm passes up to ~minute-scale cold compiles);
+# time-to-reschedule is SIMULATED seconds (lifecycle disruption scale).
+LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+TTS_BUCKETS = (0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 600.0)
+
+# (JSON key in the histograms block, Prometheus metric name, bounds,
+# help text) — the ONE place the histogram families are defined, so the
+# JSON snapshot, the exposition text, and the checkpoint state can't
+# drift apart.
+HISTOGRAM_FAMILIES = (
+    (
+        "passLatencySeconds",
+        "kss_pass_latency_seconds",
+        LATENCY_BUCKETS,
+        "Wall-clock latency of one scheduling pass.",
+    ),
+    (
+        "compileStallSeconds",
+        "kss_compile_stall_seconds",
+        LATENCY_BUCKETS,
+        "Request-thread seconds blocked on one compile (miss builds and "
+        "in-flight waits).",
+    ),
+    (
+        "timeToRescheduleSeconds",
+        "kss_time_to_reschedule_seconds",
+        TTS_BUCKETS,
+        "Simulated seconds an evicted pod spent pending before its "
+        "re-bind.",
+    ),
+)
+
+
+def _new_histograms() -> dict:
+    return {
+        key: Histogram(bounds) for key, _, bounds, _ in HISTOGRAM_FAMILIES
+    }
 
 
 @dataclass
@@ -86,6 +201,13 @@ class SchedulingMetrics:
     _eager_fallbacks: int = 0
     _degraded_passes: int = 0
     _worker_crashes: int = 0
+    # latency-distribution state (the observability PR): Prometheus-style
+    # histograms behind the same lock as the counters, rendered into the
+    # JSON snapshot's `histograms` block and the exposition text
+    _hist: dict = field(default_factory=_new_histograms, repr=False)
+    # uptime epoch of this registry (monotonic; NOT checkpointed — a
+    # resumed run's uptime is the new process's)
+    _born_monotonic: float = field(default_factory=time.monotonic, repr=False)
 
     def record(self, rec: PassRecord) -> None:
         with self._lock:
@@ -96,6 +218,7 @@ class SchedulingMetrics:
             self._total_pods += rec.pods
             self._total_scheduled += rec.scheduled
             self._total_wall_s += rec.wall_s
+            self._hist["passLatencySeconds"].observe(rec.wall_s)
 
     def record_disruption(
         self,
@@ -113,6 +236,7 @@ class SchedulingMetrics:
                 self._tts_sum_s += float(t)
                 self._tts_max_s = max(self._tts_max_s, float(t))
                 self._tts_count += 1
+                self._hist["timeToRescheduleSeconds"].observe(float(t))
 
     def record_encode(self, mode: str, seconds: float = 0.0) -> None:
         """One encode attempt: `mode` is the path that served it
@@ -148,6 +272,8 @@ class SchedulingMetrics:
             self._compile_misses += int(misses)
             self._speculative_compiles += int(speculative)
             self._stall_s += float(stall_s)
+            if stall_s > 0:
+                self._hist["compileStallSeconds"].observe(float(stall_s))
 
     def record_resilience(
         self,
@@ -198,6 +324,10 @@ class SchedulingMetrics:
         with self._lock:
             recent = self._passes[-16:]
             return {
+                "schemaVersion": METRICS_SCHEMA_VERSION,
+                "uptimeSeconds": round(
+                    time.monotonic() - self._born_monotonic, 3
+                ),
                 "passes": self._pass_count,
                 "totalPods": self._total_pods,
                 "totalScheduled": self._total_scheduled,
@@ -247,6 +377,9 @@ class SchedulingMetrics:
                     "degradedPasses": self._degraded_passes,
                     "brokerWorkerCrashes": self._worker_crashes,
                 },
+                "histograms": {
+                    key: h.snapshot() for key, h in self._hist.items()
+                },
             }
 
     def reset(self) -> None:
@@ -276,6 +409,8 @@ class SchedulingMetrics:
             self._eager_fallbacks = 0
             self._degraded_passes = 0
             self._worker_crashes = 0
+            self._hist = _new_histograms()
+            self._born_monotonic = time.monotonic()
 
     # -- checkpointing (lifecycle/checkpoint.py) -----------------------------
 
@@ -297,11 +432,16 @@ class SchedulingMetrics:
             out = {f: getattr(self, f) for f in self._STATE_FIELDS}
             out["_phase_s"] = dict(self._phase_s)
             out["_encode_counts"] = dict(self._encode_counts)
+            out["_histograms"] = {
+                key: h.state_dict() for key, h in self._hist.items()
+            }
             return out
 
     def load_state(self, state: dict) -> None:
         """Restore counters written by `state_dict` (unknown keys are
-        ignored so old checkpoints stay loadable across counter growth)."""
+        ignored so old checkpoints stay loadable across counter growth;
+        histogram state written before the telemetry PR is simply
+        absent and those distributions restart empty)."""
         with self._lock:
             for f in self._STATE_FIELDS:
                 if f in state:
@@ -309,6 +449,11 @@ class SchedulingMetrics:
             for key in ("_phase_s", "_encode_counts"):
                 if isinstance(state.get(key), dict):
                     getattr(self, key).update(state[key])
+            hists = state.get("_histograms")
+            if isinstance(hists, dict):
+                for key, h in self._hist.items():
+                    if isinstance(hists.get(key), dict):
+                        h.load_state(hists[key])
 
 
 # process-wide shared registry for ad-hoc callers (benchmarks, scripts).
@@ -316,6 +461,277 @@ class SchedulingMetrics:
 # (server/service.py) so per-server numbers stay attributable when
 # several services share a process.
 GLOBAL = SchedulingMetrics()
+
+
+# -- Prometheus exposition ----------------------------------------------------
+
+# (metric name, help, snapshot path) — counters straight off the JSON
+# snapshot. Metric names are STABLE (docs/observability.md's table): a
+# rename is a breaking change for every scrape config pointed here.
+_PROM_COUNTERS = (
+    ("kss_passes_total", "Scheduling passes executed.", ("passes",)),
+    ("kss_pods_total", "Pods evaluated across all passes.", ("totalPods",)),
+    ("kss_scheduled_total", "Pods that received a node.", ("totalScheduled",)),
+    (
+        "kss_pass_wall_seconds_total",
+        "Wall-clock seconds spent inside scheduling passes.",
+        ("totalWallSeconds",),
+    ),
+    (
+        "kss_evicted_total",
+        "Pods evicted by injected lifecycle faults.",
+        ("disruption", "evicted"),
+    ),
+    (
+        "kss_rescheduled_total",
+        "Evicted pods that found a node again.",
+        ("disruption", "rescheduled"),
+    ),
+    (
+        "kss_engine_builds_total",
+        "Compiled-engine constructions (the recompile proxy).",
+        ("phases", "engineBuilds"),
+    ),
+    (
+        "kss_compile_hits_total",
+        "Engine requests served warm by the CompileBroker.",
+        ("phases", "compileHits"),
+    ),
+    (
+        "kss_compile_misses_total",
+        "Engine requests compiled synchronously on the request thread.",
+        ("phases", "compileMisses"),
+    ),
+    (
+        "kss_speculative_compiles_total",
+        "Background speculative compiles completed.",
+        ("phases", "speculativeCompiles"),
+    ),
+    (
+        "kss_compile_retries_total",
+        "Compile attempts re-run after a failure or deadline.",
+        ("phases", "compileRetries"),
+    ),
+    (
+        "kss_eager_fallbacks_total",
+        "Passes served by the un-jitted eager rung.",
+        ("phases", "eagerFallbacks"),
+    ),
+    (
+        "kss_degraded_passes_total",
+        "Passes that could not be served by a compiled engine.",
+        ("phases", "degradedPasses"),
+    ),
+    (
+        "kss_broker_worker_crashes_total",
+        "Speculative-worker crashes contained by the broker.",
+        ("phases", "brokerWorkerCrashes"),
+    ),
+    (
+        "kss_stall_seconds_total",
+        "Request-thread seconds blocked on any compile.",
+        ("phases", "stallSeconds"),
+    ),
+)
+
+
+def _fmt_value(v) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def render_prometheus(snapshot: dict, extra_gauges: "dict | None" = None) -> str:
+    """Render a `SchedulingMetrics.snapshot()` document in the
+    Prometheus text exposition format (version 0.0.4): counters,
+    gauges, and the histogram families, with stable metric names.
+    `extra_gauges` maps metric name -> (help, value) for serving-stack
+    extras (the encoding-cache capacity)."""
+    lines: list[str] = []
+
+    def family(name: str, mtype: str, help_text: str) -> None:
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {mtype}")
+
+    def walk(path: tuple):
+        v = snapshot
+        for p in path:
+            v = v.get(p, 0) if isinstance(v, dict) else 0
+        return v if isinstance(v, (int, float)) else 0
+
+    for name, help_text, path in _PROM_COUNTERS:
+        family(name, "counter", help_text)
+        lines.append(f"{name} {_fmt_value(walk(path))}")
+
+    phases = snapshot.get("phases", {})
+    family(
+        "kss_encodes_total",
+        "counter",
+        "Cluster encodes by the path that served them.",
+    )
+    for mode, key in (
+        ("delta", "deltaEncodes"),
+        ("full", "fullEncodes"),
+        ("cached", "cachedEncodes"),
+        ("empty", "emptyEncodes"),
+    ):
+        lines.append(
+            f'kss_encodes_total{{mode="{mode}"}} '
+            f"{_fmt_value(phases.get(key, 0))}"
+        )
+    family(
+        "kss_phase_seconds_total",
+        "counter",
+        "Pass wall-clock by phase (encode/compile/execute/decode).",
+    )
+    for phase in ("encode", "compile", "execute", "decode"):
+        lines.append(
+            f'kss_phase_seconds_total{{phase="{phase}"}} '
+            f"{_fmt_value(phases.get(phase + 'Seconds', 0.0))}"
+        )
+
+    family("kss_uptime_seconds", "gauge", "Seconds since this registry was born.")
+    lines.append(f"kss_uptime_seconds {_fmt_value(snapshot.get('uptimeSeconds', 0.0))}")
+    family(
+        "kss_metrics_schema_version",
+        "gauge",
+        "Schema version of the /api/v1/metrics JSON document.",
+    )
+    lines.append(
+        "kss_metrics_schema_version "
+        f"{_fmt_value(snapshot.get('schemaVersion', METRICS_SCHEMA_VERSION))}"
+    )
+    for name, (help_text, value) in (extra_gauges or {}).items():
+        family(name, "gauge", help_text)
+        lines.append(f"{name} {_fmt_value(value)}")
+
+    hists = snapshot.get("histograms", {})
+    for key, name, _, help_text in HISTOGRAM_FAMILIES:
+        h = hists.get(key)
+        if not h:
+            continue
+        family(name, "histogram", help_text)
+        for le, cum in h["buckets"].items():
+            lines.append(f'{name}_bucket{{le="{le}"}} {_fmt_value(cum)}')
+        lines.append(f"{name}_sum {_fmt_value(h['sum'])}")
+        lines.append(f"{name}_count {_fmt_value(h['count'])}")
+    return "\n".join(lines) + "\n"
+
+
+_PROM_SAMPLE_RE = None  # compiled lazily (re import kept off the hot path)
+
+
+def parse_prometheus_text(text: str) -> dict:
+    """A real text-format (0.0.4) parse of an exposition document —
+    what the observability smoke and the endpoint tests scrape through,
+    so a malformed render can't pass as 'looks about right'. Returns
+    ``{family: {"type", "help", "samples": [(name, labels, value)]}}``
+    with labels as a dict. Raises ValueError on: unparseable lines,
+    samples without a preceding TYPE, duplicate TYPE lines, histogram
+    families with non-monotonic cumulative buckets, a missing/out-of-
+    order +Inf bucket, or +Inf disagreeing with `_count`."""
+    global _PROM_SAMPLE_RE
+    import re
+
+    if _PROM_SAMPLE_RE is None:
+        _PROM_SAMPLE_RE = (
+            re.compile(
+                r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"  # metric name
+                r"(?:\{(.*)\})?"  # optional label body
+                r"\s+(-?(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?|NaN|[+-]?Inf)"
+                r"(?:\s+-?\d+)?$"  # optional timestamp
+            ),
+            re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"\s*(?:,|$)'),
+        )
+    sample_re, label_re = _PROM_SAMPLE_RE
+    families: dict = {}
+
+    def family_of(sample_name: str) -> str:
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = sample_name[: -len(suffix)] if sample_name.endswith(suffix) else None
+            if base and families.get(base, {}).get("type") == "histogram":
+                return base
+        return sample_name
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            parts = line[len("# HELP ") :].split(" ", 1)
+            families.setdefault(parts[0], {"type": None, "help": None, "samples": []})
+            families[parts[0]]["help"] = parts[1] if len(parts) > 1 else ""
+            continue
+        if line.startswith("# TYPE "):
+            parts = line[len("# TYPE ") :].split()
+            if len(parts) != 2 or parts[1] not in (
+                "counter", "gauge", "histogram", "summary", "untyped",
+            ):
+                raise ValueError(f"line {lineno}: malformed TYPE line {line!r}")
+            fam = families.setdefault(
+                parts[0], {"type": None, "help": None, "samples": []}
+            )
+            if fam["type"] is not None:
+                raise ValueError(f"line {lineno}: duplicate TYPE for {parts[0]}")
+            fam["type"] = parts[1]
+            continue
+        if line.startswith("#"):
+            continue  # comment
+        m = sample_re.match(line)
+        if not m:
+            raise ValueError(f"line {lineno}: unparseable sample {line!r}")
+        name, label_body, raw_value = m.group(1), m.group(2), m.group(3)
+        labels = {}
+        if label_body:
+            consumed = sum(
+                len(lm.group(0)) for lm in label_re.finditer(label_body)
+            )
+            if consumed != len(label_body):
+                raise ValueError(
+                    f"line {lineno}: malformed label body {label_body!r}"
+                )
+            labels = {
+                lm.group(1): lm.group(2) for lm in label_re.finditer(label_body)
+            }
+        value = float(raw_value.replace("Inf", "inf"))
+        fam_name = family_of(name)
+        fam = families.get(fam_name)
+        if fam is None or fam["type"] is None:
+            raise ValueError(
+                f"line {lineno}: sample {name!r} has no preceding TYPE"
+            )
+        fam["samples"].append((name, labels, value))
+
+    # histogram semantics: cumulative monotone buckets, +Inf last and
+    # equal to _count
+    for fam_name, fam in families.items():
+        if fam["type"] != "histogram":
+            continue
+        buckets = [
+            (labels.get("le"), value)
+            for name, labels, value in fam["samples"]
+            if name == fam_name + "_bucket"
+        ]
+        counts = [
+            value for name, _, value in fam["samples"] if name == fam_name + "_count"
+        ]
+        if not buckets or not counts:
+            raise ValueError(f"histogram {fam_name}: missing buckets or _count")
+        if buckets[-1][0] != "+Inf":
+            raise ValueError(f"histogram {fam_name}: +Inf bucket not last")
+        prev = -1.0
+        for le, cum in buckets:
+            if cum < prev:
+                raise ValueError(
+                    f"histogram {fam_name}: non-monotonic bucket le={le}"
+                )
+            prev = cum
+        if buckets[-1][1] != counts[0]:
+            raise ValueError(
+                f"histogram {fam_name}: +Inf bucket {buckets[-1][1]} != "
+                f"_count {counts[0]}"
+            )
+    return families
 
 
 @contextmanager

@@ -23,6 +23,11 @@ for _var in (
     "KSS_COMPILE_RETRIES",
     "KSS_COMPILE_BACKOFF_S",
     "KSS_COMPILE_COOLDOWN_PASSES",
+    # the flight recorder (utils/telemetry.py): an ambient KSS_TRACE=1
+    # would make every test pay span emission (and the off-by-default
+    # zero-emission test would fail for the wrong reason)
+    "KSS_TRACE",
+    "KSS_TRACE_RING_CAP",
 ):
     os.environ.pop(_var, None)
 

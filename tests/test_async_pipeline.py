@@ -284,6 +284,55 @@ class TestAsyncPipelineParity:
             )
 
 
+class TestPipelineOverlapTrace:
+    def test_device_execute_overlaps_next_pass_host_span(self):
+        """The telemetry tentpole's acceptance criterion: in the
+        exported flight recording of an async run, a `device.execute`
+        X span of pass k (the dispatch→resolve in-flight window on the
+        synthetic device track) measurably OVERLAPS a host-side
+        `lifecycle.events` span stamped with pass k+1 — the pipeline's
+        overlap asserted from the data, not eyeballed in Perfetto."""
+        from kube_scheduler_simulator_tpu.utils import telemetry
+
+        rec = telemetry.SpanRecorder(capacity=65536)
+        telemetry.activate(rec)
+        try:
+            eng = LifecycleEngine(
+                ChaosSpec.from_dict(_chaos_dict("gang", "async"))
+            )
+            res = eng.run()
+        finally:
+            telemetry.deactivate()
+        assert res["phase"] == "Succeeded"
+        events = rec.snapshot()
+        telemetry.check_nesting(events)  # well-formed even interleaved
+        intervals = telemetry.span_intervals(events)
+        device = {
+            iv["args"]["pass"]: iv
+            for iv in intervals
+            if iv["name"] == "device.execute"
+            and iv["tid"] == telemetry.DEVICE_TID
+        }
+        assert device, "no device-execute windows recorded"
+        best = 0.0
+        for h in intervals:
+            if h["name"] != "lifecycle.events":
+                continue
+            d = device.get(h["args"].get("pass", 0) - 1)
+            if d is None:
+                continue
+            best = max(
+                best,
+                min(d["end_us"], h["end_us"])
+                - max(d["start_us"], h["start_us"]),
+            )
+        assert best > 0.0, (
+            "no device-execute span of pass k overlaps a host "
+            "lifecycle.events span of pass k+1 — the async pipeline "
+            "left no overlap in the flight recording"
+        )
+
+
 class TestEncodingCacheCap:
     def test_env_override(self, monkeypatch):
         from kube_scheduler_simulator_tpu.models.store import ResourceStore

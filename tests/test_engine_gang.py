@@ -714,6 +714,26 @@ class TestEvalWindow:
                 enc, loop="static", chunk=2, eval_window=2, max_rounds=4
             )
 
+    def test_explicit_dynamic_budget_below_sweep_width_rejected(self):
+        """ADVICE r5 residue: the dynamic commit budget resets the
+        window offset on every commit, so a cap below ceil(P/WP) can
+        spend itself on the earliest windows and end the pass with later
+        windows never evaluated — rejected loudly (mirroring static)
+        instead of silently stranding feasible pods."""
+        nodes = [node("n0", cpu="8", pods="110")]
+        pods = [pod(f"p{i}", cpu="1") for i in range(16)]
+        enc = encode_cluster(nodes, pods, self._cfg(), policy=EXACT)
+        with pytest.raises(
+            ValueError, match="dynamic per-pass commit budget"
+        ):
+            GangScheduler(
+                enc, loop="dynamic", chunk=2, eval_window=2, max_rounds=4
+            )
+        # at exactly the sweep width the combination is legal
+        GangScheduler(
+            enc, loop="dynamic", chunk=2, eval_window=2, max_rounds=8
+        )
+
     def test_dynamic_window_budget_scales_with_sweep_width(self):
         """Code-review r5 repro: on ONE schedulable node with a
         permanently infeasible window prefix, every commit is preceded

@@ -1,12 +1,20 @@
-"""Scheduling metrics counters + the /api/v1/metrics route."""
+"""Scheduling metrics counters + the /api/v1/metrics route (JSON and
+Prometheus exposition), and the latency histograms the observability
+PR added to both."""
 
 import json
 import urllib.request
 
+import pytest
+
 from kube_scheduler_simulator_tpu.utils.metrics import (
     GLOBAL,
+    METRICS_SCHEMA_VERSION,
+    Histogram,
     PassRecord,
     SchedulingMetrics,
+    parse_prometheus_text,
+    render_prometheus,
 )
 
 from helpers import node, pod
@@ -95,5 +103,149 @@ def test_schedule_pass_records_and_route_serves(tmp_path):
         assert snap["passes"] >= 1
         assert snap["totalScheduled"] >= 1
         assert snap["recent"][-1]["mode"] == "sequential"
+        # same route, ?format=prometheus: exposition text that survives
+        # a REAL text-format parse (not a substring check)
+        with urllib.request.urlopen(f"{base}/metrics?format=prometheus") as resp:
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            families = parse_prometheus_text(resp.read().decode())
+        assert families["kss_passes_total"]["samples"][0][2] >= 1
+        assert families["kss_pass_latency_seconds"]["type"] == "histogram"
     finally:
         server.shutdown()
+
+
+class TestHistogram:
+    def test_cumulative_buckets_and_inf(self):
+        h = Histogram(bounds=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(v)
+        snap = h.snapshot()
+        assert snap["buckets"] == {"0.1": 1, "1.0": 3, "10.0": 4, "+Inf": 5}
+        assert snap["count"] == 5
+        assert snap["sum"] == pytest.approx(56.05)
+        # a value exactly on a bound lands IN that bound's bucket (le=)
+        h.observe(1.0)
+        assert h.snapshot()["buckets"]["1.0"] == 4
+
+    def test_bounds_must_increase(self):
+        with pytest.raises(ValueError):
+            Histogram(bounds=())
+        with pytest.raises(ValueError):
+            Histogram(bounds=(1.0, 1.0))
+        with pytest.raises(ValueError):
+            Histogram(bounds=(2.0, 1.0))
+
+    def test_state_round_trip(self):
+        h = Histogram(bounds=(0.5, 2.0))
+        for v in (0.1, 1.0, 9.0):
+            h.observe(v)
+        restored = Histogram(bounds=(0.5, 2.0))
+        restored.load_state(json.loads(json.dumps(h.state_dict())))
+        assert restored.snapshot() == h.snapshot()
+
+    def test_mismatched_bounds_ignored_not_loaded_wrong(self):
+        h = Histogram(bounds=(0.5, 2.0))
+        h.observe(1.0)
+        other = Histogram(bounds=(0.25, 4.0))
+        other.load_state(h.state_dict())
+        assert other.count == 0  # stayed fresh rather than re-bucketed
+
+
+class TestSnapshotSchema:
+    def test_schema_version_and_uptime(self):
+        m = SchedulingMetrics()
+        snap = m.snapshot()
+        assert snap["schemaVersion"] == METRICS_SCHEMA_VERSION
+        assert snap["uptimeSeconds"] >= 0.0
+        assert set(snap["histograms"]) == {
+            "passLatencySeconds",
+            "compileStallSeconds",
+            "timeToRescheduleSeconds",
+        }
+
+    def test_recorders_feed_the_histograms(self):
+        m = SchedulingMetrics()
+        m.record(PassRecord("sequential", pods=4, scheduled=4, wall_s=0.02))
+        m.record_compile(misses=1, stall_s=0.3)
+        m.record_disruption(
+            evicted=2, rescheduled=2, times_to_reschedule_s=[1.5, 40.0]
+        )
+        hists = m.snapshot()["histograms"]
+        assert hists["passLatencySeconds"]["count"] == 1
+        assert hists["compileStallSeconds"]["count"] == 1
+        assert hists["timeToRescheduleSeconds"]["count"] == 2
+        assert hists["timeToRescheduleSeconds"]["buckets"]["2.5"] == 1
+        m.reset()
+        assert m.snapshot()["histograms"]["passLatencySeconds"]["count"] == 0
+
+    def test_state_dict_round_trips_histograms(self):
+        m = SchedulingMetrics()
+        m.record(PassRecord("gang", pods=8, scheduled=8, wall_s=0.004))
+        m.record_disruption(times_to_reschedule_s=[7.0])
+        fresh = SchedulingMetrics()
+        fresh.load_state(json.loads(json.dumps(m.state_dict())))
+        a, b = m.snapshot(), fresh.snapshot()
+        assert a["histograms"] == b["histograms"]
+        assert a["passes"] == b["passes"]
+        # pre-telemetry checkpoint (no _histograms key): loads clean
+        state = m.state_dict()
+        state.pop("_histograms")
+        legacy = SchedulingMetrics()
+        legacy.load_state(state)
+        assert legacy.snapshot()["passes"] == 1
+        assert legacy.snapshot()["histograms"]["passLatencySeconds"]["count"] == 0
+
+
+class TestPrometheusExposition:
+    def test_render_survives_a_real_parse(self):
+        m = SchedulingMetrics()
+        m.record(PassRecord("sequential", pods=10, scheduled=9, wall_s=0.5))
+        m.record_compile(hits=3, misses=1, stall_s=0.2)
+        text = render_prometheus(
+            m.snapshot(),
+            extra_gauges={
+                "kss_encoding_cache_capacity": ("Encoding cache slots.", 8)
+            },
+        )
+        families = parse_prometheus_text(text)
+        assert families["kss_passes_total"]["samples"] == [
+            ("kss_passes_total", {}, 1.0)
+        ]
+        assert families["kss_encoding_cache_capacity"]["type"] == "gauge"
+        modes = {
+            labels["mode"]: v
+            for _, labels, v in families["kss_encodes_total"]["samples"]
+        }
+        assert set(modes) == {"delta", "full", "cached", "empty"}
+        hist = families["kss_pass_latency_seconds"]
+        assert hist["type"] == "histogram"
+        inf = [
+            v
+            for name, labels, v in hist["samples"]
+            if labels.get("le") == "+Inf"
+        ]
+        assert inf == [1.0]
+
+    def test_parser_rejects_malformed(self):
+        with pytest.raises(ValueError, match="no preceding TYPE"):
+            parse_prometheus_text("kss_mystery_total 3\n")
+        with pytest.raises(ValueError, match="duplicate TYPE"):
+            parse_prometheus_text(
+                "# TYPE kss_x counter\n# TYPE kss_x counter\nkss_x 1\n"
+            )
+        with pytest.raises(ValueError, match="unparseable"):
+            parse_prometheus_text("# TYPE kss_x counter\nkss_x one\n")
+        with pytest.raises(ValueError, match="non-monotonic"):
+            parse_prometheus_text(
+                "# TYPE kss_h histogram\n"
+                'kss_h_bucket{le="1.0"} 5\n'
+                'kss_h_bucket{le="+Inf"} 3\n'
+                "kss_h_sum 1\nkss_h_count 3\n"
+            )
+        with pytest.raises(ValueError, match="!= _count"):
+            parse_prometheus_text(
+                "# TYPE kss_h histogram\n"
+                'kss_h_bucket{le="1.0"} 2\n'
+                'kss_h_bucket{le="+Inf"} 3\n'
+                "kss_h_sum 1\nkss_h_count 4\n"
+            )

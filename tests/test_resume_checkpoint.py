@@ -161,6 +161,46 @@ class TestKillAndResumeParity:
         assert r2["metrics"]["disruption"] == rf["metrics"]["disruption"]
         assert r2["pods"] == rf["pods"]
 
+    def test_histogram_state_survives_the_checkpoint(self, tmp_path):
+        """Telemetry continuity (the observability PR's satellite): the
+        checkpoint carries `SchedulingMetrics` histogram state, so a
+        resumed run's latency distributions cover the WHOLE run. Bucket
+        placement of wall-clock histograms isn't deterministic, so the
+        parity assertions stick to deterministic quantities: observation
+        counts, and the sim-time time-to-reschedule family exactly."""
+        ckpt = str(tmp_path / "hist.ckpt.json")
+        full = LifecycleEngine(_spec("gang", "sync"))
+        rf = full.run()
+        assert rf["phase"] == "Succeeded"
+
+        eng = LifecycleEngine(
+            _spec("gang", "sync"), checkpoint_path=ckpt, stop_after_events=7
+        )
+        eng.run()
+        doc = load_checkpoint(ckpt)
+        # the checkpoint itself carries the histogram block, and the
+        # prefix's pass latencies are already in it
+        assert set(doc["metrics"]["_histograms"]) == {
+            "passLatencySeconds",
+            "compileStallSeconds",
+            "timeToRescheduleSeconds",
+        }
+        prefix_hist = doc["metrics"]["_histograms"]["passLatencySeconds"]
+        assert 0 < prefix_hist["count"] == doc["metrics"]["_pass_count"]
+
+        resumed = LifecycleEngine.from_checkpoint(doc)
+        r2 = resumed.run()
+        assert r2["phase"] == "Succeeded"
+        h_full, h_res = rf["metrics"]["histograms"], r2["metrics"]["histograms"]
+        # one latency observation per pass, prefix + suffix = whole run
+        assert (
+            h_res["passLatencySeconds"]["count"]
+            == h_full["passLatencySeconds"]["count"]
+            == rf["metrics"]["passes"]
+        )
+        # sim-time distribution is deterministic: exact bucket parity
+        assert h_res["timeToRescheduleSeconds"] == h_full["timeToRescheduleSeconds"]
+
 
 class TestPeriodicCheckpoints:
     def test_event_cadence_and_any_checkpoint_resumes(self, tmp_path):

@@ -1,0 +1,322 @@
+"""The span flight recorder (utils/telemetry.py): ring bound, on/off
+switch, pass-id causality, nesting well-formedness — including under
+the async pipelined lifecycle engine, whose three concurrent
+machineries are exactly what the recorder exists to make visible.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import pytest
+
+from kube_scheduler_simulator_tpu.lifecycle.engine import LifecycleEngine
+from kube_scheduler_simulator_tpu.scenario.chaos import ChaosSpec
+from kube_scheduler_simulator_tpu.utils import telemetry
+
+from helpers import node, pod
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_recorder():
+    """Every test starts from the deactivated, env-driven default and
+    leaves nothing armed behind (the suite runs with KSS_TRACE scrubbed
+    — tests/conftest.py)."""
+    telemetry.deactivate()
+    yield
+    telemetry.deactivate()
+
+
+class TestRingBuffer:
+    def test_bound_holds_under_concurrent_writers(self):
+        rec = telemetry.SpanRecorder(capacity=256)
+        writers, per_writer = 8, 500
+
+        def hammer(w: int) -> None:
+            for i in range(per_writer):
+                rec.emit({"ph": "i", "name": f"w{w}", "ts": float(i)})
+
+        threads = [
+            threading.Thread(target=hammer, args=(w,)) for w in range(writers)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        total = writers * per_writer
+        assert rec.emitted == total  # no emission lost from the count
+        assert rec.dropped == total - 256
+        assert len(rec) == 256  # the bound HELD
+        window = rec.snapshot()
+        assert len(window) == 256
+        assert all(ev is not None for ev in window)
+
+    def test_snapshot_oldest_first_after_wrap(self):
+        rec = telemetry.SpanRecorder(capacity=4)
+        for i in range(10):
+            rec.emit({"seq": i})
+        assert [ev["seq"] for ev in rec.snapshot()] == [6, 7, 8, 9]
+        assert rec.dropped == 6
+
+    def test_capacity_validation_and_env_fallback(self, monkeypatch):
+        with pytest.raises(ValueError):
+            telemetry.SpanRecorder(capacity=0)
+        monkeypatch.setenv("KSS_TRACE_RING_CAP", "32")
+        assert telemetry.ring_capacity_from_env() == 32
+        for bad in ("nope", "0", "-5", ""):
+            monkeypatch.setenv("KSS_TRACE_RING_CAP", bad)
+            assert (
+                telemetry.ring_capacity_from_env()
+                == telemetry.DEFAULT_RING_CAP
+            )
+
+    def test_dead_subscriber_never_breaks_emission(self):
+        rec = telemetry.SpanRecorder(capacity=8)
+        got = []
+
+        def bad(ev):
+            raise RuntimeError("subscriber died")
+
+        rec.subscribe(bad)
+        rec.subscribe(got.append)
+        rec.emit({"name": "survives"})
+        assert [ev["name"] for ev in got] == ["survives"]
+        rec.unsubscribe(bad)
+        rec.unsubscribe(got.append)
+        rec.emit({"name": "after"})
+        assert len(got) == 1  # unsubscribed: no longer fed
+
+
+class TestOnOffSwitch:
+    def test_off_by_default_emits_nothing(self, monkeypatch):
+        monkeypatch.delenv(telemetry.ENV_VAR, raising=False)
+        assert telemetry.active() is None
+        assert not telemetry.enabled()
+        # the whole emission surface is a no-op with nothing recorded
+        with telemetry.span("never", pass_id=3):
+            telemetry.instant("never")
+        telemetry.complete("never", 0.0, 1.0)
+
+    def test_kss_trace_zero_emits_nothing(self, monkeypatch):
+        monkeypatch.setenv(telemetry.ENV_VAR, "0")
+        assert telemetry.active() is None
+        with telemetry.span("never"):
+            pass
+        s = telemetry.span("never2")
+        assert s is telemetry.span("never3")  # the SHARED no-op span
+
+    def test_env_arms_a_recorder_with_env_capacity(self, monkeypatch):
+        monkeypatch.setenv(telemetry.ENV_VAR, "1")
+        monkeypatch.setenv(telemetry.CAP_VAR, "64")
+        rec = telemetry.active()
+        assert rec is not None and rec.capacity == 64
+        with telemetry.span("seen"):
+            pass
+        assert [ev["ph"] for ev in rec.snapshot()] == ["B", "E"]
+
+    def test_activate_overrides_env(self, monkeypatch):
+        monkeypatch.setenv(telemetry.ENV_VAR, "0")
+        mine = telemetry.SpanRecorder(capacity=16)
+        telemetry.activate(mine)
+        assert telemetry.active() is mine
+        telemetry.instant("mark")
+        assert len(mine) == 1
+        telemetry.deactivate()
+        assert telemetry.active() is None
+
+
+class TestPassCausality:
+    def test_spans_carry_the_current_pass_id(self):
+        rec = telemetry.SpanRecorder(capacity=32)
+        telemetry.activate(rec)
+        with telemetry.pass_context(7):
+            assert telemetry.current_pass_id() == 7
+            with telemetry.span("inner"):
+                telemetry.instant("mark")
+            with telemetry.pass_context(8):
+                telemetry.instant("nested")
+            assert telemetry.current_pass_id() == 7
+        assert telemetry.current_pass_id() is None
+        passes = [ev["args"].get("pass") for ev in rec.snapshot()]
+        assert passes == [7, 7, 7, 8]  # B, i(mark), E, i(nested)
+
+    def test_context_reenters_on_worker_threads(self):
+        """The broker's speculation contract: the arming pass's id
+        travels to the worker thread and stamps its spans there."""
+        rec = telemetry.SpanRecorder(capacity=32)
+        telemetry.activate(rec)
+        armed_by = 41
+        done = threading.Event()
+
+        def worker():
+            with telemetry.pass_context(armed_by):
+                telemetry.instant("speculative-ish")
+            done.set()
+
+        threading.Thread(target=worker).start()
+        assert done.wait(timeout=10)
+        (ev,) = rec.snapshot()
+        assert ev["args"]["pass"] == armed_by
+        assert ev["tid"] != threading.get_ident()
+
+
+class TestWellFormedness:
+    def test_intervals_and_balanced_nesting(self):
+        rec = telemetry.SpanRecorder(capacity=64)
+        telemetry.activate(rec)
+        with telemetry.span("outer"):
+            with telemetry.span("inner"):
+                pass
+        telemetry.complete("window", 1.0, 2.0, tid=telemetry.DEVICE_TID)
+        events = rec.snapshot()
+        telemetry.check_nesting(events)  # must not raise
+        ivs = {iv["name"]: iv for iv in telemetry.span_intervals(events)}
+        assert set(ivs) == {"outer", "inner", "window"}
+        assert ivs["window"]["tid"] == telemetry.DEVICE_TID
+        assert ivs["window"]["end_us"] - ivs["window"]["start_us"] == 1e6
+        assert ivs["inner"]["start_us"] >= ivs["outer"]["start_us"]
+        assert ivs["inner"]["end_us"] <= ivs["outer"]["end_us"]
+
+    def test_check_nesting_rejects_malformed(self):
+        tid = 9
+        with pytest.raises(ValueError, match="unmatched E"):
+            telemetry.check_nesting([{"ph": "E", "name": "x", "tid": tid}])
+        with pytest.raises(ValueError, match="interleaved"):
+            telemetry.check_nesting(
+                [
+                    {"ph": "B", "name": "a", "tid": tid},
+                    {"ph": "B", "name": "b", "tid": tid},
+                    {"ph": "E", "name": "a", "tid": tid},
+                ]
+            )
+        with pytest.raises(ValueError, match="unclosed"):
+            telemetry.check_nesting([{"ph": "B", "name": "a", "tid": tid}])
+
+    def test_ring_wrapped_window_tolerates_orphan_ends(self):
+        """A flight recording longer than the ring starts mid-span: the
+        window's leading E events lost their B partners to eviction.
+        With the drop count passed, those orphans are tolerated (they
+        always land on an empty stack — LIFO closing), while real
+        malformations still raise."""
+        rec = telemetry.SpanRecorder(capacity=4)
+        telemetry.activate(rec)
+        with telemetry.span("outer"):
+            with telemetry.span("mid"):
+                with telemetry.span("inner"):
+                    pass
+        # capacity 4 kept: E(inner) E(mid) E(outer) preceded by B(inner)
+        events = rec.snapshot()
+        assert rec.dropped > 0
+        with pytest.raises(ValueError, match="unmatched E"):
+            telemetry.check_nesting(events)
+        telemetry.check_nesting(events, dropped=rec.dropped)  # tolerated
+        # interleaving is still a hard error even with drops claimed
+        tid = 9
+        with pytest.raises(ValueError, match="interleaved"):
+            telemetry.check_nesting(
+                [
+                    {"ph": "B", "name": "a", "tid": tid},
+                    {"ph": "B", "name": "b", "tid": tid},
+                    {"ph": "E", "name": "a", "tid": tid},
+                ],
+                dropped=3,
+            )
+
+    def test_chrome_trace_export_loadable(self, tmp_path):
+        rec = telemetry.SpanRecorder(capacity=32)
+        telemetry.activate(rec)
+        with telemetry.span("pass.gang", pass_id=1):
+            pass
+        telemetry.complete(
+            "device.execute", 0.5, 1.5, tid=telemetry.DEVICE_TID, pass_id=1
+        )
+        out = tmp_path / "trace.json"
+        n = telemetry.dump_chrome_trace(str(out), rec)
+        assert n == 3
+        doc = json.loads(out.read_text())
+        events = doc["traceEvents"]
+        # process metadata + a thread_name per track, device included
+        assert any(
+            ev["ph"] == "M" and ev["name"] == "process_name" for ev in events
+        )
+        device_meta = [
+            ev
+            for ev in events
+            if ev["ph"] == "M"
+            and ev["name"] == "thread_name"
+            and ev["tid"] == telemetry.DEVICE_TID
+        ]
+        assert device_meta and "device" in device_meta[0]["args"]["name"]
+        assert doc["otherData"]["droppedEvents"] == 0
+
+
+def _chaos_dict() -> dict:
+    nodes = [node(f"t{i}", cpu="16", mem="32Gi", pods="110") for i in range(4)]
+    return {
+        "name": "telemetry-async",
+        "seed": 5,
+        "horizon": 30.0,
+        "schedulerMode": "gang",
+        "pipeline": "async",
+        "snapshot": {"nodes": nodes},
+        "arrivals": [
+            {
+                "kind": "poisson",
+                "rate": 0.8,
+                "count": 10,
+                "template": {
+                    "metadata": {"name": "churn"},
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "c",
+                                "resources": {
+                                    "requests": {
+                                        "cpu": "100m",
+                                        "memory": "64Mi",
+                                    }
+                                },
+                            }
+                        ]
+                    },
+                },
+            }
+        ],
+        "faults": [
+            {"at": 9.0, "action": "cordon", "node": "t0"},
+            {"at": 18.0, "action": "uncordon", "node": "t0"},
+        ],
+    }
+
+
+class TestUnderAsyncPipeline:
+    def test_nesting_balanced_and_passes_stamped(self):
+        """The satellite contract: B/E spans stay balanced per thread
+        across the async pipeline's dispatch/resolve split, and every
+        pass span carries its causal id."""
+        rec = telemetry.SpanRecorder(capacity=65536)
+        telemetry.activate(rec)
+        try:
+            eng = LifecycleEngine(ChaosSpec.from_dict(_chaos_dict()))
+            res = eng.run()
+        finally:
+            telemetry.deactivate()
+        assert res["phase"] == "Succeeded"
+        events = rec.snapshot()
+        assert events, "the traced run recorded nothing"
+        telemetry.check_nesting(events)  # balanced B/E per thread
+        dispatches = [
+            ev
+            for ev in events
+            if ev["ph"] == "B" and ev["name"] == "pass.gang.dispatch"
+        ]
+        assert dispatches
+        ids = [ev["args"]["pass"] for ev in dispatches]
+        assert ids == sorted(ids) and len(set(ids)) == len(ids)
+        # fault marks landed with sim-time correlation
+        faults = [ev for ev in events if ev["name"] == "lifecycle.fault"]
+        assert {ev["args"]["action"] for ev in faults} == {
+            "cordon",
+            "uncordon",
+        }

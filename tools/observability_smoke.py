@@ -1,0 +1,250 @@
+"""`make observability-smoke`: the unified telemetry plane end-to-end
+on CPU (docs/observability.md). Three gates, one JSON line:
+
+1. **Flight recorder → Perfetto** — a short async-pipelined chaos
+   timeline runs with tracing ON; the exported Chrome trace-event JSON
+   must load back as well-formed JSON, every thread's B/E spans must be
+   balanced (`telemetry.check_nesting`), and the async pipeline's
+   overlap must be PRESENT in the data: a `device.execute` X span of
+   pass k overlapping a host-side `lifecycle.events` span of pass k+1.
+
+2. **Prometheus** — `GET /api/v1/metrics?format=prometheus` against a
+   live server is scraped through the REAL text-format parser
+   (`metrics.parse_prometheus_text`), which enforces TYPE lines,
+   sample grammar, and histogram bucket semantics.
+
+3. **SSE** — `GET /api/v1/events` yields at least one event.
+
+Exit 0 on pass. Small enough for CI (seconds, CPU-only).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+
+def _chaos_spec_dict() -> dict:
+    nodes = [
+        {
+            "metadata": {"name": f"o{i}"},
+            "status": {
+                "allocatable": {"cpu": "16", "memory": "32Gi", "pods": "110"}
+            },
+        }
+        for i in range(4)
+    ]
+    return {
+        "name": "observability-smoke",
+        "seed": 3,
+        "horizon": 20.0,
+        "schedulerMode": "gang",
+        "pipeline": "async",
+        "snapshot": {"nodes": nodes},
+        "arrivals": [
+            {
+                "kind": "poisson",
+                "rate": 1.0,
+                "count": 12,
+                "template": {
+                    "metadata": {"name": "churn"},
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "c",
+                                "resources": {
+                                    "requests": {
+                                        "cpu": "100m",
+                                        "memory": "64Mi",
+                                    }
+                                },
+                            }
+                        ]
+                    },
+                },
+            }
+        ],
+        "faults": [
+            {"at": 6.0, "action": "cordon", "node": "o0"},
+            {"at": 12.0, "action": "uncordon", "node": "o0"},
+        ],
+    }
+
+
+def _async_overlap(intervals: list[dict]) -> "float | None":
+    """Largest overlap (seconds) between a device-execute window of pass
+    k and a host lifecycle.events span of pass k+1; None when no pair
+    overlaps — the async pipeline's signature, asserted not eyeballed."""
+    from kube_scheduler_simulator_tpu.utils import telemetry
+
+    best = None
+    device = [
+        iv
+        for iv in intervals
+        if iv["name"] == "device.execute" and iv["tid"] == telemetry.DEVICE_TID
+    ]
+    hosts = [iv for iv in intervals if iv["name"] == "lifecycle.events"]
+    for d in device:
+        k = d["args"].get("pass")
+        if k is None:
+            continue
+        for h in hosts:
+            if h["args"].get("pass") != k + 1:
+                continue
+            overlap = min(d["end_us"], h["end_us"]) - max(
+                d["start_us"], h["start_us"]
+            )
+            if overlap > 0 and (best is None or overlap > best):
+                best = overlap
+    return None if best is None else best / 1e6
+
+
+def _trace_gate() -> "tuple[dict, list[str]]":
+    from kube_scheduler_simulator_tpu.lifecycle.engine import LifecycleEngine
+    from kube_scheduler_simulator_tpu.scenario.chaos import ChaosSpec
+    from kube_scheduler_simulator_tpu.utils import telemetry
+
+    problems: list[str] = []
+    recorder = telemetry.SpanRecorder(capacity=65536)
+    telemetry.activate(recorder)
+    try:
+        eng = LifecycleEngine(ChaosSpec.from_dict(_chaos_spec_dict()))
+        result = eng.run()
+        if result["phase"] != "Succeeded":
+            problems.append(f"chaos run phase {result['phase']!r}")
+        out = os.path.join(tempfile.mkdtemp(prefix="kss-obs-"), "trace.json")
+        n = telemetry.dump_chrome_trace(out, recorder)
+    finally:
+        telemetry.deactivate()
+    with open(out) as f:
+        doc = json.load(f)  # raises on malformed JSON: the gate
+    events = [e for e in doc["traceEvents"] if e.get("ph") != "M"]
+    if len(events) != n:
+        problems.append(f"export wrote {n} events, file carries {len(events)}")
+    if not events:
+        problems.append("flight recorder captured nothing")
+    try:
+        telemetry.check_nesting(
+            events, dropped=doc["otherData"].get("droppedEvents", 0)
+        )
+    except ValueError as e:
+        problems.append(f"span nesting ill-formed: {e}")
+    overlap_s = _async_overlap(telemetry.span_intervals(events))
+    if overlap_s is None:
+        problems.append(
+            "no device-execute span of pass k overlaps a host "
+            "lifecycle.events span of pass k+1"
+        )
+    fields = {
+        "trace_file": out,
+        "trace_events": len(events),
+        "async_overlap_s": round(overlap_s, 6) if overlap_s else 0.0,
+    }
+    return fields, problems
+
+
+def _server_gates() -> "tuple[dict, list[str]]":
+    import urllib.request
+
+    from kube_scheduler_simulator_tpu.server.httpserver import SimulatorServer
+    from kube_scheduler_simulator_tpu.utils.metrics import (
+        parse_prometheus_text,
+    )
+
+    problems: list[str] = []
+    server = SimulatorServer(port=0).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        # one real pass so counters and the latency histogram are live
+        server.service.store.apply(
+            "nodes",
+            {
+                "metadata": {"name": "s0"},
+                "status": {
+                    "allocatable": {"cpu": "4", "memory": "8Gi", "pods": "10"}
+                },
+            },
+        )
+        server.service.store.apply(
+            "pods",
+            {
+                "metadata": {"name": "sp0"},
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "c",
+                            "resources": {"requests": {"cpu": "100m"}},
+                        }
+                    ]
+                },
+            },
+        )
+        server.service.scheduler.schedule()
+        with urllib.request.urlopen(
+            f"{base}/api/v1/metrics?format=prometheus", timeout=30
+        ) as r:
+            ctype = r.headers.get("Content-Type", "")
+            text = r.read().decode()
+        families = parse_prometheus_text(text)  # raises on malformed text
+        if "text/plain" not in ctype:
+            problems.append(f"prometheus content-type {ctype!r}")
+        for needed in (
+            "kss_passes_total",
+            "kss_pass_latency_seconds",
+            "kss_uptime_seconds",
+        ):
+            if needed not in families:
+                problems.append(f"metric family {needed} missing")
+        if families.get("kss_passes_total", {}).get("samples", [(0, 0, 0)])[
+            0
+        ][2] < 1:
+            problems.append("kss_passes_total did not count the pass")
+        # SSE: the stream must yield >= 1 event promptly
+        req = urllib.request.Request(f"{base}/api/v1/events")
+        sse_event = None
+        with urllib.request.urlopen(req, timeout=30) as r:
+            for _ in range(32):
+                line = r.readline().decode()
+                if line.startswith("event:"):
+                    sse_event = line.split(":", 1)[1].strip()
+                    break
+        if sse_event is None:
+            problems.append("SSE stream yielded no event")
+        fields = {
+            "prometheus_families": len(families),
+            "sse_first_event": sse_event or "",
+        }
+        return fields, problems
+    finally:
+        server.shutdown()
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # runnable from a bare checkout: the package lives at the repo root
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    from kube_scheduler_simulator_tpu.utils.compilecache import (
+        enable_compile_cache,
+    )
+
+    enable_compile_cache()
+    trace_fields, problems = _trace_gate()
+    server_fields, server_problems = _server_gates()
+    problems += server_problems
+    line = {"config": "observability_smoke", **trace_fields, **server_fields}
+    print(json.dumps(line), flush=True)
+    if problems:
+        print(
+            "observability-smoke FAILED: " + "; ".join(problems),
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
