@@ -5,7 +5,7 @@
 PORT ?= 1212
 PY ?= python
 
-.PHONY: test test-fast lint start bench dryrun batch lifecycle-smoke perf-smoke resilience-smoke observability-smoke docker docker-up clean
+.PHONY: test test-fast lint start bench dryrun batch lifecycle-smoke perf-smoke resilience-smoke observability-smoke session-smoke docker docker-up clean
 
 # full suite on the 8-device virtual CPU mesh (tests/conftest.py pins it)
 test:
@@ -60,6 +60,14 @@ resilience-smoke:
 # event; one JSON line
 observability-smoke:
 	env JAX_PLATFORMS=cpu $(PY) tools/observability_smoke.py
+
+# session-plane smoke (docs/sessions.md): 3 bucket-compatible sessions
+# share ONE compiled engine (broker compileMisses stays at the cold
+# start's 1), evict/restore round-trips with zero loss, and admission
+# control past the session/pod quotas sheds structured 503 +
+# Retry-After; one JSON line
+session-smoke:
+	env JAX_PLATFORMS=cpu $(PY) tools/session_smoke.py
 
 # containerized dev flow (reference `make docker_build_and_up`, one service)
 docker:

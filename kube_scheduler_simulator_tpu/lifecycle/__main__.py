@@ -46,6 +46,13 @@ def _load_spec(path: str) -> dict:
 def main(argv: "list[str] | None" = None) -> int:
     import argparse
 
+    # strict KSS_* validation first: a malformed KSS_FAULT_INJECT (or
+    # any typo'd knob) fails the run HERE with a clear message instead
+    # of mid-timeline at the first fire point (utils/envcheck.py)
+    from ..utils import envcheck
+
+    envcheck.fail_fast()
+
     ap = argparse.ArgumentParser(
         prog="kube_scheduler_simulator_tpu.lifecycle",
         description="Cluster-lifecycle chaos runner (discrete-event churn, "

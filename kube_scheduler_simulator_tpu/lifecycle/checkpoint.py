@@ -38,6 +38,17 @@ import os
 
 CHECKPOINT_FORMAT = "kss-lifecycle-checkpoint/v1"
 
+# The session plane's snapshot format (server/sessions.py): the same
+# atomic-write/verbatim-store machinery persisting an idle session's
+# state so eviction is load shedding, never data loss (docs/sessions.md).
+SESSION_CHECKPOINT_FORMAT = "kss-session-checkpoint/v1"
+
+# required top-level keys per format
+_REQUIRED_KEYS = {
+    CHECKPOINT_FORMAT: ("spec", "cursor", "store", "trace", "engine"),
+    SESSION_CHECKPOINT_FORMAT: ("store", "metrics"),
+}
+
 
 def checkpoint_doc(engine) -> dict:
     """Build the checkpoint document for `engine` (a `LifecycleEngine`
@@ -97,17 +108,19 @@ def write_checkpoint(doc: dict, path: str) -> str:
     return path
 
 
-def load_checkpoint(path: str) -> dict:
-    """Load + validate a checkpoint document."""
+def load_checkpoint(path: str, expected_format: str = CHECKPOINT_FORMAT) -> dict:
+    """Load + validate a checkpoint document of `expected_format` (a
+    lifecycle-run checkpoint by default; the session plane passes
+    `SESSION_CHECKPOINT_FORMAT`)."""
     with open(path) as f:
         doc = json.load(f)
-    if not isinstance(doc, dict) or doc.get("format") != CHECKPOINT_FORMAT:
+    if not isinstance(doc, dict) or doc.get("format") != expected_format:
         raise ValueError(
-            f"{path}: not a lifecycle checkpoint "
+            f"{path}: not a checkpoint of the expected kind "
             f"(format {doc.get('format') if isinstance(doc, dict) else None!r}, "
-            f"expected {CHECKPOINT_FORMAT!r})"
+            f"expected {expected_format!r})"
         )
-    for key in ("spec", "cursor", "store", "trace", "engine"):
+    for key in _REQUIRED_KEYS.get(expected_format, ()):
         if key not in doc:
             raise ValueError(f"{path}: checkpoint missing {key!r}")
     return doc

@@ -19,6 +19,13 @@ from .service import SimulatorService
 
 
 def main(argv: "list[str] | None" = None) -> int:
+    # strict KSS_* validation BEFORE anything heavy: a typo'd knob is a
+    # clear boot error, not a silently-defaulted value or a 500 deep
+    # inside the first request handler (utils/envcheck.py)
+    from ..utils import envcheck
+
+    envcheck.fail_fast()
+
     parser = argparse.ArgumentParser(prog="kube-scheduler-simulator-tpu")
     parser.add_argument("--port", type=int, default=None)
     parser.add_argument("--host", default="127.0.0.1")

@@ -31,6 +31,28 @@ typed store — SURVEY.md §2 #3):
     GET                /api/v1/lifecycle/trace   last run's JSONL event trace
     GET                /  (or /ui)           built-in dashboard (webui.py)
 
+The multi-tenant session plane (docs/sessions.md — server/sessions.py):
+
+    GET/POST           /api/v1/sessions      list / create sessions
+    GET/DELETE         /api/v1/sessions/<id> session info / destroy
+    POST               /api/v1/sessions/<id>/fork    branch a session
+    POST               /api/v1/sessions/<id>/evict   snapshot to disk now
+    *                  /api/v1/sessions/<id>/<any route above>
+                                             every route in this file,
+                                             scoped to that session's
+                                             store/scheduler/metrics
+
+    GET                /api/v1/healthz       liveness (always 200)
+    GET                /api/v1/readyz        readiness: 503 while the
+                                             shared compile broker is
+                                             cooldown-saturated or its
+                                             worker crashed
+
+Legacy (un-prefixed) routes operate on the implicit `default` session.
+Admission control (session limit, per-session pending-pod quota, the
+bounded concurrent-pass semaphore) sheds with the same structured 503 +
+Retry-After as compile degradation.
+
 The watch stream mirrors the reference's wire shape — a sequence of JSON
 objects `{"Kind": ..., "EventType": ..., "Obj": {...}}` flushed per event
 (simulator/resourcewatcher/streamwriter/streamwriter.go:18-51), with the
@@ -61,11 +83,26 @@ from .service import (
     SchedulerServiceDisabled,
     SimulatorService,
 )
+from .sessions import (
+    DEFAULT_SESSION_ID,
+    ServerSaturated,
+    SessionBusy,
+    SessionLimitExceeded,
+    SessionManager,
+    SessionQuotaExceeded,
+    UnknownSession,
+)
 
 # Retry-After hint (seconds) on 503 degradation responses: long enough
 # for a compile cooldown window to elapse, short enough that a client
 # retry lands while the engine is probably healthy again.
 DEGRADED_RETRY_AFTER_S = 2
+
+# Bound of each SSE subscriber's event queue: past it the consumer is
+# provably slower than the span source, and the subscriber is
+# DISCONNECTED (drops counted in sseDroppedEvents) rather than served a
+# silently gap-ridden stream (docs/sessions.md).
+SSE_QUEUE_MAX = 4096
 
 # kind → (watch wire name, lastResourceVersion query param); reference
 # resourcewatcher.go:22-30 + handler/watcher.go:27-34 (note the singular
@@ -92,11 +129,23 @@ class SimulatorServer:
         auto_schedule: bool = False,
         extender_service=None,
         cors_allowed_origins: "list[str] | None" = None,
+        session_config: "dict | None" = None,
     ):
         self.service = service or SimulatorService()
         self.auto_schedule = auto_schedule
         self.extender_service = extender_service
         self.cors_allowed_origins = cors_allowed_origins or []
+        # the multi-tenant session plane (server/sessions.py): adopts
+        # self.service as the implicit `default` session and owns the
+        # SHARED CompileBroker + admission knobs. `session_config`
+        # overrides the KSS_* environment (tests, embedded drivers).
+        self.sessions = SessionManager(self.service, **(session_config or {}))
+        # SSE subscriber accounting (the satellite hardening): live
+        # subscriber count against the manager's cap, and the events
+        # dropped on slow consumers (surfaced as sseDroppedEvents)
+        self._sse_lock = threading.Lock()
+        self._sse_subs = 0
+        self._sse_dropped = 0
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.httpd.daemon_threads = True
@@ -123,24 +172,63 @@ class SimulatorServer:
         return self
 
     def shutdown(self):
+        self.sessions.shutdown()
         self.httpd.shutdown()
         self.httpd.server_close()
         if self._thread:
             self._thread.join(timeout=5)
 
-    def maybe_schedule(self):
-        """Post-mutation convergence: the controller subset always runs
-        to fixpoint (the reference's continuously-running controllers —
-        POST a Deployment, GET its Pods), then a scheduling pass follows
-        when --auto-schedule is on."""
-        self.service.run_controllers()
-        if self.auto_schedule and not self.service.scheduler.disabled:
-            self.service.scheduler.schedule()
+    def maybe_schedule(self, service: "SimulatorService | None" = None):
+        """Post-mutation convergence for the mutated session: the
+        controller subset always runs to fixpoint (the reference's
+        continuously-running controllers — POST a Deployment, GET its
+        Pods), then a scheduling pass follows when --auto-schedule is
+        on."""
+        svc = service if service is not None else self.service
+        svc.run_controllers()
+        if self.auto_schedule and not svc.scheduler.disabled:
+            # auto-passes obey the same bounded-concurrency semaphore as
+            # explicit /schedule; at saturation the pass is SKIPPED (the
+            # pod stays pending — the next mutation or an explicit
+            # schedule converges it) rather than 503-failing the CRUD
+            # that triggered it, and rather than queueing unboundedly
+            # behind the device
+            if svc.scheduler._schedule_lock.locked():
+                # a pass is already converging this session: skip, don't
+                # queue on its lock while holding a global slot
+                return
+            try:
+                with self.sessions.pass_slot():
+                    svc.scheduler.schedule()
+            except ServerSaturated:
+                pass
+
+    # -- SSE subscriber accounting ------------------------------------------
+
+    def sse_acquire(self) -> bool:
+        """Claim one SSE subscriber slot against the cap
+        (KSS_SSE_MAX_SUBSCRIBERS); False = saturated, the route sheds."""
+        with self._sse_lock:
+            if self._sse_subs >= self.sessions.sse_max_subscribers:
+                return False
+            self._sse_subs += 1
+            return True
+
+    def sse_release(self) -> None:
+        with self._sse_lock:
+            self._sse_subs -= 1
+
+    def sse_count_drop(self, n: int = 1) -> None:
+        with self._sse_lock:
+            self._sse_dropped += n
+
+    @property
+    def sse_dropped(self) -> int:
+        with self._sse_lock:
+            return self._sse_dropped
 
 
 def _make_handler(server: SimulatorServer):
-    service = server.service
-
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
@@ -263,219 +351,38 @@ def _make_handler(server: SimulatorServer):
                 if parts[:2] != ["api", "v1"]:
                     return self._error(404, "not found")
                 rest = parts[2:]
-                if rest == ["schedulerconfiguration"]:
-                    return self._scheduler_config(method)
-                if rest == ["reset"] and method == "PUT":
-                    service.reset()
-                    return self._json(202)
-                if rest == ["export"] and method == "GET":
-                    return self._json(200, service.export())
-                if rest == ["import"] and method == "POST":
-                    errs = service.import_(self._body() or {})
-                    server.maybe_schedule()
-                    return self._json(200, {"errors": errs})
-                if rest == ["listwatchresources"] and method == "GET":
-                    return self._list_watch(parse_qs(url.query))
-                if rest == ["metrics"] and method == "GET":
-                    doc = service.scheduler.metrics.snapshot()
-                    # serving-stack configuration alongside the counters:
-                    # the encoding-cache bound (KSS_ENCODING_CACHE_CAP)
-                    doc["encodingCacheCapacity"] = (
-                        service.scheduler.encoding_cache_capacity
-                    )
-                    fmt = parse_qs(url.query).get("format", ["json"])[0]
-                    if fmt == "prometheus":
-                        body = metrics_mod.render_prometheus(
-                            doc,
-                            extra_gauges={
-                                "kss_encoding_cache_capacity": (
-                                    "Capacity of the per-service encoding "
-                                    "cache (KSS_ENCODING_CACHE_CAP).",
-                                    doc["encodingCacheCapacity"],
-                                )
-                            },
-                        ).encode()
-                        self.send_response(200)
-                        self._cors_headers()
-                        self.send_header(
-                            "Content-Type",
-                            "text/plain; version=0.0.4; charset=utf-8",
-                        )
-                        self.send_header("Content-Length", str(len(body)))
-                        self.end_headers()
-                        self.wfile.write(body)
-                        return None
-                    if fmt != "json":
-                        return self._error(
-                            400, f"unknown metrics format {fmt!r}"
-                        )
-                    return self._json(200, doc)
-                if rest == ["debug", "trace"] and method == "GET":
-                    # the flight recorder's retained window as Chrome
-                    # trace-event JSON — loadable as-is in Perfetto
-                    # (docs/observability.md). With tracing off the
-                    # document is empty but still loadable, and
-                    # otherData.tracingEnabled says why.
-                    rec = telemetry.active()
-                    events = rec.snapshot() if rec is not None else []
-                    doc = telemetry.chrome_trace(
-                        events, dropped=rec.dropped if rec is not None else 0
-                    )
-                    doc["otherData"]["tracingEnabled"] = rec is not None
-                    return self._json(200, doc)
-                if rest == ["debug", "profile"] and method == "POST":
-                    return self._debug_profile(self._body() or {})
-                if rest == ["events"] and method == "GET":
-                    return self._events_stream(parse_qs(url.query))
-                if rest == ["schedule"] and method == "POST":
-                    mode = parse_qs(url.query).get("mode", ["sequential"])[0]
-                    if mode not in ("sequential", "gang"):
-                        return self._error(
-                            400, f"unknown scheduling mode {mode!r}"
-                        )
-                    if mode == "gang":
-                        # records default ON (the annotations are the
-                        # product); ?record=0 is the bulk opt-out;
-                        # ?window=W passes eval_window through (the
-                        # at-scale round-cost lever)
-                        q = parse_qs(url.query)
-                        rec_q = q.get("record", ["1"])[0]
-                        record = rec_q not in ("0", "false", "no")
-                        window = None
-                        if "window" in q:
-                            try:
-                                window = int(q["window"][0])
-                            except ValueError:
-                                return self._error(
-                                    400,
-                                    f"window must be an integer, got"
-                                    f" {q['window'][0]!r}",
-                                )
-                        try:
-                            placements, rounds, results = (
-                                service.scheduler.schedule_gang(
-                                    record=record, window=window
-                                )
-                            )
-                        except ValueError as e:
-                            # known-unsupported combination (extenders
-                            # configured) is the client's request, not a
-                            # server fault
-                            return self._error(400, str(e))
-                        body = {
-                            "mode": "gang",
-                            "rounds": rounds,
-                            "scheduled": sum(
-                                1 for v in placements.values() if v
-                            ),
-                            "unschedulable": sum(
-                                1 for v in placements.values() if not v
-                            ),
-                        }
-                        if results is not None:
-                            body["results"] = [
-                                {
-                                    "namespace": r.pod_namespace,
-                                    "name": r.pod_name,
-                                    "status": r.status,
-                                    "selectedNode": r.selected_node,
-                                }
-                                for r in results
-                            ]
-                        return self._json(200, body)
-                    results = service.scheduler.schedule()
-                    return self._json(
-                        200,
-                        {
-                            "scheduled": sum(
-                                1 for r in results if r.status == "Scheduled"
-                            ),
-                            "results": [
-                                {
-                                    "namespace": r.pod_namespace,
-                                    "name": r.pod_name,
-                                    "status": r.status,
-                                    "selectedNode": r.selected_node,
-                                }
-                                for r in results
-                            ],
-                        },
-                    )
-                if rest == ["scenario"] and method == "POST":
-                    # one-shot KEP-140 scenario / KEP-159 sweep run over
-                    # the serving shell: the body is a batch-job spec
-                    # (scenario/batch.py — operations + schedulerConfig,
-                    # or a sweep snapshot + weightVariants). Runs against
-                    # its OWN isolated store (KEP-140's one-scenario-at-
-                    # a-time pre-cleaned cluster, README.md:600-610), not
-                    # the server's; synchronous, returns the result doc.
-                    # Concurrent scenario requests serialize (KEP: one
-                    # scenario at a time; run_job additionally holds the
-                    # process-wide device lock for sweep jobs).
-                    from ..scenario.batch import BatchJob, run_job
-
-                    try:
-                        spec = self._body() or {}
-                        if not isinstance(spec, dict):
-                            return self._error(400, "spec must be a mapping")
-                        job = BatchJob.from_spec(
-                            spec.get("name", "http-scenario"), spec
-                        )
-                    except (ValueError, KeyError, AttributeError, TypeError) as e:
-                        return self._error(400, f"{type(e).__name__}: {e}")
-                    with server._scenario_lock:
-                        return self._json(200, run_job(job))
-                if rest == ["lifecycle"] and method == "POST":
-                    # one-shot cluster-lifecycle chaos run: the body is a
-                    # ChaosSpec (scenario/chaos.py — seeded fault schedule
-                    # + arrival processes + optional snapshot). Runs over
-                    # its OWN isolated store (service.run_lifecycle), the
-                    # serving store is untouched; synchronous, returns the
-                    # result document WITH the replayable trace inline.
-                    # Serialized with scenario runs (one device-driving
-                    # timeline at a time).
-                    from ..scenario.chaos import ChaosSpec
-
-                    try:
-                        spec = ChaosSpec.from_dict(self._body() or {})
-                    except (ValueError, KeyError, TypeError) as e:
-                        return self._error(400, f"{type(e).__name__}: {e}")
-                    try:
-                        with server._scenario_lock:
-                            result = service.run_lifecycle(spec)
-                            # read under the lock: a concurrent run must
-                            # not swap ITS trace into THIS response
-                            result["trace"] = service.last_lifecycle_trace
-                    except ValueError as e:
-                        # a spec that parses but can't build a run (bad
-                        # snapshot, unusable scheduler config) is the
-                        # client's input, not a server fault
-                        return self._error(400, str(e))
-                    return self._json(200, result)
-                if rest == ["lifecycle", "trace"] and method == "GET":
-                    # the last run's replayable event trace as JSONL
-                    # (application/x-ndjson), byte-identical across
-                    # re-runs of the same seeded spec
-                    trace = service.last_lifecycle_trace
-                    if trace is None:
-                        return self._error(404, "no lifecycle run yet")
-                    from ..lifecycle.engine import trace_jsonl
-
-                    body = trace_jsonl(trace).encode()
-                    self.send_response(200)
-                    self._cors_headers()
-                    self.send_header("Content-Type", "application/x-ndjson")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
-                    return None
-                if rest and rest[0] == "extender":
-                    return self._extender(method, rest[1:])
-                if rest and rest[0] == "resources":
-                    return self._resources(method, rest[1:], parse_qs(url.query))
-                return self._error(404, "not found")
+                if rest == ["healthz"] and method == "GET":
+                    return self._json(200, {"ok": True})
+                if rest == ["readyz"] and method == "GET":
+                    return self._readyz()
+                if rest and rest[0] == "sessions":
+                    return self._sessions_route(method, rest[1:], url)
+                # legacy (un-prefixed) surface: the implicit default
+                # session — sid None marks the legacy entry, which the
+                # metrics route uses to scrape EVERY session at once
+                return self._api(method, rest, url, server.service, None)
             except BrokenPipeError:
                 raise
+            except UnknownSession as e:
+                return self._error(404, str(e), kind="UnknownSession")
+            except (
+                SessionLimitExceeded,
+                SessionQuotaExceeded,
+                ServerSaturated,
+            ) as e:
+                # admission control sheds with the SAME structured 503 +
+                # Retry-After shape as compile degradation: overload is
+                # a retryable service condition (docs/sessions.md)
+                return self._error(
+                    503,
+                    str(e),
+                    kind=type(e).__name__,
+                    detail="admission control: load shed; retry after the "
+                    "hinted backoff",
+                    headers={"Retry-After": str(e.retry_after_s)},
+                )
+            except SessionBusy as e:
+                return self._error(409, str(e), kind="SessionBusy")
             except SchedulerServiceDisabled as e:
                 # reference schedulerconfig.go:32-34: external-scheduler
                 # mode answers config/scheduling calls with 400
@@ -494,29 +401,343 @@ def _make_handler(server: SimulatorServer):
                     detail="unhandled error at the API boundary",
                 )
 
+        # -- session plane --------------------------------------------------
+
+        def _readyz(self):
+            """Readiness for external load balancers: not-ready while
+            the SHARED broker is cooldown-saturated (some session's
+            compile ladder is exhausted and cooling) or its speculative
+            worker crashed — a sick compile plane should be drained, not
+            handed fresh tenants."""
+            health = server.sessions.broker.health()
+            reasons = []
+            if health["cooldownKeys"]:
+                reasons.append(
+                    f"{health['cooldownKeys']} compile key(s) in cooldown"
+                )
+            if health["stuckCompiles"]:
+                reasons.append(
+                    f"{health['stuckCompiles']} wedged compile thread(s)"
+                )
+            if health["workerCrashed"]:
+                reasons.append("speculative compile worker crashed")
+            doc = {"ready": not reasons, "reasons": reasons, "broker": health}
+            if reasons:
+                return self._json(
+                    503, doc, headers={"Retry-After": str(DEGRADED_RETRY_AFTER_S)}
+                )
+            return self._json(200, doc)
+
+        def _sessions_route(self, method: str, rest: list[str], url):
+            mgr = server.sessions
+            if not rest:
+                if method == "GET":
+                    return self._json(
+                        200,
+                        {
+                            "sessions": mgr.list_info(),
+                            "broker": mgr.broker.stats(),
+                            "limits": mgr.stats(),
+                        },
+                    )
+                if method == "POST":
+                    body = self._body() or {}
+                    if not isinstance(body, dict):
+                        return self._error(400, "session spec must be a mapping")
+                    try:
+                        sess, errors = mgr.create(
+                            name=body.get("name"),
+                            snapshot=body.get("snapshot"),
+                            fault_inject=body.get("faultInject"),
+                        )
+                    except ValueError as e:
+                        # a malformed faultInject spec is the client's
+                        # input (admission errors raise their own types)
+                        return self._error(400, str(e))
+                    doc = sess.info()
+                    doc["errors"] = errors
+                    return self._json(201, doc)
+                return self._error(405, "method not allowed")
+            sid, sub = rest[0], rest[1:]
+            if not sub:
+                if method == "GET":
+                    return self._json(200, mgr.info(sid))
+                if method == "DELETE":
+                    try:
+                        mgr.delete(sid)
+                    except ValueError as e:
+                        return self._error(400, str(e))
+                    return self._json(200, {"deleted": sid})
+                return self._error(405, "method not allowed")
+            if sub == ["fork"] and method == "POST":
+                body = self._body() or {}
+                sess = mgr.fork(sid, name=(body or {}).get("name"))
+                return self._json(201, sess.info())
+            if sub == ["evict"] and method == "POST":
+                try:
+                    path = mgr.evict(sid)
+                except ValueError as e:
+                    return self._error(400, str(e))
+                return self._json(200, {"evicted": sid, "snapshot": path})
+            # any other sub-path: the full API surface scoped to this
+            # session (restoring it from its snapshot if evicted). The
+            # `using` window registers the request with the manager so
+            # the idle sweeper cannot evict the service out from under a
+            # mutation it is about to acknowledge
+            with mgr.using(sid) as sess:
+                return self._api(method, sub, url, sess.service, sid)
+
+        # -- the per-session API surface ------------------------------------
+
+        def _api(self, method: str, rest: list[str], url, svc, sid):
+            """Every route of the original single-tenant surface, bound
+            to `svc` — the default session for legacy paths (sid None)
+            or the session addressed by /api/v1/sessions/<sid>/...."""
+            if rest == ["schedulerconfiguration"]:
+                return self._scheduler_config(method, svc)
+            if rest == ["reset"] and method == "PUT":
+                svc.reset()
+                return self._json(202)
+            if rest == ["export"] and method == "GET":
+                return self._json(200, svc.export())
+            if rest == ["import"] and method == "POST":
+                body = self._body() or {}
+                # bulk pod entry obeys the same per-session quota as
+                # one-at-a-time CRUD (docs/sessions.md)
+                server.sessions.admit_import(svc, body)
+                errs = svc.import_(body)
+                server.maybe_schedule(svc)
+                return self._json(200, {"errors": errs})
+            if rest == ["listwatchresources"] and method == "GET":
+                return self._list_watch(parse_qs(url.query), svc)
+            if rest == ["metrics"] and method == "GET":
+                return self._metrics(parse_qs(url.query), svc, sid)
+            if rest == ["debug", "trace"] and method == "GET":
+                # the flight recorder's retained window as Chrome
+                # trace-event JSON — loadable as-is in Perfetto
+                # (docs/observability.md). With tracing off the
+                # document is empty but still loadable, and
+                # otherData.tracingEnabled says why. Process-global:
+                # every session's spans share the one ring (each span
+                # carries its session id in args).
+                rec = telemetry.active()
+                events = rec.snapshot() if rec is not None else []
+                doc = telemetry.chrome_trace(
+                    events, dropped=rec.dropped if rec is not None else 0
+                )
+                doc["otherData"]["tracingEnabled"] = rec is not None
+                return self._json(200, doc)
+            if rest == ["debug", "profile"] and method == "POST":
+                return self._debug_profile(self._body() or {})
+            if rest == ["events"] and method == "GET":
+                q = parse_qs(url.query)
+                # nested routes filter to their session; the legacy
+                # stream carries everything unless ?session= narrows it
+                session_filter = sid or q.get("session", [None])[0]
+                if sid is None and session_filter is not None:
+                    # validate + resolve so the metrics feed is the
+                    # filtered session's, not the default's
+                    svc = server.sessions.get(session_filter).service
+                return self._events_stream(q, svc, session_filter)
+            if rest == ["schedule"] and method == "POST":
+                mode = parse_qs(url.query).get("mode", ["sequential"])[0]
+                if mode not in ("sequential", "gang"):
+                    return self._error(
+                        400, f"unknown scheduling mode {mode!r}"
+                    )
+                if svc.scheduler._schedule_lock.locked():
+                    # this session already has a pass in flight: shed
+                    # NOW, before claiming a concurrent-pass slot —
+                    # queued same-session requests would otherwise sit
+                    # on the global slots doing no device work, starving
+                    # every other tenant (the semaphore bounds device
+                    # concurrency, not waiting-room depth)
+                    raise ServerSaturated(
+                        f"session {svc.scheduler.session_id or 'default'!r} "
+                        f"already has a pass in flight; retry later"
+                    )
+                if mode == "gang":
+                    # records default ON (the annotations are the
+                    # product); ?record=0 is the bulk opt-out;
+                    # ?window=W passes eval_window through (the
+                    # at-scale round-cost lever)
+                    q = parse_qs(url.query)
+                    rec_q = q.get("record", ["1"])[0]
+                    record = rec_q not in ("0", "false", "no")
+                    window = None
+                    if "window" in q:
+                        try:
+                            window = int(q["window"][0])
+                        except ValueError:
+                            return self._error(
+                                400,
+                                f"window must be an integer, got"
+                                f" {q['window'][0]!r}",
+                            )
+                    try:
+                        with server.sessions.pass_slot():
+                            placements, rounds, results = (
+                                svc.scheduler.schedule_gang(
+                                    record=record, window=window
+                                )
+                            )
+                    except ValueError as e:
+                        # known-unsupported combination (extenders
+                        # configured) is the client's request, not a
+                        # server fault
+                        return self._error(400, str(e))
+                    body = {
+                        "mode": "gang",
+                        "rounds": rounds,
+                        "scheduled": sum(
+                            1 for v in placements.values() if v
+                        ),
+                        "unschedulable": sum(
+                            1 for v in placements.values() if not v
+                        ),
+                    }
+                    if results is not None:
+                        body["results"] = [
+                            {
+                                "namespace": r.pod_namespace,
+                                "name": r.pod_name,
+                                "status": r.status,
+                                "selectedNode": r.selected_node,
+                            }
+                            for r in results
+                        ]
+                    return self._json(200, body)
+                with server.sessions.pass_slot():
+                    results = svc.scheduler.schedule()
+                return self._json(
+                    200,
+                    {
+                        "scheduled": sum(
+                            1 for r in results if r.status == "Scheduled"
+                        ),
+                        "results": [
+                            {
+                                "namespace": r.pod_namespace,
+                                "name": r.pod_name,
+                                "status": r.status,
+                                "selectedNode": r.selected_node,
+                            }
+                            for r in results
+                        ],
+                    },
+                )
+            if rest == ["scenario"] and method == "POST":
+                # one-shot KEP-140 scenario / KEP-159 sweep run over
+                # the serving shell: the body is a batch-job spec
+                # (scenario/batch.py — operations + schedulerConfig,
+                # or a sweep snapshot + weightVariants). Runs against
+                # its OWN isolated store (KEP-140's one-scenario-at-
+                # a-time pre-cleaned cluster, README.md:600-610), not
+                # the server's; synchronous, returns the result doc.
+                # Concurrent scenario requests serialize (KEP: one
+                # scenario at a time; run_job additionally holds the
+                # process-wide device lock for sweep jobs) and take a
+                # concurrent-pass slot — scenario storms shed like any
+                # other device-driving overload.
+                from ..scenario.batch import BatchJob, run_job
+
+                try:
+                    spec = self._body() or {}
+                    if not isinstance(spec, dict):
+                        return self._error(400, "spec must be a mapping")
+                    job = BatchJob.from_spec(
+                        spec.get("name", "http-scenario"), spec
+                    )
+                except (ValueError, KeyError, AttributeError, TypeError) as e:
+                    return self._error(400, f"{type(e).__name__}: {e}")
+                # scenario lock FIRST (blocking, holding nothing), slot
+                # second: waiting on the one-timeline-at-a-time lock
+                # while holding a global pass slot would starve other
+                # sessions' device work
+                with server._scenario_lock, server.sessions.pass_slot():
+                    return self._json(200, run_job(job))
+            if rest == ["lifecycle"] and method == "POST":
+                # one-shot cluster-lifecycle chaos run: the body is a
+                # ChaosSpec (scenario/chaos.py — seeded fault schedule
+                # + arrival processes + optional snapshot). Runs over
+                # its OWN isolated store (svc.run_lifecycle), the
+                # serving store is untouched; synchronous, returns the
+                # result document WITH the replayable trace inline.
+                # Serialized with scenario runs (one device-driving
+                # timeline at a time); metrics flow into the addressed
+                # session's registry.
+                from ..scenario.chaos import ChaosSpec
+
+                try:
+                    spec = ChaosSpec.from_dict(self._body() or {})
+                except (ValueError, KeyError, TypeError) as e:
+                    return self._error(400, f"{type(e).__name__}: {e}")
+                try:
+                    # same ordering rationale as the scenario route
+                    with server._scenario_lock, server.sessions.pass_slot():
+                        result = svc.run_lifecycle(spec)
+                        # read under the lock: a concurrent run must
+                        # not swap ITS trace into THIS response
+                        result["trace"] = svc.last_lifecycle_trace
+                except ValueError as e:
+                    # a spec that parses but can't build a run (bad
+                    # snapshot, unusable scheduler config) is the
+                    # client's input, not a server fault
+                    return self._error(400, str(e))
+                return self._json(200, result)
+            if rest == ["lifecycle", "trace"] and method == "GET":
+                # the last run's replayable event trace as JSONL
+                # (application/x-ndjson), byte-identical across
+                # re-runs of the same seeded spec
+                trace = svc.last_lifecycle_trace
+                if trace is None:
+                    return self._error(404, "no lifecycle run yet")
+                from ..lifecycle.engine import trace_jsonl
+
+                body = trace_jsonl(trace).encode()
+                self.send_response(200)
+                self._cors_headers()
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return None
+            if rest and rest[0] == "extender":
+                return self._extender(method, rest[1:], svc)
+            if rest and rest[0] == "resources":
+                return self._resources(
+                    method, rest[1:], parse_qs(url.query), svc
+                )
+            return self._error(404, "not found")
+
         # -- handlers -------------------------------------------------------
 
-        def _scheduler_config(self, method: str):
+        def _scheduler_config(self, method: str, svc):
             if method == "GET":
-                return self._json(200, service.scheduler.get_config())
+                return self._json(200, svc.scheduler.get_config())
             if method == "POST":
                 # only .profiles (+ .extenders) are honored, reference
                 # convertConfigurationForSimulator semantics (config parse
                 # enforces this downstream)
-                service.scheduler.restart(self._body() or {})
+                svc.scheduler.restart(self._body() or {})
                 return self._json(202)
             return self._error(405, "method not allowed")
 
-        def _resources(self, method: str, rest: list[str], q: dict):
+        def _resources(self, method: str, rest: list[str], q: dict, svc):
             if not rest or rest[0] not in KINDS:
                 return self._error(404, f"unknown kind {rest[:1]}")
             kind = rest[0]
             if len(rest) == 1:
                 if method == "GET":
-                    return self._json(200, {"items": service.store.list(kind)})
+                    return self._json(200, {"items": svc.store.list(kind)})
                 if method in ("POST", "PUT"):
-                    obj = service.store.apply(kind, self._body() or {})
-                    server.maybe_schedule()
+                    body = self._body() or {}
+                    if kind == "pods":
+                        # per-session pending-pod quota: shed BEFORE the
+                        # store mutation (docs/sessions.md)
+                        server.sessions.admit_pod(svc, body)
+                    obj = svc.store.apply(kind, body)
+                    server.maybe_schedule(svc)
                     return self._json(201, obj)
             else:
                 if len(rest) == 3:
@@ -526,7 +747,7 @@ def _make_handler(server: SimulatorServer):
                 else:
                     return self._error(404, "bad resource path")
                 if method == "GET":
-                    obj = service.store.get(kind, name, namespace)
+                    obj = svc.store.get(kind, name, namespace)
                     if obj is None:
                         return self._error(404, "not found")
                     if q.get("format", [None])[0] == "yaml":
@@ -570,19 +791,25 @@ def _make_handler(server: SimulatorServer):
                             )
                         meta["namespace"] = namespace
                     obj["metadata"] = meta
-                    out = service.store.replace(kind, obj)
-                    server.maybe_schedule()
+                    if kind == "pods":
+                        # quota-metered like a collection apply, plus the
+                        # replace-only transition: a body omitting
+                        # spec.nodeName UNBINDS a bound pod back into the
+                        # pending queue (replace deletes absent fields)
+                        server.sessions.admit_pod(svc, obj, replace=True)
+                    out = svc.store.replace(kind, obj)
+                    server.maybe_schedule(svc)
                     return self._json(200, out)
                 if method == "DELETE":
-                    ok = service.store.delete(kind, name, namespace)
+                    ok = svc.store.delete(kind, name, namespace)
                     if not ok:
                         return self._error(404, "not found")
-                    server.maybe_schedule()
+                    server.maybe_schedule(svc)
                     return self._json(200)
             return self._error(405, "method not allowed")
 
-        def _extender(self, method: str, rest: list[str]):
-            ext = server.extender_service or service.scheduler.extender_service
+        def _extender(self, method: str, rest: list[str], svc):
+            ext = server.extender_service or svc.scheduler.extender_service
             if method != "POST" or len(rest) != 2:
                 return self._error(404, "bad extender path")
             verb, id_str = rest
@@ -631,7 +858,102 @@ def _make_handler(server: SimulatorServer):
                 400, f"action must be start|stop, got {action!r}"
             )
 
-        def _events_stream(self, q: dict):
+        def _metrics(self, q: dict, svc, sid):
+            """GET /api/v1/metrics (+ per-session nested form): the
+            session's counter snapshot as JSON, or Prometheus text with
+            a `session` label on every sample. The LEGACY (un-prefixed)
+            Prometheus scrape renders EVERY live session in one
+            document — the one endpoint an external Prometheus points
+            at (docs/sessions.md)."""
+            fmt = q.get("format", ["json"])[0]
+            doc = None
+            if fmt == "json" or sid is not None:
+                # the legacy prometheus scrape (sid None) re-snapshots
+                # every live session inside its consistent cut below —
+                # don't pay a discarded extra snapshot per scrape
+                doc = svc.scheduler.metrics.snapshot()
+                # serving-stack configuration alongside the counters:
+                # the encoding-cache bound (KSS_ENCODING_CACHE_CAP)
+                doc["encodingCacheCapacity"] = (
+                    svc.scheduler.encoding_cache_capacity
+                )
+                doc["sessionId"] = sid or DEFAULT_SESSION_ID
+                # server-wide SSE hardening counter (the satellite): how
+                # many events were dropped disconnecting slow subscribers
+                doc["sseDroppedEvents"] = server.sse_dropped
+            if fmt == "prometheus":
+                def entry(session_id, snapshot, cache_cap):
+                    return (
+                        {"session": session_id},
+                        snapshot,
+                        {
+                            "kss_encoding_cache_capacity": (
+                                "Capacity of the per-service encoding "
+                                "cache (KSS_ENCODING_CACHE_CAP).",
+                                cache_cap,
+                            )
+                        },
+                    )
+
+                if sid is None:
+                    # the scrape endpoint: every LIVE session, labeled,
+                    # from ONE consistent cut — no per-id re-lookup to
+                    # race a concurrent DELETE into a scrape-wide 404,
+                    # and no restore (scrapes must not defeat idle
+                    # eviction; an evicted session's counters live in
+                    # its snapshot file until the next real touch)
+                    entries = [
+                        entry(
+                            session_id,
+                            service.scheduler.metrics.snapshot(),
+                            service.scheduler.encoding_cache_capacity,
+                        )
+                        for session_id, service in (
+                            server.sessions.live_services()
+                        )
+                    ]
+                else:
+                    entries = [entry(sid, doc, doc["encodingCacheCapacity"])]
+                mgr_stats = server.sessions.stats()
+                body = metrics_mod.render_prometheus_sessions(
+                    entries,
+                    global_counters={
+                        "kss_sse_dropped_events_total": (
+                            "Events dropped disconnecting slow SSE "
+                            "subscribers.",
+                            server.sse_dropped,
+                        ),
+                        "kss_session_evictions_total": (
+                            "Idle sessions snapshotted to disk.",
+                            mgr_stats["evictions"],
+                        ),
+                    },
+                    global_gauges={
+                        "kss_sessions_live": (
+                            "Sessions resident in memory.",
+                            mgr_stats["live"],
+                        ),
+                        "kss_sessions_evicted": (
+                            "Sessions evicted to disk snapshots.",
+                            mgr_stats["evicted"],
+                        ),
+                    },
+                ).encode()
+                self.send_response(200)
+                self._cors_headers()
+                self.send_header(
+                    "Content-Type",
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return None
+            if fmt != "json":
+                return self._error(400, f"unknown metrics format {fmt!r}")
+            return self._json(200, doc)
+
+        def _events_stream(self, q: dict, svc, session_filter: "str | None"):
             """GET /api/v1/events: live telemetry over SSE
             (text/event-stream), reusing the listwatch chunked plumbing.
             Two event types (docs/observability.md):
@@ -643,20 +965,47 @@ def _make_handler(server: SimulatorServer):
                 (requires `KSS_TRACE=1`; without it the stream carries
                 metrics events only).
 
-            A comment heartbeat (``:``) flows on idle so a vanished
-            client is detected and the subscription reclaimed."""
+            With `session_filter` (a nested /sessions/<id>/events route,
+            or ?session= on the legacy route) only that session's spans
+            flow; metrics snapshots are the addressed session's.
+
+            Robustness (the satellite hardening): subscriber count is
+            capped (KSS_SSE_MAX_SUBSCRIBERS → 503 past it), and a slow
+            consumer whose bounded queue overflows is DISCONNECTED —
+            with the drop counted in `sseDroppedEvents` — instead of
+            silently receiving a gap-ridden interleaving. A comment
+            heartbeat (``:``) flows on idle so a vanished client is
+            detected and the subscription reclaimed."""
+            if not server.sse_acquire():
+                return self._error(
+                    503,
+                    f"SSE subscriber limit reached "
+                    f"({server.sessions.sse_max_subscribers}, "
+                    f"KSS_SSE_MAX_SUBSCRIBERS)",
+                    kind="SSESubscriberLimit",
+                    headers={"Retry-After": str(DEGRADED_RETRY_AFTER_S)},
+                )
             rec = telemetry.active()
             # bounded feed: a slow/stalled client must not accumulate
             # every span the process emits (the unbounded growth the
-            # ring buffer exists to prevent) — past the bound, spans
-            # are dropped for THIS subscriber, flight-recorder style
-            events: "queue.Queue" = queue.Queue(maxsize=4096)
+            # ring buffer exists to prevent) — past the bound the
+            # consumer is provably too slow and gets disconnected
+            events: "queue.Queue" = queue.Queue(maxsize=SSE_QUEUE_MAX)
+            overflowed = threading.Event()
 
             def feed(ev: dict) -> None:
+                if overflowed.is_set():
+                    return  # already condemned; don't count more drops
+                if (
+                    session_filter is not None
+                    and (ev.get("args") or {}).get("session") != session_filter
+                ):
+                    return  # another tenant's span: filtered, not a drop
                 try:
                     events.put_nowait(ev)
                 except queue.Full:
-                    pass
+                    server.sse_count_drop()
+                    overflowed.set()
 
             if rec is not None:
                 rec.subscribe(feed)
@@ -677,7 +1026,7 @@ def _make_handler(server: SimulatorServer):
                     self.wfile.flush()
 
                 def counters():
-                    snap = service.scheduler.metrics.snapshot()
+                    snap = svc.scheduler.metrics.snapshot()
                     snap.pop("uptimeSeconds", None)  # changes every read
                     return snap
 
@@ -685,7 +1034,7 @@ def _make_handler(server: SimulatorServer):
                 push("metrics", last)
                 idle = 0
                 checked = time.monotonic()
-                while True:
+                while not overflowed.is_set():
                     try:
                         ev = events.get(timeout=1.0)
                     except queue.Empty:
@@ -711,16 +1060,20 @@ def _make_handler(server: SimulatorServer):
                         # SSE comment line: a spec-legal heartbeat
                         self.wfile.write(b"3\r\n:\n\n\r\n")
                         self.wfile.flush()
+                # overflow: fall through — closing the connection IS the
+                # disconnect (the client reconnects and re-syncs from a
+                # fresh metrics snapshot)
             except (BrokenPipeError, ConnectionResetError):
                 pass
             finally:
                 if rec is not None:
                     rec.unsubscribe(feed)
+                server.sse_release()
 
         # -- watch stream ---------------------------------------------------
 
-        def _list_watch(self, q: dict):
-            store = service.store
+        def _list_watch(self, q: dict, svc):
+            store = svc.store
             # validate every lastResourceVersion BEFORE the 200/chunked
             # headers go out — past that point errors can't be reported
             last_rvs: dict[str, "int | None"] = {}

@@ -33,6 +33,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+from contextlib import ExitStack
 
 import jax
 
@@ -123,6 +124,7 @@ class SchedulingPassHandle:
             self.scheduled = self._finish()
         finally:
             self._done = True
+            self._service._unlease_engine()
             self._service._schedule_lock.release()
         return self.scheduled
 
@@ -131,6 +133,7 @@ class SchedulingPassHandle:
         paths only — the store is left without this pass's results)."""
         if not self._done:
             self._done = True
+            self._service._unlease_engine()
             self._service._schedule_lock.release()
 
 
@@ -144,8 +147,22 @@ class SchedulerService:
         metrics: "metrics_mod.SchedulingMetrics | None" = None,
         disabled: bool = False,
         broker: "CompileBroker | None" = None,
+        session_id: "str | None" = None,
+        fault_plane=None,
     ):
         self.store = store
+        # multi-tenant session plane (docs/sessions.md): the session id
+        # labels this service's telemetry spans (SSE filtering, the
+        # Prometheus `session` label) and namespaces its cooldowns on a
+        # SHARED broker; the optional per-session fault plane
+        # (utils/faultinject.FaultPlane) rules this service's passes
+        # only — the bulkhead that confines a tenant's injected storm
+        self.session_id = session_id
+        self.fault_plane = fault_plane
+        # the engine lease held across the current pass's dispatch→finish
+        # window (broker.lease — cross-session engine serialization);
+        # at most one, since _schedule_lock serializes passes
+        self._engine_lease: "threading.RLock | None" = None
         # external-scheduler mode: the service exists (the HTTP layer
         # still routes to it) but refuses config and scheduling calls
         self.disabled = disabled
@@ -212,6 +229,36 @@ class SchedulerService:
         the async pipeline) with that pass's causal id."""
         return self._pass_seq + 1
 
+    def _session_scope(self) -> ExitStack:
+        """The per-pass bulkhead contexts (docs/sessions.md): spans
+        emitted inside carry this service's session id, and the
+        session's private fault plane (when it has one) shadows the
+        process plane on this thread for the duration. Empty for
+        sessionless services — the historical behavior."""
+        stack = ExitStack()
+        if self.session_id is not None:
+            stack.enter_context(telemetry.session_context(self.session_id))
+        if self.fault_plane is not None:
+            stack.enter_context(faultinject.scoped(self.fault_plane))
+        return stack
+
+    def _lease_engine(self, sig: tuple) -> None:
+        """Hold `sig`'s engine lease for the rest of this pass: warm
+        engines in a SHARED broker are stateful (retarget mutates them),
+        so two bucket-compatible sessions may share the executable but
+        never a concurrent mutation of it. Released by the pass finish
+        (or any error path) via `_unlease_engine`."""
+        lease = self.broker.lease(sig)
+        lease.acquire()
+        self._engine_lease = lease
+
+    def _unlease_engine(self) -> None:
+        """Release the held engine lease, if any (idempotent — finish
+        paths and outer error handlers may both call it)."""
+        lease, self._engine_lease = self._engine_lease, None
+        if lease is not None:
+            lease.release()
+
     @staticmethod
     def _encoding_cache_cap_from_env() -> int:
         """EncodingCache capacity: KSS_ENCODING_CACHE_CAP when it parses
@@ -276,31 +323,36 @@ class SchedulerService:
         """
         if self.disabled:
             raise SchedulerServiceDisabled()
-        with self._schedule_lock:
-            # one config read per pass: encode, branch, and label must
-            # all see the same configuration even if restart() lands
-            # mid-pass
-            with self._lock:
-                config = self._config
-            mode = "extender" if config.extenders else "sequential"
-            pass_id = self._next_pass_id()
-            with telemetry.pass_context(pass_id), telemetry.span(
-                f"pass.{mode}", pass_id=pass_id
-            ):
-                with self.metrics.time_pass(mode) as ctx:
-                    results = self._schedule_locked(config)
-                    # a preempting pod yields two records (Nominated +
-                    # retry): count distinct pods so decisions/sec isn't
-                    # inflated
-                    ctx.done(
-                        pods=len(
-                            {(r.pod_namespace, r.pod_name) for r in results}
-                        ),
-                        scheduled=sum(
-                            1 for r in results if r.status == "Scheduled"
-                        ),
-                    )
-            return results
+        with self._schedule_lock, self._session_scope():
+            try:
+                # one config read per pass: encode, branch, and label must
+                # all see the same configuration even if restart() lands
+                # mid-pass
+                with self._lock:
+                    config = self._config
+                mode = "extender" if config.extenders else "sequential"
+                pass_id = self._next_pass_id()
+                with telemetry.pass_context(pass_id), telemetry.span(
+                    f"pass.{mode}", pass_id=pass_id
+                ):
+                    with self.metrics.time_pass(mode) as ctx:
+                        results = self._schedule_locked(config)
+                        # a preempting pod yields two records (Nominated +
+                        # retry): count distinct pods so decisions/sec isn't
+                        # inflated
+                        ctx.done(
+                            pods=len(
+                                {(r.pod_namespace, r.pod_name) for r in results}
+                            ),
+                            scheduled=sum(
+                                1 for r in results if r.status == "Scheduled"
+                            ),
+                        )
+                return results
+            finally:
+                # error paths between dispatch and finish (eager-ladder
+                # exhaustion, device faults) must not strand the lease
+                self._unlease_engine()
 
     def schedule_gang(
         self, record: bool = True, window: "int | None" = None
@@ -322,8 +374,11 @@ class SchedulerService:
             raise SchedulerServiceDisabled()
         if window is not None and int(window) < 1:
             raise ValueError(f"window must be >= 1, got {window}")
-        with self._schedule_lock:
-            return self._schedule_gang_timed(record, window)
+        with self._schedule_lock, self._session_scope():
+            try:
+                return self._schedule_gang_timed(record, window)
+            finally:
+                self._unlease_engine()
 
     def _schedule_gang_timed(self, record: bool, window: "int | None" = None):
         with self._lock:
@@ -409,6 +464,9 @@ class SchedulerService:
             GangScheduler.compile_signature(enc),
             GangScheduler.effective_window(enc, window, GANG_CHUNK),
         )
+        # cross-session serialization of the (possibly shared) engine:
+        # held until _gang_finish (docs/sessions.md)
+        self._lease_engine(sig)
         t0 = time.perf_counter()
         holder: dict = {}
 
@@ -427,7 +485,10 @@ class SchedulerService:
 
         broker_info: dict = {}
         try:
-            gang = self.broker.get_resilient(sig, build, info=broker_info)
+            gang = self.broker.get_resilient(
+                sig, build, info=broker_info,
+                metrics=self.metrics, scope=self.session_id,
+            )
         except CompileUnavailable as e:
             # the ladder's last rung: the SAME pass, un-jitted (build
             # runs the engine, so the finish path is identical)
@@ -453,7 +514,14 @@ class SchedulerService:
 
     def _gang_finish(self, disp, record: bool):
         """The deferred tail of a gang pass: decode (ONE batched device
-        transfer for the assignment diff), victim deletes, write-backs."""
+        transfer for the assignment diff), victim deletes, write-backs.
+        Releases the pass's engine lease on every exit."""
+        try:
+            return self._gang_finish_inner(disp, record)
+        finally:
+            self._unlease_engine()
+
+    def _gang_finish_inner(self, disp, record: bool):
         import numpy as np
 
         enc, gang = disp
@@ -583,6 +651,7 @@ class SchedulerService:
             broker.speculate(
                 token,
                 self._speculation_task(config, kind, record, window, target),
+                metrics=self.metrics,
             )
 
     def _speculation_task(self, config, kind: str, record: bool, window, target: int):
@@ -666,17 +735,19 @@ class SchedulerService:
             raise SchedulerServiceDisabled()
         self._schedule_lock.acquire()
         try:
-            with self._lock:
-                config = self._config
-            mode = "extender" if config.extenders else "sequential"
-            pass_id = self._next_pass_id()
-            t0 = time.perf_counter()
-            with telemetry.pass_context(pass_id), telemetry.span(
-                f"pass.{mode}.dispatch", pass_id=pass_id
-            ):
-                disp = self._seq_dispatch(config)
-            info = self.last_encode_info
+            with self._session_scope():
+                with self._lock:
+                    config = self._config
+                mode = "extender" if config.extenders else "sequential"
+                pass_id = self._next_pass_id()
+                t0 = time.perf_counter()
+                with telemetry.pass_context(pass_id), telemetry.span(
+                    f"pass.{mode}.dispatch", pass_id=pass_id
+                ):
+                    disp = self._seq_dispatch(config)
+                info = self.last_encode_info
         except BaseException:
+            self._unlease_engine()
             self._schedule_lock.release()
             raise
 
@@ -693,9 +764,9 @@ class SchedulerService:
                 pass_id=pass_id,
                 mode=mode,
             )
-            with telemetry.pass_context(pass_id), telemetry.span(
-                f"pass.{mode}.resolve", pass_id=pass_id
-            ):
+            with self._session_scope(), telemetry.pass_context(
+                pass_id
+            ), telemetry.span(f"pass.{mode}.resolve", pass_id=pass_id):
                 results = [] if disp is None else self._seq_finish(disp)
                 scheduled = sum(
                     1 for r in results if r.status == "Scheduled"
@@ -725,20 +796,23 @@ class SchedulerService:
             raise ValueError(f"window must be >= 1, got {window}")
         self._schedule_lock.acquire()
         try:
-            with self._lock:
-                config = self._config
-            if config.extenders:
-                raise ValueError(
-                    "gang mode does not support extenders; use sequential mode"
-                )
-            pass_id = self._next_pass_id()
-            t0 = time.perf_counter()
-            with telemetry.pass_context(pass_id), telemetry.span(
-                "pass.gang.dispatch", pass_id=pass_id
-            ):
-                disp = self._gang_dispatch(config, record, window)
-            info = self.last_encode_info
+            with self._session_scope():
+                with self._lock:
+                    config = self._config
+                if config.extenders:
+                    raise ValueError(
+                        "gang mode does not support extenders; use "
+                        "sequential mode"
+                    )
+                pass_id = self._next_pass_id()
+                t0 = time.perf_counter()
+                with telemetry.pass_context(pass_id), telemetry.span(
+                    "pass.gang.dispatch", pass_id=pass_id
+                ):
+                    disp = self._gang_dispatch(config, record, window)
+                info = self.last_encode_info
         except BaseException:
+            self._unlease_engine()
             self._schedule_lock.release()
             raise
 
@@ -758,9 +832,9 @@ class SchedulerService:
                     )
                 )
                 return 0
-            with telemetry.pass_context(pass_id), telemetry.span(
-                "pass.gang.resolve", pass_id=pass_id
-            ):
+            with self._session_scope(), telemetry.pass_context(
+                pass_id
+            ), telemetry.span("pass.gang.resolve", pass_id=pass_id):
                 placements, rounds, _results = self._gang_finish(disp, record)
             scheduled = sum(1 for v in placements.values() if v)
             self.metrics.record(
@@ -799,6 +873,7 @@ class SchedulerService:
             from ..engine.extender_loop import ExtenderScheduler
 
             sig = ("ext", BatchedScheduler.compile_signature(enc))
+            self._lease_engine(sig)
             holder: dict = {}
 
             def build():
@@ -808,7 +883,9 @@ class SchedulerService:
                 return es
 
             try:
-                ext_sched = self.broker.get_resilient(sig, build)
+                ext_sched = self.broker.get_resilient(
+                    sig, build, metrics=self.metrics, scope=self.session_id
+                )
             except CompileUnavailable as e:
                 ext_sched = self._eager_fallback(build, e)
             else:
@@ -823,6 +900,7 @@ class SchedulerService:
         # reuse the previous pass's compiled program when the encoding
         # is compile-compatible (same padded shapes + baked statics)
         sig = ("seq", BatchedScheduler.compile_signature(enc))
+        self._lease_engine(sig)
         t0 = time.perf_counter()
         holder = {}
 
@@ -836,7 +914,10 @@ class SchedulerService:
 
         broker_info: dict = {}
         try:
-            sched = self.broker.get_resilient(sig, build, info=broker_info)
+            sched = self.broker.get_resilient(
+                sig, build, info=broker_info,
+                metrics=self.metrics, scope=self.session_id,
+            )
         except CompileUnavailable as e:
             return ("batch", enc, self._eager_fallback(build, e), None)
         if not holder.get("ran"):
@@ -857,7 +938,14 @@ class SchedulerService:
 
     def _seq_finish(self, disp) -> list[PodSchedulingResult]:
         """The deferred tail of a sequential pass: trace decode (batched
-        device transfers inside `results()`), victim deletes, write-backs."""
+        device transfers inside `results()`), victim deletes, write-backs.
+        Releases the pass's engine lease on every exit."""
+        try:
+            return self._seq_finish_inner(disp)
+        finally:
+            self._unlease_engine()
+
+    def _seq_finish_inner(self, disp) -> list[PodSchedulingResult]:
         import numpy as np
 
         kind, enc, engine, results = disp
@@ -935,6 +1023,9 @@ class SimulatorService:
         self,
         initial_config: "SchedulerConfiguration | None" = None,
         external_scheduler_enabled: bool = False,
+        broker: "CompileBroker | None" = None,
+        session_id: "str | None" = None,
+        fault_plane=None,
     ):
         self.store = ResourceStore()
         self._controllers_lock = threading.Lock()
@@ -943,7 +1034,12 @@ class SimulatorService:
         # (run_lifecycle; served by GET /api/v1/lifecycle/trace)
         self.last_lifecycle_trace: "list[dict] | None" = None
         self.scheduler = SchedulerService(
-            self.store, initial_config, disabled=external_scheduler_enabled
+            self.store,
+            initial_config,
+            disabled=external_scheduler_enabled,
+            broker=broker,
+            session_id=session_id,
+            fault_plane=fault_plane,
         )
         if external_scheduler_enabled:
             # key -> last-seen bound state; a recorded external bind is

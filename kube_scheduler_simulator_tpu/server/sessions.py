@@ -1,0 +1,661 @@
+"""The multi-tenant session plane (docs/sessions.md).
+
+One process, many isolated simulations: each session owns its own
+`ResourceStore`, `SchedulerService` (and with it per-session
+`SchedulingMetrics`, encoding cache, and delta-encoder state), while a
+single SHARED `CompileBroker` keys warm engines by
+``(kind, compile signature, window)`` — bucket-compatible tenants reuse
+executables for free, and the per-key engine lease plus per-scope
+cooldowns (utils/broker.py) keep sharing safe and failures bulkheaded.
+The failure domain is a *session*, not the process: a tenant's wedged
+compile, fault-injected pass, or oversized cluster degrades that tenant
+only.
+
+Robustness machinery owned here:
+
+  * **Admission control** — ``KSS_MAX_SESSIONS`` bounds the session
+    count, ``KSS_MAX_PENDING_PODS_PER_SESSION`` bounds each tenant's
+    queue, and a bounded concurrent-pass semaphore
+    (``KSS_MAX_CONCURRENT_PASSES``) sheds device-driving requests past
+    capacity. All three surface as the existing structured 503 +
+    Retry-After (server/httpserver.py), so clients back off the same
+    way they do for compile degradation.
+  * **Idle eviction** — a session idle past
+    ``KSS_SESSION_IDLE_EVICT_S`` is snapshotted to disk in the PR 4
+    checkpoint family (``kss-session-checkpoint/v1``: verbatim store
+    dump, scheduler config, cumulative metrics, pass sequence) and its
+    in-memory state released; the next touch restores it transparently.
+    Eviction is load shedding, never data loss.
+  * **Fork** — `fork()` round-trips the same checkpoint document into a
+    fresh session id: what-if experiments branch from live (or evicted)
+    state without copying code paths.
+
+The ``default`` session wraps the server's original `SimulatorService`,
+so every legacy single-session route keeps working unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+import secrets
+import tempfile
+import threading
+import time
+from contextlib import contextmanager
+
+from ..lifecycle.checkpoint import (
+    SESSION_CHECKPOINT_FORMAT,
+    load_checkpoint,
+    write_checkpoint,
+)
+from ..utils import faultinject
+from ..utils.broker import CompileBroker
+from .service import SchedulerServiceDisabled, SimulatorService
+
+DEFAULT_SESSION_ID = "default"
+
+
+class UnknownSession(KeyError):
+    """No session with that id (404)."""
+
+    def __init__(self, sid: str):
+        super().__init__(sid)
+        self.sid = sid
+
+    def __str__(self):
+        return f"unknown session {self.sid!r}"
+
+
+class SessionLimitExceeded(RuntimeError):
+    """KSS_MAX_SESSIONS reached: session creation is shed (503)."""
+
+    retry_after_s = 5
+
+
+class SessionQuotaExceeded(RuntimeError):
+    """A per-session quota (pending pods) is full: the mutation is shed
+    (503) until the tenant schedules or deletes some of its queue."""
+
+    retry_after_s = 2
+
+
+class ServerSaturated(RuntimeError):
+    """Every concurrent-pass slot is taken: the device-driving request
+    is shed (503) instead of queueing unboundedly behind the device."""
+
+    retry_after_s = 1
+
+
+class SessionBusy(RuntimeError):
+    """The session has a pass in flight; eviction refused (409)."""
+
+
+def _env_int(env, name: str, default: int, minimum: int) -> int:
+    raw = env.get(name, "")
+    if not raw:
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r}: expected an integer") from None
+    if v < minimum:
+        raise ValueError(f"{name}={raw!r}: must be >= {minimum}")
+    return v
+
+
+def _env_float(env, name: str, default: float, minimum: float) -> float:
+    raw = env.get(name, "")
+    if not raw:
+        return default
+    try:
+        v = float(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r}: expected a number") from None
+    if v < minimum:
+        raise ValueError(f"{name}={raw!r}: must be >= {minimum}")
+    return v
+
+
+class Session:
+    """One tenant: id + its `SimulatorService` (None while evicted)."""
+
+    def __init__(self, sid: str, name: str, service: "SimulatorService | None"):
+        self.id = sid
+        self.name = name
+        self.service = service
+        self.state = "live"  # "live" | "evicted"
+        # serializes THIS session's live<->evicted transitions (and the
+        # checkpoint I/O they do) so the manager-wide lock never spans
+        # disk reads/writes: one tenant's multi-second snapshot must not
+        # stall every other tenant's request routing. Lock order:
+        # _state_lock OUTSIDE manager._lock, never the reverse.
+        self._state_lock = threading.Lock()
+        self.created_at = time.time()
+        self.last_touch = time.monotonic()
+        self.snapshot_path: "str | None" = None
+        self.fault_spec: "str | None" = None
+        self.restores = 0
+        # requests currently routed INTO this session (manager.using):
+        # eviction refuses while any is live, and aborts its commit when
+        # one raced in mid-snapshot — a 201'd write must never vanish
+        # into a discarded service object (guarded by manager._lock)
+        self._active_requests = 0
+
+    def info(self) -> dict:
+        doc = {
+            "id": self.id,
+            "name": self.name,
+            "state": self.state,
+            "createdAt": round(self.created_at, 3),
+            "idleSeconds": round(time.monotonic() - self.last_touch, 3),
+            "restores": self.restores,
+            "faultInject": self.fault_spec,
+        }
+        svc = self.service
+        if svc is not None:
+            snap = svc.scheduler.metrics.snapshot()
+            doc["passes"] = snap["passes"]
+            doc["totalScheduled"] = snap["totalScheduled"]
+            doc["pendingPods"] = svc.store.count_pending_pods()
+            doc["pods"] = svc.store.count("pods")
+            doc["nodes"] = svc.store.count("nodes")
+        else:
+            doc["snapshotPath"] = self.snapshot_path
+        return doc
+
+
+class SessionManager:
+    """Owns every session, the shared broker, and the admission knobs."""
+
+    def __init__(
+        self,
+        default_service: SimulatorService,
+        *,
+        broker: "CompileBroker | None" = None,
+        max_sessions: "int | None" = None,
+        pending_pod_quota: "int | None" = None,
+        max_concurrent_passes: "int | None" = None,
+        idle_evict_s: "float | None" = None,
+        snapshot_dir: "str | None" = None,
+        sse_max_subscribers: "int | None" = None,
+        env: "dict | None" = None,
+    ):
+        env = os.environ if env is None else env
+        self.max_sessions = (
+            max_sessions
+            if max_sessions is not None
+            else _env_int(env, "KSS_MAX_SESSIONS", 64, 1)
+        )
+        # 0 = unlimited (the historical behavior)
+        self.pending_pod_quota = (
+            pending_pod_quota
+            if pending_pod_quota is not None
+            else _env_int(env, "KSS_MAX_PENDING_PODS_PER_SESSION", 0, 0)
+        )
+        self.max_concurrent_passes = (
+            max_concurrent_passes
+            if max_concurrent_passes is not None
+            else _env_int(env, "KSS_MAX_CONCURRENT_PASSES", 4, 1)
+        )
+        self.idle_evict_s = (
+            idle_evict_s
+            if idle_evict_s is not None
+            else _env_float(env, "KSS_SESSION_IDLE_EVICT_S", 0.0, 0.0)
+        )
+        self.sse_max_subscribers = (
+            sse_max_subscribers
+            if sse_max_subscribers is not None
+            else _env_int(env, "KSS_SSE_MAX_SUBSCRIBERS", 64, 1)
+        )
+        self._snapshot_dir = snapshot_dir or env.get("KSS_SESSION_DIR") or None
+        # ONE broker for every session: warm engines shared by compile
+        # signature; per-session bulkheading lives in the broker's
+        # scope-keyed cooldowns and per-key leases (utils/broker.py).
+        # Broker-level events nobody attributes per call — real worker
+        # crashes, speculative builds armed before the metrics kwarg
+        # existed — fall back to the default session's registry, keeping
+        # the legacy /api/v1/metrics surface (brokerWorkerCrashes,
+        # speculativeCompiles) live
+        self.broker = (
+            broker
+            if broker is not None
+            else CompileBroker(metrics=default_service.scheduler.metrics)
+        )
+        self._lock = threading.RLock()
+        self._pass_sem = threading.BoundedSemaphore(self.max_concurrent_passes)
+        self.evictions = 0
+        # adopt the boot service as the implicit default session: it
+        # joins the shared compile plane and gains the session label,
+        # and every legacy route keeps hitting it unchanged
+        default_service.scheduler.session_id = DEFAULT_SESSION_ID
+        default_service.scheduler.broker = self.broker
+        self._sessions: "dict[str, Session]" = {
+            DEFAULT_SESSION_ID: Session(
+                DEFAULT_SESSION_ID, DEFAULT_SESSION_ID, default_service
+            )
+        }
+        self._stop = threading.Event()
+        self._sweeper: "threading.Thread | None" = None
+        if self.idle_evict_s > 0:
+            self._sweeper = threading.Thread(
+                target=self._sweep_loop, name="kss-session-sweeper", daemon=True
+            )
+            self._sweeper.start()
+
+    # -- lookup --------------------------------------------------------------
+
+    def get(self, sid: str, touch: bool = True, track: bool = False) -> Session:
+        """The session, restored from its snapshot if evicted (the
+        transparent-restore contract: eviction is invisible to the next
+        request beyond its latency). The restore's disk read + service
+        rebuild run under the SESSION's state lock only — other
+        tenants' routing never waits on it. `track` registers the caller
+        as an in-flight request (same locked window that confirms the
+        session live, so eviction can exclude it); pair with `using`."""
+        while True:
+            with self._lock:
+                sess = self._sessions.get(sid)
+                if sess is None:
+                    raise UnknownSession(sid)
+                if sess.state == "live":
+                    if touch:
+                        sess.last_touch = time.monotonic()
+                    if track:
+                        sess._active_requests += 1
+                    return sess
+            with sess._state_lock:
+                with self._lock:
+                    if self._sessions.get(sid) is not sess:
+                        raise UnknownSession(sid)  # raced with delete
+                if sess.state == "evicted":
+                    self._restore(sess)
+            # loop: re-take the fast path for the touch + return
+
+    @contextmanager
+    def using(self, sid: str):
+        """Route a request into a session: the session is live for the
+        duration (restored if needed) and REGISTERED as in use, so the
+        idle sweeper cannot snapshot-and-discard the service out from
+        under a mutation it is about to acknowledge (eviction is load
+        shedding, never data loss — including the race window). The
+        exit touch also restarts the idle clock at request completion,
+        not arrival."""
+        sess = self.get(sid, track=True)
+        try:
+            yield sess
+        finally:
+            with self._lock:
+                sess._active_requests -= 1
+                sess.last_touch = time.monotonic()
+
+    def info(self, sid: str) -> dict:
+        """Session info WITHOUT restoring an evicted session (listing
+        must not defeat eviction)."""
+        with self._lock:
+            sess = self._sessions.get(sid)
+            if sess is None:
+                raise UnknownSession(sid)
+            return sess.info()
+
+    def list_info(self) -> list[dict]:
+        with self._lock:
+            return [
+                s.info()
+                for s in sorted(
+                    self._sessions.values(), key=lambda s: s.created_at
+                )
+            ]
+
+    def live_services(self) -> "list[tuple[str, SimulatorService]]":
+        """One consistent cut of every LIVE session's (id, service) —
+        the scrape path's accessor: no per-id re-lookup to race with
+        DELETE, and no restore (a scrape must never defeat idle
+        eviction; an evicted session's counters live in its snapshot
+        until the next real touch)."""
+        with self._lock:
+            return [
+                (s.id, s.service)
+                for s in sorted(
+                    self._sessions.values(), key=lambda s: s.created_at
+                )
+                if s.state == "live" and s.service is not None
+            ]
+
+    def stats(self) -> dict:
+        with self._lock:
+            live = sum(1 for s in self._sessions.values() if s.state == "live")
+            return {
+                "sessions": len(self._sessions),
+                "live": live,
+                "evicted": len(self._sessions) - live,
+                "evictions": self.evictions,
+                "maxSessions": self.max_sessions,
+                "maxPendingPodsPerSession": self.pending_pod_quota,
+                "maxConcurrentPasses": self.max_concurrent_passes,
+                "idleEvictSeconds": self.idle_evict_s,
+            }
+
+    # -- create / fork / delete ---------------------------------------------
+
+    def create(
+        self,
+        name: "str | None" = None,
+        snapshot: "dict | None" = None,
+        fault_inject: "str | None" = None,
+    ) -> "tuple[Session, list[str]]":
+        """A fresh session (admission-controlled). `fault_inject` is the
+        KSS_FAULT_INJECT grammar scoped to THIS session only — the
+        chaos-testing bulkhead; a malformed spec raises ValueError (400).
+        Returns (session, import errors) — `snapshot` is applied like
+        POST /api/v1/import."""
+        plane = (
+            faultinject.FaultPlane.parse(fault_inject) if fault_inject else None
+        )
+        # quota-check the boot snapshot BEFORE any state exists: an
+        # over-quota create is shed whole, leaving nothing behind
+        self.admit_import(None, snapshot)
+        with self._lock:
+            self._admit_session_locked()
+            sid = self._new_sid_locked()
+            service = SimulatorService(
+                broker=self.broker, session_id=sid, fault_plane=plane
+            )
+            sess = Session(sid, name or sid, service)
+            sess.fault_spec = fault_inject
+            self._sessions[sid] = sess
+        errors = service.import_(snapshot) if snapshot else []
+        return sess, errors
+
+    def fork(self, sid: str, name: "str | None" = None) -> Session:
+        """Branch a session: the source's checkpoint document (built
+        in-memory when live, read from disk when evicted — no restore)
+        round-trips into a new session id. The fork inherits the
+        source's fault spec; its state diverges independently from the
+        moment of the fork. A live source with a pass in flight is
+        refused (SessionBusy, 409) — forking mid-pass would tear the
+        snapshot: half the pass's bindings with none of its counters."""
+        with self._lock:
+            src = self._sessions.get(sid)
+            if src is None:
+                raise UnknownSession(sid)
+            self._admit_session_locked()
+        with src._state_lock:
+            if src.state == "live":
+                # the same pass exclusion evict takes, for the same
+                # reason: dump_state/metrics must be a consistent cut
+                lock = src.service.scheduler._schedule_lock
+                if not lock.acquire(blocking=False):
+                    raise SessionBusy(f"session {sid!r} has a pass in flight")
+                try:
+                    doc = self._session_doc(src)
+                finally:
+                    lock.release()
+            else:
+                doc = load_checkpoint(
+                    src.snapshot_path, SESSION_CHECKPOINT_FORMAT
+                )
+        sess = Session("", name or f"{src.name}-fork", None)
+        sess.fault_spec = doc.get("faultInject")
+        sess.state = "evicted"  # materialized by the restore below
+        # holding the NEW session's state lock across insert + snapshot
+        # write: a concurrent get() of the fresh id blocks until the
+        # snapshot it restores from exists
+        with sess._state_lock:
+            with self._lock:
+                self._admit_session_locked()  # re-check: creates may race
+                new_sid = self._new_sid_locked()
+                sess.id = new_sid
+                self._sessions[new_sid] = sess
+            doc["id"] = new_sid
+            doc["name"] = sess.name
+            path = os.path.join(self.snapshot_dir(), f"{new_sid}.json")
+            write_checkpoint(doc, path)
+            sess.snapshot_path = path
+        # eager restore (outside the manager lock): the 201 response
+        # carries a live session, exactly like create()
+        return self.get(new_sid)
+
+    def delete(self, sid: str) -> None:
+        if sid == DEFAULT_SESSION_ID:
+            raise ValueError("the default session cannot be deleted")
+        with self._lock:
+            sess = self._sessions.pop(sid, None)
+            if sess is None:
+                raise UnknownSession(sid)
+            path = sess.snapshot_path
+        # purge the dead tenant's namespaced ladder state from the
+        # SHARED broker: its leftover cooldowns would otherwise keep
+        # /api/v1/readyz degraded forever (nothing re-probes a scope
+        # that can no longer issue passes)
+        self.broker.drop_scope(sid)
+        if path and os.path.exists(path):
+            os.unlink(path)
+
+    def _admit_session_locked(self) -> None:
+        if len(self._sessions) >= self.max_sessions:
+            raise SessionLimitExceeded(
+                f"session limit reached ({self.max_sessions}, "
+                f"KSS_MAX_SESSIONS); delete a session or retry later"
+            )
+
+    def _new_sid_locked(self) -> str:
+        while True:
+            sid = "s-" + secrets.token_hex(4)
+            if sid not in self._sessions:
+                return sid
+
+    # -- admission (per-request) ----------------------------------------------
+
+    def admit_pod(
+        self, service: SimulatorService, obj: dict, *, replace: bool = False
+    ) -> None:
+        """Per-session pending-pod quota, checked where pods enter the
+        store: an operation that would GROW the pending queue (a pod
+        with no spec.nodeName) past the quota is shed with the
+        structured 503 (quota 0 always passes). Growth, not shape, is
+        what admission meters: an update to an already-pending pod is
+        always allowed — a tenant at quota must still be able to label
+        or correct its own queue. `replace` marks wholesale-replace
+        semantics (item PUT), where omitting spec.nodeName UNBINDS a
+        bound pod — that transition re-enters the queue and is metered;
+        a merge-style apply onto a bound pod cannot unbind and passes."""
+        if self.pending_pod_quota <= 0:
+            return
+        if ((obj or {}).get("spec") or {}).get("nodeName"):
+            return
+        meta = (obj or {}).get("metadata") or {}
+        name = meta.get("name")
+        if name:
+            existing = service.store.get(
+                "pods", name, meta.get("namespace") or "default"
+            )
+            if existing is not None:
+                if not ((existing.get("spec") or {}).get("nodeName")):
+                    return  # already pending: the queue does not grow
+                if not replace:
+                    return  # merge keeps the existing binding: no growth
+                # replace drops the binding: bound -> pending, metered
+        pending = service.store.count_pending_pods()
+        if pending >= self.pending_pod_quota:
+            raise SessionQuotaExceeded(
+                f"pending-pod quota reached ({pending} >= "
+                f"{self.pending_pod_quota}, KSS_MAX_PENDING_PODS_PER_SESSION); "
+                f"schedule or delete pods first"
+            )
+
+    def admit_import(self, service: "SimulatorService | None", snapshot) -> None:
+        """The quota check for BULK entry points (`POST /api/v1/import`,
+        session-create snapshots): a snapshot whose pending pods would
+        push the session past the quota is shed whole, BEFORE anything
+        applies — a tenant must not smuggle an oversized queue past
+        admission in one request. `service` None = a brand-new session
+        (zero current pending). Controller-expanded pods (Deployments
+        fanning out) are deliberately exempt: they are derived objects
+        the tenant already paid quota for at the source."""
+        if self.pending_pod_quota <= 0 or not isinstance(snapshot, dict):
+            return
+        incoming = sum(
+            1
+            for p in snapshot.get("pods") or []
+            if isinstance(p, dict)
+            and not ((p.get("spec") or {}).get("nodeName"))
+        )
+        if not incoming:
+            return
+        pending = service.store.count_pending_pods() if service else 0
+        if pending + incoming > self.pending_pod_quota:
+            raise SessionQuotaExceeded(
+                f"snapshot carries {incoming} pending pods; with {pending} "
+                f"already queued that exceeds the quota "
+                f"({self.pending_pod_quota}, KSS_MAX_PENDING_PODS_PER_SESSION)"
+            )
+
+    @contextmanager
+    def pass_slot(self):
+        """One bounded concurrent-pass slot for a device-driving request
+        (schedule / lifecycle / scenario). Saturation sheds immediately
+        — a 503 the client retries beats an unbounded queue stacking up
+        behind the device."""
+        if not self._pass_sem.acquire(blocking=False):
+            raise ServerSaturated(
+                f"all {self.max_concurrent_passes} concurrent-pass slots "
+                f"are busy (KSS_MAX_CONCURRENT_PASSES); retry later"
+            )
+        try:
+            yield
+        finally:
+            self._pass_sem.release()
+
+    # -- eviction / restore ---------------------------------------------------
+
+    def snapshot_dir(self) -> str:
+        with self._lock:
+            if self._snapshot_dir is None:
+                self._snapshot_dir = tempfile.mkdtemp(prefix="kss-sessions-")
+            os.makedirs(self._snapshot_dir, exist_ok=True)
+            return self._snapshot_dir
+
+    def evict(self, sid: str) -> str:
+        """Snapshot `sid` to disk and release its in-memory state; the
+        next touch restores it. Refused for the default session and for
+        a session with a pass OR any request in flight (SessionBusy —
+        the sweeper just skips it this round); aborted, rather than
+        committed, when a request races in mid-snapshot, because the
+        document on disk may predate that request's acknowledged write."""
+        if sid == DEFAULT_SESSION_ID:
+            raise ValueError("the default session cannot be evicted")
+        with self._lock:
+            sess = self._sessions.get(sid)
+            if sess is None:
+                raise UnknownSession(sid)
+        with sess._state_lock:
+            with self._lock:
+                if self._sessions.get(sid) is not sess:
+                    raise UnknownSession(sid)  # raced with delete
+                if sess.state == "evicted":
+                    return sess.snapshot_path
+                if sess._active_requests:
+                    raise SessionBusy(
+                        f"session {sid!r} has requests in flight"
+                    )
+            # the snapshot build + disk write happen OUTSIDE the manager
+            # lock: only this session's transitions (and its passes, via
+            # the schedule lock) wait on them
+            t0 = time.monotonic()
+            lock = sess.service.scheduler._schedule_lock
+            if not lock.acquire(blocking=False):
+                raise SessionBusy(f"session {sid!r} has a pass in flight")
+            try:
+                doc = self._session_doc(sess)
+            finally:
+                lock.release()
+            path = os.path.join(self.snapshot_dir(), f"{sid}.json")
+            write_checkpoint(doc, path)
+            with self._lock:
+                if sess._active_requests or sess.last_touch >= t0:
+                    # a request routed in (or completed) while we were
+                    # snapshotting: the doc may miss its write — stay
+                    # live, leave the stale file to be overwritten
+                    raise SessionBusy(
+                        f"session {sid!r} was touched mid-snapshot"
+                    )
+                sess.snapshot_path = path
+                sess.service = None
+                sess.state = "evicted"
+                self.evictions += 1
+            return path
+
+    def _restore(self, sess: Session) -> None:
+        """Under sess._state_lock (NOT the manager lock): disk load +
+        service rebuild, then a brief manager-lock window to go live."""
+        doc = load_checkpoint(sess.snapshot_path, SESSION_CHECKPOINT_FORMAT)
+        service = self._service_from_doc(sess.id, sess, doc)
+        with self._lock:
+            sess.service = service
+            sess.state = "live"
+            sess.restores += 1
+
+    def _session_doc(self, sess: Session) -> dict:
+        """The session's checkpoint document — the PR 4 family's
+        verbatim-store shape, minus the lifecycle-run bookkeeping a
+        serving session doesn't have."""
+        svc = sess.service
+        try:
+            cfg = svc.scheduler.get_config()
+        except SchedulerServiceDisabled:
+            cfg = None
+        return {
+            "format": SESSION_CHECKPOINT_FORMAT,
+            "id": sess.id,
+            "name": sess.name,
+            "createdAt": sess.created_at,
+            "store": svc.store.dump_state(),
+            "schedulerConfig": cfg,
+            "metrics": svc.scheduler.metrics.state_dict(),
+            "passSeq": svc.scheduler._pass_seq,
+            "faultInject": sess.fault_spec,
+        }
+
+    def _service_from_doc(
+        self, sid: str, sess: Session, doc: dict
+    ) -> SimulatorService:
+        plane = (
+            faultinject.FaultPlane.parse(sess.fault_spec)
+            if sess.fault_spec
+            else None
+        )
+        service = SimulatorService(
+            broker=self.broker, session_id=sid, fault_plane=plane
+        )
+        service.store.load_state(doc["store"])
+        cfg = doc.get("schedulerConfig")
+        if cfg:
+            service.scheduler.restart(cfg)
+        service.scheduler.metrics.load_state(doc.get("metrics") or {})
+        service.scheduler._pass_seq = int(doc.get("passSeq", 0))
+        # reset() now returns to the restored state, not an empty store
+        service.store.snapshot_initial()
+        return service
+
+    def _sweep_loop(self) -> None:
+        interval = max(0.05, min(self.idle_evict_s / 4.0, 5.0))
+        while not self._stop.wait(interval):
+            now = time.monotonic()
+            with self._lock:
+                idle = [
+                    s.id
+                    for s in self._sessions.values()
+                    if s.state == "live"
+                    and s.id != DEFAULT_SESSION_ID
+                    and now - s.last_touch >= self.idle_evict_s
+                ]
+            for sid in idle:
+                try:
+                    self.evict(sid)
+                except (SessionBusy, UnknownSession):
+                    pass  # busy or raced with delete: next round
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._sweeper is not None:
+            self._sweeper.join(timeout=2)

@@ -75,9 +75,9 @@ import os
 import threading
 import time
 import weakref
-from contextlib import contextmanager
+from contextlib import contextmanager, nullcontext as _null_context
 
-from . import faultinject, telemetry
+from . import envcheck, faultinject, telemetry
 from .compilecache import enable_compile_cache, shape_bucket
 
 _log = logging.getLogger("kube_scheduler_simulator_tpu.broker")
@@ -185,6 +185,17 @@ def compile_cooldown_passes() -> int:
     return _env_number("KSS_COMPILE_COOLDOWN_PASSES", 3, int, 1)
 
 
+def cooldown_ttl_s() -> float:
+    """Wall-clock bound on how long a cooldown entry may linger UNTOUCHED
+    before it expires (KSS_COMPILE_COOLDOWN_TTL_S, default 300, 0 = never).
+    Cooldowns drain per `get_resilient` call of their own scope, so a
+    tenant that simply stops sending traffic (idle, evicted, abandoned)
+    would otherwise pin `health()` — and with it `/api/v1/readyz` — in
+    the degraded state forever. An expired entry is pruned; the scope's
+    next pass re-probes compilation exactly as a spent cooldown would."""
+    return _env_number("KSS_COMPILE_COOLDOWN_TTL_S", 300.0, float, 0.0)
+
+
 def _call_with_deadline(build, deadline_s: float):
     """Run `build()` with a watchdog: on timeout the builder thread is
     abandoned (a wedged XLA compile cannot be interrupted from Python)
@@ -218,9 +229,11 @@ def _call_with_deadline(build, deadline_s: float):
 
 def speculation_enabled_default() -> bool:
     """Speculative background compilation default: on, unless the
-    profiling kill switch KSS_NO_SPECULATIVE_COMPILE is set."""
-    return os.environ.get("KSS_NO_SPECULATIVE_COMPILE", "").lower() not in (
-        "1", "true", "yes",
+    profiling kill switch KSS_NO_SPECULATIVE_COMPILE is set (any truthy
+    spelling `envcheck` validates — the two must agree, or a 'validated'
+    kill switch silently does nothing)."""
+    return not envcheck.env_truthy(
+        os.environ.get("KSS_NO_SPECULATIVE_COMPILE")
     )
 
 
@@ -290,13 +303,30 @@ class CompileBroker:
         self._worker: "threading.Thread | None" = None
         self._busy = 0  # speculation tasks queued or running
         # degradation ladder: keys whose compile ladder is exhausted →
-        # remaining get_resilient calls served degraded without retrying
-        self._cooldown: "dict[tuple, int]" = {}
-        # watchdog-abandoned builder threads per key: while any is still
-        # alive (a truly wedged XLA compile), re-probing the key would
+        # remaining get_resilient calls served degraded without retrying.
+        # Keyed (scope, key): with a SHARED broker (the session plane),
+        # each session's ladder exhaustion cools down that session only
+        # — the bulkhead that keeps one tenant's storm from degrading
+        # its neighbors' identical-shape compiles (docs/sessions.md).
+        # Values are (remaining passes, last-touch monotonic stamp): the
+        # stamp lets health() expire entries whose scope stopped issuing
+        # passes (cooldown_ttl_s) instead of reporting not-ready forever
+        self._cooldown: "dict[tuple, tuple[int, float]]" = {}
+        # watchdog-abandoned builder threads per (scope, key): while any
+        # is still alive (a truly wedged XLA compile), re-probing would
         # leak ANOTHER stuck thread every cooldown cycle — the probe is
         # refused instead, bounding the leak at one batch per key
         self._abandoned: "dict[tuple, list[threading.Thread]]" = {}
+        # per-key engine leases: warm engines are STATEFUL (retarget
+        # mutates them), so callers sharing this broker across services
+        # hold the key's lease for the whole dispatch→finish window of a
+        # pass (server/service.py). Bounded by shape diversity, like the
+        # warm map's keyspace.
+        self._leases: "dict[tuple, threading.RLock]" = {}
+        # speculative crashes drawn from a SESSION-scoped fault plane,
+        # per scope: contained (worker survives, health stays ready) but
+        # visible — one tenant's chaos must not read as replica sickness
+        self._scoped_crashes: "dict[object, int]" = {}
         self._crash_logged = False
         # local counters (mirrored into self.metrics when present)
         self.compile_hits = 0
@@ -311,8 +341,11 @@ class CompileBroker:
 
     def _note(
         self, hits=0, misses=0, speculative=0, stall_s=0.0,
-        retries=0, worker_crashes=0,
+        retries=0, worker_crashes=0, metrics=None,
     ) -> None:
+        """Count into the broker-local aggregates, mirroring into
+        `metrics` when given (per-session attribution on a shared
+        broker) or `self.metrics` otherwise."""
         with self._lock:
             self.compile_hits += hits
             self.compile_misses += misses
@@ -320,14 +353,15 @@ class CompileBroker:
             self.stall_seconds += stall_s
             self.compile_retries += retries
             self.worker_crashes += worker_crashes
-        if self.metrics is not None:
+        sink = metrics if metrics is not None else self.metrics
+        if sink is not None:
             if hits or misses or speculative or stall_s:
-                self.metrics.record_compile(
+                sink.record_compile(
                     hits=hits, misses=misses, speculative=speculative,
                     stall_s=stall_s,
                 )
             if retries or worker_crashes:
-                self.metrics.record_resilience(
+                sink.record_resilience(
                     retries=retries, worker_crashes=worker_crashes
                 )
 
@@ -340,7 +374,69 @@ class CompileBroker:
                 "stallSeconds": round(self.stall_seconds, 6),
                 "compileRetries": self.compile_retries,
                 "brokerWorkerCrashes": self.worker_crashes,
+                "scopedWorkerCrashes": sum(self._scoped_crashes.values()),
             }
+
+    @staticmethod
+    def _cooldown_expired(entry: "tuple[int, float]") -> bool:
+        ttl = cooldown_ttl_s()
+        return ttl > 0 and (time.monotonic() - entry[1]) > ttl
+
+    def _prune_cooldowns_locked(self) -> None:
+        """Under self._lock: drop cooldown entries untouched past the
+        TTL — their scope stopped issuing passes, so nothing else would
+        ever drain them (the next pass of that scope, if one ever comes,
+        re-probes compilation like a spent cooldown)."""
+        for ck in [
+            k for k, e in self._cooldown.items() if self._cooldown_expired(e)
+        ]:
+            del self._cooldown[ck]
+
+    def health(self) -> dict:
+        """The readiness view (`GET /api/v1/readyz`): a broker with any
+        key in an active compile cooldown, or whose speculative worker
+        has crashed (speculation self-disabled), is DEGRADED — an
+        external load balancer should drain the replica rather than
+        route fresh tenants at a sick compile plane. Stale cooldowns
+        (scope went quiet, `cooldown_ttl_s`) are pruned first: an idle or
+        evicted tenant's exhausted ladder must not drain the replica
+        forever."""
+        with self._lock:
+            self._prune_cooldowns_locked()
+            cooling = len(self._cooldown)
+            stuck = sum(
+                1
+                for threads in self._abandoned.values()
+                if any(t.is_alive() for t in threads)
+            )
+            return {
+                "cooldownKeys": cooling,
+                "stuckCompiles": stuck,
+                "workerCrashed": self.worker_crashes > 0,
+                "speculative": self.speculative,
+                "warmEngines": len(self._engines),
+            }
+
+    def drop_scope(self, scope) -> None:
+        """Purge a deleted session's namespaced ladder state so a dead
+        tenant cannot keep health() degraded forever: its cooldown
+        entries, its per-scope crash tally, and its dead
+        abandoned-builder bookkeeping. A STILL-ALIVE wedged builder
+        thread stays visible — that compile is burning a real CPU
+        whatever happened to the tenant that started it (health()
+        self-clears when the thread finally dies)."""
+        with self._lock:
+            for ck in [k for k in self._cooldown if k[0] == scope]:
+                del self._cooldown[ck]
+            self._scoped_crashes.pop(scope, None)
+            for ck in list(self._abandoned):
+                if ck[0] != scope:
+                    continue
+                alive = [t for t in self._abandoned[ck] if t.is_alive()]
+                if alive:
+                    self._abandoned[ck] = alive
+                else:
+                    del self._abandoned[ck]
 
     # -- warm-engine map ----------------------------------------------------
 
@@ -348,14 +444,36 @@ class CompileBroker:
         self._engines.pop(key, None)
         self._engines[key] = engine
         while len(self._engines) > self.capacity:
-            self._engines.pop(next(iter(self._engines)))
+            old = next(iter(self._engines))
+            self._engines.pop(old)
+            # retire the evicted key's lease with it (unless a pass is
+            # mid-flight holding it — then the entry stays until the key
+            # is rebuilt and evicted again), keeping _leases bounded by
+            # the warm map's keyspace instead of lifetime shape diversity
+            lk = self._leases.get(old)
+            if lk is not None and lk.acquire(blocking=False):
+                lk.release()
+                del self._leases[old]
 
     def peek(self, key: tuple):
         """The cached engine for `key` (no build, no counters), or None."""
         with self._lock:
             return self._engines.get(key)
 
-    def get(self, key: tuple, build, info: "dict | None" = None):
+    def lease(self, key: tuple) -> "threading.RLock":
+        """The per-key engine lease. Warm engines are stateful (`retarget`
+        mutates them in place), so when several services share one broker
+        (the session plane), each holds the key's lease across its pass's
+        dispatch→finish window — two bucket-compatible tenants share the
+        executable, never a concurrent mutation of it. Re-entrant, so a
+        single-service broker's uncontended pass costs one lock probe."""
+        with self._lock:
+            lk = self._leases.get(key)
+            if lk is None:
+                lk = self._leases[key] = threading.RLock()
+            return lk
+
+    def get(self, key: tuple, build, info: "dict | None" = None, metrics=None):
         """The engine for `key`: warm from the map (hit), shared from an
         in-flight build (hit + stall), or built by THIS caller via
         `build()` (miss + stall). `build` must return the engine fully
@@ -383,7 +501,7 @@ class CompileBroker:
             if mine is None:
                 if info is not None:
                     info.update(source="hit", wait_s=0.0)
-                self._note(hits=1)
+                self._note(hits=1, metrics=metrics)
                 return eng
             if mine:
                 t0 = time.perf_counter()
@@ -402,7 +520,9 @@ class CompileBroker:
                 fl.ev.set()
                 if info is not None:
                     info.update(source="miss", wait_s=0.0)
-                self._note(misses=1, stall_s=time.perf_counter() - t0)
+                self._note(
+                    misses=1, stall_s=time.perf_counter() - t0, metrics=metrics
+                )
                 return eng
             # someone else (request thread or speculation worker) is
             # compiling this key: wait and share — no second compile
@@ -413,7 +533,7 @@ class CompileBroker:
                 wait_s = time.perf_counter() - t0
                 if info is not None:
                     info.update(source="wait", wait_s=wait_s)
-                self._note(hits=1, stall_s=wait_s)
+                self._note(hits=1, stall_s=wait_s, metrics=metrics)
                 return fl.engine
             # the builder failed; loop — this caller may build it now
 
@@ -433,7 +553,15 @@ class CompileBroker:
 
         return _call_with_deadline(attempt, compile_deadline_s())
 
-    def get_resilient(self, key: tuple, build, info: "dict | None" = None):
+    def get_resilient(
+        self,
+        key: tuple,
+        build,
+        info: "dict | None" = None,
+        *,
+        metrics=None,
+        scope=None,
+    ):
         """`get` under run supervision — the serving path's entry point
         (docs/resilience.md). Semantics on top of `get`:
 
@@ -449,31 +577,48 @@ class CompileBroker:
             (`eager_execution`). A speculative background build landing
             the key warm ends the cooldown early.
 
-        Without a deadline, retries, faults, or failures this is exactly
-        `get` (same dedupe, same counters)."""
+        `metrics` attributes the hit/miss/stall/retry counters to the
+        calling service's registry (defaults to the broker's own);
+        `scope` namespaces the cooldown + abandoned-builder state — on a
+        SHARED broker each session's ladder exhaustion degrades that
+        session only (the bulkhead, docs/sessions.md), while the warm
+        map and in-flight dedupe stay cross-scope (the shared-executable
+        win). Without a deadline, retries, faults, or failures this is
+        exactly `get` (same dedupe, same counters)."""
+        ck = (scope, key)
         while True:
             cooled = False
             with self._lock:
                 eng = self._engines.get(key)
                 if eng is not None:
                     self._engines[key] = self._engines.pop(key)  # recency
-                    self._cooldown.pop(key, None)  # warm ends the cooldown
+                    self._cooldown.pop(ck, None)  # warm ends the cooldown
                     mine = None
                 else:
-                    remaining = self._cooldown.get(key, 0)
-                    if remaining > 0:
+                    entry = self._cooldown.get(ck)
+                    if entry is not None and self._cooldown_expired(entry):
+                        # untouched past the TTL: expire — this scope's
+                        # return after a quiet spell re-probes compile
+                        del self._cooldown[ck]
+                        entry = None
+                    if entry is not None:
+                        remaining = entry[0]
                         if remaining > 1:
-                            self._cooldown[key] = remaining - 1
+                            self._cooldown[ck] = (
+                                remaining - 1, time.monotonic()
+                            )
                         else:
                             # cooldown spent: the NEXT call re-probes
-                            self._cooldown.pop(key, None)
+                            self._cooldown.pop(ck, None)
                         cooled = True
                         mine = False
-                    elif self._stuck_locked(key):
+                    elif self._stuck_locked(ck):
                         # an abandoned builder is STILL inside XLA: a
                         # re-probe would leak another thread — stay
                         # degraded until the stuck compile dies
-                        self._cooldown[key] = compile_cooldown_passes()
+                        self._cooldown[ck] = (
+                            compile_cooldown_passes(), time.monotonic()
+                        )
                         cooled = True
                         mine = False
                     else:
@@ -487,7 +632,7 @@ class CompileBroker:
             if mine is None:
                 if info is not None:
                     info.update(source="hit", wait_s=0.0)
-                self._note(hits=1)
+                self._note(hits=1, metrics=metrics)
                 return eng
             if cooled:
                 raise CompileUnavailable(
@@ -495,7 +640,9 @@ class CompileBroker:
                     f"exhaustion; serve degraded"
                 )
             if mine:
-                return self._build_resilient(key, fl, build, info)
+                return self._build_resilient(
+                    key, fl, build, info, metrics=metrics, ck=ck
+                )
             # share someone else's in-flight build, like `get`
             t0 = time.perf_counter()
             with telemetry.span("compile.wait", key=str(key)):
@@ -504,23 +651,28 @@ class CompileBroker:
                 wait_s = time.perf_counter() - t0
                 if info is not None:
                     info.update(source="wait", wait_s=wait_s)
-                self._note(hits=1, stall_s=wait_s)
+                self._note(hits=1, stall_s=wait_s, metrics=metrics)
                 return fl.engine
             # builder failed: loop — the cooldown it set (or a free
             # slot) decides this caller's fate
 
-    def _stuck_locked(self, key: tuple) -> bool:
-        """Under self._lock: prune dead abandoned builders for `key`;
-        True when one is still running (the wedged compile persists)."""
-        alive = [t for t in self._abandoned.get(key, ()) if t.is_alive()]
+    def _stuck_locked(self, ck: tuple) -> bool:
+        """Under self._lock: prune dead abandoned builders for the
+        (scope, key) pair; True when one is still running (the wedged
+        compile persists)."""
+        alive = [t for t in self._abandoned.get(ck, ()) if t.is_alive()]
         if alive:
-            self._abandoned[key] = alive
+            self._abandoned[ck] = alive
             return True
-        self._abandoned.pop(key, None)
+        self._abandoned.pop(ck, None)
         return False
 
-    def _build_resilient(self, key: tuple, fl: _Inflight, build, info):
+    def _build_resilient(
+        self, key: tuple, fl: _Inflight, build, info, metrics=None, ck=None
+    ):
         """The retry ladder for the caller that owns the in-flight slot."""
+        if ck is None:
+            ck = (None, key)
         t0 = time.perf_counter()
         attempts = 1 + compile_retry_limit()
         backoff = compile_backoff_s()
@@ -529,7 +681,7 @@ class CompileBroker:
         try:
             for i in range(attempts):
                 if i:
-                    self._note(retries=1)
+                    self._note(retries=1, metrics=metrics)
                     telemetry.instant(
                         "compile.retry", key=str(key), attempt=i + 1
                     )
@@ -546,7 +698,7 @@ class CompileBroker:
                     th = getattr(e, "thread", None)
                     if th is not None:
                         with self._lock:
-                            self._abandoned.setdefault(key, []).append(th)
+                            self._abandoned.setdefault(ck, []).append(th)
                         telemetry.instant(
                             "compile.deadline_abandoned", key=str(key)
                         )
@@ -560,9 +712,11 @@ class CompileBroker:
         if eng is None:
             with self._lock:
                 self._inflight.pop(key, None)
-                self._cooldown[key] = compile_cooldown_passes()
+                self._cooldown[ck] = (
+                    compile_cooldown_passes(), time.monotonic()
+                )
             fl.ev.set()  # engine stays None: waiters re-enter the ladder
-            self._note(stall_s=time.perf_counter() - t0)
+            self._note(stall_s=time.perf_counter() - t0, metrics=metrics)
             telemetry.instant("compile.ladder_exhausted", key=str(key))
             raise CompileUnavailable(
                 f"compile ladder exhausted for {key!r} after {attempts} "
@@ -575,29 +729,37 @@ class CompileBroker:
         fl.ev.set()
         if info is not None:
             info.update(source="miss", wait_s=0.0)
-        self._note(misses=1, stall_s=time.perf_counter() - t0)
+        self._note(misses=1, stall_s=time.perf_counter() - t0, metrics=metrics)
         return eng
 
     # -- speculation --------------------------------------------------------
 
-    def speculate(self, token, task) -> bool:
+    def speculate(self, token, task, metrics=None) -> bool:
         """Queue `task` for the background worker. `task()` runs off the
         request thread and returns ``(key, build)`` — or None to skip —
         after which the worker builds and stores the engine (skipping
         keys already warm or in flight). `token` dedupes while the task
         is queued/running. Returns False when speculation is disabled or
-        the token is already pending."""
+        the token is already pending. `metrics` attributes the eventual
+        speculativeCompiles count to the ARMING service's registry (on a
+        shared broker, the session that armed the build)."""
         if not self.speculative:
             return False
-        # the causal pass id of the ARMING request thread travels with
-        # the task: the worker re-enters it, so a speculative build's
-        # telemetry spans name the pass that armed it (utils/telemetry.py)
+        # the causal pass id + session of the ARMING request thread (and
+        # its thread-locally scoped fault plane, the session bulkhead)
+        # travel with the task: the worker re-enters all three, so a
+        # speculative build's telemetry spans name the pass/session that
+        # armed it and its faults draw from the arming session's plane
         armed_by = telemetry.current_pass_id()
+        armed_session = telemetry.current_session_id()
+        armed_plane = faultinject.scoped_active()
         with self._lock:
             if token in self._tokens:
                 return False
             self._tokens.add(token)
-            self._tasks.append((token, task, armed_by))
+            self._tasks.append(
+                (token, task, armed_by, armed_session, armed_plane, metrics)
+            )
             self._busy += 1
             if self._worker is None:
                 self._worker = threading.Thread(
@@ -612,9 +774,19 @@ class CompileBroker:
                 if not self._tasks:
                     self._worker = None
                     return
-                token, task, armed_by = self._tasks.pop(0)
+                (
+                    token, task, armed_by, armed_session, armed_plane,
+                    armed_metrics,
+                ) = self._tasks.pop(0)
             try:
-                with telemetry.pass_context(armed_by), telemetry.span(
+                scope = (
+                    faultinject.scoped(armed_plane)
+                    if armed_plane is not None
+                    else _null_context()
+                )
+                with scope, telemetry.pass_context(
+                    armed_by
+                ), telemetry.session_context(armed_session), telemetry.span(
                     "compile.speculative", token=str(token)
                 ):
                     plane = faultinject.active()
@@ -623,9 +795,17 @@ class CompileBroker:
                     res = task()
                     if res is not None:
                         key, build = res
-                        self._background_build(key, build)
+                        self._background_build(key, build, metrics=armed_metrics)
             except BaseException as e:  # noqa: BLE001 — speculation never fails a run
-                self._contain_worker_crash(e)
+                if armed_plane is not None:
+                    # the crash came from a SESSION-scoped fault plane
+                    # (the arming tenant's private chaos spec): contain
+                    # it to that tenant — the shared worker stays up and
+                    # the broker stays ready for every other session
+                    # (docs/sessions.md bulkheads)
+                    self._contain_scoped_crash(e, armed_session)
+                else:
+                    self._contain_worker_crash(e)
             finally:
                 with self._lock:
                     self._tokens.discard(token)
@@ -648,7 +828,24 @@ class CompileBroker:
         self.speculative = False
         self._note(worker_crashes=1)
 
-    def _background_build(self, key: tuple, build) -> None:
+    def _contain_scoped_crash(self, exc: BaseException, scope) -> None:
+        """A speculative task crashed under a SESSION's private fault
+        plane: counted per scope (visible in stats), logged once per
+        scope, but the shared worker keeps running, broker-level
+        `worker_crashes` stays 0, and health() stays ready — one
+        tenant's chaos spec must not drain the replica or cost its
+        neighbors speculation."""
+        with self._lock:
+            first = scope not in self._scoped_crashes
+            self._scoped_crashes[scope] = self._scoped_crashes.get(scope, 0) + 1
+        if first:
+            _log.warning(
+                "speculative build crashed under session %r's fault plane "
+                "(%s: %s); contained to that session",
+                scope, type(exc).__name__, exc,
+            )
+
+    def _background_build(self, key: tuple, build, metrics=None) -> None:
         with self._lock:
             if key in self._engines or key in self._inflight:
                 return  # already warm / being compiled — nothing to do
@@ -673,7 +870,7 @@ class CompileBroker:
             self._inflight.pop(key, None)
         fl.engine = eng
         fl.ev.set()
-        self._note(speculative=1)
+        self._note(speculative=1, metrics=metrics)
 
     def drain(self, timeout: "float | None" = None) -> bool:
         """Block until the speculation queue is empty and no task is

@@ -1,0 +1,145 @@
+"""Strict startup validation of the KSS_* environment surface.
+
+The serving-stack knobs are deliberately LENIENT at their point of use —
+a malformed `KSS_ENCODING_CACHE_CAP` must not take a long-lived library
+caller down, so the runtime parsers fall back to defaults (or, for the
+fault plane, raise at the first fire point deep inside a request
+handler). That leniency is exactly wrong at process startup: an operator
+who typo'd a knob should learn it from a clear boot-time error, not from
+a silently-defaulted cache size or a 500 mid-request. The entry points
+(`python -m ...server`, `python -m ...lifecycle`) call `fail_fast()`
+before doing anything else.
+
+The registry below is the single catalogue of KSS_* variables
+(docs/environment-variables.md mirrors it); unknown `KSS_`-prefixed
+names are flagged too, catching the `KSS_ENCODNG_CACHE_CAP` class of
+typo that otherwise configures nothing.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# The ONE boolean vocabulary: every spelling `check_env` accepts is a
+# spelling the runtime parsers honor (broker speculation kill switch,
+# telemetry KSS_TRACE). Validation blessing a value the runtime would
+# silently ignore is exactly the misconfiguration class this module
+# exists to catch.
+TRUTHY = ("1", "true", "yes", "on", "t")
+FALSY = ("", "0", "false", "no", "off", "f")
+_BOOLISH = TRUTHY + FALSY
+
+
+def env_truthy(raw: "str | None") -> bool:
+    """Shared boolean env parse: True for any TRUTHY spelling (case- and
+    whitespace-insensitive), False otherwise."""
+    return (raw or "").strip().lower() in TRUTHY
+
+
+def _int_validator(minimum: "int | None" = None):
+    def check(raw: str) -> "str | None":
+        try:
+            v = int(raw)
+        except ValueError:
+            return f"expected an integer, got {raw!r}"
+        if minimum is not None and v < minimum:
+            return f"must be >= {minimum}, got {v}"
+        return None
+
+    return check
+
+
+def _float_validator(minimum: "float | None" = None):
+    def check(raw: str) -> "str | None":
+        try:
+            v = float(raw)
+        except ValueError:
+            return f"expected a number, got {raw!r}"
+        if minimum is not None and v < minimum:
+            return f"must be >= {minimum}, got {v}"
+        return None
+
+    return check
+
+
+def _bool_validator(raw: str) -> "str | None":
+    if raw.strip().lower() not in _BOOLISH:
+        return f"expected a boolean (0/1/true/false/yes/no/on/off), got {raw!r}"
+    return None
+
+
+def _fault_spec_validator(raw: str) -> "str | None":
+    from . import faultinject
+
+    try:
+        faultinject.FaultPlane.parse(raw)
+    except ValueError as e:
+        return str(e)
+    return None
+
+
+def _path_validator(raw: str) -> "str | None":
+    return None  # any string is a path; existence is created on demand
+
+
+# name -> validator(raw) returning an error string or None. The ONE
+# catalogue of KSS_* configuration (docs/environment-variables.md).
+KNOWN = {
+    # serving stack
+    "KSS_ENCODING_CACHE_CAP": _int_validator(1),
+    "KSS_NO_SPECULATIVE_COMPILE": _bool_validator,
+    "KSS_JAX_CACHE_DIR": _path_validator,
+    # telemetry plane
+    "KSS_TRACE": _bool_validator,
+    "KSS_TRACE_RING_CAP": _int_validator(1),
+    # run supervision
+    "KSS_COMPILE_DEADLINE_S": _float_validator(0.0),
+    "KSS_COMPILE_RETRIES": _int_validator(0),
+    "KSS_COMPILE_BACKOFF_S": _float_validator(0.0),
+    "KSS_COMPILE_COOLDOWN_PASSES": _int_validator(1),
+    "KSS_COMPILE_COOLDOWN_TTL_S": _float_validator(0.0),
+    "KSS_FAULT_INJECT": _fault_spec_validator,
+    "KSS_FAULT_INJECT_SEED": _int_validator(),
+    # session plane (docs/sessions.md)
+    "KSS_MAX_SESSIONS": _int_validator(1),
+    "KSS_MAX_PENDING_PODS_PER_SESSION": _int_validator(0),
+    "KSS_MAX_CONCURRENT_PASSES": _int_validator(1),
+    "KSS_SESSION_IDLE_EVICT_S": _float_validator(0.0),
+    "KSS_SESSION_DIR": _path_validator,
+    "KSS_SSE_MAX_SUBSCRIBERS": _int_validator(1),
+}
+
+
+def check_env(env: "dict | None" = None) -> list[str]:
+    """Validate every KSS_* variable in `env` (default: os.environ).
+    Returns a list of human-readable problems — empty means the
+    environment parses cleanly. Unset variables are never errors."""
+    env = os.environ if env is None else env
+    problems: list[str] = []
+    for name, validator in KNOWN.items():
+        raw = env.get(name)
+        if raw is None or raw == "":
+            continue
+        err = validator(raw)
+        if err:
+            problems.append(f"{name}={raw!r}: {err}")
+    for name in sorted(env):
+        if name.startswith("KSS_") and name not in KNOWN:
+            problems.append(
+                f"{name}: unknown KSS_* variable (typo? see "
+                f"docs/environment-variables.md)"
+            )
+    return problems
+
+
+def fail_fast(env: "dict | None" = None) -> None:
+    """Entry-point gate: print every env problem and exit 2. A clear
+    refusal at boot beats a silently-defaulted knob or a ValueError deep
+    inside the first request handler."""
+    problems = check_env(env)
+    if not problems:
+        return
+    for p in problems:
+        print(f"environment: {p}", file=sys.stderr)
+    raise SystemExit(2)

@@ -175,6 +175,44 @@ def _parse_duration_s(site: str, raw: str) -> float:
 
 # -- the process-global active plane ----------------------------------------
 
+# Thread-local scoped plane (the multi-tenant bulkhead, docs/sessions.md):
+# a session created with its own fault spec enters `scoped(plane)` for the
+# duration of each of its passes, so its storm fires on ITS request thread
+# only — neighbors (and the env-configured plane) are untouched. The
+# broker's speculative worker re-enters the arming thread's scope so a
+# session's background builds draw from the same plane.
+_tls = threading.local()
+
+
+class scoped:
+    """Make `plane` the active plane on THIS thread for the block,
+    shadowing the env-configured (or `activate`d) process plane. Nests;
+    restores the previous scope on exit."""
+
+    __slots__ = ("_plane", "_prev")
+
+    def __init__(self, plane: "FaultPlane | None"):
+        self._plane = plane
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "scope", None)
+        _tls.scope = (self._plane,)
+        return self
+
+    def __exit__(self, *exc):
+        _tls.scope = self._prev
+        return False
+
+
+def scoped_active() -> "FaultPlane | None":
+    """The thread-locally scoped plane, or None when this thread is not
+    inside `scoped` (callers that capture a scope to re-enter on a
+    worker thread — CompileBroker.speculate)."""
+    sc = getattr(_tls, "scope", None)
+    return sc[0] if sc is not None else None
+
+
 _lock = threading.Lock()
 # (raw env string, seed string) -> plane parsed from them; an explicit
 # `activate` overrides the environment until `deactivate`
@@ -191,8 +229,14 @@ def active() -> "FaultPlane | None":
     and dispatch paths. A malformed env spec raises here — at the first
     fire point — rather than being silently ignored: a fault-injection
     run that injects nothing is the worst failure mode this module has.
+
+    A thread-local `scoped` plane (the session bulkhead) shadows both
+    the override and the environment on its thread.
     """
     global _cached
+    sc = getattr(_tls, "scope", None)
+    if sc is not None:
+        return sc[0]
     with _lock:
         if _overridden:
             return _override

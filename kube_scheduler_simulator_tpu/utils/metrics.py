@@ -548,13 +548,44 @@ def render_prometheus(snapshot: dict, extra_gauges: "dict | None" = None) -> str
     gauges, and the histogram families, with stable metric names.
     `extra_gauges` maps metric name -> (help, value) for serving-stack
     extras (the encoding-cache capacity)."""
+    return _render_prometheus([({}, snapshot, extra_gauges)])
+
+
+def render_prometheus_sessions(
+    entries: "list[tuple[dict, dict, dict | None]]",
+    global_counters: "dict | None" = None,
+    global_gauges: "dict | None" = None,
+) -> str:
+    """Multi-tenant exposition (docs/sessions.md): one document, each
+    family declared ONCE, every sample labeled per entry. `entries` is
+    ``[(labels, snapshot, extra_gauges), ...]`` — the session plane
+    passes ``{"session": id}`` labels so one scrape covers every tenant.
+    `global_counters`/`global_gauges` map name -> (help, value) for
+    server-wide unlabeled extras (the SSE drop counter, session counts)."""
+    return _render_prometheus(
+        entries, global_counters=global_counters, global_gauges=global_gauges
+    )
+
+
+def _label_body(labels: dict, extra: "tuple | None" = None) -> str:
+    items = list(labels.items()) + list(extra or ())
+    if not items:
+        return ""
+    return "{" + ",".join(f'{k}="{v}"' for k, v in items) + "}"
+
+
+def _render_prometheus(
+    entries,
+    global_counters: "dict | None" = None,
+    global_gauges: "dict | None" = None,
+) -> str:
     lines: list[str] = []
 
     def family(name: str, mtype: str, help_text: str) -> None:
         lines.append(f"# HELP {name} {help_text}")
         lines.append(f"# TYPE {name} {mtype}")
 
-    def walk(path: tuple):
+    def walk(snapshot: dict, path: tuple):
         v = snapshot
         for p in path:
             v = v.get(p, 0) if isinstance(v, dict) else 0
@@ -562,60 +593,105 @@ def render_prometheus(snapshot: dict, extra_gauges: "dict | None" = None) -> str
 
     for name, help_text, path in _PROM_COUNTERS:
         family(name, "counter", help_text)
-        lines.append(f"{name} {_fmt_value(walk(path))}")
+        for labels, snapshot, _extra in entries:
+            lines.append(
+                f"{name}{_label_body(labels)} "
+                f"{_fmt_value(walk(snapshot, path))}"
+            )
 
-    phases = snapshot.get("phases", {})
     family(
         "kss_encodes_total",
         "counter",
         "Cluster encodes by the path that served them.",
     )
-    for mode, key in (
-        ("delta", "deltaEncodes"),
-        ("full", "fullEncodes"),
-        ("cached", "cachedEncodes"),
-        ("empty", "emptyEncodes"),
-    ):
-        lines.append(
-            f'kss_encodes_total{{mode="{mode}"}} '
-            f"{_fmt_value(phases.get(key, 0))}"
-        )
+    for labels, snapshot, _extra in entries:
+        phases = snapshot.get("phases", {})
+        for mode, key in (
+            ("delta", "deltaEncodes"),
+            ("full", "fullEncodes"),
+            ("cached", "cachedEncodes"),
+            ("empty", "emptyEncodes"),
+        ):
+            lines.append(
+                f"kss_encodes_total{_label_body(labels, (('mode', mode),))} "
+                f"{_fmt_value(phases.get(key, 0))}"
+            )
     family(
         "kss_phase_seconds_total",
         "counter",
         "Pass wall-clock by phase (encode/compile/execute/decode).",
     )
-    for phase in ("encode", "compile", "execute", "decode"):
-        lines.append(
-            f'kss_phase_seconds_total{{phase="{phase}"}} '
-            f"{_fmt_value(phases.get(phase + 'Seconds', 0.0))}"
-        )
+    for labels, snapshot, _extra in entries:
+        phases = snapshot.get("phases", {})
+        for phase in ("encode", "compile", "execute", "decode"):
+            lines.append(
+                f"kss_phase_seconds_total"
+                f"{_label_body(labels, (('phase', phase),))} "
+                f"{_fmt_value(phases.get(phase + 'Seconds', 0.0))}"
+            )
 
     family("kss_uptime_seconds", "gauge", "Seconds since this registry was born.")
-    lines.append(f"kss_uptime_seconds {_fmt_value(snapshot.get('uptimeSeconds', 0.0))}")
+    for labels, snapshot, _extra in entries:
+        lines.append(
+            f"kss_uptime_seconds{_label_body(labels)} "
+            f"{_fmt_value(snapshot.get('uptimeSeconds', 0.0))}"
+        )
     family(
         "kss_metrics_schema_version",
         "gauge",
         "Schema version of the /api/v1/metrics JSON document.",
     )
-    lines.append(
-        "kss_metrics_schema_version "
-        f"{_fmt_value(snapshot.get('schemaVersion', METRICS_SCHEMA_VERSION))}"
-    )
-    for name, (help_text, value) in (extra_gauges or {}).items():
+    for labels, snapshot, _extra in entries:
+        lines.append(
+            f"kss_metrics_schema_version{_label_body(labels)} "
+            f"{_fmt_value(snapshot.get('schemaVersion', METRICS_SCHEMA_VERSION))}"
+        )
+    # per-entry extra gauges: each family declared once (help from the
+    # first entry carrying it), then one labeled sample per entry
+    extra_names: list[str] = []
+    for _labels, _snapshot, extra in entries:
+        for name in extra or ():
+            if name not in extra_names:
+                extra_names.append(name)
+    for name in extra_names:
+        help_text = next(
+            extra[name][0]
+            for _l, _s, extra in entries
+            if extra and name in extra
+        )
+        family(name, "gauge", help_text)
+        for labels, _snapshot, extra in entries:
+            if extra and name in extra:
+                lines.append(
+                    f"{name}{_label_body(labels)} "
+                    f"{_fmt_value(extra[name][1])}"
+                )
+    for name, (help_text, value) in (global_counters or {}).items():
+        family(name, "counter", help_text)
+        lines.append(f"{name} {_fmt_value(value)}")
+    for name, (help_text, value) in (global_gauges or {}).items():
         family(name, "gauge", help_text)
         lines.append(f"{name} {_fmt_value(value)}")
 
-    hists = snapshot.get("histograms", {})
     for key, name, _, help_text in HISTOGRAM_FAMILIES:
-        h = hists.get(key)
-        if not h:
+        carrying = [
+            (labels, snapshot.get("histograms", {}).get(key))
+            for labels, snapshot, _extra in entries
+        ]
+        carrying = [(labels, h) for labels, h in carrying if h]
+        if not carrying:
             continue
         family(name, "histogram", help_text)
-        for le, cum in h["buckets"].items():
-            lines.append(f'{name}_bucket{{le="{le}"}} {_fmt_value(cum)}')
-        lines.append(f"{name}_sum {_fmt_value(h['sum'])}")
-        lines.append(f"{name}_count {_fmt_value(h['count'])}")
+        for labels, h in carrying:
+            for le, cum in h["buckets"].items():
+                lines.append(
+                    f"{name}_bucket{_label_body(labels, (('le', le),))} "
+                    f"{_fmt_value(cum)}"
+                )
+            lines.append(f"{name}_sum{_label_body(labels)} {_fmt_value(h['sum'])}")
+            lines.append(
+                f"{name}_count{_label_body(labels)} {_fmt_value(h['count'])}"
+            )
     return "\n".join(lines) + "\n"
 
 
@@ -703,34 +779,47 @@ def parse_prometheus_text(text: str) -> dict:
         fam["samples"].append((name, labels, value))
 
     # histogram semantics: cumulative monotone buckets, +Inf last and
-    # equal to _count
+    # equal to _count — validated PER LABEL SET (minus `le`), so a
+    # multi-session exposition (one series per `session` label,
+    # docs/sessions.md) checks each tenant's distribution independently
     for fam_name, fam in families.items():
         if fam["type"] != "histogram":
             continue
-        buckets = [
-            (labels.get("le"), value)
-            for name, labels, value in fam["samples"]
-            if name == fam_name + "_bucket"
-        ]
-        counts = [
-            value for name, _, value in fam["samples"] if name == fam_name + "_count"
-        ]
-        if not buckets or not counts:
-            raise ValueError(f"histogram {fam_name}: missing buckets or _count")
-        if buckets[-1][0] != "+Inf":
-            raise ValueError(f"histogram {fam_name}: +Inf bucket not last")
-        prev = -1.0
-        for le, cum in buckets:
-            if cum < prev:
-                raise ValueError(
-                    f"histogram {fam_name}: non-monotonic bucket le={le}"
-                )
-            prev = cum
-        if buckets[-1][1] != counts[0]:
-            raise ValueError(
-                f"histogram {fam_name}: +Inf bucket {buckets[-1][1]} != "
-                f"_count {counts[0]}"
+        groups: dict = {}
+
+        def series_of(labels: dict) -> tuple:
+            return tuple(
+                sorted((k, v) for k, v in labels.items() if k != "le")
             )
+
+        for name, labels, value in fam["samples"]:
+            g = groups.setdefault(
+                series_of(labels), {"buckets": [], "counts": []}
+            )
+            if name == fam_name + "_bucket":
+                g["buckets"].append((labels.get("le"), value))
+            elif name == fam_name + "_count":
+                g["counts"].append(value)
+        for g in groups.values():
+            buckets, counts = g["buckets"], g["counts"]
+            if not buckets or not counts:
+                raise ValueError(
+                    f"histogram {fam_name}: missing buckets or _count"
+                )
+            if buckets[-1][0] != "+Inf":
+                raise ValueError(f"histogram {fam_name}: +Inf bucket not last")
+            prev = -1.0
+            for le, cum in buckets:
+                if cum < prev:
+                    raise ValueError(
+                        f"histogram {fam_name}: non-monotonic bucket le={le}"
+                    )
+                prev = cum
+            if buckets[-1][1] != counts[0]:
+                raise ValueError(
+                    f"histogram {fam_name}: +Inf bucket {buckets[-1][1]} != "
+                    f"_count {counts[0]}"
+                )
     return families
 
 

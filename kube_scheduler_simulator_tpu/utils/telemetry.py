@@ -64,7 +64,10 @@ DEFAULT_RING_CAP = 65536
 # CPython object addresses and never 0, so 0 is collision-free.
 DEVICE_TID = 0
 
-_TRUE = ("1", "true", "yes", "on")
+# the shared boolean vocabulary (envcheck.TRUTHY): KSS_TRACE honors
+# every spelling startup validation accepts — a 'validated' tracing run
+# must never silently record nothing
+from .envcheck import TRUTHY as _TRUE
 
 _PID = os.getpid()
 
@@ -219,7 +222,7 @@ def deactivate() -> None:
         _override_state = (False, None)
 
 
-# -- pass-id causality --------------------------------------------------------
+# -- pass-id / session causality ----------------------------------------------
 
 _ctx = threading.local()
 
@@ -227,6 +230,35 @@ _ctx = threading.local()
 def current_pass_id() -> "int | None":
     """The pass id of the innermost `pass_context` on this thread."""
     return getattr(_ctx, "pass_id", None)
+
+
+def current_session_id() -> "str | None":
+    """The session id of the innermost `session_context` on this thread
+    (the multi-tenant session plane, docs/sessions.md)."""
+    return getattr(_ctx, "session_id", None)
+
+
+class session_context:
+    """Thread-local session causality: spans/instants emitted inside
+    carry ``args["session"] = session_id`` — the label the SSE route
+    filters on and the Prometheus exposition keys by. Re-entered on
+    broker worker threads for work a session's pass armed, exactly like
+    `pass_context`."""
+
+    __slots__ = ("_session_id", "_prev")
+
+    def __init__(self, session_id: "str | None"):
+        self._session_id = session_id
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_ctx, "session_id", None)
+        _ctx.session_id = self._session_id
+        return self
+
+    def __exit__(self, *exc):
+        _ctx.session_id = self._prev
+        return False
 
 
 class pass_context:
@@ -258,9 +290,13 @@ class pass_context:
 def _args(pass_id, attrs: dict) -> dict:
     if pass_id is None:
         pass_id = current_pass_id()
-    if pass_id is not None:
+    session_id = attrs.get("session", current_session_id())
+    if pass_id is not None or session_id is not None:
         attrs = dict(attrs)
-        attrs["pass"] = pass_id
+        if pass_id is not None:
+            attrs["pass"] = pass_id
+        if session_id is not None:
+            attrs["session"] = session_id
     return attrs
 
 

@@ -23,11 +23,20 @@ for _var in (
     "KSS_COMPILE_RETRIES",
     "KSS_COMPILE_BACKOFF_S",
     "KSS_COMPILE_COOLDOWN_PASSES",
+    "KSS_COMPILE_COOLDOWN_TTL_S",
     # the flight recorder (utils/telemetry.py): an ambient KSS_TRACE=1
     # would make every test pay span emission (and the off-by-default
     # zero-emission test would fail for the wrong reason)
     "KSS_TRACE",
     "KSS_TRACE_RING_CAP",
+    # the session plane (server/sessions.py): ambient admission knobs
+    # would change quota/limit behavior under test
+    "KSS_MAX_SESSIONS",
+    "KSS_MAX_PENDING_PODS_PER_SESSION",
+    "KSS_MAX_CONCURRENT_PASSES",
+    "KSS_SESSION_IDLE_EVICT_S",
+    "KSS_SESSION_DIR",
+    "KSS_SSE_MAX_SUBSCRIBERS",
 ):
     os.environ.pop(_var, None)
 

@@ -160,7 +160,9 @@ class TestResilientLadder:
         with pytest.raises(CompileUnavailable):
             broker.get_resilient(("k",), wedged)
         assert len(builds) == 1
-        th = broker._abandoned[("k",)][0]
+        # abandoned builders are keyed (scope, key) since the session
+        # plane scoped the ladder state; scope None = sessionless caller
+        th = broker._abandoned[(None, ("k",))][0]
         with pytest.raises(CompileUnavailable):
             broker.get_resilient(("k",), wedged)  # consumes the cooldown
         # the re-probe slot: refused — the abandoned builder is alive
@@ -181,6 +183,27 @@ class TestResilientLadder:
         broker = CompileBroker(speculative=False)
         with pytest.raises(CompileUnavailable):
             broker.get_resilient(("k",), lambda: "engine")
+
+    def test_expired_cooldown_reprobes_compile(self, monkeypatch):
+        """A cooldown untouched past KSS_COMPILE_COOLDOWN_TTL_S expires:
+        the next call of that scope re-probes the build (a returning
+        tenant after a quiet spell gets a fresh compile attempt, and the
+        stale entry stops degrading health())."""
+        monkeypatch.setenv("KSS_COMPILE_BACKOFF_S", "0.001")
+        monkeypatch.setenv("KSS_COMPILE_RETRIES", "0")
+        monkeypatch.setenv("KSS_COMPILE_COOLDOWN_PASSES", "100")
+        monkeypatch.setenv("KSS_COMPILE_COOLDOWN_TTL_S", "0.05")
+        broker = CompileBroker(speculative=False)
+        with pytest.raises(CompileUnavailable):
+            broker.get_resilient(
+                ("k",), lambda: (_ for _ in ()).throw(RuntimeError("x"))
+            )
+        assert broker.health()["cooldownKeys"] == 1
+        time.sleep(0.1)
+        # the 100-pass cooldown would still be draining, but the TTL
+        # expired it: health recovers and the next call builds
+        assert broker.health()["cooldownKeys"] == 0
+        assert broker.get_resilient(("k",), lambda: "engine") == "engine"
 
     def test_warm_hit_ends_cooldown(self, monkeypatch):
         monkeypatch.setenv("KSS_COMPILE_RETRIES", "0")

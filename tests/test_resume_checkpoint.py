@@ -253,7 +253,9 @@ class TestCheckpointFormat:
         with pytest.raises(FileNotFoundError):
             load_checkpoint(path)
         write_checkpoint({"format": "wrong"}, path)
-        with pytest.raises(ValueError, match="not a lifecycle checkpoint"):
+        with pytest.raises(
+            ValueError, match="not a checkpoint of the expected kind"
+        ):
             load_checkpoint(path)
         # no torn temp files left behind
         assert os.listdir(tmp_path) == ["x.json"]

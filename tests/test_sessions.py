@@ -1,0 +1,800 @@
+"""The multi-tenant session plane (docs/sessions.md): session CRUD and
+isolation, the shared CompileBroker (one build across bucket-compatible
+tenants, per-session bulkheads for fault storms), admission control's
+structured 503s, evict/restore round-trips, readiness, SSE hardening,
+the Prometheus `session` label, and strict KSS_* env validation."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kube_scheduler_simulator_tpu.server import SimulatorServer, SimulatorService
+from kube_scheduler_simulator_tpu.server.sessions import SessionManager
+from kube_scheduler_simulator_tpu.utils import envcheck, telemetry
+from kube_scheduler_simulator_tpu.utils.metrics import parse_prometheus_text
+
+from helpers import node, pod
+
+
+def _req(port, method, path, body=None, timeout=300):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            raw = resp.read()
+            return resp.status, json.loads(raw) if raw else None, dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        return e.code, json.loads(raw) if raw else None, dict(e.headers)
+
+
+def _server(**session_config):
+    return SimulatorServer(
+        SimulatorService(), port=0, session_config=session_config
+    ).start()
+
+
+@pytest.fixture()
+def server():
+    srv = _server()
+    yield srv
+    srv.shutdown()
+
+
+def _mksession(port, body=None):
+    code, doc, _ = _req(port, "POST", "/api/v1/sessions", body or {})
+    assert code == 201, doc
+    return doc["id"]
+
+
+class TestSessionCrudAndIsolation:
+    def test_create_list_get_delete(self, server):
+        p = server.port
+        sid = _mksession(p, {"name": "tenant-a"})
+        code, lst, _ = _req(p, "GET", "/api/v1/sessions")
+        assert code == 200
+        assert {s["id"] for s in lst["sessions"]} == {"default", sid}
+        assert "compileMisses" in lst["broker"]
+        code, info, _ = _req(p, "GET", f"/api/v1/sessions/{sid}")
+        assert code == 200 and info["name"] == "tenant-a"
+        code, _, _ = _req(p, "DELETE", f"/api/v1/sessions/{sid}")
+        assert code == 200
+        code, err, _ = _req(p, "GET", f"/api/v1/sessions/{sid}")
+        assert code == 404 and err["kind"] == "UnknownSession"
+
+    def test_sessions_are_isolated_from_each_other_and_default(self, server):
+        p = server.port
+        a = _mksession(p)
+        b = _mksession(p)
+        _req(p, "PUT", f"/api/v1/sessions/{a}/resources/nodes", node("n0"))
+        _req(p, "PUT", f"/api/v1/sessions/{a}/resources/pods", pod("w"))
+        for path in (
+            f"/api/v1/sessions/{b}/resources/pods",
+            "/api/v1/resources/pods",  # legacy = default session
+        ):
+            code, items, _ = _req(p, "GET", path)
+            assert code == 200 and items["items"] == []
+        # scheduling in A binds A's pod and nobody else's metrics move
+        code, out, _ = _req(p, "POST", f"/api/v1/sessions/{a}/schedule")
+        assert code == 200 and out["scheduled"] == 1
+        code, mb, _ = _req(p, "GET", f"/api/v1/sessions/{b}/metrics")
+        assert mb["passes"] == 0
+
+    def test_default_session_cannot_be_deleted_or_evicted(self, server):
+        p = server.port
+        assert _req(p, "DELETE", "/api/v1/sessions/default")[0] == 400
+        assert _req(p, "POST", "/api/v1/sessions/default/evict")[0] == 400
+
+    def test_bad_fault_spec_is_400(self, server):
+        code, err, _ = _req(
+            server.port,
+            "POST",
+            "/api/v1/sessions",
+            {"faultInject": "no_such_site:1.0"},
+        )
+        assert code == 400
+        assert "no_such_site" in err["error"]
+
+    def test_create_with_snapshot_imports(self, server):
+        p = server.port
+        snap = {"nodes": [node("sn0")], "pods": [pod("sp0")]}
+        code, doc, _ = _req(p, "POST", "/api/v1/sessions", {"snapshot": snap})
+        assert code == 201 and doc["errors"] == []
+        code, items, _ = _req(
+            p, "GET", f"/api/v1/sessions/{doc['id']}/resources/nodes"
+        )
+        assert [i["metadata"]["name"] for i in items["items"]] == ["sn0"]
+
+
+class TestFork:
+    def test_fork_branches_state(self, server):
+        p = server.port
+        a = _mksession(p)
+        _req(p, "PUT", f"/api/v1/sessions/{a}/resources/nodes", node("n0"))
+        _req(p, "PUT", f"/api/v1/sessions/{a}/resources/pods", pod("w"))
+        code, fk, _ = _req(p, "POST", f"/api/v1/sessions/{a}/fork")
+        assert code == 201
+        b = fk["id"]
+        code, items, _ = _req(p, "GET", f"/api/v1/sessions/{b}/resources/pods")
+        assert [i["metadata"]["name"] for i in items["items"]] == ["w"]
+        # divergence: deleting in the fork leaves the source untouched
+        _req(p, "DELETE", f"/api/v1/sessions/{b}/resources/pods/default/w")
+        code, items, _ = _req(p, "GET", f"/api/v1/sessions/{a}/resources/pods")
+        assert [i["metadata"]["name"] for i in items["items"]] == ["w"]
+
+
+class TestSharedBroker:
+    def test_bucket_compatible_sessions_share_one_build(self, server):
+        """The tentpole's sharing contract + the thread-safety
+        satellite: two sessions with bucket-compatible clusters
+        scheduling CONCURRENTLY produce exactly one compile — the
+        shared broker's warm map + per-key lease serve the second
+        tenant without a second build."""
+        p = server.port
+        sids = [_mksession(p) for _ in range(2)]
+        for sid in sids:
+            _req(p, "PUT", f"/api/v1/sessions/{sid}/resources/nodes", node("n0"))
+            _req(p, "PUT", f"/api/v1/sessions/{sid}/resources/pods", pod("w"))
+        results = {}
+
+        def run(sid):
+            results[sid] = _req(p, "POST", f"/api/v1/sessions/{sid}/schedule")
+
+        threads = [threading.Thread(target=run, args=(s,)) for s in sids]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        for sid in sids:
+            code, out, _ = results[sid]
+            assert code == 200 and out["scheduled"] == 1
+        assert server.sessions.broker.compile_misses == 1
+        assert server.sessions.broker.compile_hits >= 1
+
+
+class TestBulkheadIsolation:
+    def test_fault_storm_confined_to_one_session(self, server, monkeypatch):
+        """The acceptance criterion: a compile_fail:1.0 storm scoped to
+        session A (the KSS_FAULT_INJECT grammar, session-scoped) leaves
+        A completing every pass on the eager rung while B's passes stay
+        jitted — B's eagerFallbacks/degradedPasses never move and its
+        warm passes keep hitting the shared broker."""
+        monkeypatch.setenv("KSS_COMPILE_BACKOFF_S", "0.001")
+        p = server.port
+        # A storms in gang mode, B stays sequential: distinct broker
+        # keys, so A's never-compiling key can't be served warm by B
+        a = _mksession(p, {"faultInject": "compile_fail:1.0"})
+        b = _mksession(p)
+        for sid in (a, b):
+            _req(p, "PUT", f"/api/v1/sessions/{sid}/resources/nodes", node("n0"))
+            _req(p, "PUT", f"/api/v1/sessions/{sid}/resources/pods", pod("w"))
+        # B warms up first: one cold compile, then pure warm hits
+        code, out, _ = _req(p, "POST", f"/api/v1/sessions/{b}/schedule")
+        assert code == 200 and out["scheduled"] == 1
+        # A's storm: every pass completes anyway (the eager rung)
+        for i in range(2):
+            _req(
+                p, "PUT", f"/api/v1/sessions/{a}/resources/pods", pod(f"x{i}")
+            )
+            code, out, _ = _req(
+                p, "POST", f"/api/v1/sessions/{a}/schedule?mode=gang&record=0"
+            )
+            assert code == 200, out
+            assert out["scheduled"] >= 1
+        # B keeps serving warm, jitted passes mid-storm
+        _req(p, "PUT", f"/api/v1/sessions/{b}/resources/pods", pod("y"))
+        code, out, _ = _req(p, "POST", f"/api/v1/sessions/{b}/schedule")
+        assert code == 200 and out["scheduled"] == 1
+        code, ma, _ = _req(p, "GET", f"/api/v1/sessions/{a}/metrics")
+        code, mb, _ = _req(p, "GET", f"/api/v1/sessions/{b}/metrics")
+        assert ma["phases"]["eagerFallbacks"] >= 2
+        assert ma["phases"]["degradedPasses"] >= 2
+        assert ma["phases"]["compileMisses"] == 0  # nothing ever compiled
+        # the bulkhead: the healthy neighbor never degraded
+        assert mb["phases"]["eagerFallbacks"] == 0
+        assert mb["phases"]["degradedPasses"] == 0
+        assert mb["phases"]["compileMisses"] == 1  # its own cold start only
+        assert mb["phases"]["compileHits"] >= 1  # warm mid-storm
+
+
+class TestAdmissionControl:
+    def test_session_limit_sheds_with_structured_503(self):
+        srv = _server(max_sessions=2)  # default + 1
+        try:
+            p = srv.port
+            _mksession(p)
+            code, err, headers = _req(p, "POST", "/api/v1/sessions", {})
+            assert code == 503
+            assert err["kind"] == "SessionLimitExceeded"
+            assert "error" in err and "detail" in err
+            assert headers.get("Retry-After")
+        finally:
+            srv.shutdown()
+
+    def test_pending_pod_quota(self):
+        srv = _server(pending_pod_quota=2)
+        try:
+            p = srv.port
+            sid = _mksession(p)
+            base = f"/api/v1/sessions/{sid}/resources/pods"
+            assert _req(p, "PUT", base, pod("a"))[0] == 201
+            assert _req(p, "PUT", base, pod("b"))[0] == 201
+            code, err, headers = _req(p, "PUT", base, pod("c"))
+            assert code == 503
+            assert err["kind"] == "SessionQuotaExceeded"
+            assert headers.get("Retry-After")
+            # bound pods don't consume pending quota
+            assert _req(p, "PUT", base, pod("d", node_name="n0"))[0] == 201
+        finally:
+            srv.shutdown()
+
+    def test_quota_allows_updates_to_existing_pending_pods(self):
+        """Admission meters queue GROWTH, not pod shape: a tenant at
+        quota must still be able to label or correct pods already in its
+        queue — the count doesn't change."""
+        srv = _server(pending_pod_quota=2)
+        try:
+            p = srv.port
+            sid = _mksession(p)
+            base = f"/api/v1/sessions/{sid}/resources/pods"
+            assert _req(p, "PUT", base, pod("a"))[0] == 201
+            assert _req(p, "PUT", base, pod("b"))[0] == 201
+            relabel = pod("a")
+            relabel["metadata"]["labels"] = {"tier": "gold"}
+            code, obj, _ = _req(p, "POST", base, relabel)  # collection apply
+            assert code == 201
+            assert obj["metadata"]["labels"]["tier"] == "gold"
+            code, _, _ = _req(p, "PUT", base + "/default/a", relabel)  # replace
+            assert code == 200
+        finally:
+            srv.shutdown()
+
+    def test_quota_meters_unbind_via_replace(self):
+        """The bypass: bound pods are admitted freely, but an item PUT
+        whose body omits spec.nodeName UNBINDS the pod back into the
+        pending queue (replace deletes absent fields) — without metering
+        that transition a tenant could turn N bound pods into an
+        arbitrarily long queue past KSS_MAX_PENDING_PODS_PER_SESSION."""
+        srv = _server(pending_pod_quota=1)
+        try:
+            p = srv.port
+            sid = _mksession(p)
+            base = f"/api/v1/sessions/{sid}/resources/pods"
+            for name in ("a", "b"):
+                assert _req(p, "PUT", base, pod(name, node_name="n0"))[0] == 201
+            # the first unbind fills the quota...
+            assert _req(p, "PUT", base + "/default/a", pod("a"))[0] == 200
+            # ...the second would exceed it and is shed
+            code, err, _ = _req(p, "PUT", base + "/default/b", pod("b"))
+            assert code == 503 and err["kind"] == "SessionQuotaExceeded"
+        finally:
+            srv.shutdown()
+
+    def test_concurrent_pass_semaphore_sheds(self):
+        srv = _server(max_concurrent_passes=1)
+        try:
+            p = srv.port
+            sid = _mksession(p)
+            _req(p, "PUT", f"/api/v1/sessions/{sid}/resources/nodes", node("n0"))
+            _req(p, "PUT", f"/api/v1/sessions/{sid}/resources/pods", pod("w"))
+            assert srv.sessions._pass_sem.acquire(blocking=False)
+            try:
+                code, err, headers = _req(
+                    p, "POST", f"/api/v1/sessions/{sid}/schedule"
+                )
+                assert code == 503
+                assert err["kind"] == "ServerSaturated"
+                assert headers.get("Retry-After")
+            finally:
+                srv.sessions._pass_sem.release()
+            code, out, _ = _req(p, "POST", f"/api/v1/sessions/{sid}/schedule")
+            assert code == 200 and out["scheduled"] == 1
+        finally:
+            srv.shutdown()
+
+
+class TestSlotStarvation:
+    def test_same_session_schedule_sheds_instead_of_queueing(self, server):
+        """A session with a pass already in flight sheds further
+        /schedule requests BEFORE they claim a concurrent-pass slot:
+        queued same-session waiters would otherwise hold the global
+        slots doing no device work, starving every other tenant."""
+        p = server.port
+        sid = _mksession(p)
+        _req(p, "PUT", f"/api/v1/sessions/{sid}/resources/nodes", node("n0"))
+        _req(p, "PUT", f"/api/v1/sessions/{sid}/resources/pods", pod("w"))
+        svc = server.sessions.get(sid).service
+        assert svc.scheduler._schedule_lock.acquire(blocking=False)
+        try:
+            code, err, headers = _req(
+                p, "POST", f"/api/v1/sessions/{sid}/schedule"
+            )
+            assert code == 503
+            assert err["kind"] == "ServerSaturated"
+            assert "pass in flight" in err["error"]
+            assert headers.get("Retry-After")
+            # no slot was consumed by the shed request
+            assert server.sessions._pass_sem.acquire(blocking=False)
+            server.sessions._pass_sem.release()
+        finally:
+            svc.scheduler._schedule_lock.release()
+        code, out, _ = _req(p, "POST", f"/api/v1/sessions/{sid}/schedule")
+        assert code == 200 and out["scheduled"] == 1
+
+
+class TestEvictRestore:
+    def test_evict_then_touch_restores_without_loss(self, server):
+        p = server.port
+        sid = _mksession(p)
+        _req(p, "PUT", f"/api/v1/sessions/{sid}/resources/nodes", node("n0"))
+        _req(p, "PUT", f"/api/v1/sessions/{sid}/resources/pods", pod("w"))
+        code, out, _ = _req(p, "POST", f"/api/v1/sessions/{sid}/schedule")
+        assert code == 200 and out["scheduled"] == 1
+        code, before, _ = _req(
+            p, "GET", f"/api/v1/sessions/{sid}/resources/pods"
+        )
+        code, ev, _ = _req(p, "POST", f"/api/v1/sessions/{sid}/evict")
+        assert code == 200 and ev["snapshot"]
+        code, info, _ = _req(p, "GET", f"/api/v1/sessions/{sid}")
+        assert info["state"] == "evicted"
+        # transparent restore on the next touch: objects verbatim
+        # (resourceVersions included) and cumulative metrics intact
+        code, after, _ = _req(
+            p, "GET", f"/api/v1/sessions/{sid}/resources/pods"
+        )
+        assert code == 200 and after == before
+        code, m, _ = _req(p, "GET", f"/api/v1/sessions/{sid}/metrics")
+        assert m["passes"] == 1
+        code, info, _ = _req(p, "GET", f"/api/v1/sessions/{sid}")
+        assert info["state"] == "live" and info["restores"] == 1
+
+    def test_evict_refused_while_request_in_flight(self, server):
+        """Eviction excludes in-flight REQUESTS, not just passes: a CRUD
+        the server is about to acknowledge must not be applied to a
+        service object eviction is discarding (data loss). `using` is
+        the HTTP layer's per-request registration."""
+        from kube_scheduler_simulator_tpu.server.sessions import SessionBusy
+
+        p = server.port
+        sid = _mksession(p)
+        mgr = server.sessions
+        with mgr.using(sid):
+            with pytest.raises(SessionBusy):
+                mgr.evict(sid)
+        assert mgr.evict(sid)  # quiesced: eviction proceeds
+        assert _req(p, "GET", f"/api/v1/sessions/{sid}")[1]["state"] == "evicted"
+
+    def test_idle_sweeper_evicts_and_touch_revives(self):
+        srv = _server(idle_evict_s=0.25)
+        try:
+            p = srv.port
+            sid = _mksession(p)
+            _req(p, "PUT", f"/api/v1/sessions/{sid}/resources/nodes", node("n0"))
+            deadline = time.time() + 10
+            state = "live"
+            while state != "evicted" and time.time() < deadline:
+                time.sleep(0.1)
+                state = _req(p, "GET", f"/api/v1/sessions/{sid}")[1]["state"]
+            assert state == "evicted"
+            code, items, _ = _req(
+                p, "GET", f"/api/v1/sessions/{sid}/resources/nodes"
+            )
+            assert code == 200
+            assert [i["metadata"]["name"] for i in items["items"]] == ["n0"]
+        finally:
+            srv.shutdown()
+
+
+class TestReadiness:
+    def test_readyz_degrades_on_cooldown_and_worker_crash(self, server):
+        p = server.port
+        assert _req(p, "GET", "/api/v1/healthz")[0] == 200
+        assert _req(p, "GET", "/api/v1/readyz")[0] == 200
+        broker = server.sessions.broker
+        broker._cooldown[("sess", ("k",))] = (3, time.monotonic())
+        try:
+            code, doc, headers = _req(p, "GET", "/api/v1/readyz")
+            assert code == 503 and not doc["ready"]
+            assert headers.get("Retry-After")
+            assert any("cooldown" in r for r in doc["reasons"])
+        finally:
+            broker._cooldown.clear()
+        assert _req(p, "GET", "/api/v1/readyz")[0] == 200
+        broker.worker_crashes = 1
+        try:
+            code, doc, _ = _req(p, "GET", "/api/v1/readyz")
+            assert code == 503
+            assert any("worker" in r for r in doc["reasons"])
+        finally:
+            broker.worker_crashes = 0
+
+
+class TestSSEHardening:
+    def test_subscriber_cap_sheds(self):
+        srv = _server(sse_max_subscribers=1)
+        try:
+            p = srv.port
+            first = urllib.request.urlopen(
+                f"http://127.0.0.1:{p}/api/v1/events", timeout=10
+            )
+            try:
+                code, err, headers = _req(p, "GET", "/api/v1/events", timeout=10)
+                assert code == 503
+                assert err["kind"] == "SSESubscriberLimit"
+                assert headers.get("Retry-After")
+            finally:
+                first.close()
+        finally:
+            srv.shutdown()
+
+    def test_slow_consumer_disconnected_and_drops_counted(
+        self, server, monkeypatch
+    ):
+        from kube_scheduler_simulator_tpu.server import httpserver
+
+        monkeypatch.setattr(httpserver, "SSE_QUEUE_MAX", 4)
+        rec = telemetry.SpanRecorder(capacity=4096)
+        telemetry.activate(rec)
+        try:
+            p = server.port
+            resp = urllib.request.urlopen(
+                f"http://127.0.0.1:{p}/api/v1/events", timeout=10
+            )
+            try:
+                deadline = time.time() + 5
+                while server._sse_subs < 1 and time.time() < deadline:
+                    time.sleep(0.02)
+                # a stalled client: never reads while spans flood in
+                for i in range(64):
+                    telemetry.instant("flood", i=i)
+                deadline = time.time() + 10
+                while server.sse_dropped == 0 and time.time() < deadline:
+                    time.sleep(0.05)
+                assert server.sse_dropped >= 1
+                # the slot is reclaimed: the server disconnected us
+                deadline = time.time() + 10
+                while server._sse_subs > 0 and time.time() < deadline:
+                    time.sleep(0.05)
+                assert server._sse_subs == 0
+            finally:
+                resp.close()
+            code, doc, _ = _req(p, "GET", "/api/v1/metrics")
+            assert doc["sseDroppedEvents"] >= 1
+        finally:
+            telemetry.deactivate()
+
+
+class TestTelemetrySessionLabels:
+    def test_spans_carry_session_id(self, server):
+        rec = telemetry.SpanRecorder(capacity=4096)
+        telemetry.activate(rec)
+        try:
+            p = server.port
+            sid = _mksession(p)
+            _req(p, "PUT", f"/api/v1/sessions/{sid}/resources/nodes", node("n0"))
+            _req(p, "PUT", f"/api/v1/sessions/{sid}/resources/pods", pod("w"))
+            code, out, _ = _req(p, "POST", f"/api/v1/sessions/{sid}/schedule")
+            assert code == 200
+            sessions = {
+                (ev.get("args") or {}).get("session")
+                for ev in rec.snapshot()
+                if ev["name"].startswith("pass.")
+            }
+            assert sid in sessions
+        finally:
+            telemetry.deactivate()
+
+    def test_prometheus_exposition_labels_every_session(self, server):
+        p = server.port
+        sid = _mksession(p)
+        code, _, _ = _req(p, "GET", "/api/v1/metrics")
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{p}/api/v1/metrics?format=prometheus"
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            families = parse_prometheus_text(resp.read().decode())
+        labels = {
+            lab.get("session")
+            for _, lab, _ in families["kss_passes_total"]["samples"]
+        }
+        assert labels == {"default", sid}
+        # histograms validate per label set (the parser groups by series)
+        assert families["kss_pass_latency_seconds"]["type"] == "histogram"
+        # the nested per-session scrape carries just that session
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{p}/api/v1/sessions/{sid}/metrics"
+            f"?format=prometheus"
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            families = parse_prometheus_text(resp.read().decode())
+        labels = {
+            lab.get("session")
+            for _, lab, _ in families["kss_passes_total"]["samples"]
+        }
+        assert labels == {sid}
+
+
+class TestSessionManagerUnit:
+    def test_manager_env_parsing_is_strict(self):
+        with pytest.raises(ValueError, match="KSS_MAX_SESSIONS"):
+            SessionManager(
+                SimulatorService(), env={"KSS_MAX_SESSIONS": "lots"}
+            )
+        with pytest.raises(ValueError, match="must be >= 1"):
+            SessionManager(
+                SimulatorService(), env={"KSS_MAX_CONCURRENT_PASSES": "0"}
+            )
+
+
+class TestEnvCheck:
+    def test_clean_env_passes(self):
+        assert envcheck.check_env({}) == []
+        assert envcheck.check_env(
+            {"KSS_ENCODING_CACHE_CAP": "16", "KSS_FAULT_INJECT": "compile_fail:0.5"}
+        ) == []
+
+    def test_malformed_values_are_reported(self):
+        problems = envcheck.check_env(
+            {
+                "KSS_ENCODING_CACHE_CAP": "abc",
+                "KSS_COMPILE_DEADLINE_S": "-1",
+                "KSS_FAULT_INJECT": "bogus_site:1.0",
+            }
+        )
+        text = "\n".join(problems)
+        assert "KSS_ENCODING_CACHE_CAP" in text
+        assert "KSS_COMPILE_DEADLINE_S" in text
+        assert "bogus_site" in text
+
+    def test_unknown_kss_variable_is_a_typo(self):
+        problems = envcheck.check_env({"KSS_ENCODNG_CACHE_CAP": "8"})
+        assert problems and "unknown" in problems[0]
+
+    def test_fail_fast_exits_2(self, capsys):
+        with pytest.raises(SystemExit) as exc:
+            envcheck.fail_fast({"KSS_TRACE_RING_CAP": "huge"})
+        assert exc.value.code == 2
+        assert "KSS_TRACE_RING_CAP" in capsys.readouterr().err
+        envcheck.fail_fast({})  # clean env: no exit
+
+    def test_boolean_vocabulary_matches_runtime_parsers(self, monkeypatch):
+        """Every boolean spelling check_env blesses must flip the
+        runtime switches: a value validation accepts but the runtime
+        silently ignores (KSS_NO_SPECULATIVE_COMPILE=on leaving
+        speculation enabled, KSS_TRACE=t recording nothing) is exactly
+        the misconfiguration class envcheck exists to kill."""
+        from kube_scheduler_simulator_tpu.utils import broker as broker_mod
+
+        for raw in envcheck.TRUTHY:
+            assert envcheck.check_env({"KSS_NO_SPECULATIVE_COMPILE": raw}) == []
+            monkeypatch.setenv("KSS_NO_SPECULATIVE_COMPILE", raw)
+            assert broker_mod.speculation_enabled_default() is False, raw
+            monkeypatch.setenv("KSS_TRACE", raw)
+            assert telemetry.active() is not None, raw
+        for raw in envcheck.FALSY:
+            assert envcheck.check_env({"KSS_NO_SPECULATIVE_COMPILE": raw}) == []
+            monkeypatch.setenv("KSS_NO_SPECULATIVE_COMPILE", raw)
+            assert broker_mod.speculation_enabled_default() is True, raw
+            monkeypatch.setenv("KSS_TRACE", raw)
+            assert telemetry.active() is None, raw
+
+
+class TestSharedBrokerHygiene:
+    """The review-hardening set: a dead or chaos-testing tenant must not
+    leave the SHARED broker (and with it /api/v1/readyz) degraded."""
+
+    def test_speculative_build_attributes_to_arming_metrics(self):
+        """On a shared broker, a speculative build counts into the
+        ARMING service's registry (the session that requested it) — not
+        nowhere (metrics=None froze speculativeCompiles at 0)."""
+        from kube_scheduler_simulator_tpu.utils.broker import CompileBroker
+        from kube_scheduler_simulator_tpu.utils.metrics import SchedulingMetrics
+
+        broker = CompileBroker(speculative=True)
+        m = SchedulingMetrics()
+        assert broker.speculate(
+            "t", lambda: (("k",), lambda: "engine"), metrics=m
+        )
+        assert broker.drain(timeout=10)
+        assert m.snapshot()["phases"]["speculativeCompiles"] == 1
+        assert broker.peek(("k",)) == "engine"
+
+    def test_lease_map_bounded_by_warm_map(self):
+        """The per-key lease dict retires entries with their engine's
+        LRU eviction instead of growing with lifetime shape diversity."""
+        from kube_scheduler_simulator_tpu.utils.broker import CompileBroker
+
+        broker = CompileBroker()
+        broker.capacity = 2
+        for i in range(6):
+            key = ("k", i)
+            broker.lease(key)
+            broker.get(key, lambda i=i: f"engine{i}")
+        assert len(broker._engines) == 2
+        assert set(broker._leases) == set(broker._engines)
+
+    def test_stale_cooldown_expires_from_readyz(self, server, monkeypatch):
+        """Cooldowns drain per pass OF THEIR OWN SCOPE, so a tenant that
+        simply stops sending traffic (idle, evicted — delete is not the
+        only way to go quiet) would pin readyz at 503 forever. Untouched
+        entries expire after KSS_COMPILE_COOLDOWN_TTL_S and health()
+        prunes them."""
+        monkeypatch.setenv("KSS_COMPILE_COOLDOWN_TTL_S", "0.05")
+        p = server.port
+        broker = server.sessions.broker
+        broker._cooldown[("gone-quiet", ("k",))] = (3, time.monotonic())
+        assert _req(p, "GET", "/api/v1/readyz")[0] == 503
+        time.sleep(0.1)
+        assert _req(p, "GET", "/api/v1/readyz")[0] == 200
+        assert broker._cooldown == {}
+
+    def test_delete_purges_scope_cooldowns_readyz_recovers(self, server):
+        p = server.port
+        sid = _mksession(p)
+        broker = server.sessions.broker
+        # the tenant's compile ladder exhausted: its scope-keyed cooldown
+        broker._cooldown[(sid, ("k",))] = (3, time.monotonic())
+        assert _req(p, "GET", "/api/v1/readyz")[0] == 503
+        code, _, _ = _req(p, "DELETE", f"/api/v1/sessions/{sid}")
+        assert code == 200
+        # nothing re-probes a deleted scope — delete must purge it
+        assert broker._cooldown == {}
+        assert _req(p, "GET", "/api/v1/readyz")[0] == 200
+
+    def test_scoped_worker_crash_does_not_disable_shared_speculation(self):
+        from kube_scheduler_simulator_tpu.utils import faultinject
+        from kube_scheduler_simulator_tpu.utils.broker import CompileBroker
+
+        broker = CompileBroker(speculative=True)
+        plane = faultinject.FaultPlane.parse("worker_crash:1.0")
+        # a session's pass arms speculation under ITS private fault
+        # plane: the crash rides into the worker but is contained to
+        # that scope — the shared worker survives, health stays ready
+        with faultinject.scoped(plane), telemetry.session_context("s-chaos"):
+            assert broker.speculate("t", lambda: None)
+        assert broker.drain(timeout=10)
+        assert broker.speculative is True  # neighbors keep speculation
+        assert broker.worker_crashes == 0  # replica-level health clean
+        assert broker.health()["workerCrashed"] is False
+        assert broker.stats()["scopedWorkerCrashes"] == 1
+        # ...and a later GLOBAL (process-plane) crash still self-disables
+        def bad_task():
+            raise RuntimeError("real worker bug")
+
+        assert broker.speculate("t2", bad_task)
+        assert broker.drain(timeout=10)
+        assert broker.speculative is False
+        assert broker.worker_crashes == 1
+
+    def test_drop_scope_is_per_scope(self):
+        from kube_scheduler_simulator_tpu.utils.broker import CompileBroker
+
+        broker = CompileBroker()
+        broker._cooldown[("a", ("k",))] = (2, time.monotonic())
+        broker._cooldown[("b", ("k",))] = (2, time.monotonic())
+        broker._cooldown[(None, ("k",))] = (2, time.monotonic())  # the sessionless default
+        broker.drop_scope("a")
+        assert ("a", ("k",)) not in broker._cooldown
+        assert ("b", ("k",)) in broker._cooldown
+        assert (None, ("k",)) in broker._cooldown
+
+
+class TestBulkAdmission:
+    def test_import_respects_pending_pod_quota(self):
+        srv = _server(pending_pod_quota=2)
+        try:
+            p = srv.port
+            sid = _mksession(p)
+            snap = {"pods": [pod(f"q{i}") for i in range(3)]}
+            code, err, headers = _req(
+                p, "POST", f"/api/v1/sessions/{sid}/import", snap
+            )
+            assert code == 503
+            assert err["kind"] == "SessionQuotaExceeded"
+            assert headers.get("Retry-After")
+            # shed WHOLE: nothing from the snapshot applied
+            code, items, _ = _req(
+                p, "GET", f"/api/v1/sessions/{sid}/resources/pods"
+            )
+            assert items["items"] == []
+            # bound pods don't count against the pending quota
+            snap = {
+                "pods": [pod(f"b{i}", node_name="n0") for i in range(5)]
+                + [pod("p0")]
+            }
+            code, out, _ = _req(
+                p, "POST", f"/api/v1/sessions/{sid}/import", snap
+            )
+            assert code == 200, out
+        finally:
+            srv.shutdown()
+
+    def test_create_snapshot_respects_quota_and_leaves_nothing(self):
+        srv = _server(pending_pod_quota=1)
+        try:
+            p = srv.port
+            before = _req(p, "GET", "/api/v1/sessions")[1]
+            code, err, _ = _req(
+                p,
+                "POST",
+                "/api/v1/sessions",
+                {"snapshot": {"pods": [pod("a"), pod("b")]}},
+            )
+            assert code == 503 and err["kind"] == "SessionQuotaExceeded"
+            after = _req(p, "GET", "/api/v1/sessions")[1]
+            assert len(after["sessions"]) == len(before["sessions"])
+        finally:
+            srv.shutdown()
+
+    def test_auto_schedule_sheds_quietly_at_saturation(self):
+        srv = SimulatorServer(
+            SimulatorService(),
+            port=0,
+            auto_schedule=True,
+            session_config={"max_concurrent_passes": 1},
+        ).start()
+        try:
+            p = srv.port
+            _req(p, "PUT", "/api/v1/resources/nodes", node("n0"))
+            baseline = _req(p, "GET", "/api/v1/metrics")[1]["passes"]
+            assert srv.sessions._pass_sem.acquire(blocking=False)
+            try:
+                # the CRUD that triggers the auto-pass SUCCEEDS; only
+                # the pass itself is skipped at saturation
+                code, _, _ = _req(p, "PUT", "/api/v1/resources/pods", pod("w"))
+                assert code == 201
+                code, m, _ = _req(p, "GET", "/api/v1/metrics")
+                assert m["passes"] == baseline  # shed, not queued
+            finally:
+                srv.sessions._pass_sem.release()
+            # with the slot free the next mutation converges as usual
+            code, _, _ = _req(p, "PUT", "/api/v1/resources/pods", pod("w2"))
+            assert code == 201
+            code, m, _ = _req(p, "GET", "/api/v1/metrics")
+            assert m["passes"] == baseline + 1
+        finally:
+            srv.shutdown()
+
+
+class TestSnapshotConsistency:
+    def test_fork_refused_while_pass_in_flight(self, server):
+        p = server.port
+        sid = _mksession(p)
+        svc = server.sessions.get(sid).service
+        assert svc.scheduler._schedule_lock.acquire(blocking=False)
+        try:
+            code, err, _ = _req(p, "POST", f"/api/v1/sessions/{sid}/fork")
+            assert code == 409
+            assert err["kind"] == "SessionBusy"
+        finally:
+            svc.scheduler._schedule_lock.release()
+        code, fk, _ = _req(p, "POST", f"/api/v1/sessions/{sid}/fork")
+        assert code == 201 and fk["state"] == "live"
+
+    def test_scrape_never_restores_an_evicted_session(self, server):
+        p = server.port
+        sid = _mksession(p)
+        _req(p, "PUT", f"/api/v1/sessions/{sid}/resources/nodes", node("n0"))
+        assert _req(p, "POST", f"/api/v1/sessions/{sid}/evict")[0] == 200
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{p}/api/v1/metrics?format=prometheus"
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            families = parse_prometheus_text(resp.read().decode())
+        labels = {
+            lab.get("session")
+            for _, lab, _ in families["kss_passes_total"]["samples"]
+        }
+        assert sid not in labels  # paused series, not a restore
+        code, info, _ = _req(p, "GET", f"/api/v1/sessions/{sid}")
+        assert info["state"] == "evicted"  # the scrape did not revive it

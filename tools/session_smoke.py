@@ -1,0 +1,207 @@
+"""`make session-smoke`: the multi-tenant session plane end-to-end on CPU
+(docs/sessions.md). Four gates, one JSON line:
+
+1. **Shared warm engines** — 3 sessions with bucket-compatible clusters
+   each run a scheduling pass; the SHARED CompileBroker's
+   `compileMisses` must stay at the single-session cold-start count (1
+   unique shape → 1 compile), every later tenant served warm.
+2. **Evict/restore is lossless** — one session is evicted to its disk
+   snapshot and touched back to life: the resource set (names AND
+   resourceVersions) is byte-identical and the cumulative pass counters
+   survive — eviction is load shedding, never data loss.
+3. **Session admission** — creating sessions past KSS_MAX_SESSIONS
+   sheds with the structured 503 (`error`/`kind`/`detail`) + Retry-After.
+4. **Pod-quota admission** — pending pods past
+   KSS_MAX_PENDING_PODS_PER_SESSION shed the same way.
+
+Exit 0 on pass. Small enough for CI (seconds, CPU-only): a sanity gate,
+not a benchmark.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+N_SESSIONS = 3
+MAX_SESSIONS = 1 + N_SESSIONS  # the implicit default + the tenants
+POD_QUOTA = 4
+
+
+def _req(port, method, path, body=None, timeout=300):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            raw = resp.read()
+            return resp.status, json.loads(raw) if raw else None, dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        return e.code, json.loads(raw) if raw else None, dict(e.headers)
+
+
+def _node(name: str) -> dict:
+    return {
+        "metadata": {"name": name},
+        "status": {
+            "allocatable": {"cpu": "16", "memory": "32Gi", "pods": "110"}
+        },
+    }
+
+
+def _pod(name: str) -> dict:
+    return {
+        "metadata": {"name": name},
+        "spec": {
+            "containers": [
+                {
+                    "name": "c",
+                    "resources": {
+                        "requests": {"cpu": "100m", "memory": "64Mi"}
+                    },
+                }
+            ]
+        },
+    }
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # deterministic broker counters: no background speculative builds
+    os.environ["KSS_NO_SPECULATIVE_COMPILE"] = "1"
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from kube_scheduler_simulator_tpu.server import (
+        SimulatorServer,
+        SimulatorService,
+    )
+    from kube_scheduler_simulator_tpu.utils.compilecache import (
+        enable_compile_cache,
+    )
+
+    enable_compile_cache()
+    problems: list[str] = []
+    server = SimulatorServer(
+        SimulatorService(),
+        port=0,
+        session_config={
+            "max_sessions": MAX_SESSIONS,
+            "pending_pod_quota": POD_QUOTA,
+        },
+    ).start()
+    try:
+        p = server.port
+
+        # -- gate 1: N bucket-compatible tenants, ONE compile ------------
+        sids = []
+        for i in range(N_SESSIONS):
+            code, doc, _ = _req(
+                p, "POST", "/api/v1/sessions", {"name": f"tenant-{i}"}
+            )
+            if code != 201:
+                problems.append(f"session create {i} returned {code}")
+                continue
+            sids.append(doc["id"])
+        for sid in sids:
+            for i in range(4):
+                _req(
+                    p,
+                    "PUT",
+                    f"/api/v1/sessions/{sid}/resources/nodes",
+                    _node(f"n{i}"),
+                )
+            for i in range(2):
+                _req(
+                    p,
+                    "PUT",
+                    f"/api/v1/sessions/{sid}/resources/pods",
+                    _pod(f"w{i}"),
+                )
+            code, out, _ = _req(p, "POST", f"/api/v1/sessions/{sid}/schedule")
+            if code != 200 or out["scheduled"] != 2:
+                problems.append(f"session {sid}: schedule returned {code} {out}")
+        code, lst, _ = _req(p, "GET", "/api/v1/sessions")
+        broker = lst["broker"]
+        if broker["compileMisses"] != 1:
+            problems.append(
+                f"expected the cold start's 1 compileMiss across "
+                f"{N_SESSIONS} bucket-compatible sessions, got "
+                f"{broker['compileMisses']}"
+            )
+        if broker["compileHits"] < N_SESSIONS - 1:
+            problems.append(
+                f"warm sharing missing: compileHits={broker['compileHits']}"
+            )
+
+        # -- gate 2: evict → restore with zero loss ----------------------
+        victim = sids[0]
+        code, before, _ = _req(
+            p, "GET", f"/api/v1/sessions/{victim}/resources/pods"
+        )
+        code, mbefore, _ = _req(p, "GET", f"/api/v1/sessions/{victim}/metrics")
+        code, ev, _ = _req(p, "POST", f"/api/v1/sessions/{victim}/evict")
+        if code != 200:
+            problems.append(f"evict returned {code}")
+        code, info, _ = _req(p, "GET", f"/api/v1/sessions/{victim}")
+        if info["state"] != "evicted":
+            problems.append(f"victim state {info['state']!r} after evict")
+        code, after, _ = _req(
+            p, "GET", f"/api/v1/sessions/{victim}/resources/pods"
+        )
+        if code != 200 or after != before:
+            problems.append("restored resources differ from pre-eviction")
+        code, mafter, _ = _req(p, "GET", f"/api/v1/sessions/{victim}/metrics")
+        if mafter["passes"] != mbefore["passes"]:
+            problems.append(
+                f"pass counters lost across evict/restore "
+                f"({mbefore['passes']} -> {mafter['passes']})"
+            )
+
+        # -- gate 3: session admission past the limit --------------------
+        code, err, headers = _req(p, "POST", "/api/v1/sessions", {})
+        if code != 503:
+            problems.append(f"over-limit session create returned {code}")
+        else:
+            if err.get("kind") != "SessionLimitExceeded" or "error" not in err:
+                problems.append(f"unstructured admission 503: {err}")
+            if not headers.get("Retry-After"):
+                problems.append("admission 503 missing Retry-After")
+
+        # -- gate 4: pending-pod quota ------------------------------------
+        tenant = sids[1]
+        base = f"/api/v1/sessions/{tenant}/resources/pods"
+        for i in range(POD_QUOTA):  # fills up to the quota (2 are bound)
+            _req(p, "PUT", base, _pod(f"q{i}"))
+        code, err, headers = _req(p, "PUT", base, _pod("overflow"))
+        if code != 503:
+            problems.append(f"over-quota pod create returned {code}")
+        elif err.get("kind") != "SessionQuotaExceeded" or not headers.get(
+            "Retry-After"
+        ):
+            problems.append(f"unstructured quota 503: {err}")
+
+        line = {
+            "config": "session_smoke",
+            "sessions": len(sids) + 1,
+            "compile_misses": broker["compileMisses"],
+            "compile_hits": broker["compileHits"],
+            "evictions": lst["limits"]["evictions"] + 1,
+            "restored_pods": len((after or {}).get("items", [])),
+            "ok": not problems,
+        }
+        if problems:
+            line["problems"] = problems
+        print(json.dumps(line))
+        return 0 if not problems else 1
+    finally:
+        server.shutdown()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
