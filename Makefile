@@ -15,8 +15,19 @@ test:
 test-fast:
 	$(PY) -m pytest tests/ -q -x --ignore=tests/test_engine_parity_preempt.py
 
+# the default verify path: `make lint && make test` before every PR.
+# lint = bytecode sanity + the kss-lint contract analyzers
+# (docs/static-analysis.md: env registry, metrics registry, jit purity,
+# lock order, span balance — also run as tier-1 tests) + ruff + the
+# scoped strict mypy. ruff/mypy are skipped with a note when not
+# installed (configs live in pyproject.toml); the analyzers always run.
 lint:
 	$(PY) -m compileall -q kube_scheduler_simulator_tpu tests bench.py __graft_entry__.py
+	$(PY) -m kube_scheduler_simulator_tpu.analysis
+	@if command -v ruff >/dev/null 2>&1; then ruff check .; \
+	else echo "lint: ruff not installed -- skipped (config: pyproject [tool.ruff])"; fi
+	@if command -v mypy >/dev/null 2>&1; then mypy; \
+	else echo "lint: mypy not installed -- skipped (config: pyproject [tool.mypy])"; fi
 
 # the HTTP simulator (reference `make start`: PORT=1212 ./bin/simulator)
 start:

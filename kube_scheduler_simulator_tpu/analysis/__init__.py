@@ -1,0 +1,49 @@
+"""kss-lint: project-native static analysis of the cross-cutting contracts.
+
+PRs 3-6 built a threaded serving stack whose correctness rests on
+contracts no single module can see whole: every engine compile goes
+through the CompileBroker, every ``KSS_*`` env read is declared in the
+envcheck registry, every metric rendered is documented (and vice versa),
+spans are balanced, locks are acquired in one global order. Nothing in
+Python enforces any of that — the next PR can silently break all five.
+
+This package is the mechanical reviewer: an AST-based lint framework
+(`core.py`) with five analyzers, each guarding one contract:
+
+  ===========  ==========================================================
+  rules        contract
+  ===========  ==========================================================
+  KSS1xx       env-registry — KSS_* reads <-> utils/envcheck.KNOWN <->
+               docs/environment-variables.md (no undeclared knob, no
+               dead config, no undocumented knob)
+  KSS2xx       metrics-registry — Prometheus name surface <->
+               docs/observability.md table; every snapshot counter is
+               rendered AND checkpointed
+  KSS3xx       jit-purity — `jax.jit` only inside utils/broker.py (the
+               broker-owns-all-compiles contract) and jitted bodies
+               free of host effects
+  KSS4xx       lock-order — the static lock-acquisition graph is acyclic
+               (the runtime counterpart is utils/locking.py's
+               KSS_LOCK_CHECK witness)
+  KSS5xx       span-balance — telemetry spans are statically paired
+               (with-statement discipline; no raw B/E emission)
+  ===========  ==========================================================
+
+Run as tier-1 tests (tests/test_static_analysis.py), as a CLI
+(``python -m kube_scheduler_simulator_tpu.analysis``), and via
+``make lint``. The allowlist (core.ALLOWLIST) exists for emergencies and
+MUST stay empty: a violation is fixed, not waived (the tier-1 suite
+pins the allowlist empty). Rule catalog: docs/static-analysis.md.
+"""
+
+from __future__ import annotations
+
+from .core import (  # noqa: F401 — the package's public surface
+    ALLOWLIST,
+    Finding,
+    RepoContext,
+    SourceFile,
+    SourceTree,
+    all_analyzers,
+    run_all,
+)

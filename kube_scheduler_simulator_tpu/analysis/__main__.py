@@ -1,0 +1,85 @@
+"""kss-lint CLI: run the contract analyzers over the live source tree.
+
+    python -m kube_scheduler_simulator_tpu.analysis            # all rules
+    python -m kube_scheduler_simulator_tpu.analysis --rule env-registry
+    python -m kube_scheduler_simulator_tpu.analysis --format json
+
+Exit status: 0 clean, 1 findings, 2 usage error. `make lint` runs this
+alongside ruff and the scoped strict mypy (both gated on availability).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .core import ALLOWLIST, RepoContext, SourceTree, all_analyzers, run_all
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    names = sorted(all_analyzers())
+    ap = argparse.ArgumentParser(
+        prog="kube_scheduler_simulator_tpu.analysis",
+        description="kss-lint: AST analyzers for the codebase's "
+        "cross-cutting contracts (docs/static-analysis.md)",
+    )
+    ap.add_argument(
+        "--rule",
+        action="append",
+        choices=names,
+        metavar="NAME",
+        help=f"run only this analyzer (repeatable; one of: {', '.join(names)})",
+    )
+    ap.add_argument(
+        "--format", choices=("text", "json"), default="text", dest="fmt"
+    )
+    ap.add_argument(
+        "--package-dir",
+        metavar="DIR",
+        help="analyze this package directory instead of the installed one",
+    )
+    args = ap.parse_args(argv)
+
+    tree = SourceTree.load(args.package_dir)
+    repo = RepoContext.discover(args.package_dir)
+    # semantic rules import the INSTALLED modules — only meaningful when
+    # the analyzed tree IS the installed package
+    repo.live = args.package_dir is None
+    findings = run_all(tree, repo, only=args.rule)
+
+    if args.fmt == "json":
+        print(
+            json.dumps(
+                [
+                    {
+                        "rule": f.rule,
+                        "path": f.path,
+                        "line": f.line,
+                        "message": f.message,
+                        "hint": f.hint,
+                    }
+                    for f in findings
+                ]
+            )
+        )
+    else:
+        for f in findings:
+            print(f.render())
+        ran = args.rule or names
+        if findings:
+            print(f"\nkss-lint: {len(findings)} finding(s) across {len(ran)} analyzer(s)")
+        else:
+            print(f"kss-lint: clean ({', '.join(ran)})")
+        if ALLOWLIST:
+            print(
+                "kss-lint: WARNING: the allowlist is non-empty "
+                f"({sum(len(v) for v in ALLOWLIST.values())} waiver(s)) — "
+                "it must stay empty (fix, don't waive)",
+                file=sys.stderr,
+            )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

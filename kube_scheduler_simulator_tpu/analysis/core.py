@@ -1,0 +1,213 @@
+"""Shared infrastructure for the kss-lint analyzers.
+
+One parse of the package per run (`SourceTree.load`), one finding model
+(`Finding`: rule id + file:line + message + fix hint), one allowlist
+(`ALLOWLIST` — present so an emergency waiver is *possible*, pinned
+empty by the tier-1 suite so it never silently grows), and the analyzer
+registry `all_analyzers` the CLI and the tests share.
+
+Analyzers are plain functions ``(SourceTree, RepoContext) ->
+list[Finding]``; `SourceTree.from_sources` builds an in-memory tree so
+every analyzer is negative-testable on synthetic violations without
+touching the real checkout.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+
+# rule id -> ("relpath:line", ...) waivers. MUST stay empty: every
+# violation in the shipped tree is fixed, not allowlisted
+# (tests/test_static_analysis.py::test_allowlist_is_empty pins this).
+ALLOWLIST: "dict[str, tuple[str, ...]]" = {}
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One contract violation, pinned to a source location."""
+
+    rule: str  # "KSS101"
+    path: str  # package-relative, e.g. "utils/broker.py"
+    line: int
+    message: str
+    hint: str = ""  # how to fix, shown by the CLI
+
+    @property
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def render(self) -> str:
+        out = f"{self.location}: {self.rule}: {self.message}"
+        if self.hint:
+            out += f"\n    fix: {self.hint}"
+        return out
+
+
+@dataclass
+class SourceFile:
+    """One parsed module of the tree under analysis."""
+
+    rel: str  # package-relative posix path
+    source: str
+    tree: ast.Module
+
+    def docstring_linenos(self) -> "set[int]":
+        """Line numbers spanned by docstrings (module/class/function) —
+        literal collectors skip these: prose is not a contract site."""
+        out: set[int] = set()
+        for node in ast.walk(self.tree):
+            if not isinstance(
+                node, (ast.Module, ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            body = getattr(node, "body", [])
+            if (
+                body
+                and isinstance(body[0], ast.Expr)
+                and isinstance(body[0].value, ast.Constant)
+                and isinstance(body[0].value.value, str)
+            ):
+                doc = body[0].value
+                out.update(range(doc.lineno, (doc.end_lineno or doc.lineno) + 1))
+        return out
+
+    def string_literals(
+        self, *, skip_docstrings: bool = True
+    ) -> "list[tuple[str, int]]":
+        """Every string constant in the module as (value, lineno)."""
+        skip = self.docstring_linenos() if skip_docstrings else set()
+        out: list[tuple[str, int]] = []
+        for node in ast.walk(self.tree):
+            if (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and node.lineno not in skip
+            ):
+                out.append((node.value, node.lineno))
+        return out
+
+
+@dataclass
+class SourceTree:
+    """The package's modules, parsed once and shared by every analyzer."""
+
+    files: "list[SourceFile]" = field(default_factory=list)
+
+    @classmethod
+    def load(cls, package_dir: "str | None" = None) -> "SourceTree":
+        """Parse every .py under the package directory (default: the
+        installed kube_scheduler_simulator_tpu package itself — the
+        analyzers always run over the LIVE source tree)."""
+        if package_dir is None:
+            package_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        files: list[SourceFile] = []
+        for dirpath, dirnames, filenames in os.walk(package_dir):
+            dirnames[:] = sorted(
+                d for d in dirnames if d != "__pycache__" and not d.startswith(".")
+            )
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                rel = os.path.relpath(path, package_dir).replace(os.sep, "/")
+                with open(path, "r", encoding="utf-8") as f:
+                    source = f.read()
+                files.append(SourceFile(rel, source, ast.parse(source, filename=rel)))
+        return cls(files)
+
+    @classmethod
+    def from_sources(cls, sources: "dict[str, str]") -> "SourceTree":
+        """An in-memory tree from {relpath: source} — the negative-test
+        entry point: every analyzer must fire on a synthetic violation."""
+        return cls(
+            [
+                SourceFile(rel, src, ast.parse(src, filename=rel))
+                for rel, src in sorted(sources.items())
+            ]
+        )
+
+    def get(self, rel: str) -> "SourceFile | None":
+        for f in self.files:
+            if f.rel == rel:
+                return f
+        return None
+
+
+@dataclass
+class RepoContext:
+    """Paths outside the package the analyzers cross-check against
+    (docs tables). Any of them may be None — e.g. a site-packages
+    install without a docs/ tree — in which case doc-facing rules are
+    skipped rather than spuriously fired."""
+
+    docs_dir: "str | None" = None
+    # True when the tree under analysis IS the live installed package:
+    # semantic rules (import-and-exercise, e.g. KSS203/204) only make
+    # sense there — a synthetic negative-test tree skips them
+    live: bool = False
+
+    @classmethod
+    def discover(cls, package_dir: "str | None" = None) -> "RepoContext":
+        if package_dir is None:
+            package_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        docs = os.path.join(os.path.dirname(package_dir), "docs")
+        return cls(docs_dir=docs if os.path.isdir(docs) else None, live=True)
+
+    def doc_text(self, name: str) -> "str | None":
+        if self.docs_dir is None:
+            return None
+        path = os.path.join(self.docs_dir, name)
+        if not os.path.isfile(path):
+            return None
+        with open(path, "r", encoding="utf-8") as f:
+            return f.read()
+
+
+def apply_allowlist(
+    findings: "list[Finding]",
+    allowlist: "dict[str, tuple[str, ...]] | None" = None,
+) -> "list[Finding]":
+    """Drop findings waived by the allowlist (rule id -> locations)."""
+    allow = ALLOWLIST if allowlist is None else allowlist
+    if not allow:
+        return list(findings)
+    return [
+        f for f in findings if f.location not in allow.get(f.rule, ())
+    ]
+
+
+def all_analyzers() -> "dict[str, object]":
+    """name -> analyzer callable, in rule-id order. Imported lazily so
+    `core` stays import-cycle-free for the analyzer modules."""
+    from . import env_registry, jit_purity, lock_order, metrics_registry, span_balance
+
+    return {
+        "env-registry": env_registry.run,
+        "metrics-registry": metrics_registry.run,
+        "jit-purity": jit_purity.run,
+        "lock-order": lock_order.run,
+        "span-balance": span_balance.run,
+    }
+
+
+def run_all(
+    tree: "SourceTree | None" = None,
+    repo: "RepoContext | None" = None,
+    *,
+    only: "list[str] | None" = None,
+) -> "list[Finding]":
+    """Run every analyzer (or the `only` subset) over `tree` (default:
+    the live package source), allowlist applied, findings ordered by
+    location then rule."""
+    tree = SourceTree.load() if tree is None else tree
+    repo = RepoContext.discover() if repo is None else repo
+    findings: list[Finding] = []
+    for name, analyzer in all_analyzers().items():
+        if only and name not in only:
+            continue
+        findings.extend(analyzer(tree, repo))
+    return sorted(
+        apply_allowlist(findings), key=lambda f: (f.path, f.line, f.rule)
+    )

@@ -1,0 +1,300 @@
+"""jit-purity analyzer (KSS301-302): the broker-owns-all-compiles
+contract and host-effect-free jitted bodies.
+
+PR 3 routed every engine compile through ``utils/broker.jit`` so the
+persistent compile cache is always armed, the eager degradation rung
+can pass through, and compile accounting stays truthful. And a function
+handed to jit is *traced*: host effects inside it either run once at
+trace time (silently wrong under the warm-engine map) or crash on a
+tracer. Two rules:
+
+  KSS301  a direct ``jax.jit`` call outside utils/broker.py — the
+          compile escapes the broker's cache arming, eager rung, and
+          accounting;
+  KSS302  a host effect inside a function passed to ``jit`` (either
+          spelling): I/O (open/print), ``time.*``, lock acquisition,
+          ``os.environ``/``os.getenv``, telemetry span emission,
+          logging, Python ``random``, ``.item()``, ``jax.device_get``,
+          or ``np.asarray``/``np.array`` applied directly to a traced
+          parameter.
+
+Resolution is intentionally static and conservative: lambdas and
+``jax.vmap``/``functools.partial`` wrappers are unwrapped; bare names
+and ``self.X`` attributes resolve to same-module functions/methods
+first, then to a unique package-wide match; anything unresolvable is
+skipped, never guessed. The check is one level deep (the jit boundary
+itself) — helpers called from a jitted body are assumed pure, which is
+where the runtime parity suites take over.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, RepoContext, SourceFile, SourceTree
+
+BROKER_REL = "utils/broker.py"
+
+# attribute roots whose calls are host effects inside a traced body
+_EFFECT_MODULES = ("time", "logging", "random")
+_EFFECT_CALL_NAMES = ("open", "print", "input")
+_TELEMETRY_EMITS = ("span", "instant", "complete")
+_NP_NAMES = ("np", "numpy", "onp")
+
+
+def _is_jit_call(node: ast.Call) -> "str | None":
+    """"jax" for jax.jit, "broker" for <broker module>.jit / bare jit."""
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and fn.attr == "jit":
+        if isinstance(fn.value, ast.Name) and fn.value.id == "jax":
+            return "jax"
+        return "broker"
+    if isinstance(fn, ast.Name) and fn.id == "jit":
+        return "broker"
+    return None
+
+
+def _unwrap(arg: ast.expr) -> ast.expr:
+    """Peel jax.vmap(f, ...) / functools.partial(f, ...) wrappers."""
+    while isinstance(arg, ast.Call):
+        fn = arg.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else ""
+        )
+        if name in ("vmap", "partial", "pmap", "checkpoint") and arg.args:
+            arg = arg.args[0]
+        else:
+            break
+    return arg
+
+
+def _functions_by_name(tree: SourceTree) -> "dict[str, list[tuple[SourceFile, ast.FunctionDef]]]":
+    out: dict[str, list[tuple[SourceFile, ast.FunctionDef]]] = {}
+    for sf in tree.files:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.FunctionDef):
+                out.setdefault(node.name, []).append((sf, node))
+    return out
+
+
+def _assignments_of(
+    name: str, tree: ast.Module
+) -> "list[tuple[ast.expr, int]]":
+    """Expressions assigned to `self.<name>` / `<name>` in the module:
+    [(value expression, position in a tuple target or -1)]."""
+    out: list[tuple[ast.expr, int]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for target in node.targets:
+            elts = target.elts if isinstance(target, ast.Tuple) else [target]
+            for pos, elt in enumerate(elts):
+                matches = (
+                    isinstance(elt, ast.Name) and elt.id == name
+                ) or (
+                    isinstance(elt, ast.Attribute)
+                    and elt.attr == name
+                    and isinstance(elt.value, ast.Name)
+                    and elt.value.id == "self"
+                )
+                if matches:
+                    out.append(
+                        (node.value, pos if isinstance(target, ast.Tuple) else -1)
+                    )
+    return out
+
+
+def _builder_return(
+    fn: ast.FunctionDef, pos: int
+) -> "ast.expr | None":
+    """What a factory method returns: the return expression itself, or
+    element `pos` of a returned tuple."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and node.value is not None:
+            value = node.value
+            if pos >= 0 and isinstance(value, ast.Tuple) and pos < len(value.elts):
+                return value.elts[pos]
+            if pos < 0:
+                return value
+    return None
+
+
+def _resolve(
+    arg: ast.expr,
+    sf: SourceFile,
+    index: "dict[str, list[tuple[SourceFile, ast.FunctionDef]]]",
+    depth: int = 0,
+) -> "tuple[SourceFile, ast.Lambda | ast.FunctionDef] | None":
+    if depth > 5:
+        return None
+    arg = _unwrap(arg)
+    if isinstance(arg, ast.Lambda):
+        return (sf, arg)
+    if isinstance(arg, ast.IfExp):
+        return _resolve(arg.body, sf, index, depth + 1)
+    name: "str | None" = None
+    if isinstance(arg, ast.Name):
+        name = arg.id
+    elif isinstance(arg, ast.Attribute):
+        name = arg.attr
+    if name is None:
+        return None
+    candidates = index.get(name, [])
+    local = [(f, fn) for f, fn in candidates if f.rel == sf.rel]
+    if len(local) == 1:
+        return local[0]
+    if len(candidates) == 1:
+        return candidates[0]
+    if candidates:
+        return None  # ambiguous across modules: skip, never guess
+    # no function def by that name: follow `self.X = ...` / `X = ...`
+    # assignments — the `self.run_fn = self._build_run()` closure idiom
+    # (local module first, then a unique package-wide assignment)
+    for scope in (sf,), tuple(f for f in _iter_files(index) if f.rel != sf.rel):
+        assigns = [
+            (f, value, pos)
+            for f in scope
+            for value, pos in _assignments_of(name, f.tree)
+        ]
+        if not assigns:
+            continue
+        if len(assigns) > 1:
+            return None  # several writers: skip
+        f, value, pos = assigns[0]
+        if isinstance(value, ast.Call):
+            builder = _resolve(value.func, f, index, depth + 1)
+            if builder is None or not isinstance(builder[1], ast.FunctionDef):
+                return None
+            returned = _builder_return(builder[1], pos)
+            if returned is None:
+                return None
+            return _resolve(returned, builder[0], index, depth + 1)
+        return _resolve(value, f, index, depth + 1)
+    return None
+
+
+def _iter_files(index) -> "list[SourceFile]":
+    seen: dict[str, SourceFile] = {}
+    for entries in index.values():
+        for f, _fn in entries:
+            seen.setdefault(f.rel, f)
+    return list(seen.values())
+
+
+def _jit_params(fn: "ast.Lambda | ast.FunctionDef") -> "set[str]":
+    a = fn.args
+    names = {p.arg for p in a.posonlyargs + a.args + a.kwonlyargs}
+    names.discard("self")
+    return names
+
+
+def _effects(fn: "ast.Lambda | ast.FunctionDef") -> "list[tuple[int, str]]":
+    """(lineno, description) for each host effect in the body."""
+    out: list[tuple[int, str]] = []
+    params = _jit_params(fn)
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Name) and f.id in _EFFECT_CALL_NAMES:
+                    out.append((node.lineno, f"{f.id}() call"))
+                elif isinstance(f, ast.Attribute):
+                    root = f.value
+                    if isinstance(root, ast.Name):
+                        if root.id in _EFFECT_MODULES:
+                            out.append(
+                                (node.lineno, f"{root.id}.{f.attr}() call")
+                            )
+                        elif root.id == "os" and f.attr == "getenv":
+                            out.append((node.lineno, "os.getenv() read"))
+                        elif (
+                            root.id == "telemetry"
+                            and f.attr in _TELEMETRY_EMITS
+                        ):
+                            out.append(
+                                (node.lineno, f"telemetry.{f.attr}() emission")
+                            )
+                        elif root.id == "jax" and f.attr == "device_get":
+                            out.append((node.lineno, "jax.device_get() transfer"))
+                        elif (
+                            root.id in _NP_NAMES
+                            and f.attr in ("asarray", "array")
+                            and node.args
+                            and isinstance(node.args[0], ast.Name)
+                            and node.args[0].id in params
+                        ):
+                            out.append(
+                                (
+                                    node.lineno,
+                                    f"{root.id}.{f.attr}() on traced "
+                                    f"parameter {node.args[0].id!r}",
+                                )
+                            )
+                    if f.attr == "acquire":
+                        out.append((node.lineno, "lock .acquire() call"))
+                    elif f.attr == "item" and not node.args:
+                        out.append((node.lineno, ".item() host transfer"))
+            elif isinstance(node, ast.Attribute):
+                if (
+                    node.attr == "environ"
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "os"
+                ):
+                    out.append((node.lineno, "os.environ access"))
+            elif isinstance(node, ast.With):
+                for item in node.items:
+                    ctx = item.context_expr
+                    attr = (
+                        ctx.attr
+                        if isinstance(ctx, ast.Attribute)
+                        else ctx.id if isinstance(ctx, ast.Name) else ""
+                    )
+                    if "lock" in attr.lower():
+                        out.append((node.lineno, f"lock acquisition ({attr})"))
+    return out
+
+
+def run(tree: SourceTree, repo: RepoContext) -> "list[Finding]":
+    findings: list[Finding] = []
+    index = _functions_by_name(tree)
+    for sf in tree.files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _is_jit_call(node)
+            if kind is None or not node.args:
+                continue
+            if kind == "jax" and sf.rel != BROKER_REL:
+                findings.append(
+                    Finding(
+                        "KSS301",
+                        sf.rel,
+                        node.lineno,
+                        "direct jax.jit call outside utils/broker.py — "
+                        "the compile escapes the CompileBroker (no "
+                        "persistent-cache arming, no eager rung, no "
+                        "accounting)",
+                        hint="route through `from ..utils import broker as "
+                        "broker_mod; broker_mod.jit(...)`",
+                    )
+                )
+            if sf.rel == BROKER_REL:
+                continue  # the jit implementation itself
+            resolved = _resolve(node.args[0], sf, index)
+            if resolved is None:
+                continue
+            fn_sf, fn = resolved
+            for lineno, what in _effects(fn):
+                findings.append(
+                    Finding(
+                        "KSS302",
+                        fn_sf.rel,
+                        lineno,
+                        f"host effect inside a jitted function: {what} "
+                        f"(jitted at {sf.rel}:{node.lineno})",
+                        hint="hoist the effect out of the traced body; "
+                        "jitted functions must be pure array programs",
+                    )
+                )
+    return findings

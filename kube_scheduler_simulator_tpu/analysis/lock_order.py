@@ -1,0 +1,273 @@
+"""lock-order analyzer (KSS401): the static lock-acquisition graph.
+
+The serving stack holds locks across layers — session state locks over
+the manager lock, the schedule lock over broker and store locks — and a
+deadlock needs nothing more than two call paths acquiring two of them
+in opposite orders. This analyzer extracts the static acquisition
+graph and reports every cycle:
+
+  * lock identities are the attributes assigned a
+    ``threading.Lock/RLock/Condition`` (or a ``locking.make_lock /
+    make_rlock`` witness factory) — per class, so ``Session._state_lock``
+    and ``SessionManager._lock`` are distinct nodes even when attribute
+    names collide across classes;
+  * an edge A -> B is recorded when a ``with <B>`` (or ``<B>.acquire()``)
+    executes lexically inside a ``with <A>`` body, or when a
+    ``self.method()`` call made while holding A belongs to a same-module
+    method that acquires B (one interprocedural hop — the
+    ``evict -> snapshot_dir`` shape);
+  * a cycle in the resulting graph is a potential deadlock: two threads
+    walking different edges of the cycle can block each other forever.
+
+The graph deliberately under-approximates: locks reached through
+cross-module variables are skipped, never guessed. The runtime witness
+(utils/locking.py, ``KSS_LOCK_CHECK=1``) covers the orders the static
+view cannot see by recording what the test suite actually acquires.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from .core import Finding, RepoContext, SourceFile, SourceTree
+
+_LOCK_FACTORIES = ("Lock", "RLock", "Condition")
+_WITNESS_FACTORIES = ("make_lock", "make_rlock")
+
+
+@dataclass(frozen=True)
+class LockNode:
+    rel: str
+    owner: str  # class name, or "<module>" for module-level locks
+    attr: str
+
+    def __str__(self) -> str:
+        return f"{self.rel}:{self.owner}.{self.attr}"
+
+
+def _is_lock_ctor(expr: ast.expr) -> bool:
+    if not isinstance(expr, ast.Call):
+        return False
+    fn = expr.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else ""
+    )
+    if name in _LOCK_FACTORIES + _WITNESS_FACTORIES:
+        return True
+    # the dataclass idiom: field(default_factory=lambda: make_lock(...))
+    # (SchedulingMetrics._lock) — unwrap the factory to the ctor
+    if name == "field":
+        for kw in expr.keywords:
+            if kw.arg == "default_factory":
+                factory = kw.value
+                if isinstance(factory, ast.Lambda):
+                    return _is_lock_ctor(factory.body)
+                if isinstance(factory, (ast.Name, ast.Attribute)):
+                    inner = (
+                        factory.attr
+                        if isinstance(factory, ast.Attribute)
+                        else factory.id
+                    )
+                    return inner in _LOCK_FACTORIES + _WITNESS_FACTORIES
+    return False
+
+
+def _module_locks(sf: SourceFile) -> "dict[str, list[LockNode]]":
+    """attr (or module-level name) -> declared LockNodes. An attr
+    declared by several classes resolves only when unique."""
+    out: dict[str, list[LockNode]] = {}
+
+    def note(owner: str, attr: str) -> None:
+        node = LockNode(sf.rel, owner, attr)
+        out.setdefault(attr, [])
+        if node not in out[attr]:
+            out[attr].append(node)
+
+    for top in sf.tree.body:
+        if (
+            isinstance(top, ast.Assign)
+            and len(top.targets) == 1
+            and isinstance(top.targets[0], ast.Name)
+            and _is_lock_ctor(top.value)
+        ):
+            note("<module>", top.targets[0].id)
+        elif isinstance(top, ast.ClassDef):
+            for node in ast.walk(top):
+                if (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Attribute)
+                    and isinstance(node.targets[0].value, ast.Name)
+                    and node.targets[0].value.id == "self"
+                    and _is_lock_ctor(node.value)
+                ):
+                    note(top.name, node.targets[0].attr)
+                elif (
+                    # dataclass field declaration at class level:
+                    # `_lock: threading.Lock = field(default_factory=...)`
+                    isinstance(node, ast.AnnAssign)
+                    and isinstance(node.target, ast.Name)
+                    and node.value is not None
+                    and _is_lock_ctor(node.value)
+                ):
+                    note(top.name, node.target.id)
+    return out
+
+
+def _lock_of(
+    expr: ast.expr, locks: "dict[str, list[LockNode]]"
+) -> "LockNode | None":
+    attr: "str | None" = None
+    if isinstance(expr, ast.Attribute):
+        attr = expr.attr
+    elif isinstance(expr, ast.Name):
+        attr = expr.id
+    if attr is None:
+        return None
+    nodes = locks.get(attr)
+    if nodes and len(nodes) == 1:
+        return nodes[0]
+    return None
+
+
+Edges = "dict[tuple[LockNode, LockNode], tuple[str, int]]"
+
+
+class _ModuleWalker:
+    """Tracks lexically-held locks through one module, recording
+    held -> acquired edges (plus one-hop self.method() edges)."""
+
+    def __init__(self, sf: SourceFile, edges):
+        self.sf = sf
+        self.locks = _module_locks(sf)
+        self.edges = edges
+        self.method_locks = self._method_locks()
+
+    def _method_locks(self) -> "dict[str, set[LockNode]]":
+        """method name -> every module-declared lock its body acquires
+        (any depth, for the one-hop interprocedural edges)."""
+        out: dict[str, set[LockNode]] = {}
+        for node in ast.walk(self.sf.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            acquired: set[LockNode] = set()
+            for inner in ast.walk(node):
+                if isinstance(inner, ast.With):
+                    for item in inner.items:
+                        ln = _lock_of(item.context_expr, self.locks)
+                        if ln is not None:
+                            acquired.add(ln)
+                elif (
+                    isinstance(inner, ast.Call)
+                    and isinstance(inner.func, ast.Attribute)
+                    and inner.func.attr == "acquire"
+                ):
+                    ln = _lock_of(inner.func.value, self.locks)
+                    if ln is not None:
+                        acquired.add(ln)
+            if acquired:
+                out.setdefault(node.name, set()).update(acquired)
+        return out
+
+    def _note(self, held, target: LockNode, lineno: int) -> None:
+        for h in held:
+            if h != target and (h, target) not in self.edges:
+                self.edges[(h, target)] = (self.sf.rel, lineno)
+
+    def walk(self) -> None:
+        self._visit(self.sf.tree, ())
+
+    def _visit(self, node: ast.AST, held: "tuple[LockNode, ...]") -> None:
+        if isinstance(node, ast.With):
+            new_held = list(held)
+            for item in node.items:
+                ln = _lock_of(item.context_expr, self.locks)
+                if ln is not None:
+                    self._note(new_held, ln, node.lineno)
+                    new_held.append(ln)
+            for child in node.body:
+                self._visit(child, tuple(new_held))
+            return
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            # a nested definition runs later, under whatever locks its
+            # caller holds — not the ones held at definition time
+            body = node.body if isinstance(node.body, list) else [node.body]
+            for child in body:
+                self._visit(child, ())
+            return
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr == "acquire":
+                ln = _lock_of(fn.value, self.locks)
+                if ln is not None:
+                    self._note(held, ln, node.lineno)
+            elif (
+                held
+                and isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "self"
+                and fn.attr in self.method_locks
+            ):
+                for target in self.method_locks[fn.attr]:
+                    self._note(held, target, node.lineno)
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, held)
+
+
+def lock_graph(tree: SourceTree):
+    """The static acquisition graph: (held, acquired) -> first site."""
+    edges: dict = {}
+    for sf in tree.files:
+        walker = _ModuleWalker(sf, edges)
+        if walker.locks:
+            walker.walk()
+    return edges
+
+
+def _find_cycles(edges) -> "list[list[LockNode]]":
+    graph: dict[LockNode, list[LockNode]] = {}
+    for a, b in edges:
+        graph.setdefault(a, []).append(b)
+    cycles: list[list[LockNode]] = []
+    seen: set[tuple] = set()
+
+    def dfs(start: LockNode, node: LockNode, path: "list[LockNode]") -> None:
+        for nxt in sorted(graph.get(node, ()), key=str):
+            if nxt == start:
+                key = tuple(sorted(str(n) for n in path))
+                if key not in seen:
+                    seen.add(key)
+                    cycles.append(path[:])
+            elif nxt not in path and len(path) < 8:
+                dfs(start, nxt, path + [nxt])
+
+    for start in sorted(graph, key=str):
+        dfs(start, start, [start])
+    return cycles
+
+
+def run(tree: SourceTree, repo: RepoContext) -> "list[Finding]":
+    edges = lock_graph(tree)
+    findings: list[Finding] = []
+    for cycle in _find_cycles(edges):
+        ordered = cycle + [cycle[0]]
+        sites = [
+            f"{a} -> {b} at {edges[(a, b)][0]}:{edges[(a, b)][1]}"
+            for a, b in zip(ordered, ordered[1:])
+        ]
+        rel, lineno = edges[(ordered[0], ordered[1])]
+        findings.append(
+            Finding(
+                "KSS401",
+                rel,
+                lineno,
+                "lock-order cycle (potential deadlock): " + "; ".join(sites),
+                hint="pick one global order for these locks and acquire "
+                "them in it everywhere; verify at runtime with "
+                "KSS_LOCK_CHECK=1 (utils/locking.py)",
+            )
+        )
+    return findings

@@ -1,0 +1,186 @@
+"""metrics-registry analyzer (KSS201-204): the Prometheus name surface.
+
+docs/observability.md declares the metric-name table the scrape configs
+stand on; utils/metrics.py and the serving layer's extra gauges
+(server/httpserver.py) emit the names. Four rules keep them one
+surface:
+
+  KSS201  a ``kss_*`` metric name emitted by the package that the
+          docs/observability.md table does not list (a scrapeable
+          series operators cannot discover);
+  KSS202  a ``kss_*`` name in the docs table that no source literal
+          carries (documentation of a metric that does not exist);
+  KSS203  a cumulative counter in ``SchedulingMetrics.snapshot()`` that
+          the Prometheus renderer drops (JSON-only accounting invisible
+          to scrapes) — checked SEMANTICALLY: a registry is loaded with
+          a distinct sentinel per counter, rendered, re-parsed, and
+          every sentinel must surface as a sample value;
+  KSS204  a cumulative counter the checkpoint state
+          (``state_dict``/``load_state``) loses — a resumed run's
+          metrics would silently restart that counter.
+
+The AST rules (201/202) treat every ``kss_[a-z0-9_]+`` string literal
+outside docstrings as part of the name surface — exactly the discipline
+that makes a rename reviewable: the name appears in source, in the
+docs table, and nowhere else.
+
+Known JSON-only derivations (``decisionsPerSecond`` and the disruption
+means — recomputable from rendered counters/histograms) are excluded
+from 203/204 by construction: the semantic check walks the *cumulative*
+fields the checkpoint carries, not the derived ones.
+"""
+
+from __future__ import annotations
+
+import re
+
+from .core import Finding, RepoContext, SourceTree
+
+_METRIC_RE = re.compile(r"^kss_[a-z0-9_]+$")
+# sample suffixes derived from a histogram family name, never declared
+_DERIVED_SUFFIXES = ("_bucket", "_sum", "_count")
+_DOC_NAME_RE = re.compile(r"`(kss_[a-z0-9_]+)(?:\{[^}]*\})?`")
+
+OBSERVABILITY_DOC = "observability.md"
+
+
+def source_names(tree: SourceTree) -> "dict[str, tuple[str, int]]":
+    """Every kss_* metric-name literal in the package: {name:
+    (relpath, lineno)} (first sighting)."""
+    out: dict[str, tuple[str, int]] = {}
+    for sf in tree.files:
+        for value, lineno in sf.string_literals():
+            if _METRIC_RE.match(value) and value not in out:
+                out[value] = (sf.rel, lineno)
+    return out
+
+
+def doc_names(doc: str) -> "set[str]":
+    """kss_* names from the docs markdown (table rows and prose)."""
+    return set(_DOC_NAME_RE.findall(doc))
+
+
+def _counter_leaves(snapshot: dict) -> "dict[str, float]":
+    """The cumulative counter leaves of a metrics snapshot: dotted path
+    -> value. Derived analytics (rates, means) and cosmetic blocks
+    (recent passes) are not counters and stay out."""
+    leaves: dict[str, float] = {}
+    for key in ("passes", "totalPods", "totalScheduled", "totalWallSeconds"):
+        leaves[key] = snapshot.get(key, 0)
+    for key in ("evicted", "rescheduled"):
+        leaves[f"disruption.{key}"] = snapshot.get("disruption", {}).get(key, 0)
+    for key, value in snapshot.get("phases", {}).items():
+        if isinstance(value, (int, float)):
+            leaves[f"phases.{key}"] = value
+    return leaves
+
+
+def render_coverage_findings(metrics_cls=None) -> "list[Finding]":
+    """KSS203/KSS204 — semantic: every cumulative snapshot counter must
+    survive render->parse (203) and state_dict->load_state (204).
+    `metrics_cls` defaults to the live SchedulingMetrics; tests pass a
+    doctored subclass to prove the rules fire."""
+    from ..utils import metrics as metrics_mod
+
+    cls = metrics_cls if metrics_cls is not None else metrics_mod.SchedulingMetrics
+    findings: list[Finding] = []
+
+    # distinct sentinel per counter, loaded through the checkpoint API
+    reference = cls()
+    state = reference.state_dict()
+    sentinel = 1009  # prime; stays apart from bucket counts and zeros
+
+    def fill(obj):
+        nonlocal sentinel
+        if isinstance(obj, dict):
+            return {k: fill(v) for k, v in obj.items()}
+        if isinstance(obj, (int, float)) and not isinstance(obj, bool):
+            sentinel += 2
+            return type(obj)(sentinel)
+        return obj
+
+    loaded = cls()
+    loaded.load_state(
+        {k: fill(v) for k, v in state.items() if k != "_histograms"}
+    )
+    snap = loaded.snapshot()
+    leaves = _counter_leaves(snap)
+
+    fresh_leaves = _counter_leaves(cls().snapshot())
+    for path, value in sorted(leaves.items()):
+        if float(value) == float(fresh_leaves.get(path, 0)):
+            findings.append(
+                Finding(
+                    "KSS204",
+                    "utils/metrics.py",
+                    1,
+                    f"snapshot counter {path} does not round-trip "
+                    f"state_dict/load_state (a resumed run restarts it)",
+                    hint="carry the field in SchedulingMetrics._STATE_FIELDS "
+                    "(or the _phase_s/_encode_counts dicts)",
+                )
+            )
+
+    rendered = metrics_mod.render_prometheus(snap)
+    families = metrics_mod.parse_prometheus_text(rendered)
+    sample_values = {
+        value
+        for fam in families.values()
+        for _name, _labels, value in fam["samples"]
+    }
+    for path, value in sorted(leaves.items()):
+        if float(value) == 0.0:
+            continue  # not settable -> already reported by KSS204
+        if float(value) not in sample_values:
+            findings.append(
+                Finding(
+                    "KSS203",
+                    "utils/metrics.py",
+                    1,
+                    f"snapshot counter {path} is not rendered by "
+                    f"render_prometheus (JSON-only accounting)",
+                    hint="add the counter to _PROM_COUNTERS (or a labeled "
+                    "family) in utils/metrics.py and a row to "
+                    "docs/observability.md",
+                )
+            )
+    return findings
+
+
+def run(tree: SourceTree, repo: RepoContext) -> "list[Finding]":
+    findings: list[Finding] = []
+    names = source_names(tree)
+    doc = repo.doc_text(OBSERVABILITY_DOC)
+    if doc is not None:
+        documented = doc_names(doc)
+        for name, (rel, lineno) in sorted(names.items()):
+            if name not in documented:
+                findings.append(
+                    Finding(
+                        "KSS201",
+                        rel,
+                        lineno,
+                        f"metric name {name} is not listed in "
+                        f"docs/observability.md's name table",
+                        hint="add a `name | type | meaning` row to the "
+                        "exposition table in docs/observability.md",
+                    )
+                )
+        for name in sorted(documented - set(names)):
+            if name.endswith(_DERIVED_SUFFIXES):
+                continue
+            findings.append(
+                Finding(
+                    "KSS202",
+                    f"docs/{OBSERVABILITY_DOC}",
+                    1,
+                    f"documented metric {name} does not exist in the "
+                    f"source tree",
+                    hint="drop the stale docs row or restore the metric",
+                )
+            )
+    # the semantic rules run only over the LIVE tree (they import the
+    # real metrics module); synthetic trees check the AST rules above
+    if repo.live:
+        findings.extend(render_coverage_findings())
+    return findings

@@ -1,0 +1,105 @@
+"""span-balance analyzer (KSS501-502): statically paired telemetry spans.
+
+The flight recorder's exports are only loadable/assertable because B/E
+events are balanced per thread — `telemetry.check_nesting` verifies a
+recorded window, but only this analyzer prevents the unbalanced code
+from being written: a `span()` whose `__enter__` runs without a
+guaranteed `__exit__` leaks an open span into every future export.
+
+  KSS501  a ``telemetry.span(...)`` call that is not the context
+          expression of a ``with`` statement (or an
+          ``ExitStack.enter_context(...)`` argument, which guarantees
+          the paired exit) — storing or manually entering a span breaks
+          the static pairing;
+  KSS502  a raw ring emission of a ``B`` or ``E`` event
+          (``recorder.emit({"ph": "B", ...})``) outside
+          utils/telemetry.py — begin/end pairing is the span context
+          manager's job; hand-rolled halves cannot be statically
+          matched.
+
+``instant``/``complete`` are exempt by design: point and pre-closed
+interval events cannot dangle.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, RepoContext, SourceTree
+
+TELEMETRY_REL = "utils/telemetry.py"
+
+
+def _is_span_call(node: ast.Call) -> bool:
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and fn.attr == "span":
+        base = fn.value
+        return isinstance(base, ast.Name) and base.id == "telemetry"
+    return False
+
+
+def _is_raw_begin_end_emit(node: ast.Call) -> bool:
+    fn = node.func
+    if not (isinstance(fn, ast.Attribute) and fn.attr == "emit"):
+        return False
+    for arg in node.args:
+        if not isinstance(arg, ast.Dict):
+            continue
+        for k, v in zip(arg.keys, arg.values):
+            if (
+                isinstance(k, ast.Constant)
+                and k.value == "ph"
+                and isinstance(v, ast.Constant)
+                and v.value in ("B", "E")
+            ):
+                return True
+    return False
+
+
+def run(tree: SourceTree, repo: RepoContext) -> "list[Finding]":
+    findings: list[Finding] = []
+    for sf in tree.files:
+        # every expression position that guarantees a paired __exit__
+        safe: set[int] = set()
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    safe.add(id(item.context_expr))
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "enter_context"
+            ):
+                for arg in node.args:
+                    safe.add(id(arg))
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_span_call(node) and sf.rel != TELEMETRY_REL:
+                if id(node) not in safe:
+                    findings.append(
+                        Finding(
+                            "KSS501",
+                            sf.rel,
+                            node.lineno,
+                            "telemetry.span(...) outside a with statement "
+                            "— its B event has no statically paired E",
+                            hint="use `with telemetry.span(...):` (or "
+                            "ExitStack.enter_context); for non-nesting "
+                            "intervals use telemetry.complete()",
+                        )
+                    )
+            if _is_raw_begin_end_emit(node) and sf.rel != TELEMETRY_REL:
+                findings.append(
+                    Finding(
+                        "KSS502",
+                        sf.rel,
+                        node.lineno,
+                        "raw B/E trace-event emission outside "
+                        "utils/telemetry.py — begin/end pairing cannot "
+                        "be statically checked",
+                        hint="emit through telemetry.span()/complete()/"
+                        "instant() instead of recorder.emit",
+                    )
+                )
+    return findings

@@ -70,6 +70,7 @@ from ..models.objects import (
 )
 from ..models.store import ResourceStore, StaleResourceVersion
 from ..sched.resources import to_int_resources
+from ..utils import broker as broker_mod
 from ..utils.compilecache import capacity_buckets, shape_bucket
 from .encode import (
     MISSING_NODE,
@@ -159,25 +160,30 @@ class _NoGrowClauses:
 
 
 @functools.lru_cache(maxsize=None)
-def _scatter_fns():
+def _scatter_fns(eager: bool = False):
+    # compiled through broker_mod.jit (the broker-owns-all-compiles
+    # contract, analyzer KSS301): the persistent disk cache is armed and
+    # the degradation ladder's eager rung passes through. The cache key
+    # carries the eager flag so un-jitted scatters built inside an
+    # eager_execution() fallback never stick for jitted passes.
     kw = {"donate_argnums": (0,)} if jax.default_backend() != "cpu" else {}
     return (
-        jax.jit(lambda arr, idx, rows: arr.at[idx].set(rows), **kw),
-        jax.jit(lambda arr, idx, rows: arr.at[idx].add(rows), **kw),
-        jax.jit(lambda arr, vec: arr + vec, **kw),
+        broker_mod.jit(lambda arr, idx, rows: arr.at[idx].set(rows), **kw),
+        broker_mod.jit(lambda arr, idx, rows: arr.at[idx].add(rows), **kw),
+        broker_mod.jit(lambda arr, vec: arr + vec, **kw),
     )
 
 
 def _scatter_set(arr, idx, rows):
-    return _scatter_fns()[0](arr, idx, rows)
+    return _scatter_fns(broker_mod.eager_active())[0](arr, idx, rows)
 
 
 def _scatter_add(arr, idx, rows):
-    return _scatter_fns()[1](arr, idx, rows)
+    return _scatter_fns(broker_mod.eager_active())[1](arr, idx, rows)
 
 
 def _vec_add(arr, vec):
-    return _scatter_fns()[2](arr, vec)
+    return _scatter_fns(broker_mod.eager_active())[2](arr, vec)
 
 
 def _apply_set(arr, idx: list, rows: list):

@@ -38,6 +38,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..engine.encode import EncodedCluster, TPU32, encode_cluster
 from ..engine.gang import GangScheduler
+from ..utils import broker as broker_mod
 
 
 class FaultSweep:
@@ -96,8 +97,10 @@ class FaultSweep:
                 rounds,
             )
 
-        self._one = jax.jit(one_scenario)
-        self._vrun = jax.jit(
+        # broker_mod.jit, not jax.jit: every engine compile goes through
+        # the broker's cache arming (analyzer KSS301)
+        self._one = broker_mod.jit(one_scenario)
+        self._vrun = broker_mod.jit(
             jax.vmap(one_scenario, in_axes=(None, None, None, None, 0))
         )
 
@@ -156,7 +159,7 @@ class FaultSweep:
                     )
                 sel[p_idx] = node_idx[node_name]
                 mask[p_idx] = True
-        bind = jax.jit(self.gang._bind_all)
+        bind = broker_mod.jit(self.gang._bind_all)
         return bind(
             enc.state0, enc.arrays, jnp.asarray(mask), jnp.asarray(sel),
             self._order,

@@ -13,7 +13,7 @@ sum-of-containers) + overhead; scoring applies non-zero defaults of 100m cpu
 from __future__ import annotations
 
 from fractions import Fraction
-from typing import Any, Iterable
+from typing import Any
 
 from ..utils.quantity import parse_quantity
 

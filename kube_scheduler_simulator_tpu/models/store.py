@@ -16,10 +16,11 @@ from __future__ import annotations
 import bisect
 import copy
 import itertools
-import threading
 from collections import deque
 from dataclasses import dataclass
-from typing import Callable, Iterable
+from typing import Callable
+
+from ..utils import locking
 
 # The seven watched kinds, in the reference's order
 # (resourcewatcher.go:22-30), plus the workload kinds the controller
@@ -57,7 +58,7 @@ class ResourceStore:
     """Typed collections with list/watch semantics."""
 
     def __init__(self, event_log_capacity: int = 100_000):
-        self._lock = threading.RLock()
+        self._lock = locking.make_rlock("store.objects")
         self._rv = itertools.count(1)
         self._objs: dict[str, dict[str, dict]] = {k: {} for k in KINDS}
         self._events: list[WatchEvent] = []
@@ -75,7 +76,7 @@ class ResourceStore:
         # mutations append to _delivery under the lock, then drain it under
         # the re-entrant dispatch lock after releasing the state lock.
         self._delivery: deque[WatchEvent] = deque()
-        self._dispatch_lock = threading.RLock()
+        self._dispatch_lock = locking.make_rlock("store.dispatch")
 
     # -- keys ---------------------------------------------------------------
 

@@ -10,6 +10,7 @@ the dictionaries; device arrays only ever hold ids. Id -1 is reserved for
 
 from __future__ import annotations
 
+from typing import Iterator
 
 ABSENT = -1
 
@@ -17,7 +18,7 @@ ABSENT = -1
 class Vocab:
     """A monotone string→int32 interning table."""
 
-    def __init__(self, initial: "list[str] | None" = None):
+    def __init__(self, initial: "list[str] | None" = None) -> None:
         self._to_id: dict[str, int] = {}
         self._to_str: list[str] = []
         for s in initial or []:
@@ -46,5 +47,5 @@ class Vocab:
     def __contains__(self, s: str) -> bool:
         return s in self._to_id
 
-    def items(self):
+    def items(self) -> "Iterator[tuple[str, int]]":
         return ((s, i) for i, s in enumerate(self._to_str))

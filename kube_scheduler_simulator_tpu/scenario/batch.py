@@ -29,13 +29,13 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 from dataclasses import dataclass, field
 
 import numpy as np
 
 from ..models.snapshot import import_snapshot
 from ..models.store import ResourceStore
+from ..utils import locking
 from ..sched.config import SchedulerConfiguration
 from .runner import Operation, ScenarioRunner
 
@@ -149,7 +149,7 @@ def _run_sweep_job(job: BatchJob, mesh=None) -> dict:
 # process, whoever the caller is (the batch runner's serial loop, the
 # HTTP /api/v1/scenario route's request threads). This lock is the
 # single enforcement point.
-_DEVICE_JOB_LOCK = threading.Lock()
+_DEVICE_JOB_LOCK = locking.make_lock("batch.device")
 
 
 def run_job(job: BatchJob, *, mesh=None) -> dict:

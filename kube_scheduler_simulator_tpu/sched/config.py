@@ -23,8 +23,6 @@ from __future__ import annotations
 import copy
 import json
 from dataclasses import dataclass, field
-from typing import Any
-
 import yaml
 
 MAX_NODE_SCORE = 100

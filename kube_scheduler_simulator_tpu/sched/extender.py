@@ -27,9 +27,9 @@ from __future__ import annotations
 
 import copy
 import json
-import threading
 import urllib.request
 
+from ..utils import locking
 from .config import MAX_NODE_SCORE
 
 MAX_EXTENDER_PRIORITY = 10
@@ -148,7 +148,7 @@ class ExtenderService:
 
     def __init__(self, extender_cfgs: list[dict]):
         self.extenders = [Extender(c) for c in extender_cfgs or []]
-        self._lock = threading.Lock()
+        self._lock = locking.make_lock("extender.results")
         # (ns, pod) → verb → extender name → result
         self._results: dict[tuple[str, str], dict[str, dict]] = {}
 

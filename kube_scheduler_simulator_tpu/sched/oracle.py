@@ -19,10 +19,8 @@ Determinism policy (documented divergence from upstream):
 
 from __future__ import annotations
 
-import math
 from dataclasses import dataclass, field
-from fractions import Fraction
-from typing import Any, Callable
+from typing import Any
 
 from ..models.objects import (
     NodeView,
@@ -31,7 +29,7 @@ from ..models.objects import (
     pod_scoring_requests,
     resolve_pod_priority,
 )
-from .config import MAX_NODE_SCORE, SchedulerConfiguration
+from .config import SchedulerConfiguration
 from .resources import to_int_resources
 from .results import (
     PASSED_FILTER_MESSAGE,

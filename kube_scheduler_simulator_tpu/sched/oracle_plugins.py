@@ -17,11 +17,9 @@ NormalizeScore pass. DefaultNormalizeScore here mirrors the upstream helper
 from __future__ import annotations
 
 import math
-from fractions import Fraction
 from typing import TYPE_CHECKING, Callable
 
 from ..models.objects import (
-    NodeView,
     PodView,
     match_label_selector,
     match_node_selector_terms,

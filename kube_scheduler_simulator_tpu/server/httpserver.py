@@ -74,6 +74,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from ..models.store import KINDS, NAMESPACED, StaleResourceVersion
+from ..utils import locking
 from ..utils import metrics as metrics_mod
 from ..utils import telemetry
 from ..utils.broker import CompileDeadlineExceeded, CompileUnavailable
@@ -143,7 +144,7 @@ class SimulatorServer:
         # SSE subscriber accounting (the satellite hardening): live
         # subscriber count against the manager's cap, and the events
         # dropped on slow consumers (surfaced as sseDroppedEvents)
-        self._sse_lock = threading.Lock()
+        self._sse_lock = locking.make_lock("http.sse")
         self._sse_subs = 0
         self._sse_dropped = 0
         handler = _make_handler(self)
@@ -153,11 +154,11 @@ class SimulatorServer:
         # one scenario/sweep run at a time over this server (KEP-140's
         # one-scenario-at-a-time; each request thread would otherwise
         # drive the device concurrently)
-        self._scenario_lock = threading.Lock()
+        self._scenario_lock = locking.make_lock("http.scenario")
         # POST /api/v1/debug/profile arming state: the active jax
         # profiler capture's log dir, or None (at most one per process —
         # jax.profiler is a process-wide singleton)
-        self._profile_lock = threading.Lock()
+        self._profile_lock = locking.make_lock("http.profile")
         self._profile_dir: "str | None" = None
 
     @property

@@ -46,7 +46,7 @@ from ..models.store import ResourceStore
 from ..sched.config import SchedulerConfiguration
 from ..sched.extender import ExtenderService
 from ..sched.results import PodSchedulingResult
-from ..utils import faultinject
+from ..utils import faultinject, locking
 from ..utils import metrics as metrics_mod
 from ..utils import telemetry
 from ..utils.broker import (
@@ -177,10 +177,10 @@ class SchedulerService:
         )
         self._initial = initial_config or SchedulerConfiguration.default()
         self._config = self._initial
-        self._lock = threading.Lock()
+        self._lock = locking.make_lock("service.state")
         # whole-pass serialization (held across dispatch→resolve for
         # async passes — see SchedulingPassHandle)
-        self._schedule_lock = threading.Lock()
+        self._schedule_lock = locking.make_lock("service.schedule")
         # ALL compiled engines (sequential / gang / extender, keyed by
         # kind + compile signature) live in the CompileBroker: it dedupes
         # concurrent builds, counts hits/misses/stall seconds into this
@@ -1028,7 +1028,7 @@ class SimulatorService:
         fault_plane=None,
     ):
         self.store = ResourceStore()
-        self._controllers_lock = threading.Lock()
+        self._controllers_lock = locking.make_lock("service.controllers")
         self.external_scheduler_enabled = external_scheduler_enabled
         # replayable JSONL trace of the most recent lifecycle chaos run
         # (run_lifecycle; served by GET /api/v1/lifecycle/trace)
@@ -1047,7 +1047,7 @@ class SimulatorService:
             # or replicated already-bound never count as scheduler
             # activity (they enter the map as bound on their ADDED event)
             self._ext_seen: dict[tuple[str, str], bool] = {}
-            self._ext_lock = threading.Lock()
+            self._ext_lock = locking.make_lock("service.external")
             self.store.subscribe(self._record_external_bind)
         self.store.snapshot_initial()
 

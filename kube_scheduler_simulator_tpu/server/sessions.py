@@ -48,7 +48,7 @@ from ..lifecycle.checkpoint import (
     load_checkpoint,
     write_checkpoint,
 )
-from ..utils import faultinject
+from ..utils import faultinject, locking
 from ..utils.broker import CompileBroker
 from .service import SchedulerServiceDisabled, SimulatorService
 
@@ -129,7 +129,7 @@ class Session:
         # disk reads/writes: one tenant's multi-second snapshot must not
         # stall every other tenant's request routing. Lock order:
         # _state_lock OUTSIDE manager._lock, never the reverse.
-        self._state_lock = threading.Lock()
+        self._state_lock = locking.make_lock("session.state")
         self.created_at = time.time()
         self.last_touch = time.monotonic()
         self.snapshot_path: "str | None" = None
@@ -221,7 +221,7 @@ class SessionManager:
             if broker is not None
             else CompileBroker(metrics=default_service.scheduler.metrics)
         )
-        self._lock = threading.RLock()
+        self._lock = locking.make_rlock("sessions.manager")
         self._pass_sem = threading.BoundedSemaphore(self.max_concurrent_passes)
         self.evictions = 0
         # adopt the boot service as the implicit default session: it

@@ -77,8 +77,8 @@ import time
 import weakref
 from contextlib import contextmanager, nullcontext as _null_context
 
-from . import envcheck, faultinject, telemetry
-from .compilecache import enable_compile_cache, shape_bucket
+from . import envcheck, faultinject, locking, telemetry
+from .compilecache import enable_compile_cache
 
 _log = logging.getLogger("kube_scheduler_simulator_tpu.broker")
 
@@ -294,7 +294,7 @@ class CompileBroker:
         self.speculative = (
             speculation_enabled_default() if speculative is None else bool(speculative)
         )
-        self._lock = threading.Lock()
+        self._lock = locking.make_lock("broker.lock")
         self._idle = threading.Condition(self._lock)
         self._engines: "dict[tuple, object]" = {}  # LRU via dict order
         self._inflight: "dict[tuple, _Inflight]" = {}
@@ -470,7 +470,7 @@ class CompileBroker:
         with self._lock:
             lk = self._leases.get(key)
             if lk is None:
-                lk = self._leases[key] = threading.RLock()
+                lk = self._leases[key] = locking.make_rlock("broker.lease")
             return lk
 
     def get(self, key: tuple, build, info: "dict | None" = None, metrics=None):

@@ -76,11 +76,11 @@ def enable_compile_cache(min_compile_time_secs: float = 0.1) -> None:
     try:
         import jax
 
-        jax.config.update(
+        jax.config.update(  # type: ignore[no-untyped-call]
             "jax_compilation_cache_dir",
             os.environ.get("KSS_JAX_CACHE_DIR", default_cache_dir()),
         )
-        jax.config.update(
+        jax.config.update(  # type: ignore[no-untyped-call]
             "jax_persistent_cache_min_compile_time_secs",
             min_compile_time_secs,
         )

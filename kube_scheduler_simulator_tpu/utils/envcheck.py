@@ -20,14 +20,19 @@ from __future__ import annotations
 
 import os
 import sys
+from typing import Callable, Mapping
+
+# a validator takes the raw env string and answers an error message, or
+# None when the value parses (the registry's value type)
+Validator = Callable[[str], "str | None"]
 
 # The ONE boolean vocabulary: every spelling `check_env` accepts is a
 # spelling the runtime parsers honor (broker speculation kill switch,
 # telemetry KSS_TRACE). Validation blessing a value the runtime would
 # silently ignore is exactly the misconfiguration class this module
 # exists to catch.
-TRUTHY = ("1", "true", "yes", "on", "t")
-FALSY = ("", "0", "false", "no", "off", "f")
+TRUTHY: "tuple[str, ...]" = ("1", "true", "yes", "on", "t")
+FALSY: "tuple[str, ...]" = ("", "0", "false", "no", "off", "f")
 _BOOLISH = TRUTHY + FALSY
 
 
@@ -37,7 +42,7 @@ def env_truthy(raw: "str | None") -> bool:
     return (raw or "").strip().lower() in TRUTHY
 
 
-def _int_validator(minimum: "int | None" = None):
+def _int_validator(minimum: "int | None" = None) -> Validator:
     def check(raw: str) -> "str | None":
         try:
             v = int(raw)
@@ -50,7 +55,7 @@ def _int_validator(minimum: "int | None" = None):
     return check
 
 
-def _float_validator(minimum: "float | None" = None):
+def _float_validator(minimum: "float | None" = None) -> Validator:
     def check(raw: str) -> "str | None":
         try:
             v = float(raw)
@@ -73,7 +78,7 @@ def _fault_spec_validator(raw: str) -> "str | None":
     from . import faultinject
 
     try:
-        faultinject.FaultPlane.parse(raw)
+        faultinject.FaultPlane.parse(raw)  # type: ignore[no-untyped-call]
     except ValueError as e:
         return str(e)
     return None
@@ -85,7 +90,7 @@ def _path_validator(raw: str) -> "str | None":
 
 # name -> validator(raw) returning an error string or None. The ONE
 # catalogue of KSS_* configuration (docs/environment-variables.md).
-KNOWN = {
+KNOWN: "dict[str, Validator]" = {
     # serving stack
     "KSS_ENCODING_CACHE_CAP": _int_validator(1),
     "KSS_NO_SPECULATIVE_COMPILE": _bool_validator,
@@ -101,6 +106,10 @@ KNOWN = {
     "KSS_COMPILE_COOLDOWN_TTL_S": _float_validator(0.0),
     "KSS_FAULT_INJECT": _fault_spec_validator,
     "KSS_FAULT_INJECT_SEED": _int_validator(),
+    # static analysis / debug tooling (docs/static-analysis.md): wrap
+    # the serving stack's known locks in the runtime lock-order witness
+    # (utils/locking.py) — raises on an acquisition-order inversion
+    "KSS_LOCK_CHECK": _bool_validator,
     # session plane (docs/sessions.md)
     "KSS_MAX_SESSIONS": _int_validator(1),
     "KSS_MAX_PENDING_PODS_PER_SESSION": _int_validator(0),
@@ -111,7 +120,7 @@ KNOWN = {
 }
 
 
-def check_env(env: "dict | None" = None) -> list[str]:
+def check_env(env: "Mapping[str, str] | None" = None) -> list[str]:
     """Validate every KSS_* variable in `env` (default: os.environ).
     Returns a list of human-readable problems — empty means the
     environment parses cleanly. Unset variables are never errors."""
@@ -133,7 +142,7 @@ def check_env(env: "dict | None" = None) -> list[str]:
     return problems
 
 
-def fail_fast(env: "dict | None" = None) -> None:
+def fail_fast(env: "Mapping[str, str] | None" = None) -> None:
     """Entry-point gate: print every env problem and exit 2. A clear
     refusal at boot beats a silently-defaulted knob or a ValueError deep
     inside the first request handler."""

@@ -48,7 +48,7 @@ import random
 import threading
 import time
 
-from . import telemetry
+from . import locking, telemetry
 
 PROBABILITY_SITES = ("compile_fail", "device_error", "worker_crash")
 DURATION_SITES = ("compile_slow",)
@@ -77,7 +77,7 @@ class FaultPlane:
                 )
         self.rules = dict(rules)
         self.seed = int(seed)
-        self._lock = threading.Lock()
+        self._lock = locking.make_lock("faultinject.plane")
         self._rng = {
             site: random.Random(f"kss-fault:{self.seed}:{site}")
             for site in PROBABILITY_SITES
@@ -213,7 +213,7 @@ def scoped_active() -> "FaultPlane | None":
     return sc[0] if sc is not None else None
 
 
-_lock = threading.Lock()
+_lock = locking.make_lock("faultinject.registry")
 # (raw env string, seed string) -> plane parsed from them; an explicit
 # `activate` overrides the environment until `deactivate`
 _cached: "tuple[tuple[str, str], FaultPlane | None] | None" = None

@@ -1,0 +1,286 @@
+"""Runtime lock-order witness: ``KSS_LOCK_CHECK=1`` (docs/static-analysis.md).
+
+The static lock-order analyzer (analysis/lock_order.py) sees only the
+acquisitions it can resolve lexically; locks reached through
+cross-module calls — the schedule lock over the broker lock over the
+store locks — are invisible to it. This module is the dynamic half: a
+lightweight deadlock/race detector in the happens-before style (cf.
+Go's lock-order assertions and pthread's PTHREAD_MUTEX_ERRORCHECK
+lineage), cheap enough to run under the whole test suite.
+
+Every lock the serving stack creates goes through `make_lock` /
+`make_rlock` with a stable ROLE name ("broker.lock",
+"sessions.manager", ...). With ``KSS_LOCK_CHECK`` unset (the default)
+these return plain `threading.Lock`/`RLock` objects — zero overhead,
+byte-identical behavior. With ``KSS_LOCK_CHECK=1`` they return witness
+wrappers that:
+
+  * track the set of roles each thread currently holds;
+  * on every acquisition record the edge ``held role -> acquired
+    role`` into a process-global order graph, stamped with the first
+    observing call site;
+  * RAISE `LockOrderInversion` the moment an acquisition would close a
+    cycle in that graph — two call paths have been SEEN acquiring the
+    same roles in opposite orders, which is a deadlock waiting for the
+    right interleaving.
+
+Same-role edges are skipped: roles name lock *classes* (every
+`SpanRecorder` ring shares "telemetry.ring"), and two instances of one
+role cannot be ordered by name. Re-entrant re-acquisition of an RLock
+records nothing (depth bookkeeping only).
+
+`tests/test_lock_witness.py` drives a concurrent session-plane stress
+under the witness and pins zero inversions; the witness itself is
+negative-tested by forcing an AB/BA pair.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+
+from . import envcheck
+
+ENV_VAR = "KSS_LOCK_CHECK"
+
+
+def lock_check_enabled(env: "dict | None" = None) -> bool:
+    """The witness switch, read at LOCK CREATION time (wrapping is a
+    construction-time decision; flipping the env mid-process affects
+    only locks created afterwards)."""
+    env = os.environ if env is None else env
+    return envcheck.env_truthy(env.get(ENV_VAR))
+
+
+class LockOrderInversion(RuntimeError):
+    """Two lock roles have been acquired in both orders — a deadlock
+    exists for some interleaving. Carries both sites."""
+
+
+class LockWitness:
+    """The process-global order graph + per-thread held sets."""
+
+    def __init__(self) -> None:
+        self._graph_lock = threading.Lock()
+        # (held role, acquired role) -> first observing site (str)
+        self.edges: "dict[tuple[str, str], str]" = {}
+        self.inversions: "list[str]" = []
+        self.acquisitions = 0
+        self._held = threading.local()
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    def _held_list(self) -> "list[str]":
+        held = getattr(self._held, "roles", None)
+        if held is None:
+            held = self._held.roles = []
+        return held
+
+    @staticmethod
+    def _site() -> str:
+        for frame in reversed(traceback.extract_stack(limit=16)):
+            if "utils/locking" not in frame.filename.replace(os.sep, "/"):
+                return f"{frame.filename}:{frame.lineno}"
+        return "<unknown>"
+
+    def _would_cycle(self, a: str, b: str) -> "list[str] | None":
+        """Path b ~> a in the edge graph (so adding a -> b closes a
+        cycle); returns the role path or None. Graph is tiny (one node
+        per lock role), so a DFS per new edge is fine."""
+        stack = [(b, [b])]
+        seen = {b}
+        while stack:
+            node, path = stack.pop()
+            if node == a:
+                return path
+            for (x, y) in self.edges:
+                if x == node and y not in seen:
+                    seen.add(y)
+                    stack.append((y, path + [y]))
+        return None
+
+    def on_acquired(self, role: str) -> "list[str]":
+        """Called by a wrapper AFTER it acquired its underlying lock:
+        record edges from every held role, raising on an inversion.
+        Returns the acquiring thread's held list so a plain-Lock wrapper
+        can hand it to `on_released_list` even when the release happens
+        on ANOTHER thread (the pass-handle dispatch→resolve shape)."""
+        held = self._held_list()
+        # the call site is only needed when a NEW edge lands (or an
+        # inversion fires) — extracting the stack on every steady-state
+        # acquisition would dominate a witnessed run's cost
+        site: "str | None" = None
+        error: "str | None" = None
+        with self._graph_lock:
+            self.acquisitions += 1
+            for h in held:
+                if h == role or (h, role) in self.edges:
+                    continue
+                if site is None:
+                    site = self._site()
+                cycle = self._would_cycle(h, role)
+                if cycle is not None:
+                    first = self.edges.get(
+                        (cycle[0], cycle[1]), "<site unknown>"
+                    ) if len(cycle) > 1 else "<site unknown>"
+                    error = (
+                        f"lock-order inversion: acquiring {role!r} while "
+                        f"holding {h!r} at {site}, but the opposite order "
+                        f"{' -> '.join(cycle)} was seen at {first}"
+                    )
+                    self.inversions.append(error)
+                    break
+                self.edges[(h, role)] = site
+            if error is None:
+                # appended under the graph lock: a cross-thread release
+                # (on_released_list) may mutate this list concurrently
+                held.append(role)
+        if error is not None:
+            # the caller releases the underlying lock on this raise, so
+            # the role must NOT enter the held list
+            raise LockOrderInversion(error)
+        return held
+
+    def on_released_list(self, held: "list[str]", role: str) -> None:
+        """Drop `role` from a specific thread's held list (the one
+        `on_acquired` returned) — correct even when a plain Lock is
+        released by a thread other than its acquirer."""
+        with self._graph_lock:
+            # locks need not release LIFO: drop the most recent matching
+            for i in range(len(held) - 1, -1, -1):
+                if held[i] == role:
+                    del held[i]
+                    break
+
+    def on_released(self, role: str) -> None:
+        """Drop `role` from the CALLING thread's held list (the RLock
+        path: RLocks are owner-released by contract)."""
+        self.on_released_list(self._held_list(), role)
+
+    def snapshot(self) -> dict:
+        with self._graph_lock:
+            return {
+                "edges": {
+                    f"{a} -> {b}": site
+                    for (a, b), site in sorted(self.edges.items())
+                },
+                "inversions": list(self.inversions),
+                "acquisitions": self.acquisitions,
+            }
+
+    def reset(self) -> None:
+        with self._graph_lock:
+            self.edges.clear()
+            self.inversions.clear()
+            self.acquisitions = 0
+
+
+WITNESS = LockWitness()
+
+
+class _WitnessBase:
+    """Shared context-manager plumbing for the witness wrappers. Both
+    play the Condition(lock) role (threading.Condition only needs
+    acquire/release; its `_is_owned` fallback probes with a
+    non-blocking acquire, which flows through here like any other
+    acquisition)."""
+
+    def __init__(self, role: str, witness: "LockWitness | None" = None):
+        self.role = role
+        self.witness = witness if witness is not None else WITNESS
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    def __repr__(self) -> str:
+        return f"<{type(self).__name__} role={self.role!r}>"
+
+
+class WitnessLock(_WitnessBase):
+    """Plain-Lock wrapper. A `threading.Lock` may legally be released
+    by a thread other than its acquirer (the `SchedulingPassHandle`
+    dispatch→resolve shape), so the acquirer's held list travels on the
+    INSTANCE — release removes the role from the list `on_acquired`
+    returned, whichever thread calls it."""
+
+    def __init__(self, role: str, witness: "LockWitness | None" = None):
+        super().__init__(role, witness)
+        self._inner = threading.Lock()
+        self._holder_held: "list[str] | None" = None
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            try:
+                self._holder_held = self.witness.on_acquired(self.role)
+            except BaseException:
+                self._inner.release()
+                raise
+        return ok
+
+    def release(self) -> None:
+        held, self._holder_held = self._holder_held, None
+        if held is not None:
+            self.witness.on_released_list(held, self.role)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+
+class WitnessRLock(_WitnessBase):
+    """RLock wrapper: re-entrant re-acquisition records nothing (depth
+    bookkeeping only). RLocks are owner-released by contract — the
+    inner RLock raises on a foreign release — so per-thread depth is
+    sound. No `locked()`: threading.RLock exposes none on this Python,
+    and the wrapper keeps the underlying type's surface."""
+
+    def __init__(self, role: str, witness: "LockWitness | None" = None):
+        super().__init__(role, witness)
+        self._inner = threading.RLock()
+        self._depth = threading.local()
+
+    def _depth_add(self, delta: int) -> int:
+        n = getattr(self._depth, "n", 0) + delta
+        self._depth.n = n
+        return n
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            if self._depth_add(+1) == 1:
+                try:
+                    self.witness.on_acquired(self.role)
+                except BaseException:
+                    self._depth_add(-1)
+                    self._inner.release()
+                    raise
+        return ok
+
+    def release(self) -> None:
+        if getattr(self._depth, "n", 0) <= 0:
+            # foreign/over-release: let the inner RLock raise its own
+            # RuntimeError without corrupting the witness
+            self._inner.release()
+            return
+        if self._depth_add(-1) == 0:
+            self.witness.on_released(self.role)
+        self._inner.release()
+
+
+def make_lock(role: str):
+    """A `threading.Lock` — witness-wrapped when KSS_LOCK_CHECK is set
+    at creation time. `role` is the stable order-graph node name."""
+    return WitnessLock(role) if lock_check_enabled() else threading.Lock()
+
+
+def make_rlock(role: str):
+    """A `threading.RLock` — witness-wrapped when KSS_LOCK_CHECK is set
+    at creation time (re-entrant re-acquisition records nothing)."""
+    return WitnessRLock(role) if lock_check_enabled() else threading.RLock()

@@ -19,6 +19,8 @@ import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 
+from . import locking
+
 # The /api/v1/metrics JSON document's schema version: bumped whenever a
 # field changes meaning or disappears (additions don't bump it). v2
 # introduced the version stamp itself, uptimeSeconds, and the
@@ -155,7 +157,10 @@ class SchedulingMetrics:
     counter from BASELINE.json, kept in-framework)."""
 
     keep: int = 256
-    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+    _lock: threading.Lock = field(
+        default_factory=lambda: locking.make_lock("metrics.registry"),
+        repr=False,
+    )
     _passes: list[PassRecord] = field(default_factory=list, repr=False)
     _pass_count: int = 0  # monotonic; _passes is a bounded window of it
     _total_pods: int = 0

@@ -20,7 +20,7 @@ from dataclasses import dataclass, field
 from fractions import Fraction
 from functools import lru_cache
 
-_BINARY_SUFFIXES = {
+_BINARY_SUFFIXES: "dict[str, int]" = {
     "Ki": 1024,
     "Mi": 1024**2,
     "Gi": 1024**3,
@@ -29,7 +29,7 @@ _BINARY_SUFFIXES = {
     "Ei": 1024**6,
 }
 
-_DECIMAL_SUFFIXES = {
+_DECIMAL_SUFFIXES: "dict[str, Fraction]" = {
     "n": Fraction(1, 10**9),
     "u": Fraction(1, 10**6),
     "m": Fraction(1, 10**3),

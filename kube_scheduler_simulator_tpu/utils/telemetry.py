@@ -55,6 +55,12 @@ import os
 import threading
 import time
 
+from . import locking
+# the shared boolean vocabulary (envcheck.TRUTHY): KSS_TRACE honors
+# every spelling startup validation accepts — a 'validated' tracing run
+# must never silently record nothing
+from .envcheck import TRUTHY as _TRUE
+
 ENV_VAR = "KSS_TRACE"
 CAP_VAR = "KSS_TRACE_RING_CAP"
 DEFAULT_RING_CAP = 65536
@@ -63,11 +69,6 @@ DEFAULT_RING_CAP = 65536
 # pipeline's in-flight device-execute windows). Python thread idents are
 # CPython object addresses and never 0, so 0 is collision-free.
 DEVICE_TID = 0
-
-# the shared boolean vocabulary (envcheck.TRUTHY): KSS_TRACE honors
-# every spelling startup validation accepts — a 'validated' tracing run
-# must never silently record nothing
-from .envcheck import TRUTHY as _TRUE
 
 _PID = os.getpid()
 
@@ -101,7 +102,7 @@ class SpanRecorder:
         if cap < 1:
             raise ValueError(f"ring capacity must be >= 1, got {cap}")
         self.capacity = cap
-        self._lock = threading.Lock()
+        self._lock = locking.make_lock("telemetry.ring")
         self._ring: "list[dict | None]" = [None] * cap
         self._seq = 0  # monotonic count of events ever emitted
         self._subs: list = []
@@ -161,7 +162,7 @@ class SpanRecorder:
 
 # -- the process-global active recorder --------------------------------------
 
-_lock = threading.Lock()
+_lock = locking.make_lock("telemetry.config")
 # (KSS_TRACE, KSS_TRACE_RING_CAP) raw strings -> recorder parsed from
 # them; an explicit `activate` overrides the environment (tests, the
 # lifecycle CLI's --perfetto-out) until `deactivate`. Both globals are

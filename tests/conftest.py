@@ -29,6 +29,10 @@ for _var in (
     # zero-emission test would fail for the wrong reason)
     "KSS_TRACE",
     "KSS_TRACE_RING_CAP",
+    # the lock-order witness (utils/locking.py): an ambient
+    # KSS_LOCK_CHECK=1 would wrap every lock the suite creates; the
+    # witness tests arm it explicitly with monkeypatch
+    "KSS_LOCK_CHECK",
     # the session plane (server/sessions.py): ambient admission knobs
     # would change quota/limit behavior under test
     "KSS_MAX_SESSIONS",

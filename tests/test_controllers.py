@@ -5,7 +5,6 @@ simulator/controller/controller.go:77-86)."""
 import pytest
 
 from kube_scheduler_simulator_tpu.controllers import (
-    deployment_controller_step,
     pv_controller_step,
     replicaset_controller_step,
     run_to_fixpoint,

@@ -21,7 +21,6 @@ from kube_scheduler_simulator_tpu.engine import (
     encode_cluster,
 )
 from kube_scheduler_simulator_tpu.engine.gang import GangScheduler
-from kube_scheduler_simulator_tpu.sched.config import SchedulerConfiguration
 
 from helpers import node, pod
 from test_engine_parity import restricted_config

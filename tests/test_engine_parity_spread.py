@@ -7,7 +7,7 @@ import pytest
 from kube_scheduler_simulator_tpu.engine import EXACT, TPU32
 
 from helpers import node, pod
-from test_engine_parity import assert_parity, restricted_config
+from test_engine_parity import assert_parity
 from test_engine_parity_m3 import m3a_config
 
 

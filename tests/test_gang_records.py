@@ -18,8 +18,6 @@ Strong cases pinned here:
 from __future__ import annotations
 
 import numpy as np
-import pytest
-
 from kube_scheduler_simulator_tpu.engine import (
     TPU32,
     BatchedScheduler,

@@ -4,8 +4,6 @@ status AND `timeout.String()` are recorded per permit plugin)."""
 
 import json
 
-import pytest
-
 from kube_scheduler_simulator_tpu.engine import EXACT, BatchedScheduler, encode_cluster
 from kube_scheduler_simulator_tpu.engine import kernels as K
 from kube_scheduler_simulator_tpu.sched.results import go_duration

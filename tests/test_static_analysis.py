@@ -1,0 +1,411 @@
+"""kss-lint (kube_scheduler_simulator_tpu/analysis): the tier-1 gate.
+
+Two halves:
+
+  * the LIVE tree must be clean — every cross-cutting contract
+    (env registry, metrics registry, jit purity, lock order, span
+    balance) holds over the shipped source, with an EMPTY allowlist;
+  * every analyzer must FIRE on a synthetic violation — a green gate
+    that cannot go red is no gate at all.
+"""
+
+import json
+import os
+
+import pytest
+
+from kube_scheduler_simulator_tpu.analysis import core
+from kube_scheduler_simulator_tpu.analysis import (
+    env_registry,
+    jit_purity,
+    lock_order,
+    metrics_registry,
+    span_balance,
+)
+from kube_scheduler_simulator_tpu.analysis.core import (
+    ALLOWLIST,
+    Finding,
+    RepoContext,
+    SourceTree,
+    run_all,
+)
+
+
+@pytest.fixture(scope="module")
+def live_tree():
+    return SourceTree.load()
+
+
+@pytest.fixture(scope="module")
+def live_repo():
+    return RepoContext.discover()
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# -- the gate -----------------------------------------------------------------
+
+
+def test_live_tree_is_clean(live_tree, live_repo):
+    findings = run_all(live_tree, live_repo)
+    assert findings == [], "\n" + "\n".join(f.render() for f in findings)
+
+
+def test_allowlist_is_empty():
+    # the allowlist exists for emergencies and must stay empty: fix the
+    # violation, don't waive it (ISSUE 7 acceptance criterion)
+    assert ALLOWLIST == {}
+
+
+def test_cli_clean_on_live_tree(capsys):
+    from kube_scheduler_simulator_tpu.analysis.__main__ import main
+
+    assert main(["--format", "json"]) == 0
+    assert json.loads(capsys.readouterr().out) == []
+
+
+def test_live_lock_graph_is_populated(live_tree):
+    # the lock-order analyzer must be analyzing something real: the
+    # documented session-plane ordering (state OUTSIDE manager) is a
+    # static edge it must see
+    edges = {
+        (str(a), str(b)) for a, b in lock_order.lock_graph(live_tree)
+    }
+    assert (
+        "server/sessions.py:Session._state_lock",
+        "server/sessions.py:SessionManager._lock",
+    ) in edges
+    assert len(edges) >= 3
+
+
+def test_live_env_registry_is_populated(live_tree):
+    known = env_registry.registry_names(live_tree)
+    assert "KSS_LOCK_CHECK" in known  # dogfood: registered in this PR
+    assert len(known) >= 15
+
+
+# -- negative tests: each analyzer fires on a synthetic violation -------------
+
+
+def _docs(tmp_path, **files):
+    for name, text in files.items():
+        (tmp_path / f"{name}.md").write_text(text)
+    return RepoContext(docs_dir=str(tmp_path))
+
+
+def test_env_registry_fires_on_undeclared_read(tmp_path):
+    tree = SourceTree.from_sources(
+        {
+            "utils/envcheck.py": "KNOWN = {\n    'KSS_GOOD': None,\n}\n",
+            "server/thing.py": (
+                "import os\n"
+                "good = os.environ.get('KSS_GOOD')\n"
+                "bad = os.environ.get('KSS_BOGUS_KNOB')\n"
+            ),
+        }
+    )
+    repo = _docs(tmp_path, **{"environment-variables": "`KSS_GOOD`\n"})
+    findings = env_registry.run(tree, repo)
+    assert rules_of(findings) == {"KSS101"}
+    (f,) = findings
+    assert "KSS_BOGUS_KNOB" in f.message and f.path == "server/thing.py"
+
+
+def test_env_registry_fires_on_dead_and_undocumented_config(tmp_path):
+    tree = SourceTree.from_sources(
+        {
+            "utils/envcheck.py": (
+                "KNOWN = {\n"
+                "    'KSS_USED': None,\n"
+                "    'KSS_DEAD': None,\n"
+                "}\n"
+            ),
+            "server/thing.py": (
+                "import os\nused = os.environ.get('KSS_USED')\n"
+            ),
+        }
+    )
+    repo = _docs(tmp_path, **{"environment-variables": "`KSS_USED`\n"})
+    findings = env_registry.run(tree, repo)
+    assert rules_of(findings) == {"KSS102", "KSS103"}
+    assert all("KSS_DEAD" in f.message for f in findings)
+
+
+def test_env_registry_resolves_constants_and_helpers():
+    # the two indirect read idioms: a module-level name constant
+    # (telemetry's ENV_VAR) and a reader-helper parameter (broker's
+    # _env_number) must both count as reads
+    tree = SourceTree.from_sources(
+        {
+            "utils/envcheck.py": "KNOWN = {}\n",
+            "a.py": (
+                "import os\n"
+                "ENV_VAR = 'KSS_BY_CONST'\n"
+                "v = os.environ.get(ENV_VAR)\n"
+            ),
+            "b.py": (
+                "import os\n"
+                "def _env_number(name, default):\n"
+                "    return os.environ.get(name, default)\n"
+                "x = _env_number('KSS_BY_HELPER', '1')\n"
+            ),
+        }
+    )
+    findings = env_registry.run(tree, RepoContext())
+    assert {m for f in findings for m in (f.message,)} == {
+        "environment read of KSS_BY_CONST is not declared in "
+        "utils/envcheck.KNOWN",
+        "environment read of KSS_BY_HELPER is not declared in "
+        "utils/envcheck.KNOWN",
+    }
+
+
+def test_metrics_registry_fires_on_undeclared_metric(tmp_path):
+    tree = SourceTree.from_sources(
+        {"utils/metrics.py": "NAME = 'kss_bogus_total'\n"}
+    )
+    repo = _docs(
+        tmp_path, observability="| `kss_ghost_total` | counter | gone |\n"
+    )
+    findings = metrics_registry.run(tree, repo)
+    assert rules_of(findings) == {"KSS201", "KSS202"}
+    by_rule = {f.rule: f for f in findings}
+    assert "kss_bogus_total" in by_rule["KSS201"].message
+    assert "kss_ghost_total" in by_rule["KSS202"].message
+
+
+def test_metrics_registry_semantic_render_coverage_fires():
+    from kube_scheduler_simulator_tpu.utils.metrics import SchedulingMetrics
+
+    class Unrendered(SchedulingMetrics):
+        # a counter that is checkpointed but never rendered: KSS203
+        _STATE_FIELDS = SchedulingMetrics._STATE_FIELDS + ("_rogue",)
+        _rogue = 0
+
+        def snapshot(self):
+            doc = super().snapshot()
+            doc["phases"]["rogueCounter"] = self._rogue
+            return doc
+
+    findings = metrics_registry.render_coverage_findings(Unrendered)
+    assert rules_of(findings) == {"KSS203"}
+    assert "rogueCounter" in findings[0].message
+
+    class Unpersisted(SchedulingMetrics):
+        # a counter the checkpoint state loses: KSS204
+        _lost = 0
+
+        def snapshot(self):
+            doc = super().snapshot()
+            doc["phases"]["lostCounter"] = self._lost
+            return doc
+
+    findings = metrics_registry.render_coverage_findings(Unpersisted)
+    assert rules_of(findings) == {"KSS204"}
+    assert "lostCounter" in findings[0].message
+
+
+def test_metrics_registry_semantic_clean_on_live_class():
+    assert metrics_registry.render_coverage_findings() == []
+
+
+def test_jit_purity_fires_on_direct_jax_jit():
+    tree = SourceTree.from_sources(
+        {
+            "engine/thing.py": (
+                "import jax\n"
+                "def f(x):\n"
+                "    return x + 1\n"
+                "g = jax.jit(f)\n"
+            )
+        }
+    )
+    findings = jit_purity.run(tree, RepoContext())
+    assert rules_of(findings) == {"KSS301"}
+
+
+def test_jit_purity_fires_on_impure_body():
+    tree = SourceTree.from_sources(
+        {
+            "engine/thing.py": (
+                "import time\n"
+                "import os\n"
+                "from ..utils import broker as broker_mod\n"
+                "def f(x):\n"
+                "    time.sleep(0.1)\n"
+                "    v = os.environ.get('HOME')\n"
+                "    return x.item()\n"
+                "g = broker_mod.jit(f)\n"
+            )
+        }
+    )
+    findings = jit_purity.run(tree, RepoContext())
+    assert rules_of(findings) == {"KSS302"}
+    effects = "\n".join(f.message for f in findings)
+    assert "time.sleep" in effects
+    assert ".item()" in effects
+
+
+def test_jit_purity_resolves_builder_closures():
+    # the `self.run_fn = self._build_run()` idiom must resolve through
+    # the factory's return so the closure body is actually scanned
+    tree = SourceTree.from_sources(
+        {
+            "engine/thing.py": (
+                "from ..utils import broker as broker_mod\n"
+                "class Engine:\n"
+                "    def __init__(self):\n"
+                "        self.run_fn = self._build_run()\n"
+                "        self._run = broker_mod.jit(self.run_fn)\n"
+                "    def _build_run(self):\n"
+                "        def run(arrays, state):\n"
+                "            print('tracing')\n"
+                "            return state\n"
+                "        return run\n"
+            )
+        }
+    )
+    findings = jit_purity.run(tree, RepoContext())
+    assert rules_of(findings) == {"KSS302"}
+    assert "print() call" in findings[0].message
+
+
+def test_lock_order_fires_on_cycle():
+    tree = SourceTree.from_sources(
+        {
+            "server/thing.py": (
+                "import threading\n"
+                "class T:\n"
+                "    def __init__(self):\n"
+                "        self._a = threading.Lock()\n"
+                "        self._b = threading.Lock()\n"
+                "    def one(self):\n"
+                "        with self._a:\n"
+                "            with self._b:\n"
+                "                pass\n"
+                "    def two(self):\n"
+                "        with self._b:\n"
+                "            with self._a:\n"
+                "                pass\n"
+            )
+        }
+    )
+    findings = lock_order.run(tree, RepoContext())
+    assert rules_of(findings) == {"KSS401"}
+    assert "T._a" in findings[0].message and "T._b" in findings[0].message
+
+
+def test_lock_order_one_hop_self_call_edge():
+    # evict -> snapshot_dir shape: a method called under a held lock
+    # contributes the locks it acquires
+    tree = SourceTree.from_sources(
+        {
+            "server/thing.py": (
+                "import threading\n"
+                "class T:\n"
+                "    def __init__(self):\n"
+                "        self._a = threading.Lock()\n"
+                "        self._b = threading.Lock()\n"
+                "    def helper(self):\n"
+                "        with self._b:\n"
+                "            pass\n"
+                "    def one(self):\n"
+                "        with self._a:\n"
+                "            self.helper()\n"
+                "    def two(self):\n"
+                "        with self._b:\n"
+                "            with self._a:\n"
+                "                pass\n"
+            )
+        }
+    )
+    findings = lock_order.run(tree, RepoContext())
+    assert rules_of(findings) == {"KSS401"}
+
+
+def test_span_balance_fires_on_bare_span():
+    tree = SourceTree.from_sources(
+        {
+            "server/thing.py": (
+                "from ..utils import telemetry\n"
+                "def f():\n"
+                "    s = telemetry.span('pass.custom')\n"
+                "    s.__enter__()\n"
+            )
+        }
+    )
+    findings = span_balance.run(tree, RepoContext())
+    assert rules_of(findings) == {"KSS501"}
+
+
+def test_span_balance_allows_with_and_enter_context():
+    tree = SourceTree.from_sources(
+        {
+            "server/thing.py": (
+                "from contextlib import ExitStack\n"
+                "from ..utils import telemetry\n"
+                "def f():\n"
+                "    with telemetry.span('a'), telemetry.span('b'):\n"
+                "        pass\n"
+                "    with ExitStack() as stack:\n"
+                "        stack.enter_context(telemetry.span('c'))\n"
+            )
+        }
+    )
+    assert span_balance.run(tree, RepoContext()) == []
+
+
+def test_span_balance_fires_on_raw_begin_emit():
+    tree = SourceTree.from_sources(
+        {
+            "server/thing.py": (
+                "def f(recorder):\n"
+                "    recorder.emit({'ph': 'B', 'name': 'x'})\n"
+            )
+        }
+    )
+    findings = span_balance.run(tree, RepoContext())
+    assert rules_of(findings) == {"KSS502"}
+
+
+# -- framework plumbing -------------------------------------------------------
+
+
+def test_allowlist_filters_by_location():
+    f = Finding("KSS999", "a.py", 3, "msg")
+    kept = core.apply_allowlist([f], {"KSS999": ("a.py:3",)})
+    assert kept == []
+    kept = core.apply_allowlist([f], {"KSS999": ("a.py:4",)})
+    assert kept == [f]
+
+
+def test_docstring_literals_are_skipped():
+    tree = SourceTree.from_sources(
+        {"m.py": '"""mentions kss_fake_total."""\nX = "kss_real_total"\n'}
+    )
+    names = metrics_registry.source_names(tree)
+    assert "kss_real_total" in names
+    assert "kss_fake_total" not in names
+
+
+def test_cli_reports_findings_nonzero(tmp_path, capsys):
+    # a package dir with a violation drives exit code 1 through the CLI
+    pkg = tmp_path / "pkg"
+    (pkg / "engine").mkdir(parents=True)
+    (pkg / "engine" / "bad.py").write_text(
+        "import jax\ng = jax.jit(lambda x: x)\n"
+    )
+    from kube_scheduler_simulator_tpu.analysis.__main__ import main
+
+    rc = main(["--package-dir", str(pkg), "--rule", "jit-purity"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "KSS301" in out
+
+
+def test_finding_render_is_clickable():
+    f = Finding("KSS101", "utils/x.py", 12, "boom", hint="fix it")
+    assert f.render().startswith("utils/x.py:12: KSS101: boom")
+    assert os.linesep not in f.rule

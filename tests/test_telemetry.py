@@ -15,7 +15,7 @@ from kube_scheduler_simulator_tpu.lifecycle.engine import LifecycleEngine
 from kube_scheduler_simulator_tpu.scenario.chaos import ChaosSpec
 from kube_scheduler_simulator_tpu.utils import telemetry
 
-from helpers import node, pod
+from helpers import node
 
 
 @pytest.fixture(autouse=True)

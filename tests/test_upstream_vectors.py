@@ -37,8 +37,6 @@ from __future__ import annotations
 
 import json
 
-import pytest
-
 from kube_scheduler_simulator_tpu.engine import (
     EXACT,
     BatchedScheduler,
