@@ -5,7 +5,7 @@
 PORT ?= 1212
 PY ?= python
 
-.PHONY: test test-fast lint start bench dryrun batch lifecycle-smoke perf-smoke resilience-smoke observability-smoke session-smoke docker docker-up clean
+.PHONY: test test-fast lint start bench dryrun batch lifecycle-smoke perf-smoke resilience-smoke observability-smoke session-smoke soak-smoke docker docker-up clean
 
 # full suite on the 8-device virtual CPU mesh (tests/conftest.py pins it)
 test:
@@ -79,6 +79,15 @@ observability-smoke:
 # Retry-After; one JSON line
 session-smoke:
 	env JAX_PLATFORMS=cpu $(PY) tools/session_smoke.py
+
+# survivable-execution-plane soak (docs/resilience.md): a seeded
+# interleaving of injected device faults (device_lost, dispatch_hang),
+# kill/resume chains, a real `kill -TERM`, and an HTTP server drain —
+# every disturbed run's trace must stay byte-identical to the oracle
+# and every exit must be clean, with the lock-order witness armed
+# throughout; one JSON line. Minutes on CPU, deliberately not tier-1.
+soak-smoke:
+	env JAX_PLATFORMS=cpu $(PY) tools/soak_smoke.py
 
 # containerized dev flow (reference `make docker_build_and_up`, one service)
 docker:

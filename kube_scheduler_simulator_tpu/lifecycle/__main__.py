@@ -23,8 +23,18 @@ the run gracefully at the next batch boundary with a FINAL checkpoint
 the default handler for a hard kill. ``--stop-after-events K`` is the
 deterministic stand-in for that kill (tools/resilience_smoke.py).
 
-Exit code 0 on a Succeeded run, 1 otherwise (the KEP-184 runner's
-contract, same as scenario/batch.py).
+Exit code 0 on a Succeeded run — and ALSO on an ``Interrupted`` run
+that wrote its final checkpoint: a graceful SIGTERM with checkpointing
+configured is the ORDERLY drain path (docs/resilience.md), and an
+orderly drain that lost nothing must read as success to a supervisor
+driving rolling restarts. Any other outcome exits 1 (the KEP-184
+runner's contract, same as scenario/batch.py).
+
+Boot-time device probe: like the serving shell (server/__main__.py),
+the CLI probes `jax.devices()` under a watchdog before running and
+re-execs itself on the scrubbed CPU backend when the accelerator is
+wedged (utils/axonenv.py) — a slower, labeled run beats a hung one.
+``--no-device-probe`` skips it.
 """
 
 from __future__ import annotations
@@ -110,6 +120,13 @@ def main(argv: "list[str] | None" = None) -> int:
         "--fail-prob", type=float, default=0.1,
         help="per-node failure probability for --sweep (default 0.1)",
     )
+    ap.add_argument(
+        "--no-device-probe",
+        action="store_true",
+        help="skip the boot-time accelerator watchdog (same probe as "
+        "the serving shell: a wedged backend re-execs the run on the "
+        "scrubbed CPU backend instead of hanging forever)",
+    )
     args = ap.parse_args(argv)
     if not args.spec and not args.resume:
         ap.error("one of --spec / --resume is required")
@@ -119,6 +136,32 @@ def main(argv: "list[str] | None" = None) -> int:
         # a run the operator BELIEVES is checkpointing but isn't is the
         # worst outcome of a flag typo — refuse up front
         ap.error("--checkpoint-every-* requires --checkpoint-to")
+
+    if not args.no_device_probe:
+        # the serving shell's boot-time device watchdog, honored here
+        # too (the satellite of the execution-ladder PR): a wedged
+        # accelerator tunnel hangs even jax.devices(), which would turn
+        # the first scheduling pass into an unbounded stall. Probe
+        # under a watchdog and re-exec on the scrubbed CPU backend when
+        # the accelerator is unusable.
+        import os
+
+        from ..utils import axonenv
+
+        if not os.environ.get("_KSS_LIFECYCLE_CPU_FALLBACK"):
+            devices, error = axonenv.probe_devices()
+            if not devices:
+                axonenv.reexec_on_cpu(
+                    "lifecycle",
+                    "_KSS_LIFECYCLE_CPU_FALLBACK",
+                    [
+                        sys.executable,
+                        "-m",
+                        "kube_scheduler_simulator_tpu.lifecycle",
+                    ]
+                    + list(argv if argv is not None else sys.argv[1:]),
+                    axonenv.probe_why(error, axonenv.PROBE_TIMEOUT_S),
+                )
 
     from ..scenario.chaos import ChaosSpec
     from ..utils import telemetry
@@ -214,7 +257,16 @@ def main(argv: "list[str] | None" = None) -> int:
 
     json.dump(result, sys.stdout, indent=2, sort_keys=True)
     print()
-    return 0 if result.get("phase") == "Succeeded" else 1
+    phase = result.get("phase")
+    if phase == "Succeeded":
+        return 0
+    if phase == "Interrupted" and result.get("checkpoint"):
+        # the orderly drain: a graceful stop whose final checkpoint
+        # landed lost NOTHING — resume reproduces the uninterrupted
+        # trace byte-for-byte (docs/resilience.md). Exit 0 so rolling
+        # restarts read as success, like the serving shell's SIGTERM.
+        return 0
+    return 1
 
 
 if __name__ == "__main__":

@@ -53,3 +53,32 @@ def build_mesh(
         (replicas, node_shards), devices=devices[:n_devices]
     )
     return Mesh(grid, ("replicas", "nodes"))
+
+
+def surviving_mesh(
+    lost,
+    devices=None,
+    *,
+    replicas: "int | None" = None,
+    node_shards: "int | None" = None,
+) -> Mesh:
+    """Rebuild the (replicas, nodes) mesh over the devices that survive
+    `lost` — the execution ladder's mesh-shrink rung
+    (docs/resilience.md). The replicas axis absorbs the loss: it is the
+    embarrassingly-parallel Monte-Carlo axis, so fewer replicas means
+    fewer concurrent variants, never a wrong answer. An odd survivor
+    count factors to ``node_shards=1`` (build_mesh's default keeps the
+    node axis narrow). Raises ValueError when nothing survives — the
+    caller's cue to fall to the CPU rung."""
+    if devices is None:
+        devices = jax.devices()
+    lost_set = set(lost)
+    survivors = [d for d in devices if d not in lost_set]
+    if not survivors:
+        raise ValueError(
+            f"no devices survive ({len(lost_set)} lost of {len(devices)}): "
+            f"nothing to rebuild the mesh on"
+        )
+    return build_mesh(
+        devices=survivors, replicas=replicas, node_shards=node_shards
+    )

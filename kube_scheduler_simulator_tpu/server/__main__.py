@@ -133,10 +133,49 @@ def main(argv: "list[str] | None" = None) -> int:
     )
     server.start()
     print(f"simulator serving on http://{args.host}:{server.port}/api/v1")
+
+    # zero-loss graceful drain (docs/resilience.md): SIGTERM — the
+    # rolling-restart signal — begins the drain (readyz flips to the
+    # distinct `draining` 503, new requests shed, in-flight passes
+    # finish under KSS_DRAIN_DEADLINE_S, every session snapshots to
+    # KSS_SESSION_DIR, the broker quiesces) and the process exits 0; a
+    # restart over the same session directory adopts the snapshots, so
+    # no acknowledged write is lost. POST /api/v1/admin/drain reaches
+    # the same path over HTTP.
+    import signal
+
+    def _term(signum, frame):
+        server.begin_drain()
+
     try:
-        server._thread.join()
+        signal.signal(signal.SIGTERM, _term)
+    except ValueError:  # non-main thread (embedded use): skip
+        pass
+    try:
+        while not server.drain_done.wait(0.5):
+            if server._thread is not None and not server._thread.is_alive():
+                # the HTTP server thread died without a drain (the shape
+                # the old `_thread.join()` wait exited on): shut down and
+                # return instead of spinning on a drain that will never
+                # come — a supervisor must never see a live PID serving
+                # nothing
+                server.shutdown()
+                return 0
     except KeyboardInterrupt:
         server.shutdown()
+        return 0
+    server.shutdown()
+    # exit 0 is the ZERO-LOSS claim, so it must be earned: a drain that
+    # raised outright, or lost any session's snapshot, reports failure —
+    # a rolling-restart supervisor must not proceed as if nothing was
+    # lost (docs/resilience.md)
+    result = server.drain_status().get("result") or {}
+    problems = result.get("error") or result.get("errors")
+    if problems:
+        import sys
+
+        print(f"drain failed: {problems}", file=sys.stderr)
+        return 1
     return 0
 
 

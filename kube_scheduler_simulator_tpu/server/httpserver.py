@@ -46,7 +46,16 @@ The multi-tenant session plane (docs/sessions.md — server/sessions.py):
     GET                /api/v1/readyz        readiness: 503 while the
                                              shared compile broker is
                                              cooldown-saturated or its
-                                             worker crashed
+                                             worker crashed, or while
+                                             the server is draining
+                                             (state "draining", distinct
+                                             from "cooldown-saturated")
+    POST               /api/v1/admin/drain   begin the zero-loss drain
+                                             (docs/resilience.md): shed
+                                             new requests, finish
+                                             in-flight passes, snapshot
+                                             every session, quiesce the
+                                             broker; GET reports status
 
 Legacy (un-prefixed) routes operate on the implicit `default` session.
 Admission control (session limit, per-session pending-pod quota, the
@@ -160,6 +169,15 @@ class SimulatorServer:
         # jax.profiler is a process-wide singleton)
         self._profile_lock = locking.make_lock("http.profile")
         self._profile_dir: "str | None" = None
+        # graceful-drain state (docs/resilience.md): begin_drain flips
+        # `draining` (readyz 503 + request shedding) and runs the
+        # session-plane drain on a background thread; `drain_done`
+        # fires when every session is snapshotted and the broker is
+        # quiesced — the CLI's SIGTERM path waits on it and exits 0
+        self._drain_lock = locking.make_lock("http.drain")
+        self._drain_thread: "threading.Thread | None" = None
+        self._drain_result: "dict | None" = None
+        self.drain_done = threading.Event()
 
     @property
     def port(self) -> int:
@@ -178,6 +196,53 @@ class SimulatorServer:
         self.httpd.server_close()
         if self._thread:
             self._thread.join(timeout=5)
+
+    # -- graceful drain (docs/resilience.md) --------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self.sessions.draining
+
+    def begin_drain(self, deadline_s: "float | None" = None) -> bool:
+        """Start the zero-loss drain on a background thread (the route
+        and the SIGTERM handler both call this; neither may block for
+        the drain deadline). False when a drain is already running —
+        begin is idempotent, the first caller wins."""
+        with self._drain_lock:
+            if self.sessions.draining:
+                return False
+            self.sessions.draining = True  # shed + readyz flip NOW
+            self._drain_thread = threading.Thread(
+                target=self._drain_run,
+                args=(deadline_s,),
+                name="kss-drain",
+                daemon=True,
+            )
+            self._drain_thread.start()
+            return True
+
+    def _drain_run(self, deadline_s: "float | None") -> None:
+        try:
+            self._drain_result = self.sessions.drain(deadline_s)
+        except Exception as e:  # noqa: BLE001 — a failed drain must not hang exit
+            self._drain_result = {"error": f"{type(e).__name__}: {e}"}
+        finally:
+            self.drain_done.set()
+
+    def drain(self, deadline_s: "float | None" = None, timeout=None) -> dict:
+        """Synchronous drain: begin (if not already begun) and wait for
+        completion. Embedded drivers and tests use this; the serving
+        CLI prefers begin_drain + waiting on `drain_done`."""
+        self.begin_drain(deadline_s)
+        self.drain_done.wait(timeout)
+        return self.drain_status()
+
+    def drain_status(self) -> dict:
+        return {
+            "draining": self.draining,
+            "done": self.drain_done.is_set(),
+            "result": self._drain_result,
+        }
 
     def maybe_schedule(self, service: "SimulatorService | None" = None):
         """Post-mutation convergence for the mutated session: the
@@ -356,6 +421,34 @@ def _make_handler(server: SimulatorServer):
                     return self._json(200, {"ok": True})
                 if rest == ["readyz"] and method == "GET":
                     return self._readyz()
+                if rest == ["admin", "drain"]:
+                    if method == "POST":
+                        started = server.begin_drain()
+                        doc = server.drain_status()
+                        doc["started"] = started
+                        return self._json(202, doc)
+                    if method == "GET":
+                        return self._json(200, server.drain_status())
+                    return self._error(405, "method not allowed")
+                if server.draining and not (
+                    method == "GET" and rest == ["metrics"]
+                ):
+                    # the zero-loss drain path (docs/resilience.md):
+                    # new work is shed with the same structured 503 +
+                    # Retry-After shape as admission control, while
+                    # in-flight passes finish and sessions snapshot.
+                    # Health, readiness, drain status, and the legacy
+                    # metrics scrape stay answerable — an operator must
+                    # be able to watch the drain complete.
+                    return self._error(
+                        503,
+                        "server is draining; retry against another replica",
+                        kind="ServerDraining",
+                        detail="graceful drain in progress: new requests "
+                        "are shed, in-flight passes finish, sessions "
+                        "snapshot to disk",
+                        headers={"Retry-After": str(DEGRADED_RETRY_AFTER_S)},
+                    )
                 if rest and rest[0] == "sessions":
                     return self._sessions_route(method, rest[1:], url)
                 # legacy (un-prefixed) surface: the implicit default
@@ -409,7 +502,20 @@ def _make_handler(server: SimulatorServer):
             the SHARED broker is cooldown-saturated (some session's
             compile ladder is exhausted and cooling) or its speculative
             worker crashed — a sick compile plane should be drained, not
-            handed fresh tenants."""
+            handed fresh tenants. A DRAINING server is also not-ready,
+            with the distinct ``state: "draining"`` (docs/resilience.md)
+            so orchestrators can tell an intentional rolling-restart
+            drain from a sick compile plane."""
+            if server.draining:
+                doc = {
+                    "ready": False,
+                    "state": "draining",
+                    "reasons": ["server is draining"],
+                    "drain": server.drain_status(),
+                }
+                return self._json(
+                    503, doc, headers={"Retry-After": str(DEGRADED_RETRY_AFTER_S)}
+                )
             health = server.sessions.broker.health()
             reasons = []
             if health["cooldownKeys"]:
@@ -422,7 +528,12 @@ def _make_handler(server: SimulatorServer):
                 )
             if health["workerCrashed"]:
                 reasons.append("speculative compile worker crashed")
-            doc = {"ready": not reasons, "reasons": reasons, "broker": health}
+            doc = {
+                "ready": not reasons,
+                "state": "cooldown-saturated" if reasons else "ready",
+                "reasons": reasons,
+                "broker": health,
+            }
             if reasons:
                 return self._json(
                     503, doc, headers={"Retry-After": str(DEGRADED_RETRY_AFTER_S)}
@@ -882,6 +993,12 @@ def _make_handler(server: SimulatorServer):
                 # server-wide SSE hardening counter (the satellite): how
                 # many events were dropped disconnecting slow subscribers
                 doc["sseDroppedEvents"] = server.sse_dropped
+                # execution-ladder + drain state (docs/resilience.md):
+                # which rung this session's service dispatches on, and
+                # the server-wide drain view
+                doc["deviceRung"] = svc.scheduler.device_rung
+                doc["draining"] = server.draining
+                doc["drainedSessions"] = server.sessions.drained
             if fmt == "prometheus":
                 def entry(session_id, snapshot, cache_cap):
                     return (
@@ -928,6 +1045,11 @@ def _make_handler(server: SimulatorServer):
                             "Idle sessions snapshotted to disk.",
                             mgr_stats["evictions"],
                         ),
+                        "kss_drained_sessions_total": (
+                            "Sessions snapshotted by the graceful drain "
+                            "path.",
+                            mgr_stats["drainedSessions"],
+                        ),
                     },
                     global_gauges={
                         "kss_sessions_live": (
@@ -937,6 +1059,10 @@ def _make_handler(server: SimulatorServer):
                         "kss_sessions_evicted": (
                             "Sessions evicted to disk snapshots.",
                             mgr_stats["evicted"],
+                        ),
+                        "kss_server_draining": (
+                            "1 while the graceful drain is in progress.",
+                            1 if mgr_stats["draining"] else 0,
                         ),
                     },
                 ).encode()

@@ -46,6 +46,7 @@ from ..models.store import ResourceStore
 from ..sched.config import SchedulerConfiguration
 from ..sched.extender import ExtenderService
 from ..sched.results import PodSchedulingResult
+from ..utils import devices as devices_mod
 from ..utils import faultinject, locking
 from ..utils import metrics as metrics_mod
 from ..utils import telemetry
@@ -213,6 +214,22 @@ class SchedulerService:
         # monotonic pass sequence (telemetry causality): advanced under
         # the schedule lock, so ids order exactly like passes do
         self._pass_seq = 0
+        # -- execution ladder state (docs/resilience.md) -----------------
+        # the rung this service currently dispatches on:
+        #   "device" — the healthy default;
+        #   "shrunk" — a device was lost, engines rebuilt over the
+        #              surviving mesh (self.mesh) under a bumped epoch;
+        #   "cpu"    — mid-process CPU failover: every pass re-encodes
+        #              and runs on the CPU backend (the generalization
+        #              of the boot-time re-exec in utils/axonenv.py).
+        # Rungs latch: once escalated, later passes run there directly
+        # instead of re-walking the ladder per pass.
+        self._device_rung = "device"
+        self._dispatch_device = None  # default-device override per rung
+        self._lost_devices: set = set()
+        # joins broker keys once non-zero, so rebuilt engines never
+        # collide with a warm engine compiled for a dead device
+        self._device_epoch = 0
         self.extender_service = ExtenderService(self._config.extenders)
 
     def _next_pass_id(self) -> int:
@@ -409,16 +426,171 @@ class SchedulerService:
             return {}, 0, ([] if record else None)
         return self._gang_finish(disp, record)
 
-    @staticmethod
-    def _fire_device_dispatch() -> None:
-        """The fault plane's device-dispatch site (``device_error``,
-        utils/faultinject.py): fired once per pass dispatch, upstream of
-        engine acquisition. An injected device error propagates — it is
-        not a compile problem, so the eager rung can't help; the
-        lifecycle engine's Abort path / the HTTP 500 boundary own it."""
+    def _fire_device_dispatch(self) -> None:
+        """The fault plane's device-dispatch site (``device_error`` /
+        ``device_lost`` / ``dispatch_hang``, utils/faultinject.py):
+        fired once per pass dispatch, upstream of engine acquisition,
+        under the KSS_DISPATCH_DEADLINE_S watchdog (an injected hang
+        must trip the deadline exactly like a wedged real dispatch). An
+        injected device fault escalates through the EXECUTION ladder
+        (`_supervised_dispatch`) — retried, mesh-shrunk, then failed
+        over to CPU; on the CPU rung the sites no longer fire (they
+        model the accelerator, and that rung no longer touches it)."""
+        if self._device_rung == "cpu":
+            return
         plane = faultinject.active()
-        if plane is not None:
+        if plane is None:
+            return
+
+        def probe():
+            plane.delay("dispatch_hang")
             plane.maybe_raise("device_error")
+            plane.maybe_raise("device_lost")
+
+        devices_mod.run_with_deadline(probe, devices_mod.dispatch_deadline_s())
+
+    # -- the execution ladder (docs/resilience.md) --------------------------
+
+    @property
+    def device_rung(self) -> str:
+        """The execution ladder rung this service dispatches on
+        (``device`` / ``shrunk`` / ``cpu``) — surfaced by
+        GET /api/v1/metrics as ``deviceRung``."""
+        return self._device_rung
+
+    def _epoch_sig(self, sig: tuple) -> tuple:
+        """Append the device epoch to a broker key once any escalation
+        happened: a rebuilt engine must never collide with a warm engine
+        compiled for a dead (or abandoned) device. Epoch 0 keys stay
+        byte-identical to the historical shape, so bucket-compatible
+        sessions keep sharing executables."""
+        if self._device_epoch:
+            return sig + (("devepoch", self._device_epoch),)
+        return sig
+
+    def _run_rung(self, once):
+        """Run one dispatch attempt on the current rung: under the
+        rung's default-device override (a shrink survivor or a CPU
+        device) when one is set, inline otherwise."""
+        if self._dispatch_device is not None:
+            with jax.default_device(self._dispatch_device):
+                return once()
+        return once()
+
+    def _invalidate_encodings(self) -> None:
+        """Escalation invalidates every retained encoding: the cached /
+        delta-retained arrays live on the device that just failed, so
+        the next `_encode_current` must re-encode from the store under
+        the new rung's placement."""
+        self._enc_cache = EncodingCache(capacity=self.encoding_cache_capacity)
+        self._delta = DeltaEncoder()
+
+    def _try_shrink(self) -> bool:
+        """The ladder's mesh-shrink rung: mark the dispatch device lost,
+        rebuild the (replicas, nodes) mesh over the survivors (the
+        replicas axis absorbs the loss — parallel/mesh.surviving_mesh),
+        bump the engine epoch so the broker rebuilds on the new
+        topology, and re-encode. False when nothing survives (single
+        device, or backend enumeration itself failing) — the caller's
+        cue to fall straight to the CPU rung."""
+        try:
+            all_devices = jax.devices()
+        except Exception:  # noqa: BLE001 — a dead backend can't enumerate
+            return False
+        if len(all_devices) <= 1:
+            return False
+        # the faulted device is the one dispatches were landing on: the
+        # rung's override when set, else the process default (devices[0])
+        faulted = (
+            self._dispatch_device
+            if self._dispatch_device is not None
+            else all_devices[0]
+        )
+        self._lost_devices.add(faulted)
+        survivors = [d for d in all_devices if d not in self._lost_devices]
+        if not survivors:
+            return False
+        from ..parallel.mesh import surviving_mesh
+
+        try:
+            # validates that a (replicas, nodes) topology exists over
+            # the survivors (odd counts fall to node_shards=1) — the
+            # rung's actual effect is the dispatch-device pin + epoch
+            mesh = surviving_mesh(self._lost_devices, devices=all_devices)
+        except ValueError:
+            return False
+        self._dispatch_device = survivors[0]
+        self._device_rung = "shrunk"
+        self._device_epoch += 1
+        self._invalidate_encodings()
+        self.metrics.record_resilience(mesh_shrinks=1)
+        telemetry.instant(
+            "dispatch.mesh_shrink",
+            survivors=len(survivors),
+            replicas=mesh.shape["replicas"],
+        )
+        return True
+
+    def _engage_cpu_failover(self, err: "Exception | None") -> None:
+        """The ladder's last rung — the mid-process generalization of
+        the boot-time CPU re-exec (utils/axonenv.py): re-encode on the
+        CPU backend and run the SAME pass there. Same placements, same
+        trace bytes; only latency degrades. Latches for the rest of the
+        process (like the re-exec'd server). With no usable CPU backend
+        the ladder is truly exhausted: EngineDegraded, the 503 path."""
+        cpus = devices_mod.cpu_devices()
+        if not cpus:
+            raise EngineDegraded(
+                f"device ladder exhausted ({err}) and no CPU backend is "
+                f"available for the failover rung"
+            ) from err
+        self._device_rung = "cpu"
+        self._dispatch_device = cpus[0]
+        self._device_epoch += 1
+        self._invalidate_encodings()
+        self.metrics.record_resilience(device_failovers=1)
+        telemetry.instant("dispatch.cpu_failover", reason=str(err))
+
+    def _supervised_dispatch(self, once):
+        """Walk the execution ladder around one dispatch closure
+        (docs/resilience.md). `once` is the FULL dispatch — encode,
+        engine acquisition through the broker, device run — so every
+        escalation re-encodes and rebuilds on the new rung's devices:
+
+          1. up to 1 + KSS_DISPATCH_RETRIES attempts on the current
+             rung (each re-run counted as ``dispatchRetries``);
+          2. one mesh shrink (drop the faulted device, rebuild over the
+             survivors under a bumped epoch) and one attempt there;
+          3. CPU failover: the same pass, re-encoded and re-run on the
+             CPU backend (``deviceFailovers``).
+
+        Only device faults (`utils/devices.is_device_fault`) escalate;
+        every other exception propagates untouched. Rungs latch — a
+        failed-over service dispatches straight on CPU next pass."""
+        if self._device_rung == "cpu":
+            return self._run_rung(once)
+        last: "Exception | None" = None
+        for attempt in range(1 + devices_mod.dispatch_retries()):
+            if attempt:
+                self.metrics.record_resilience(dispatch_retries=1)
+                telemetry.instant("dispatch.retry", attempt=attempt + 1)
+            try:
+                return self._run_rung(once)
+            except Exception as e:  # noqa: BLE001 — classified below
+                if not devices_mod.is_device_fault(e):
+                    raise
+                last = e
+                self._unlease_engine()
+        if self._device_rung == "device" and self._try_shrink():
+            try:
+                return self._run_rung(once)
+            except Exception as e:  # noqa: BLE001 — classified below
+                if not devices_mod.is_device_fault(e):
+                    raise
+                last = e
+                self._unlease_engine()
+        self._engage_cpu_failover(last)
+        return self._run_rung(once)
 
     def _eager_fallback(self, build, err: Exception):
         """The degradation ladder's last rung (docs/resilience.md): run
@@ -446,6 +618,16 @@ class SchedulerService:
         return engine
 
     def _gang_dispatch(self, config, record: bool, window=None):
+        """One gang dispatch under the execution ladder: the full
+        encode + engine-acquire + run closure walks
+        `_supervised_dispatch`, so a device fault anywhere inside is
+        retried, mesh-shrunk, or failed over to CPU — with the SAME
+        pass re-encoded and re-run, never a changed answer."""
+        return self._supervised_dispatch(
+            lambda: self._gang_dispatch_once(config, record, window)
+        )
+
+    def _gang_dispatch_once(self, config, record: bool, window=None):
         """Encode + execute one gang pass, engine served by the broker;
         returns an opaque tuple for `_gang_finish`, or None when nothing
         is schedulable. Everything downstream of this (decode,
@@ -459,11 +641,11 @@ class SchedulerService:
         # the window joins the broker key as the CANONICAL chunk-rounded
         # value program identity actually depends on (raw windows that
         # round to the same WP share one compilation)
-        sig = (
+        sig = self._epoch_sig((
             "gang",
             GangScheduler.compile_signature(enc),
             GangScheduler.effective_window(enc, window, GANG_CHUNK),
-        )
+        ))
         # cross-session serialization of the (possibly shared) engine:
         # held until _gang_finish (docs/sessions.md)
         self._lease_engine(sig)
@@ -665,6 +847,13 @@ class SchedulerService:
         policy = self._delta.policy
         node_lo = self._delta.node_lo
         pod_lo = self._delta.pod_lo
+        # the device epoch at ARMING time: a speculative build must key
+        # like the passes it serves (a failover between arming and the
+        # worker running simply wastes the stale build)
+        epoch = self._device_epoch
+
+        def _sig(base: tuple) -> tuple:
+            return base + (("devepoch", epoch),) if epoch else base
 
         def task():
             from ..engine.encode import encode_cluster
@@ -699,11 +888,11 @@ class SchedulerService:
             if kind == "gang":
                 from ..engine.gang import GangScheduler
 
-                sig = (
+                sig = _sig((
                     "gang",
                     GangScheduler.compile_signature(enc_s),
                     GangScheduler.effective_window(enc_s, window, GANG_CHUNK),
-                )
+                ))
 
                 def build():
                     return GangScheduler(
@@ -711,7 +900,7 @@ class SchedulerService:
                     ).warmup(record=record)
 
             else:
-                sig = ("seq", BatchedScheduler.compile_signature(enc_s))
+                sig = _sig(("seq", BatchedScheduler.compile_signature(enc_s)))
 
                 def build():
                     return BatchedScheduler(
@@ -857,6 +1046,14 @@ class SchedulerService:
         return self._seq_finish(disp)
 
     def _seq_dispatch(self, config):
+        """One sequential dispatch under the execution ladder (see
+        `_gang_dispatch`): device faults inside the closure escalate
+        through retry → mesh shrink → CPU failover."""
+        return self._supervised_dispatch(
+            lambda: self._seq_dispatch_once(config)
+        )
+
+    def _seq_dispatch_once(self, config):
         """Encode + execute one sequential pass (engine via the broker);
         returns an opaque tuple for `_seq_finish`, or None when nothing
         is schedulable. Trace decode and write-backs are deferred to the
@@ -872,7 +1069,9 @@ class SchedulerService:
             # mid-pass), so the run happens here; only write-backs defer.
             from ..engine.extender_loop import ExtenderScheduler
 
-            sig = ("ext", BatchedScheduler.compile_signature(enc))
+            sig = self._epoch_sig(
+                ("ext", BatchedScheduler.compile_signature(enc))
+            )
             self._lease_engine(sig)
             holder: dict = {}
 
@@ -899,7 +1098,7 @@ class SchedulerService:
             return ("ext", enc, ext_sched, results)
         # reuse the previous pass's compiled program when the encoding
         # is compile-compatible (same padded shapes + baked statics)
-        sig = ("seq", BatchedScheduler.compile_signature(enc))
+        sig = self._epoch_sig(("seq", BatchedScheduler.compile_signature(enc)))
         self._lease_engine(sig)
         t0 = time.perf_counter()
         holder = {}

@@ -29,6 +29,14 @@ Robustness machinery owned here:
   * **Fork** — `fork()` round-trips the same checkpoint document into a
     fresh session id: what-if experiments branch from live (or evicted)
     state without copying code paths.
+  * **Graceful drain** — `drain()` (SIGTERM / POST /api/v1/admin/drain,
+    docs/resilience.md) lets every in-flight pass finish under
+    ``KSS_DRAIN_DEADLINE_S``, snapshots EVERY live session — the
+    default included — through the same checkpoint family, and
+    quiesces the shared broker; a server restarted over the same
+    ``KSS_SESSION_DIR`` adopts the snapshots at boot
+    (`adopt_snapshots`), so a rolling restart loses zero acknowledged
+    writes.
 
 The ``default`` session wraps the server's original `SimulatorService`,
 so every legacy single-session route keeps working unchanged.
@@ -207,6 +215,12 @@ class SessionManager:
             if sse_max_subscribers is not None
             else _env_int(env, "KSS_SSE_MAX_SUBSCRIBERS", 64, 1)
         )
+        # graceful-drain budget: how long in-flight passes may keep
+        # running before a draining snapshot proceeds without them
+        # (docs/resilience.md). 0 = snapshot immediately.
+        self.drain_deadline_s = _env_float(
+            env, "KSS_DRAIN_DEADLINE_S", 30.0, 0.0
+        )
         self._snapshot_dir = snapshot_dir or env.get("KSS_SESSION_DIR") or None
         # ONE broker for every session: warm engines shared by compile
         # signature; per-session bulkheading lives in the broker's
@@ -224,6 +238,11 @@ class SessionManager:
         self._lock = locking.make_rlock("sessions.manager")
         self._pass_sem = threading.BoundedSemaphore(self.max_concurrent_passes)
         self.evictions = 0
+        # graceful-drain state (docs/resilience.md): `draining` flips
+        # /readyz to the distinct `draining` 503; `drained` counts the
+        # sessions snapshotted by drain() (kss_drained_sessions_total)
+        self.draining = False
+        self.drained = 0
         # adopt the boot service as the implicit default session: it
         # joins the shared compile plane and gains the session label,
         # and every legacy route keeps hitting it unchanged
@@ -241,6 +260,12 @@ class SessionManager:
                 target=self._sweep_loop, name="kss-session-sweeper", daemon=True
             )
             self._sweeper.start()
+        # a previous process's drain (or idle eviction) may have left
+        # session snapshots in the configured directory: adopt them so
+        # the restart is transparent to every tenant (the default
+        # session's state restores in place; others restore on touch)
+        if self._snapshot_dir:
+            self.adopt_snapshots()
 
     # -- lookup --------------------------------------------------------------
 
@@ -333,6 +358,9 @@ class SessionManager:
                 "maxPendingPodsPerSession": self.pending_pod_quota,
                 "maxConcurrentPasses": self.max_concurrent_passes,
                 "idleEvictSeconds": self.idle_evict_s,
+                "draining": self.draining,
+                "drainedSessions": self.drained,
+                "drainDeadlineSeconds": self.drain_deadline_s,
             }
 
     # -- create / fork / delete ---------------------------------------------
@@ -554,23 +582,33 @@ class SessionManager:
                     raise UnknownSession(sid)  # raced with delete
                 if sess.state == "evicted":
                     return sess.snapshot_path
-                if sess._active_requests:
+            # a request whose response already flushed may still be
+            # inside `using`'s exit bookkeeping (the decrement runs
+            # AFTER the bytes hit the socket), so an evict issued
+            # right after a completed call can observe a stale
+            # in-flight count — give it a short grace to drain before
+            # refusing, instead of a spurious 409
+            # (polling, not a Condition: the manager lock is a witness-
+            # wrappable RLock, and Condition's ownership probe misreads
+            # re-entrant wrappers. The wait is bounded and exits on the
+            # first quiet poll, so a genuinely idle session — the
+            # sweeper's only targets — pays one probe, not the grace.)
+            grace = time.monotonic() + 0.25
+            while True:
+                with self._lock:
+                    active = sess._active_requests
+                if not active:
+                    break
+                if time.monotonic() >= grace:
                     raise SessionBusy(
                         f"session {sid!r} has requests in flight"
                     )
+                time.sleep(0.005)
             # the snapshot build + disk write happen OUTSIDE the manager
             # lock: only this session's transitions (and its passes, via
             # the schedule lock) wait on them
             t0 = time.monotonic()
-            lock = sess.service.scheduler._schedule_lock
-            if not lock.acquire(blocking=False):
-                raise SessionBusy(f"session {sid!r} has a pass in flight")
-            try:
-                doc = self._session_doc(sess)
-            finally:
-                lock.release()
-            path = os.path.join(self.snapshot_dir(), f"{sid}.json")
-            write_checkpoint(doc, path)
+            path, _ = self._write_session_snapshot(sess, 0.0, force=False)
             with self._lock:
                 if sess._active_requests or sess.last_touch >= t0:
                     # a request routed in (or completed) while we were
@@ -584,6 +622,39 @@ class SessionManager:
                 sess.state = "evicted"
                 self.evictions += 1
             return path
+
+    def _write_session_snapshot(
+        self, sess: Session, wait_s: float, *, force: bool
+    ) -> "tuple[str, bool]":
+        """The ONE quiesce-and-snapshot sequence evict and drain share
+        (call under `sess._state_lock`): wait up to `wait_s` for the
+        session's pass boundary (the schedule lock), build the
+        checkpoint document, atomically persist it, and remember the
+        path on the session. `force=False` (eviction) REFUSES when the
+        boundary can't be taken — eviction is optional load shedding;
+        `force=True` (drain) snapshots anyway — the process is about to
+        exit, and a bounded drain beats a hung one; a FORCED snapshot
+        may capture a still-resolving pass's partial write-backs (the
+        price of the bound — raise KSS_DRAIN_DEADLINE_S where strict
+        pass atomicity matters more than drain time). Returns
+        (path, got_pass_boundary)."""
+        lock = sess.service.scheduler._schedule_lock
+        got = (
+            lock.acquire(timeout=wait_s)
+            if wait_s > 0
+            else lock.acquire(blocking=False)
+        )
+        if not got and not force:
+            raise SessionBusy(f"session {sess.id!r} has a pass in flight")
+        try:
+            doc = self._session_doc(sess)
+        finally:
+            if got:
+                lock.release()
+        path = os.path.join(self.snapshot_dir(), f"{sess.id}.json")
+        write_checkpoint(doc, path)
+        sess.snapshot_path = path
+        return path, got
 
     def _restore(self, sess: Session) -> None:
         """Under sess._state_lock (NOT the manager lock): disk load +
@@ -636,6 +707,140 @@ class SessionManager:
         # reset() now returns to the restored state, not an empty store
         service.store.snapshot_initial()
         return service
+
+    # -- graceful drain (docs/resilience.md) ----------------------------------
+
+    def drain(self, deadline_s: "float | None" = None) -> dict:
+        """The zero-loss drain path: mark the plane draining (the HTTP
+        layer sheds new requests with the structured 503 and `/readyz`
+        reports the distinct ``draining`` state), stop the idle
+        sweeper, then snapshot EVERY live session — the default
+        included — through the ``kss-session-checkpoint/v1`` path.
+        In-flight requests AND passes get until `deadline_s` (default
+        ``KSS_DRAIN_DEADLINE_S``) to finish — new ones are already shed
+        at the HTTP layer, so this drains to quiescence, and an
+        acknowledged write is always IN the snapshot (the same
+        `_active_requests` guard eviction uses). Past the deadline the
+        session is snapshotted anyway (`forced` in the result) — the
+        store is internally consistent, and an unresolved pass has
+        acknowledged nothing. Finally the shared broker is quiesced
+        (speculation off, in-flight background builds out-waited: the
+        PR 4 atexit-abort hazard, now handled on the orderly path).
+        Idempotent; returns a summary the drain route serves."""
+        deadline_total = (
+            self.drain_deadline_s if deadline_s is None else float(deadline_s)
+        )
+        with self._lock:
+            self.draining = True
+            sessions = [
+                s
+                for s in sorted(
+                    self._sessions.values(), key=lambda s: s.created_at
+                )
+                if s.state == "live" and s.service is not None
+            ]
+        self._stop.set()  # the idle sweeper must not race the snapshots
+        deadline = time.monotonic() + deadline_total
+        drained: list[str] = []
+        forced: list[str] = []
+        errors: dict[str, str] = {}
+        for sess in sessions:
+            # per-session containment: one tenant's failed snapshot (a
+            # serialization bug, a transient disk error) must not skip
+            # every remaining tenant's snapshot or the broker quiesce —
+            # it is recorded, surfaced in the result, and makes the
+            # drain read as FAILED to the exit path (server/__main__.py)
+            try:
+                with sess._state_lock:
+                    with self._lock:
+                        if self._sessions.get(sess.id) is not sess:
+                            continue  # raced with delete
+                        if sess.state != "live" or sess.service is None:
+                            continue  # evicted meanwhile: already on disk
+                    # wait out requests already routed INTO the session
+                    # (`using` registrations): their 200s must be in
+                    # the snapshot — the same guard eviction enforces,
+                    # here bounded by the drain deadline, not refused
+                    quiesced = True
+                    while True:
+                        with self._lock:
+                            active = sess._active_requests
+                        if not active:
+                            break
+                        if time.monotonic() >= deadline:
+                            quiesced = False
+                            break
+                        time.sleep(0.01)
+                    remaining = max(0.0, deadline - time.monotonic())
+                    _, got = self._write_session_snapshot(
+                        sess, remaining, force=True
+                    )
+            except Exception as e:  # noqa: BLE001 — contained per session
+                errors[sess.id] = f"{type(e).__name__}: {e}"
+                continue
+            drained.append(sess.id)
+            if not got or not quiesced:
+                forced.append(sess.id)
+            with self._lock:
+                self.drained += 1
+        self.broker.quiesce(timeout=max(0.0, deadline - time.monotonic()))
+        result: dict = {
+            "drainedSessions": drained,
+            "forced": forced,
+            "snapshotDir": self.snapshot_dir(),
+        }
+        if errors:
+            result["errors"] = errors
+        return result
+
+    def adopt_snapshots(self) -> list[str]:
+        """Register every ``kss-session-checkpoint/v1`` document found
+        in the snapshot directory — what a previous process's drain (or
+        idle eviction) left behind. The default session's state is
+        restored INTO the live default service (and its file consumed);
+        every other snapshot becomes an evicted session that restores
+        transparently on first touch. Unreadable files are skipped —
+        boot must not die on a stray artifact."""
+        d = self._snapshot_dir
+        if not d or not os.path.isdir(d):
+            return []
+        adopted: list[str] = []
+        for fn in sorted(os.listdir(d)):
+            if not fn.endswith(".json"):
+                continue
+            path = os.path.join(d, fn)
+            try:
+                doc = load_checkpoint(path, SESSION_CHECKPOINT_FORMAT)
+            except (ValueError, OSError):
+                continue
+            sid = doc.get("id") or fn[: -len(".json")]
+            with self._lock:
+                if sid == DEFAULT_SESSION_ID:
+                    svc = self._sessions[DEFAULT_SESSION_ID].service
+                    svc.store.load_state(doc["store"])
+                    cfg = doc.get("schedulerConfig")
+                    if cfg:
+                        try:
+                            svc.scheduler.restart(cfg)
+                        except SchedulerServiceDisabled:
+                            pass
+                    svc.scheduler.metrics.load_state(doc.get("metrics") or {})
+                    svc.scheduler._pass_seq = int(doc.get("passSeq", 0))
+                    svc.store.snapshot_initial()
+                    os.unlink(path)  # consumed: the live service IS the state
+                else:
+                    if sid in self._sessions:
+                        continue
+                    sess = Session(sid, doc.get("name") or sid, None)
+                    sess.state = "evicted"
+                    sess.snapshot_path = path
+                    sess.fault_spec = doc.get("faultInject")
+                    created = doc.get("createdAt")
+                    if created is not None:
+                        sess.created_at = float(created)
+                    self._sessions[sid] = sess
+            adopted.append(sid)
+        return adopted
 
     def _sweep_loop(self) -> None:
         interval = max(0.05, min(self.idle_evict_s / 4.0, 5.0))

@@ -21,6 +21,7 @@ PROBE_TIMEOUT_S = 180.0
 
 def probe_devices(
     timeout_s: float = PROBE_TIMEOUT_S,
+    get_devices=None,
 ) -> tuple[list, "BaseException | None"]:
     """Discover jax.devices() under a watchdog (a wedged TPU tunnel hangs
     even device enumeration — the observed failure mode this guards).
@@ -28,15 +29,22 @@ def probe_devices(
     Returns (devices, error): a non-empty device list on success; an
     empty list with the probe's exception when backend init *failed*; an
     empty list and None when it *hung* past the timeout (the daemon
-    thread is abandoned — it must not block process exit)."""
+    thread is abandoned — it must not block process exit).
+
+    `get_devices` overrides the enumeration (default: import jax and
+    call jax.devices()) so the hang/fail paths are unit-testable against
+    a fake wedged backend without a real one (tests/test_axonenv.py)."""
     out: list = []
     err: list = []
 
     def probe():
         try:
-            import jax
+            if get_devices is not None:
+                out.extend(get_devices())
+            else:
+                import jax
 
-            out.extend(jax.devices())
+                out.extend(jax.devices())
         except BaseException as e:  # noqa: BLE001 — reported to caller
             err.append(e)
 
@@ -60,10 +68,19 @@ def reexec_on_cpu(label: str, marker_env: str, argv: list[str], why: str):
     replaces the image without flushing stdio, so a block-buffered
     stdout (docker/systemd pipes) would silently eat the only signal
     that the process degraded to the CPU backend. `marker_env` guards
-    against re-exec loops (the callee raises instead of re-execing when
-    it sees it)."""
+    against re-exec loops: callers skip the probe when they see it, and
+    this function REFUSES to re-exec when the marker is already present
+    in the current environment — a probe that fails even on the
+    scrubbed CPU backend must surface as an error, not an execve storm
+    (the documented contract; previously only the caller-side half
+    existed)."""
     import sys
 
+    if os.environ.get(marker_env):
+        raise RuntimeError(
+            f"{label}: probe failed on the CPU-fallback re-exec too "
+            f"({why}); refusing a re-exec loop ({marker_env} is set)"
+        )
     sys.stderr.write(f"{label}: {why}; re-exec on CPU backend\n")
     sys.stderr.flush()
     env = scrubbed_cpu_env()

@@ -148,16 +148,23 @@ class CompileUnavailable(RuntimeError):
     layer's eager fallback (server/service.py)."""
 
 
-def _env_number(name: str, default, convert, minimum):
-    """A ladder knob from the environment: malformed or out-of-range
-    values fall back to the default — a typo must never disarm the
-    degradation ladder."""
-    raw = os.environ.get(name, "")
+def _coerce_env_number(raw: str, default, convert, minimum):
+    """The shared lenient-knob coercion: malformed or out-of-range
+    values fall back to the default — a typo must never disarm a
+    ladder. Env READS stay module-local (`_env_number` here, its twin
+    in utils/devices.py) so the KSS1xx env-registry analyzer can tie
+    each KSS_* name to its reader; only the coercion is shared."""
     try:
         v = convert(raw) if raw else default
     except ValueError:
         return default
     return v if v >= minimum else default
+
+
+def _env_number(name: str, default, convert, minimum):
+    """A ladder knob from the environment (lenient, see
+    `_coerce_env_number`)."""
+    return _coerce_env_number(os.environ.get(name, ""), default, convert, minimum)
 
 
 def compile_deadline_s() -> float:
@@ -196,11 +203,16 @@ def cooldown_ttl_s() -> float:
     return _env_number("KSS_COMPILE_COOLDOWN_TTL_S", 300.0, float, 0.0)
 
 
-def _call_with_deadline(build, deadline_s: float):
+def _call_with_deadline(build, deadline_s: float, make_exc=None,
+                        thread_name: str = "kss-compile-attempt"):
     """Run `build()` with a watchdog: on timeout the builder thread is
     abandoned (a wedged XLA compile cannot be interrupted from Python)
-    and `CompileDeadlineExceeded` raises on the caller. The abandoned
-    thread's result — engine or exception — is discarded."""
+    and the timeout exception raises on the caller. The abandoned
+    thread's result — engine or exception — is discarded. `make_exc`
+    maps the abandoned thread to the exception to raise (default:
+    `CompileDeadlineExceeded` carrying the thread); the execution
+    ladder's dispatch watchdog (utils/devices.run_with_deadline) reuses
+    this machinery with its own exception type."""
     if deadline_s <= 0:
         return build()
     box: dict = {}
@@ -213,11 +225,11 @@ def _call_with_deadline(build, deadline_s: float):
             box["error"] = e
         done.set()
 
-    th = threading.Thread(
-        target=runner, name="kss-compile-attempt", daemon=True
-    )
+    th = threading.Thread(target=runner, name=thread_name, daemon=True)
     th.start()
     if not done.wait(deadline_s):
+        if make_exc is not None:
+            raise make_exc(th)
         raise CompileDeadlineExceeded(
             f"compile exceeded KSS_COMPILE_DEADLINE_S={deadline_s}s",
             thread=th,
@@ -871,6 +883,17 @@ class CompileBroker:
         fl.engine = eng
         fl.ev.set()
         self._note(speculative=1, metrics=metrics)
+
+    def quiesce(self, timeout: "float | None" = None) -> bool:
+        """The ORDERLY-exit drain (server drain / graceful shutdown,
+        docs/resilience.md): stop accepting new speculation, then
+        out-wait any background build still inside XLA — the same
+        teardown hazard the atexit hook bounds as a last resort
+        (`_drain_live_brokers`), handled here on the graceful path so a
+        drained process exits 0 instead of racing the C++ compiler
+        threads at interpreter teardown. True when fully quiesced."""
+        self.speculative = False
+        return self.drain(timeout=timeout)
 
     def drain(self, timeout: "float | None" = None) -> bool:
         """Block until the speculation queue is empty and no task is

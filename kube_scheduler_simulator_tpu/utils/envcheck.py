@@ -104,6 +104,10 @@ KNOWN: "dict[str, Validator]" = {
     "KSS_COMPILE_BACKOFF_S": _float_validator(0.0),
     "KSS_COMPILE_COOLDOWN_PASSES": _int_validator(1),
     "KSS_COMPILE_COOLDOWN_TTL_S": _float_validator(0.0),
+    # execution ladder + graceful drain (docs/resilience.md)
+    "KSS_DISPATCH_DEADLINE_S": _float_validator(0.0),
+    "KSS_DISPATCH_RETRIES": _int_validator(0),
+    "KSS_DRAIN_DEADLINE_S": _float_validator(0.0),
     "KSS_FAULT_INJECT": _fault_spec_validator,
     "KSS_FAULT_INJECT_SEED": _int_validator(),
     # static analysis / debug tooling (docs/static-analysis.md): wrap

@@ -18,13 +18,23 @@ Grammar (env ``KSS_FAULT_INJECT``, comma-separated ``site:value``):
         builds in `CompileBroker.get_resilient` AND background
         speculative builds);
       - ``device_error``  — the serving layer's device-dispatch point
-        (the top of a scheduling pass dispatch);
+        (the top of a scheduling pass dispatch); classified as a device
+        fault by the EXECUTION ladder (docs/resilience.md): retried,
+        then mesh-shrunk, then failed over to CPU — never fatal;
+      - ``device_lost``   — the same dispatch point, modeling outright
+        device loss (the accelerator vanished, not a transient error);
+        walks the same execution ladder. Both device sites stop firing
+        once the service is on the CPU-failover rung — they model the
+        accelerator, and that rung no longer touches it;
       - ``worker_crash``  — the broker's speculative worker loop (the
         crash the hardened worker must contain);
   * duration sites — ``value`` is a duration (``5s``, ``250ms``): the
     site sleeps that long every time it fires:
       - ``compile_slow``  — injected compile latency, the wedged-compile
-        stand-in the KSS_COMPILE_DEADLINE_S watchdog trips on.
+        stand-in the KSS_COMPILE_DEADLINE_S watchdog trips on;
+      - ``dispatch_hang`` — injected dispatch latency at the serving
+        layer's device-dispatch point, the wedged-dispatch stand-in the
+        KSS_DISPATCH_DEADLINE_S watchdog trips on.
 
 Determinism: every probability site draws from its own
 ``random.Random(f"kss-fault:{seed}:{site}")`` stream (seed from
@@ -50,8 +60,10 @@ import time
 
 from . import locking, telemetry
 
-PROBABILITY_SITES = ("compile_fail", "device_error", "worker_crash")
-DURATION_SITES = ("compile_slow",)
+PROBABILITY_SITES = (
+    "compile_fail", "device_error", "device_lost", "worker_crash"
+)
+DURATION_SITES = ("compile_slow", "dispatch_hang")
 
 ENV_VAR = "KSS_FAULT_INJECT"
 SEED_VAR = "KSS_FAULT_INJECT_SEED"

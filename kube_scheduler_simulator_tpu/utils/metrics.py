@@ -206,6 +206,13 @@ class SchedulingMetrics:
     _eager_fallbacks: int = 0
     _degraded_passes: int = 0
     _worker_crashes: int = 0
+    # execution-ladder counters (the runtime device-fault ladder,
+    # docs/resilience.md): dispatch attempts re-run after a device
+    # fault, passes that escalated to the mid-process CPU failover, and
+    # mesh rebuilds over a shrunken surviving-device set
+    _dispatch_retries: int = 0
+    _device_failovers: int = 0
+    _mesh_shrinks: int = 0
     # latency-distribution state (the observability PR): Prometheus-style
     # histograms behind the same lock as the counters, rendered into the
     # JSON snapshot's `histograms` block and the exposition text
@@ -287,17 +294,28 @@ class SchedulingMetrics:
         eager_fallbacks: int = 0,
         degraded_passes: int = 0,
         worker_crashes: int = 0,
+        dispatch_retries: int = 0,
+        device_failovers: int = 0,
+        mesh_shrinks: int = 0,
     ) -> None:
         """Degradation-ladder accounting (docs/resilience.md): `retries`
         compile attempts re-run after a failure or deadline, `degraded_passes`
         passes that could not be served by a compiled engine,
         `eager_fallbacks` of those that the un-jitted eager rung served,
-        `worker_crashes` speculative-worker crashes the broker contained."""
+        `worker_crashes` speculative-worker crashes the broker contained.
+        The execution ladder's rungs land here too: `dispatch_retries`
+        device dispatches re-run after a device fault, `mesh_shrinks`
+        engine rebuilds over a shrunken surviving-device mesh, and
+        `device_failovers` passes that escalated to the mid-process CPU
+        failover rung."""
         with self._lock:
             self._compile_retries += int(retries)
             self._eager_fallbacks += int(eager_fallbacks)
             self._degraded_passes += int(degraded_passes)
             self._worker_crashes += int(worker_crashes)
+            self._dispatch_retries += int(dispatch_retries)
+            self._device_failovers += int(device_failovers)
+            self._mesh_shrinks += int(mesh_shrinks)
 
     def record_phase_seconds(
         self, execute: float = 0.0, decode: float = 0.0
@@ -381,6 +399,9 @@ class SchedulingMetrics:
                     "eagerFallbacks": self._eager_fallbacks,
                     "degradedPasses": self._degraded_passes,
                     "brokerWorkerCrashes": self._worker_crashes,
+                    "dispatchRetries": self._dispatch_retries,
+                    "deviceFailovers": self._device_failovers,
+                    "meshShrinks": self._mesh_shrinks,
                 },
                 "histograms": {
                     key: h.snapshot() for key, h in self._hist.items()
@@ -414,6 +435,9 @@ class SchedulingMetrics:
             self._eager_fallbacks = 0
             self._degraded_passes = 0
             self._worker_crashes = 0
+            self._dispatch_retries = 0
+            self._device_failovers = 0
+            self._mesh_shrinks = 0
             self._hist = _new_histograms()
             self._born_monotonic = time.monotonic()
 
@@ -427,6 +451,7 @@ class SchedulingMetrics:
         "_engine_builds", "_compile_hits", "_compile_misses",
         "_speculative_compiles", "_stall_s", "_compile_retries",
         "_eager_fallbacks", "_degraded_passes", "_worker_crashes",
+        "_dispatch_retries", "_device_failovers", "_mesh_shrinks",
     )
 
     def state_dict(self) -> dict:
@@ -536,6 +561,21 @@ _PROM_COUNTERS = (
         "kss_stall_seconds_total",
         "Request-thread seconds blocked on any compile.",
         ("phases", "stallSeconds"),
+    ),
+    (
+        "kss_dispatch_retries_total",
+        "Device dispatches re-run after a device fault.",
+        ("phases", "dispatchRetries"),
+    ),
+    (
+        "kss_device_failovers_total",
+        "Passes that escalated to the mid-process CPU failover rung.",
+        ("phases", "deviceFailovers"),
+    ),
+    (
+        "kss_mesh_shrinks_total",
+        "Engine rebuilds over a shrunken surviving-device mesh.",
+        ("phases", "meshShrinks"),
     ),
 )
 

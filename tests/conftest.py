@@ -24,6 +24,12 @@ for _var in (
     "KSS_COMPILE_BACKOFF_S",
     "KSS_COMPILE_COOLDOWN_PASSES",
     "KSS_COMPILE_COOLDOWN_TTL_S",
+    # the execution ladder + graceful drain (docs/resilience.md):
+    # ambient dispatch deadlines / retries / drain budgets would skew
+    # every pass; ladder tests set them with monkeypatch
+    "KSS_DISPATCH_DEADLINE_S",
+    "KSS_DISPATCH_RETRIES",
+    "KSS_DRAIN_DEADLINE_S",
     # the flight recorder (utils/telemetry.py): an ambient KSS_TRACE=1
     # would make every test pay span emission (and the off-by-default
     # zero-emission test would fail for the wrong reason)

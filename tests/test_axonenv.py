@@ -1,0 +1,180 @@
+"""The boot-time device probe + CPU re-exec path (utils/axonenv.py) —
+previously zero unit coverage (ISSUE 9 satellite): the watchdog against
+a fake WEDGED backend, the scrub/re-exec environment contract, the
+re-exec loop guard, and the lifecycle CLI honoring the same probe the
+serving shell runs."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import pytest
+
+from kube_scheduler_simulator_tpu.utils import axonenv
+
+
+class TestProbeDevices:
+    def test_healthy_backend_returns_devices(self):
+        devices, error = axonenv.probe_devices(
+            timeout_s=5.0, get_devices=lambda: ["dev0", "dev1"]
+        )
+        assert devices == ["dev0", "dev1"]
+        assert error is None
+
+    def test_wedged_backend_hangs_past_the_watchdog(self):
+        """The observed failure mode: enumeration itself hangs. The
+        probe must return ([], None) at the timeout — the daemon thread
+        is abandoned, never joined."""
+
+        def wedged():
+            time.sleep(30)
+            return ["never"]
+
+        t0 = time.monotonic()
+        devices, error = axonenv.probe_devices(
+            timeout_s=0.1, get_devices=wedged
+        )
+        assert devices == []
+        assert error is None
+        assert time.monotonic() - t0 < 5.0  # returned at the watchdog
+
+    def test_failing_backend_reports_its_exception(self):
+        def broken():
+            raise RuntimeError("plugin init failed")
+
+        devices, error = axonenv.probe_devices(
+            timeout_s=5.0, get_devices=broken
+        )
+        assert devices == []
+        assert isinstance(error, RuntimeError)
+
+    def test_probe_why_wording(self):
+        assert "failed" in axonenv.probe_why(RuntimeError("x"), 10.0)
+        assert ">180s" in axonenv.probe_why(None, 180.0)
+
+
+class TestReexecOnCpu:
+    def test_reexec_scrubs_shim_and_sets_marker(self, monkeypatch):
+        recorded = {}
+
+        def fake_execve(path, argv, env):
+            recorded.update(path=path, argv=argv, env=env)
+            raise SystemExit(0)  # execve never returns; emulate that
+
+        monkeypatch.setattr(os, "execve", fake_execve)
+        monkeypatch.setenv("AXON_CHIP", "3")
+        monkeypatch.setenv("PALLAS_AXON_MODE", "on")
+        monkeypatch.setenv(
+            "PYTHONPATH", f"/opt/.axon_site{os.pathsep}/keepme"
+        )
+        monkeypatch.delenv("_KSS_TEST_MARKER", raising=False)
+        with pytest.raises(SystemExit):
+            axonenv.reexec_on_cpu(
+                "test", "_KSS_TEST_MARKER", ["python", "-m", "x"], "why"
+            )
+        env = recorded["env"]
+        assert env["_KSS_TEST_MARKER"] == "1"
+        assert env["JAX_PLATFORMS"] == "cpu"
+        assert "AXON_CHIP" not in env
+        assert "PALLAS_AXON_MODE" not in env
+        assert ".axon_site" not in env["PYTHONPATH"]
+        assert "/keepme" in env["PYTHONPATH"]
+        assert recorded["argv"] == ["python", "-m", "x"]
+
+    def test_marker_present_refuses_the_reexec_loop(self, monkeypatch):
+        """The loop guard (the satellite bugfix): a probe that fails
+        even on the scrubbed CPU re-exec must raise, not execve again
+        forever."""
+        monkeypatch.setenv("_KSS_TEST_MARKER", "1")
+        called = {}
+        monkeypatch.setattr(
+            os, "execve", lambda *a: called.setdefault("execve", True)
+        )
+        with pytest.raises(RuntimeError, match="refusing a re-exec loop"):
+            axonenv.reexec_on_cpu(
+                "test", "_KSS_TEST_MARKER", ["python", "-m", "x"], "why"
+            )
+        assert "execve" not in called
+
+
+class TestScrubbedCpuEnv:
+    def test_virtual_devices_flag(self):
+        env = axonenv.scrubbed_cpu_env(
+            {"XLA_FLAGS": "--xla_force_host_platform_device_count=2 --keep"},
+            virtual_devices=8,
+        )
+        assert "--xla_force_host_platform_device_count=8" in env["XLA_FLAGS"]
+        assert "--keep" in env["XLA_FLAGS"]
+        assert env["JAX_PLATFORMS"] == "cpu"
+
+
+class TestLifecycleCliProbe:
+    """The lifecycle CLI honors the serving shell's boot probe."""
+
+    def _spec_file(self, tmp_path):
+        from helpers import node, pod
+
+        spec = {
+            "name": "probe",
+            "seed": 1,
+            "horizon": 1.0,
+            "snapshot": {"nodes": [node("n0")], "pods": [pod("p0")]},
+            "faults": [{"at": 0.5, "action": "cordon", "node": "n0"}],
+        }
+        path = tmp_path / "spec.json"
+        path.write_text(json.dumps(spec))
+        return str(path)
+
+    def test_wedged_probe_triggers_cpu_reexec(self, monkeypatch, tmp_path):
+        from kube_scheduler_simulator_tpu.lifecycle.__main__ import (
+            main as lifecycle_cli,
+        )
+
+        recorded = {}
+
+        def fake_probe(timeout_s=axonenv.PROBE_TIMEOUT_S, get_devices=None):
+            return [], None  # the wedged backend
+
+        def fake_reexec(label, marker, argv, why):
+            recorded.update(label=label, marker=marker, argv=argv, why=why)
+            raise SystemExit(77)  # execve replaces the image; emulate
+
+        monkeypatch.setattr(axonenv, "probe_devices", fake_probe)
+        monkeypatch.setattr(axonenv, "reexec_on_cpu", fake_reexec)
+        monkeypatch.delenv("_KSS_LIFECYCLE_CPU_FALLBACK", raising=False)
+        with pytest.raises(SystemExit, match="77"):
+            lifecycle_cli(["--spec", self._spec_file(tmp_path)])
+        assert recorded["label"] == "lifecycle"
+        assert recorded["marker"] == "_KSS_LIFECYCLE_CPU_FALLBACK"
+        assert recorded["argv"][-2:] == ["--spec", self._spec_file(tmp_path)]
+        assert "hung" in recorded["why"]
+
+    def test_marker_skips_the_probe(self, monkeypatch, tmp_path, capsys):
+        from kube_scheduler_simulator_tpu.lifecycle.__main__ import (
+            main as lifecycle_cli,
+        )
+
+        def must_not_probe(*a, **k):  # pragma: no cover - the assertion
+            raise AssertionError("probe ran despite the fallback marker")
+
+        monkeypatch.setattr(axonenv, "probe_devices", must_not_probe)
+        monkeypatch.setenv("_KSS_LIFECYCLE_CPU_FALLBACK", "1")
+        rc = lifecycle_cli(["--spec", self._spec_file(tmp_path)])
+        assert rc == 0
+
+    def test_no_device_probe_flag_skips(self, monkeypatch, tmp_path):
+        from kube_scheduler_simulator_tpu.lifecycle.__main__ import (
+            main as lifecycle_cli,
+        )
+
+        def must_not_probe(*a, **k):  # pragma: no cover - the assertion
+            raise AssertionError("probe ran despite --no-device-probe")
+
+        monkeypatch.setattr(axonenv, "probe_devices", must_not_probe)
+        monkeypatch.delenv("_KSS_LIFECYCLE_CPU_FALLBACK", raising=False)
+        rc = lifecycle_cli(
+            ["--no-device-probe", "--spec", self._spec_file(tmp_path)]
+        )
+        assert rc == 0

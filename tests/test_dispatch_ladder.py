@@ -1,0 +1,262 @@
+"""The runtime device-fault ladder (docs/resilience.md): dispatch
+watchdog + bounded retry, mesh-shrink rebuild, mid-process CPU
+failover — and the tier-1 resilience-gate parity: a chaos run under
+``device_lost:1.0`` completes on a lower rung with a trace
+byte-identical to a clean run's, and a graceful mid-run stop (the
+``kill -TERM`` stand-in) drains with exit 0 and resumes to the same
+bytes."""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+
+import pytest
+
+from kube_scheduler_simulator_tpu.lifecycle.__main__ import (
+    main as lifecycle_cli,
+)
+from kube_scheduler_simulator_tpu.lifecycle.engine import LifecycleEngine
+from kube_scheduler_simulator_tpu.models.store import ResourceStore
+from kube_scheduler_simulator_tpu.scenario.chaos import ChaosSpec
+from kube_scheduler_simulator_tpu.server.service import SchedulerService
+from kube_scheduler_simulator_tpu.utils import devices as devices_mod
+from kube_scheduler_simulator_tpu.utils import faultinject
+from kube_scheduler_simulator_tpu.utils.metrics import SchedulingMetrics
+
+from helpers import node, pod
+
+
+def _cluster_service():
+    store = ResourceStore()
+    for i in range(4):
+        store.apply("nodes", node(f"n{i}", cpu="16", mem="32Gi"))
+    for i in range(5):
+        store.apply("pods", pod(f"p{i}", cpu="100m"))
+    metrics = SchedulingMetrics()
+    return store, SchedulerService(store, metrics=metrics), metrics
+
+
+def _chaos_dict() -> dict:
+    return {
+        "name": "ladder-parity",
+        "seed": 5,
+        "horizon": 12.0,
+        "schedulerMode": "gang",
+        "snapshot": {
+            "nodes": [node(f"n{i}", cpu="8", mem="16Gi") for i in range(3)],
+            "pods": [
+                pod(f"s{i}", cpu="100m", node_name=f"n{i % 3}")
+                for i in range(6)
+            ],
+        },
+        "arrivals": [
+            {
+                "kind": "poisson",
+                "rate": 0.5,
+                "count": 5,
+                "template": pod("churn", cpu="100m"),
+            }
+        ],
+        "faults": [
+            {"at": 4.0, "action": "cordon", "node": "n0"},
+            {"at": 8.0, "action": "uncordon", "node": "n0"},
+        ],
+    }
+
+
+class TestDeviceFaultClassifier:
+    def test_injected_device_sites_classify(self):
+        for site in ("device_error", "device_lost"):
+            assert devices_mod.is_device_fault(faultinject.InjectedFault(site))
+
+    def test_other_injected_sites_do_not(self):
+        assert not devices_mod.is_device_fault(
+            faultinject.InjectedFault("compile_fail")
+        )
+
+    def test_deadline_classifies_and_ordinary_errors_do_not(self):
+        assert devices_mod.is_device_fault(
+            devices_mod.DispatchDeadlineExceeded("late")
+        )
+        assert not devices_mod.is_device_fault(ValueError("bug"))
+
+    def test_xla_runtime_error_matched_by_name(self):
+        class XlaRuntimeError(RuntimeError):
+            pass
+
+        assert devices_mod.is_device_fault(XlaRuntimeError("device lost"))
+
+
+class TestWatchdog:
+    def test_no_deadline_runs_inline(self):
+        assert devices_mod.run_with_deadline(lambda: 41 + 1, 0.0) == 42
+
+    def test_deadline_trips_on_a_hang(self):
+        import time
+
+        with pytest.raises(devices_mod.DispatchDeadlineExceeded):
+            devices_mod.run_with_deadline(lambda: time.sleep(5), 0.05)
+
+    def test_inner_exception_relayed(self):
+        def boom():
+            raise ValueError("inner")
+
+        with pytest.raises(ValueError, match="inner"):
+            devices_mod.run_with_deadline(boom, 5.0)
+
+
+class TestServiceLadder:
+    @pytest.mark.parametrize("mode", ["gang", "sequential"])
+    def test_device_lost_fails_over_with_identical_placements(
+        self, monkeypatch, mode
+    ):
+        _, svc_ok, _ = _cluster_service()
+        if mode == "gang":
+            ok = svc_ok.schedule_gang(record=False)[0]
+        else:
+            ok = {
+                (r.pod_namespace, r.pod_name): r.selected_node
+                for r in svc_ok.schedule()
+            }
+        monkeypatch.setenv("KSS_FAULT_INJECT", "device_lost:1.0")
+        monkeypatch.setenv("KSS_DISPATCH_RETRIES", "1")
+        _, svc, metrics = _cluster_service()
+        if mode == "gang":
+            got = svc.schedule_gang(record=False)[0]
+        else:
+            got = {
+                (r.pod_namespace, r.pod_name): r.selected_node
+                for r in svc.schedule()
+            }
+        assert got == ok
+        assert svc.device_rung == "cpu"
+        phases = metrics.snapshot()["phases"]
+        assert phases["dispatchRetries"] == 1
+        assert phases["meshShrinks"] == 1  # 8 virtual devices: one shrink
+        assert phases["deviceFailovers"] == 1
+
+    def test_failover_latches_no_ladder_rewalk(self, monkeypatch):
+        monkeypatch.setenv("KSS_FAULT_INJECT", "device_lost:1.0")
+        store, svc, metrics = _cluster_service()
+        svc.schedule_gang(record=False)
+        retries = metrics.snapshot()["phases"]["dispatchRetries"]
+        store.apply("pods", pod("late", cpu="100m"))
+        placements, _, _ = svc.schedule_gang(record=False)
+        assert placements  # the latched CPU rung still schedules
+        after = metrics.snapshot()["phases"]
+        assert after["dispatchRetries"] == retries
+        assert after["deviceFailovers"] == 1  # counted once, not per pass
+
+    def test_dispatch_hang_trips_deadline_and_escalates(self, monkeypatch):
+        _, svc_ok, _ = _cluster_service()
+        ok = svc_ok.schedule_gang(record=False)[0]
+        monkeypatch.setenv("KSS_FAULT_INJECT", "dispatch_hang:200ms")
+        monkeypatch.setenv("KSS_DISPATCH_DEADLINE_S", "0.02")
+        monkeypatch.setenv("KSS_DISPATCH_RETRIES", "0")
+        _, svc, metrics = _cluster_service()
+        assert svc.schedule_gang(record=False)[0] == ok
+        assert svc.device_rung == "cpu"
+        assert metrics.snapshot()["phases"]["deviceFailovers"] == 1
+
+    def test_transient_fault_recovers_without_escalation(self, monkeypatch):
+        """A device fault that clears within the retry budget stays on
+        the device rung: no shrink, no failover."""
+        fired = {"n": 0}
+
+        class OneShotPlane(faultinject.FaultPlane):
+            def maybe_raise(self, site):
+                if site == "device_error" and fired["n"] == 0:
+                    fired["n"] = 1
+                    raise faultinject.InjectedFault(site)
+
+        faultinject.activate(OneShotPlane({}, seed=0))
+        try:
+            _, svc, metrics = _cluster_service()
+            placements, _, _ = svc.schedule_gang(record=False)
+        finally:
+            faultinject.deactivate()
+        assert placements
+        assert svc.device_rung == "device"
+        phases = metrics.snapshot()["phases"]
+        assert phases["dispatchRetries"] == 1
+        assert phases["deviceFailovers"] == 0
+        assert phases["meshShrinks"] == 0
+
+    def test_non_device_errors_propagate_untouched(self, monkeypatch):
+        """The ladder must never retry an ordinary bug into silence."""
+        _, svc, metrics = _cluster_service()
+        calls = {"n": 0}
+
+        def broken(config, record, window=None):
+            calls["n"] += 1
+            raise ValueError("an encode bug, not a device fault")
+
+        monkeypatch.setattr(svc, "_gang_dispatch_once", broken)
+        with pytest.raises(ValueError, match="encode bug"):
+            svc.schedule_gang(record=False)
+        assert calls["n"] == 1  # no retry
+        assert metrics.snapshot()["phases"]["dispatchRetries"] == 0
+
+
+class TestChaosRunParity:
+    def test_device_lost_chaos_run_is_byte_identical(self, monkeypatch):
+        """The resilience gate (ISSUE 9 acceptance): with device_lost:1.0
+        injected, a chaos run completes on a lower ladder rung with a
+        trace byte-identical to a clean run."""
+        clean = LifecycleEngine(ChaosSpec.from_dict(_chaos_dict()))
+        clean_res = clean.run()
+        assert clean_res["phase"] == "Succeeded"
+        monkeypatch.setenv("KSS_FAULT_INJECT", "device_lost:1.0")
+        monkeypatch.setenv("KSS_DISPATCH_RETRIES", "0")
+        faulted = LifecycleEngine(ChaosSpec.from_dict(_chaos_dict()))
+        res = faulted.run()
+        assert res["phase"] == "Succeeded", res.get("message")
+        phases = res["metrics"]["phases"]
+        assert phases["deviceFailovers"] >= 1
+        assert faulted.trace_jsonl() == clean.trace_jsonl()
+
+    def test_graceful_stop_drains_exit_0_and_resumes_byte_identical(
+        self, tmp_path
+    ):
+        """`kill -TERM` mid-run (deterministic stand-in:
+        --stop-after-events) drains with exit 0 — Interrupted + final
+        checkpoint is the orderly zero-loss path — and the resumed
+        trace is byte-identical to the uninterrupted run's."""
+        spec_path = tmp_path / "spec.json"
+        spec_path.write_text(json.dumps(_chaos_dict()))
+        ckpt = str(tmp_path / "run.ckpt.json")
+        killed = str(tmp_path / "killed.jsonl")
+        resumed = str(tmp_path / "resumed.jsonl")
+        clean = LifecycleEngine(ChaosSpec.from_dict(_chaos_dict()))
+        clean.run()
+        clean_bytes = clean.trace_jsonl().encode()
+        with contextlib.redirect_stdout(io.StringIO()):
+            rc = lifecycle_cli(
+                [
+                    "--spec", str(spec_path), "--checkpoint-to", ckpt,
+                    "--stop-after-events", "3", "--trace-out", killed,
+                ]
+            )
+        assert rc == 0  # the orderly drain reads as success
+        assert os.path.exists(ckpt)
+        with open(killed, "rb") as f:
+            assert clean_bytes.startswith(f.read())
+        with contextlib.redirect_stdout(io.StringIO()):
+            rc = lifecycle_cli(["--resume", ckpt, "--trace-out", resumed])
+        assert rc == 0
+        with open(resumed, "rb") as f:
+            assert f.read() == clean_bytes
+
+    def test_interrupted_without_checkpoint_still_exits_1(self, tmp_path):
+        """Exit 0 is the DRAIN contract: an interrupted run that wrote
+        no checkpoint lost its tail and must keep reading as failure."""
+        spec_path = tmp_path / "spec.json"
+        spec_path.write_text(json.dumps(_chaos_dict()))
+        with contextlib.redirect_stdout(io.StringIO()):
+            rc = lifecycle_cli(
+                ["--spec", str(spec_path), "--stop-after-events", "3"]
+            )
+        assert rc == 1

@@ -1,0 +1,234 @@
+"""The zero-loss graceful drain (docs/resilience.md): readyz flips to
+the DISTINCT ``draining`` state, new requests shed with the structured
+503, every live session — the default included — snapshots through the
+``kss-session-checkpoint/v1`` path, the broker quiesces, and a manager
+restarted over the same directory adopts the snapshots transparently."""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kube_scheduler_simulator_tpu.lifecycle.checkpoint import (
+    SESSION_CHECKPOINT_FORMAT,
+    load_checkpoint,
+)
+from kube_scheduler_simulator_tpu.server.httpserver import SimulatorServer
+from kube_scheduler_simulator_tpu.server.service import SimulatorService
+from kube_scheduler_simulator_tpu.server.sessions import SessionManager
+from kube_scheduler_simulator_tpu.utils.metrics import parse_prometheus_text
+
+from helpers import node, pod
+
+
+def _req(port, method, path, body=None, timeout=300):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            raw = resp.read()
+            return resp.status, json.loads(raw) if raw else None, dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        return e.code, json.loads(raw) if raw else None, dict(e.headers)
+
+
+@pytest.fixture()
+def server(tmp_path):
+    srv = SimulatorServer(
+        SimulatorService(),
+        port=0,
+        session_config={"snapshot_dir": str(tmp_path / "sessions")},
+    ).start()
+    yield srv
+    srv.shutdown()
+
+
+class TestManagerDrain:
+    def test_drain_snapshots_every_live_session_including_default(
+        self, tmp_path
+    ):
+        mgr = SessionManager(
+            SimulatorService(), snapshot_dir=str(tmp_path), idle_evict_s=0.0
+        )
+        default_svc = mgr.get("default").service
+        default_svc.store.apply("nodes", node("dn0"))
+        sess, _ = mgr.create(name="tenant")
+        sess.service.store.apply("pods", pod("tp0"))
+        result = mgr.drain(deadline_s=5.0)
+        assert set(result["drainedSessions"]) == {"default", sess.id}
+        assert result["forced"] == []
+        assert mgr.draining
+        assert mgr.drained == 2
+        for sid in ("default", sess.id):
+            doc = load_checkpoint(
+                os.path.join(str(tmp_path), f"{sid}.json"),
+                SESSION_CHECKPOINT_FORMAT,
+            )
+            assert doc["id"] == sid
+        mgr.shutdown()
+
+    def test_drain_is_idempotent(self, tmp_path):
+        mgr = SessionManager(SimulatorService(), snapshot_dir=str(tmp_path))
+        mgr.drain(deadline_s=1.0)
+        again = mgr.drain(deadline_s=1.0)
+        assert "default" in again["drainedSessions"]  # re-snapshot, no error
+        mgr.shutdown()
+
+    def test_in_flight_pass_finishes_before_snapshot(self, tmp_path):
+        """A pass holding the schedule lock within the deadline is
+        waited out — the snapshot carries its write-backs."""
+        import threading
+        import time
+
+        mgr = SessionManager(SimulatorService(), snapshot_dir=str(tmp_path))
+        svc = mgr.get("default").service
+        svc.store.apply("nodes", node("n0"))
+        svc.store.apply("pods", pod("p0"))
+        lock = svc.scheduler._schedule_lock
+        lock.acquire()
+
+        def finish_pass():
+            time.sleep(0.3)
+            svc.store.apply("pods", pod("p1"))  # the "write-back"
+            lock.release()
+
+        t = threading.Thread(target=finish_pass)
+        t.start()
+        result = mgr.drain(deadline_s=10.0)
+        t.join()
+        assert result["forced"] == []
+        doc = load_checkpoint(
+            os.path.join(str(tmp_path), "default.json"),
+            SESSION_CHECKPOINT_FORMAT,
+        )
+        names = {o["metadata"]["name"] for o in doc["store"]["objects"]["pods"]}
+        assert names == {"p0", "p1"}
+        mgr.shutdown()
+
+    def test_wedged_pass_forces_snapshot_past_deadline(self, tmp_path):
+        """Past KSS_DRAIN_DEADLINE_S the drain stops waiting: the pass
+        is abandoned at its boundary and the session snapshots anyway
+        (an unresolved pass has acknowledged nothing)."""
+        mgr = SessionManager(SimulatorService(), snapshot_dir=str(tmp_path))
+        svc = mgr.get("default").service
+        svc.scheduler._schedule_lock.acquire()  # a pass that never ends
+        try:
+            result = mgr.drain(deadline_s=0.2)
+        finally:
+            svc.scheduler._schedule_lock.release()
+        assert result["forced"] == ["default"]
+        assert os.path.exists(os.path.join(str(tmp_path), "default.json"))
+        mgr.shutdown()
+
+    def test_restart_adopts_snapshots_transparently(self, tmp_path):
+        mgr = SessionManager(
+            SimulatorService(), snapshot_dir=str(tmp_path), idle_evict_s=0.0
+        )
+        mgr.get("default").service.store.apply("nodes", node("dn0"))
+        sess, _ = mgr.create(name="tenant")
+        sess.service.store.apply("pods", pod("tp0"))
+        sid = sess.id
+        mgr.drain(deadline_s=5.0)
+        mgr.shutdown()
+        # "rolling restart": a fresh manager over the same directory
+        mgr2 = SessionManager(SimulatorService(), snapshot_dir=str(tmp_path))
+        # the default session's state restored IN PLACE at boot (its
+        # snapshot consumed), other sessions adopted as evicted
+        assert mgr2.get("default").service.store.count("nodes") == 1
+        assert not os.path.exists(os.path.join(str(tmp_path), "default.json"))
+        assert mgr2.info(sid)["state"] == "evicted"
+        restored = mgr2.get(sid)  # the transparent-restore touch
+        assert restored.service.store.count("pods") == 1
+        assert restored.name == "tenant"
+        mgr2.shutdown()
+
+    def test_drain_contains_per_session_snapshot_failures(
+        self, tmp_path, monkeypatch
+    ):
+        """One tenant's failed snapshot must not cost the others theirs
+        — it is recorded in the result's `errors` (which the serving
+        CLI turns into a non-zero exit) while every other session still
+        lands on disk and the broker still quiesces."""
+        import kube_scheduler_simulator_tpu.server.sessions as sessions_mod
+
+        mgr = SessionManager(SimulatorService(), snapshot_dir=str(tmp_path))
+        bad, _ = mgr.create(name="bad")
+        good, _ = mgr.create(name="good")
+        real = sessions_mod.write_checkpoint
+
+        def flaky(doc, path):
+            if doc.get("id") == bad.id:
+                raise OSError("disk full")
+            return real(doc, path)
+
+        monkeypatch.setattr(sessions_mod, "write_checkpoint", flaky)
+        result = mgr.drain(deadline_s=5.0)
+        assert list(result["errors"]) == [bad.id]
+        assert "disk full" in result["errors"][bad.id]
+        assert set(result["drainedSessions"]) == {"default", good.id}
+        assert os.path.exists(os.path.join(str(tmp_path), f"{good.id}.json"))
+        mgr.shutdown()
+
+    def test_adopt_skips_unreadable_files(self, tmp_path):
+        (tmp_path / "junk.json").write_text("{not json")
+        (tmp_path / "wrong.json").write_text(json.dumps({"format": "other"}))
+        mgr = SessionManager(SimulatorService(), snapshot_dir=str(tmp_path))
+        assert set(mgr._sessions) == {"default"}
+        mgr.shutdown()
+
+
+class TestServerDrainSurface:
+    def test_drain_route_readyz_and_shedding(self, server):
+        port = server.port
+        code, doc, _ = _req(port, "GET", "/api/v1/readyz")
+        assert code == 200 and doc["state"] == "ready"
+        code, doc, _ = _req(port, "POST", "/api/v1/admin/drain")
+        assert code == 202 and doc["draining"]
+        server.drain_done.wait(30)
+        # readyz: the DISTINCT draining state, 503 + Retry-After
+        code, doc, headers = _req(port, "GET", "/api/v1/readyz")
+        assert code == 503
+        assert doc["state"] == "draining"
+        assert "Retry-After" in headers
+        # new work sheds with the structured 503
+        code, doc, headers = _req(port, "POST", "/api/v1/schedule")
+        assert code == 503
+        assert doc["kind"] == "ServerDraining"
+        assert "Retry-After" in headers
+        # health, drain status, and the metrics scrape stay answerable
+        assert _req(port, "GET", "/api/v1/healthz")[0] == 200
+        code, status, _ = _req(port, "GET", "/api/v1/admin/drain")
+        assert code == 200 and status["done"]
+        assert "default" in status["result"]["drainedSessions"]
+        code, metrics, _ = _req(port, "GET", "/api/v1/metrics")
+        assert code == 200
+        assert metrics["draining"] is True
+        assert metrics["drainedSessions"] >= 1
+
+    def test_drain_state_in_prometheus(self, server):
+        server.drain(timeout=30)
+        code, _, _ = _req(port := server.port, "GET", "/api/v1/healthz")
+        assert code == 200
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/v1/metrics?format=prometheus"
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            families = parse_prometheus_text(resp.read().decode())
+        assert families["kss_server_draining"]["samples"][0][2] == 1.0
+        drained = families["kss_drained_sessions_total"]["samples"][0][2]
+        assert drained >= 1.0
+
+    def test_metrics_reports_device_rung(self, server):
+        code, doc, _ = _req(server.port, "GET", "/api/v1/metrics")
+        assert code == 200
+        assert doc["deviceRung"] == "device"
+        assert doc["draining"] is False

@@ -27,6 +27,19 @@ class TestGrammar:
         plane = FaultPlane.parse(" compile_fail : 1.0 , ,device_error:0.5,")
         assert plane.rules == {"compile_fail": 1.0, "device_error": 0.5}
 
+    def test_execution_ladder_sites(self):
+        """The ISSUE 9 grammar additions: device_lost is a probability
+        site, dispatch_hang a duration site."""
+        plane = FaultPlane.parse("device_lost:1.0,dispatch_hang:50ms")
+        assert plane.rules == {"device_lost": 1.0, "dispatch_hang": 0.05}
+        with pytest.raises(InjectedFault) as exc:
+            plane.maybe_raise("device_lost")
+        assert exc.value.site == "device_lost"
+        with pytest.raises(ValueError):
+            FaultPlane.parse("device_lost:2.0")  # probability bounds hold
+        with pytest.raises(ValueError):
+            FaultPlane.parse("dispatch_hang:0.5")  # durations need a unit
+
     @pytest.mark.parametrize(
         "bad",
         [

@@ -12,6 +12,7 @@ from kube_scheduler_simulator_tpu.parallel import (
     WeightSweep,
     build_mesh,
     shard_encoded,
+    surviving_mesh,
     weights_for,
 )
 from kube_scheduler_simulator_tpu.synth import synthetic_cluster
@@ -65,6 +66,47 @@ class TestMesh:
             build_mesh(8, replicas=3)
         mesh = build_mesh(8, replicas=2, node_shards=4)
         assert mesh.shape == {"replicas": 2, "nodes": 4}
+
+    def test_odd_device_count_falls_to_single_node_shard(self):
+        """The rebuild edge case the execution ladder hits: shrinking 8
+        devices to an odd survivor count must factor to node_shards=1
+        (the replicas axis absorbs everything)."""
+        mesh = build_mesh(7)
+        assert mesh.shape == {"replicas": 7, "nodes": 1}
+        assert mesh.devices.size == 7
+
+    def test_explicit_surviving_device_subset(self):
+        """build_mesh over an explicit device subset — the shrink rung
+        hands it the survivors, not a prefix of jax.devices()."""
+        subset = jax.devices()[2:6]
+        mesh = build_mesh(devices=subset)
+        assert mesh.shape == {"replicas": 2, "nodes": 2}
+        assert set(mesh.devices.flat) == set(subset)
+
+    def test_bad_factorization_error_names_both_factors(self):
+        with pytest.raises(ValueError, match=r"replicas \(3\) x node_shards \(3\)"):
+            build_mesh(8, replicas=3, node_shards=3)
+
+    def test_requesting_more_devices_than_present(self):
+        with pytest.raises(ValueError, match="devices requested"):
+            build_mesh(9)
+
+
+class TestSurvivingMesh:
+    def test_shrink_drops_lost_and_narrows_replicas(self):
+        devices = jax.devices()
+        mesh = surviving_mesh({devices[0]})
+        assert mesh.shape == {"replicas": 7, "nodes": 1}
+        assert devices[0] not in set(mesh.devices.flat)
+
+    def test_shrink_even_survivors_keeps_two_node_shards(self):
+        devices = jax.devices()
+        mesh = surviving_mesh(set(devices[:2]))
+        assert mesh.shape == {"replicas": 3, "nodes": 2}
+
+    def test_nothing_surviving_raises(self):
+        with pytest.raises(ValueError, match="no devices survive"):
+            surviving_mesh(set(jax.devices()))
 
 
 class TestShardEncoded:

@@ -21,7 +21,6 @@ from kube_scheduler_simulator_tpu.server.service import (
     SchedulerService,
     SimulatorService,
 )
-from kube_scheduler_simulator_tpu.utils import faultinject
 from kube_scheduler_simulator_tpu.utils.broker import (
     CompileBroker,
     CompileDeadlineExceeded,
@@ -335,11 +334,21 @@ class TestServiceEagerFallback:
         assert phases["compileRetries"] >= 1
         assert phases["compileMisses"] == 0  # nothing compiled
 
-    def test_device_error_propagates(self, monkeypatch):
+    def test_device_error_walks_the_execution_ladder(self, monkeypatch):
+        """PR 4 semantics let an injected device_error propagate to the
+        Abort path; the execution ladder (this PR, docs/resilience.md)
+        now owns it: retried, mesh-shrunk, then failed over to CPU —
+        the pass COMPLETES with the healthy run's placements."""
+        _, svc_ok, _ = _cluster_service()
+        ok_placements, _, _ = svc_ok.schedule_gang(record=False)
         monkeypatch.setenv("KSS_FAULT_INJECT", "device_error:1.0")
-        _, svc, _ = _cluster_service()
-        with pytest.raises(faultinject.InjectedFault):
-            svc.schedule_gang(record=False)
+        _, svc, metrics = _cluster_service()
+        placements, _, _ = svc.schedule_gang(record=False)
+        assert placements == ok_placements
+        assert svc.device_rung == "cpu"
+        phases = metrics.snapshot()["phases"]
+        assert phases["dispatchRetries"] >= 1
+        assert phases["deviceFailovers"] == 1
 
     def test_record_mode_finish_stays_on_the_eager_rung(self, monkeypatch):
         """The gang record decode lazily jits its replay programs in
