@@ -19,8 +19,12 @@ WORKDIR /app
 COPY pyproject.toml ./
 COPY kube_scheduler_simulator_tpu ./kube_scheduler_simulator_tpu
 
+# the dev extra pins ruff/mypy so `make lint` inside the container (and
+# any CI that builds this image) runs the REAL linters — the Makefile's
+# skipped-with-a-note branches are for bare dev boxes only
 RUN pip install --no-cache-dir "jax[cpu]" pyyaml && \
-    pip install --no-cache-dir --no-deps .
+    pip install --no-cache-dir --no-deps . && \
+    pip install --no-cache-dir "ruff>=0.4,<0.9" "mypy>=1.8,<2"
 
 ENV PORT=1212
 EXPOSE 1212

@@ -18,16 +18,22 @@ test-fast:
 # the default verify path: `make lint && make test` before every PR.
 # lint = bytecode sanity + the kss-lint contract analyzers
 # (docs/static-analysis.md: env registry, metrics registry, jit purity,
-# lock order, span balance — also run as tier-1 tests) + ruff + the
-# scoped strict mypy. ruff/mypy are skipped with a note when not
-# installed (configs live in pyproject.toml); the analyzers always run.
+# lock order, span balance, guarded state, jaxpr audit — also run as
+# tier-1 tests) + ruff + the scoped strict mypy. ruff/mypy are pinned as
+# the `dev` extra (pip install -e '.[dev]'); when not installed they are
+# skipped with a note — EXCEPT under KSS_LINT_STRICT=1 (CI), where a
+# missing linter fails the target instead of silently weakening it.
 lint:
 	$(PY) -m compileall -q kube_scheduler_simulator_tpu tests bench.py __graft_entry__.py
 	$(PY) -m kube_scheduler_simulator_tpu.analysis
 	@if command -v ruff >/dev/null 2>&1; then ruff check .; \
-	else echo "lint: ruff not installed -- skipped (config: pyproject [tool.ruff])"; fi
+	elif [ "$$KSS_LINT_STRICT" = "1" ]; then \
+	echo "lint: ruff REQUIRED (KSS_LINT_STRICT=1) but not installed -- pip install -e '.[dev]'" >&2; exit 1; \
+	else echo "lint: ruff not installed -- skipped (config: pyproject [tool.ruff]; strict: KSS_LINT_STRICT=1)"; fi
 	@if command -v mypy >/dev/null 2>&1; then mypy; \
-	else echo "lint: mypy not installed -- skipped (config: pyproject [tool.mypy])"; fi
+	elif [ "$$KSS_LINT_STRICT" = "1" ]; then \
+	echo "lint: mypy REQUIRED (KSS_LINT_STRICT=1) but not installed -- pip install -e '.[dev]'" >&2; exit 1; \
+	else echo "lint: mypy not installed -- skipped (config: pyproject [tool.mypy]; strict: KSS_LINT_STRICT=1)"; fi
 
 # the HTTP simulator (reference `make start`: PORT=1212 ./bin/simulator)
 start:

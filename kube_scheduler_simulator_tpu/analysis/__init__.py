@@ -8,7 +8,7 @@ spans are balanced, locks are acquired in one global order. Nothing in
 Python enforces any of that — the next PR can silently break all five.
 
 This package is the mechanical reviewer: an AST-based lint framework
-(`core.py`) with five analyzers, each guarding one contract:
+(`core.py`) with seven analyzers, each guarding one contract:
 
   ===========  ==========================================================
   rules        contract
@@ -27,6 +27,18 @@ This package is the mechanical reviewer: an AST-based lint framework
                KSS_LOCK_CHECK witness)
   KSS5xx       span-balance — telemetry spans are statically paired
                (with-statement discipline; no raw B/E emission)
+  KSS6xx       guarded-state — each class's lock→attribute protection
+               map, inferred from the make_lock(role) registry; no
+               read/write of claimed state outside the owning lock
+               (runtime counterpart: KSS_RACE_CHECK descriptors raising
+               UnguardedAccess, utils/locking.py)
+  KSS7xx       jaxpr-audit — the COMPILED programs: no host-callback
+               APIs/primitives, no f64 outside the EXACT policy, shapes
+               on the shape_bucket grid, donations consumed, and per-
+               site compile fingerprints held stable across identical
+               runs (runtime counterpart: KSS_JAXPR_AUDIT hook in
+               broker.jit, fingerprints persisted next to the XLA
+               compile cache)
   ===========  ==========================================================
 
 Run as tier-1 tests (tests/test_static_analysis.py), as a CLI

@@ -4,17 +4,31 @@
     python -m kube_scheduler_simulator_tpu.analysis --rule env-registry
     python -m kube_scheduler_simulator_tpu.analysis --format json
 
-Exit status: 0 clean, 1 findings, 2 usage error. `make lint` runs this
-alongside ruff and the scoped strict mypy (both gated on availability).
+Exit status: 0 clean, 1 findings OR stale allowlist entries (a waiver
+naming a finding that no longer fires is dead weight that must be
+deleted, not kept), 2 usage error. Under ``KSS_LINT_STRICT=1`` a
+non-empty allowlist is itself a failure — the CI-honesty mode `make
+lint` runs in. `make lint` runs this alongside ruff and the scoped
+strict mypy (gated on availability; strict mode fails loudly instead).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
-from .core import ALLOWLIST, RepoContext, SourceTree, all_analyzers, run_all
+from ..utils.envcheck import env_truthy
+from .core import (
+    ALLOWLIST,
+    RepoContext,
+    SourceTree,
+    all_analyzers,
+    apply_allowlist,
+    run_all,
+    stale_waivers,
+)
 
 
 def main(argv: "list[str] | None" = None) -> int:
@@ -46,7 +60,12 @@ def main(argv: "list[str] | None" = None) -> int:
     # semantic rules import the INSTALLED modules — only meaningful when
     # the analyzed tree IS the installed package
     repo.live = args.package_dir is None
-    findings = run_all(tree, repo, only=args.rule)
+    # raw findings first: the stale-waiver check must see what the
+    # allowlist would have hidden
+    raw = run_all(tree, repo, only=args.rule, allowlist={})
+    findings = apply_allowlist(raw)
+    stale = stale_waivers(raw) if not args.rule else []
+    strict = env_truthy(os.environ.get("KSS_LINT_STRICT"))
 
     if args.fmt == "json":
         print(
@@ -75,10 +94,21 @@ def main(argv: "list[str] | None" = None) -> int:
             print(
                 "kss-lint: WARNING: the allowlist is non-empty "
                 f"({sum(len(v) for v in ALLOWLIST.values())} waiver(s)) — "
-                "it must stay empty (fix, don't waive)",
+                "it must stay empty (fix, don't waive)"
+                + (" [KSS_LINT_STRICT: failing]" if strict else ""),
                 file=sys.stderr,
             )
-    return 1 if findings else 0
+    for entry in stale:
+        print(
+            f"kss-lint: STALE allowlist entry (no such finding fires "
+            f"anymore — delete the waiver): {entry}",
+            file=sys.stderr,
+        )
+    if findings or stale:
+        return 1
+    if strict and ALLOWLIST:
+        return 1
+    return 0
 
 
 if __name__ == "__main__":

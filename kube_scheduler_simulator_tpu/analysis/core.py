@@ -178,10 +178,37 @@ def apply_allowlist(
     ]
 
 
+def stale_waivers(
+    findings: "list[Finding]",
+    allowlist: "dict[str, tuple[str, ...]] | None" = None,
+) -> "list[str]":
+    """Allowlist entries that no finding matches anymore — dead waivers.
+    `findings` must be the RAW (pre-allowlist) findings. A waiver whose
+    violation was fixed must be deleted, not kept: stale entries are how
+    an 'empty in spirit' allowlist quietly becomes a blanket one (the
+    CLI exits non-zero on these; tests pin the allowlist empty anyway)."""
+    allow = ALLOWLIST if allowlist is None else allowlist
+    live = {(f.rule, f.location) for f in findings}
+    return [
+        f"{rule}: {loc}"
+        for rule, locs in sorted(allow.items())
+        for loc in locs
+        if (rule, loc) not in live
+    ]
+
+
 def all_analyzers() -> "dict[str, object]":
     """name -> analyzer callable, in rule-id order. Imported lazily so
     `core` stays import-cycle-free for the analyzer modules."""
-    from . import env_registry, jit_purity, lock_order, metrics_registry, span_balance
+    from . import (
+        env_registry,
+        guarded_state,
+        jaxpr_audit,
+        jit_purity,
+        lock_order,
+        metrics_registry,
+        span_balance,
+    )
 
     return {
         "env-registry": env_registry.run,
@@ -189,6 +216,8 @@ def all_analyzers() -> "dict[str, object]":
         "jit-purity": jit_purity.run,
         "lock-order": lock_order.run,
         "span-balance": span_balance.run,
+        "guarded-state": guarded_state.run,
+        "jaxpr-audit": jaxpr_audit.run,
     }
 
 
@@ -197,10 +226,12 @@ def run_all(
     repo: "RepoContext | None" = None,
     *,
     only: "list[str] | None" = None,
+    allowlist: "dict[str, tuple[str, ...]] | None" = None,
 ) -> "list[Finding]":
     """Run every analyzer (or the `only` subset) over `tree` (default:
-    the live package source), allowlist applied, findings ordered by
-    location then rule."""
+    the live package source), allowlist applied (pass ``allowlist={}``
+    for the raw findings — the stale-waiver check needs them), findings
+    ordered by location then rule."""
     tree = SourceTree.load() if tree is None else tree
     repo = RepoContext.discover() if repo is None else repo
     findings: list[Finding] = []
@@ -209,5 +240,6 @@ def run_all(
             continue
         findings.extend(analyzer(tree, repo))
     return sorted(
-        apply_allowlist(findings), key=lambda f: (f.path, f.line, f.rule)
+        apply_allowlist(findings, allowlist),
+        key=lambda f: (f.path, f.line, f.rule),
     )

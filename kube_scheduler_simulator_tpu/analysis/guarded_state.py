@@ -1,0 +1,497 @@
+"""guarded-state analyzer (KSS601-602): the lock→attribute protection map.
+
+The lock-order analyzer (KSS4xx) and the runtime witness can see locks
+being acquired in the wrong ORDER — they cannot see shared state being
+touched with no lock at all, which is the race class Go's detector
+catches for the reference simulator. This analyzer infers, per class,
+which attributes each ``locking.make_lock(role)`` lock protects, then
+flags accesses that escape the protection:
+
+  * **claim inference** — an instance attribute (one ``__init__``
+    assigns) that is WRITTEN inside a region guarded by lock role R in
+    at least one non-``__init__`` method is *claimed* by R. Guarded
+    regions are lexical ``with self._lock:`` bodies, whole methods that
+    call ``self._lock.acquire()`` (the begin_pass shape), methods whose
+    every same-class call site is itself guarded (a fixpoint — the
+    ``_store_locked`` shape, any depth), and ``threading.Condition(
+    self._lock)`` aliases. Writes are plain/augmented assignment,
+    subscript stores/deletes, and calls of known mutating methods
+    (``.append``/``.pop``/``.add``/...) on the attribute.
+  * **checks** — every ``self.X`` access of a claimed attribute in a
+    non-``__init__`` method whose guard set misses every claiming role
+    is a finding: KSS601 for writes, KSS602 for reads.
+
+The analysis is deliberately lenient where it cannot see: claims take
+the UNION of roles held at write sites (an attribute written under two
+locks is safe under either); cross-class call sites do not weaken the
+locked-context fixpoint (``resolve()`` calling back into the service is
+the runtime witness's job); nested functions/lambdas (closures run on
+other threads or under caller-held locks) are exempt from checks; and
+module-level locks guarding module globals are out of scope. The
+runtime half — ``KSS_RACE_CHECK=1`` (utils/locking.py) — wraps the SAME
+inferred map in sampling descriptors that raise ``UnguardedAccess``
+when a claimed attribute is touched while no claiming lock is held,
+covering exactly the paths this static view exempts.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from .core import Finding, RepoContext, SourceFile, SourceTree
+
+_WITNESS_FACTORIES = ("make_lock", "make_rlock")
+
+# method names treated as construction: attribute writes there install
+# state before the object is published to other threads
+_CONSTRUCTION = ("__init__", "__post_init__", "__new__")
+
+# calls of these methods on a claimed attribute mutate it in place
+MUTATORS = frozenset(
+    {
+        "append", "extend", "insert", "remove", "sort", "reverse",
+        "pop", "popitem", "clear", "update", "setdefault",
+        "add", "discard",
+        "appendleft", "popleft", "put",
+    }
+)
+
+
+@dataclass
+class ClassMap:
+    """One class's inferred protection map."""
+
+    rel: str
+    name: str
+    # lock attribute -> role string ("" when the role is not a literal)
+    lock_attrs: "dict[str, str]" = field(default_factory=dict)
+    # instance attribute -> set of claiming roles
+    claims: "dict[str, set[str]]" = field(default_factory=dict)
+
+    def lock_attrs_for_role(self, role: str) -> "tuple[str, ...]":
+        return tuple(
+            sorted(a for a, r in self.lock_attrs.items() if r == role)
+        )
+
+
+@dataclass(frozen=True)
+class _Access:
+    attr: str
+    lineno: int
+    write: bool
+    guards: "frozenset[str]"
+    method: str
+
+
+def _witness_role(expr: ast.expr) -> "str | None":
+    """The role literal of a ``locking.make_lock("role")`` /
+    ``make_rlock("role")`` call expression, or None."""
+    if not isinstance(expr, ast.Call):
+        return None
+    fn = expr.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else ""
+    )
+    if name in _WITNESS_FACTORIES:
+        if expr.args and isinstance(expr.args[0], ast.Constant) and isinstance(
+            expr.args[0].value, str
+        ):
+            return expr.args[0].value
+        return ""
+    if name == "field":
+        for kw in expr.keywords:
+            if kw.arg == "default_factory" and isinstance(kw.value, ast.Lambda):
+                return _witness_role(kw.value.body)
+    return None
+
+
+def _self_attr(expr: ast.expr) -> "str | None":
+    """X for a ``self.X`` attribute expression, else None."""
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+    ):
+        return expr.attr
+    return None
+
+
+def _condition_alias(expr: ast.expr) -> "str | None":
+    """The wrapped lock attr of ``threading.Condition(self.X)`` (a
+    Condition shares its lock's guard), or None."""
+    if not isinstance(expr, ast.Call) or not expr.args:
+        return None
+    fn = expr.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else ""
+    )
+    if name != "Condition":
+        return None
+    return _self_attr(expr.args[0])
+
+
+def _class_methods(cls: ast.ClassDef) -> "list[ast.FunctionDef]":
+    return [
+        n
+        for n in cls.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+
+
+def _lock_attrs_of(cls: ast.ClassDef) -> "dict[str, str]":
+    """lock/Condition-alias attribute -> witness role, for one class."""
+    out: "dict[str, str]" = {}
+    aliases: "list[tuple[str, str]]" = []  # (alias attr, wrapped attr)
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            attr = _self_attr(node.targets[0])
+            if attr is None:
+                continue
+            role = _witness_role(node.value)
+            if role is not None:
+                out[attr] = role
+                continue
+            wrapped = _condition_alias(node.value)
+            if wrapped is not None:
+                aliases.append((attr, wrapped))
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            # dataclass field: `_lock: ... = field(default_factory=...)`
+            if isinstance(node.target, ast.Name):
+                role = _witness_role(node.value)
+                if role is not None:
+                    out[node.target.id] = role
+    for alias, wrapped in aliases:
+        if wrapped in out:
+            out[alias] = out[wrapped]
+    return out
+
+
+def _instance_attrs(cls: ast.ClassDef) -> "set[str]":
+    """Attributes the class itself installs: ``self.X = ...`` inside a
+    construction method, or a class-level (ann)assignment. Only these
+    are claimable — attributes stuck onto FOREIGN objects are not this
+    class's state."""
+    out: "set[str]" = set()
+    for stmt in cls.body:
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+        elif isinstance(stmt, ast.AnnAssign) and isinstance(
+            stmt.target, ast.Name
+        ):
+            out.add(stmt.target.id)
+    for m in _class_methods(cls):
+        if m.name not in _CONSTRUCTION:
+            continue
+        for node in ast.walk(m):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+                    for elt in elts:
+                        attr = _self_attr(elt)
+                        if attr is not None:
+                            out.add(attr)
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                attr = _self_attr(node.target)
+                if attr is not None:
+                    out.add(attr)
+    return out
+
+
+class _MethodScan:
+    """One method's accesses + guard tracking (lexical ``with`` regions
+    over the ambient guard), plus its same-class call sites."""
+
+    def __init__(
+        self,
+        method: ast.FunctionDef,
+        lock_attrs: "dict[str, str]",
+        ambient: "frozenset[str]",
+    ) -> None:
+        self.method = method
+        self.lock_attrs = lock_attrs
+        self.ambient = ambient
+        self.accesses: "list[_Access]" = []
+        # callee method name -> guard sets observed at its call sites
+        self.calls: "list[tuple[str, frozenset[str]]]" = []
+
+    def scan(self) -> None:
+        for stmt in self.method.body:
+            self._visit(stmt, self.ambient)
+
+    # -- visitors ------------------------------------------------------------
+
+    def _role_of(self, expr: ast.expr) -> "str | None":
+        attr = _self_attr(expr)
+        if attr is not None and attr in self.lock_attrs:
+            return self.lock_attrs[attr]
+        return None
+
+    def _note(self, attr: str, lineno: int, write: bool, guards) -> None:
+        if attr in self.lock_attrs:
+            return
+        self.accesses.append(
+            _Access(attr, lineno, write, frozenset(guards), self.method.name)
+        )
+
+    def _visit(self, node: ast.AST, guards: "frozenset[str]") -> None:
+        if isinstance(node, ast.With):
+            held = set(guards)
+            for item in node.items:
+                role = self._role_of(item.context_expr)
+                if role is None:
+                    self._visit(item.context_expr, frozenset(held))
+                else:
+                    held.add(role)
+                if item.optional_vars is not None:
+                    self._visit(item.optional_vars, frozenset(held))
+            for child in node.body:
+                self._visit(child, frozenset(held))
+            return
+        if isinstance(node, ast.Lambda):
+            # a lambda body's ACCESSES are exempt like any closure, but
+            # its same-class calls still count as call sites under the
+            # definition-site guards: the `_supervised_dispatch(lambda:
+            # self._dispatch_once(...))` shape invokes the lambda
+            # immediately on the calling thread, and dropping the edge
+            # would sever the locked-context chain for everything the
+            # dispatch methods touch
+            for inner in ast.walk(node.body):
+                if (
+                    isinstance(inner, ast.Call)
+                    and isinstance(inner.func, ast.Attribute)
+                    and isinstance(inner.func.value, ast.Name)
+                    and inner.func.value.id == "self"
+                ):
+                    self.calls.append((inner.func.attr, guards))
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested definition runs later — on another thread, or
+            # under whatever locks its eventual caller holds. Exempt
+            # from the static view; the runtime witness covers it.
+            return
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                self._visit_target(t, guards)
+            self._visit(node.value, guards)
+            return
+        if isinstance(node, ast.AugAssign):
+            self._visit_target(node.target, guards)
+            self._visit(node.value, guards)
+            return
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                self._visit_target(t, guards)
+            return
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute):
+                owner = _self_attr(fn.value)
+                if owner is not None and fn.attr in MUTATORS:
+                    # one write, not write-plus-read: the arguments are
+                    # still visited, the receiver expression is consumed
+                    self._note(owner, node.lineno, True, guards)
+                    for arg in node.args:
+                        self._visit(arg, guards)
+                    for kw in node.keywords:
+                        self._visit(kw.value, guards)
+                    return
+                elif (
+                    owner is not None
+                    and owner not in self.lock_attrs
+                    and fn.attr not in ("acquire", "release")
+                ):
+                    # a same-class method call (call-graph edge) or a
+                    # non-mutating method on the attribute (a read)
+                    pass
+                if isinstance(fn.value, ast.Name) and fn.value.id == "self":
+                    # a METHOD call on self is a call-graph edge even
+                    # when the method is named like a container mutator
+                    # (`self.put(...)` is a call to T.put, not a
+                    # mutation of an attribute) — the mutator branch
+                    # above only handles `self.X.put(...)` receivers
+                    self.calls.append((fn.attr, guards))
+                    for arg in node.args:
+                        self._visit(arg, guards)
+                    for kw in node.keywords:
+                        self._visit(kw.value, guards)
+                    return
+            self._visit(fn, guards)
+            for arg in node.args:
+                self._visit(arg, guards)
+            for kw in node.keywords:
+                self._visit(kw.value, guards)
+            return
+        if isinstance(node, ast.Attribute):
+            attr = _self_attr(node)
+            if attr is not None:
+                self._note(attr, node.lineno, False, guards)
+                return
+            self._visit(node.value, guards)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, guards)
+
+    def _visit_target(self, target: ast.expr, guards: "frozenset[str]") -> None:
+        """An assignment/delete target: ``self.X`` and ``self.X[k]`` are
+        writes of X; tuple targets recurse; anything else is visited as
+        an ordinary expression (its reads still count)."""
+        if isinstance(target, ast.Tuple):
+            for elt in target.elts:
+                self._visit_target(elt, guards)
+            return
+        attr = _self_attr(target)
+        if attr is not None:
+            self._note(attr, target.lineno, True, guards)
+            return
+        if isinstance(target, ast.Attribute):
+            # `self.X.y = v` mutates the object self.X points AT, not
+            # the binding: a READ of X here — the pointee's own class
+            # owns the discipline for its attributes
+            owner = _self_attr(target.value)
+            if owner is not None:
+                self._note(owner, target.lineno, False, guards)
+                return
+        if isinstance(target, ast.Subscript):
+            owner = _self_attr(target.value)
+            if owner is not None:
+                self._note(owner, target.lineno, True, guards)
+                self._visit(target.slice, guards)
+                return
+        self._visit(target, guards)
+
+
+def _acquire_roles(
+    method: ast.FunctionDef, lock_attrs: "dict[str, str]"
+) -> "frozenset[str]":
+    """Roles of locks a method explicitly ``.acquire()``s anywhere in
+    its body — the whole method is (leniently) treated as guarded by
+    them: the begin_pass acquire-then-try shape releases only on error
+    paths, and flow-sensitive tracking would buy noise, not safety."""
+    out: "set[str]" = set()
+    for node in ast.walk(method):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "acquire"
+        ):
+            attr = _self_attr(node.func.value)
+            if attr is not None and attr in lock_attrs:
+                out.add(lock_attrs[attr])
+    return frozenset(out)
+
+
+def _class_map(rel: str, cls: ast.ClassDef) -> "tuple[ClassMap, list[_Access]]":
+    """Infer one class's protection map and return it with every
+    non-construction access (guards resolved through the locked-context
+    fixpoint) for the checking pass."""
+    lock_attrs = _lock_attrs_of(cls)
+    cmap = ClassMap(rel, cls.name, lock_attrs)
+    if not lock_attrs:
+        return cmap, []
+    methods = [
+        m for m in _class_methods(cls) if m.name not in _CONSTRUCTION
+    ]
+    instance_attrs = _instance_attrs(cls)
+    acquire_ambient = {
+        m.name: _acquire_roles(m, lock_attrs) for m in methods
+    }
+    # locked-context fixpoint: ambient(m) = acquire roles ∪ the
+    # intersection of guards over every same-class call site of m.
+    # Methods with no in-class call sites are entry points (ambient =
+    # acquire roles only); cross-class call sites are invisible and do
+    # not weaken the intersection (lenient — the runtime witness covers
+    # them).
+    all_roles = frozenset(lock_attrs.values())
+    ambient: "dict[str, frozenset[str]]" = {
+        m.name: acquire_ambient[m.name] | all_roles for m in methods
+    }
+    names = {m.name for m in methods}
+    for _ in range(len(methods) + 1):
+        # rescan with current ambients; recompute call-site guards
+        scans = {}
+        for m in methods:
+            s = _MethodScan(m, lock_attrs, ambient[m.name])
+            s.scan()
+            scans[m.name] = s
+        site_guards: "dict[str, list[frozenset[str]]]" = {}
+        for s in scans.values():
+            for callee, guards in s.calls:
+                if callee in names:
+                    site_guards.setdefault(callee, []).append(guards)
+        new_ambient: "dict[str, frozenset[str]]" = {}
+        for m in methods:
+            sites = site_guards.get(m.name)
+            if sites:
+                inter = frozenset.intersection(*sites)
+            else:
+                inter = frozenset()
+            new_ambient[m.name] = acquire_ambient[m.name] | inter
+        if new_ambient == ambient:
+            break
+        ambient = new_ambient
+    # final scan under the converged ambients
+    accesses: "list[_Access]" = []
+    for m in methods:
+        s = _MethodScan(m, lock_attrs, ambient[m.name])
+        s.scan()
+        accesses.extend(s.accesses)
+    for acc in accesses:
+        if acc.write and acc.guards and acc.attr in instance_attrs:
+            cmap.claims.setdefault(acc.attr, set()).update(acc.guards)
+    return cmap, accesses
+
+
+def infer_tree(
+    tree: SourceTree,
+) -> "list[tuple[SourceFile, ClassMap, list[_Access]]]":
+    out: "list[tuple[SourceFile, ClassMap, list[_Access]]]" = []
+    for sf in tree.files:
+        for node in sf.tree.body:
+            if isinstance(node, ast.ClassDef):
+                cmap, accesses = _class_map(sf.rel, node)
+                if cmap.lock_attrs:
+                    out.append((sf, cmap, accesses))
+    return out
+
+
+def protection_map(
+    tree: SourceTree,
+) -> "dict[tuple[str, str], ClassMap]":
+    """(module rel, class name) -> inferred ClassMap — the shared
+    artifact: the static checks below consume it, and the runtime
+    witness (utils/locking.guard_inferred, KSS_RACE_CHECK=1) installs
+    its sampling descriptors from the very same inference."""
+    return {
+        (cmap.rel, cmap.name): cmap for _, cmap, _ in infer_tree(tree)
+    }
+
+
+def run(tree: SourceTree, repo: RepoContext) -> "list[Finding]":
+    findings: "list[Finding]" = []
+    for sf, cmap, accesses in infer_tree(tree):
+        for acc in accesses:
+            roles = cmap.claims.get(acc.attr)
+            if not roles or acc.guards & roles:
+                continue
+            rule = "KSS601" if acc.write else "KSS602"
+            what = "write" if acc.write else "read"
+            owners = ", ".join(sorted(roles))
+            findings.append(
+                Finding(
+                    rule,
+                    sf.rel,
+                    acc.lineno,
+                    f"unguarded {what} of {cmap.name}.{acc.attr} in "
+                    f"{acc.method}(): the attribute is claimed by lock "
+                    f"role(s) {owners} (written under them elsewhere) "
+                    f"but no claiming lock is held here",
+                    hint=f"wrap the access in `with self."
+                    f"{'/self.'.join(cmap.lock_attrs_for_role(sorted(roles)[0]) or ('<lock>',))}:`"
+                    f" or move it into a locked-context method; verify "
+                    f"at runtime with KSS_RACE_CHECK=1",
+                )
+            )
+    return findings

@@ -167,10 +167,27 @@ def _scatter_fns(eager: bool = False):
     # carries the eager flag so un-jitted scatters built inside an
     # eager_execution() fallback never stick for jitted passes.
     kw = {"donate_argnums": (0,)} if jax.default_backend() != "cpu" else {}
+    # audit (KSS7xx): the scatters' trailing dims are the target field's
+    # vocab axes ("trailing" exemption — axis 0 of arr/idx stays bucket-
+    # checked); the vector add targets per-claim vectors whose length IS
+    # a vocab axis, so its bucket check is waived entirely. The donation
+    # rule (KSS714) covers the accelerator path's donate_argnums.
     return (
-        broker_mod.jit(lambda arr, idx, rows: arr.at[idx].set(rows), **kw),
-        broker_mod.jit(lambda arr, idx, rows: arr.at[idx].add(rows), **kw),
-        broker_mod.jit(lambda arr, vec: arr + vec, **kw),
+        broker_mod.jit(
+            lambda arr, idx, rows: arr.at[idx].set(rows),
+            audit={"label": "delta.scatter_set", "exempt": "trailing"},
+            **kw,
+        ),
+        broker_mod.jit(
+            lambda arr, idx, rows: arr.at[idx].add(rows),
+            audit={"label": "delta.scatter_add", "exempt": "trailing"},
+            **kw,
+        ),
+        broker_mod.jit(
+            lambda arr, vec: arr + vec,
+            audit={"label": "delta.vec_add", "exempt": "all"},
+            **kw,
+        ),
     )
 
 

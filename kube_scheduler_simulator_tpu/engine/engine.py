@@ -228,15 +228,23 @@ class BatchedScheduler:
         # vmap over weight variants (Monte-Carlo), and for mesh-sharded jit.
         self.run_fn = self._build_run()
         # jits route through the broker module: the persistent compile
-        # cache is armed before the first lowering (utils/broker.py)
-        self._run = broker_mod.jit(self.run_fn)
-        self._run_segment = broker_mod.jit(self._run_segment_fn)
+        # cache is armed before the first lowering (utils/broker.py).
+        # The audit specs scope the KSS7xx jaxpr auditor: the encoding
+        # derives the bucket exemptions (vocab axes) + the f64 waiver
+        # (EXACT policy); the plugin-count axes are static by config.
+        aud = self.audit_spec()
+        self._run = broker_mod.jit(self.run_fn, audit={**aud, "label": "seq.run"})
+        self._run_segment = broker_mod.jit(
+            self._run_segment_fn, audit={**aud, "label": "seq.segment"}
+        )
         # single-pod segments for host-callback (extender) scheduling
         self.attempt_fn = broker_mod.jit(
-            lambda arrays, state, weights, p: self._attempt(state, arrays, weights, p)
+            lambda arrays, state, weights, p: self._attempt(state, arrays, weights, p),
+            audit={**aud, "label": "seq.attempt"},
         )
         self.bind_fn = broker_mod.jit(
-            lambda arrays, state, p, sel, qi: self._bind(state, arrays, p, sel, qi)
+            lambda arrays, state, p, sel, qi: self._bind(state, arrays, p, sel, qi),
+            audit={**aud, "label": "seq.bind"},
         )
         self._trace = None
         self._final_state = None
@@ -244,6 +252,21 @@ class BatchedScheduler:
     @property
     def _score_specs_names(self) -> list[str]:
         return [n for n, _ in self._score_specs]
+
+    def audit_spec(self) -> dict:
+        """Base KSS7xx audit options for this engine's jit sites (the
+        `label` is added per site): the encoding scopes the bucket check
+        and the EXACT-policy f64 waiver; the plugin-count axes (trace
+        tensors stack one slot per enabled kernel) are static under
+        churn, so they join the exemptions explicitly."""
+        return {
+            "enc": self.enc,
+            "extra_dims": (
+                len(self._score_specs),
+                len(self._filter_names),
+                len(self._prefilter_kernel_names),
+            ),
+        }
 
     # -- compile reuse ------------------------------------------------------
 

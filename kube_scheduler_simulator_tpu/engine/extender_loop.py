@@ -65,13 +65,16 @@ class ExtenderScheduler:
         # kernel and the batched eviction, jitted once like
         # attempt_fn/bind_fn
         if self.sched._preempt is not None:
+            aud = self.sched.audit_spec()
             self.preempt_fn = broker_mod.jit(
-                lambda arrays, state, p: self.sched._preempt(arrays, state, p)
+                lambda arrays, state, p: self.sched._preempt(arrays, state, p),
+                audit={**aud, "label": "ext.preempt"},
             )
             self.evict_fn = broker_mod.jit(
                 lambda arrays, state, mask: self.sched._evict_all(
                     state, arrays, mask
-                )
+                ),
+                audit={**aud, "label": "ext.evict"},
             )
         else:
             self.preempt_fn = None

@@ -403,9 +403,15 @@ class GangScheduler:
         self.weights = self._base.weights
         self.max_rounds = max_rounds
         self.run_fn = self._build_run()
-        self._run = broker_mod.jit(self.run_fn)
+        aud = self.audit_spec()
+        self._run = broker_mod.jit(
+            self.run_fn, audit={**aud, "label": "gang.run"}
+        )
         self._preempt_phase = (
-            broker_mod.jit(self.preempt_phase_fn)
+            broker_mod.jit(
+                self.preempt_phase_fn,
+                audit={**aud, "label": "gang.preempt_phase"},
+            )
             if self.preempt_phase_fn is not None
             else None
         )
@@ -419,6 +425,19 @@ class GangScheduler:
         self._chronology = None
         self._trace = None
         self._recorded_weights = None
+
+    def audit_spec(self) -> dict:
+        """Base KSS7xx audit options for the gang jit sites: the
+        sequential base engine's spec plus the gang-only static dims
+        (evaluation chunk, the chunk-rounded eval window, the static
+        round budget — fixed per engine build, never churn-driven)."""
+        aud = self._base.audit_spec()
+        extra = tuple(aud["extra_dims"]) + tuple(
+            int(d)
+            for d in (self.chunk, self._wp, self.static_rounds)
+            if d
+        )
+        return {**aud, "extra_dims": extra}
 
     # -- host-side queue encoding ------------------------------------------
 
@@ -1226,7 +1245,10 @@ class GangScheduler:
         arrays = self.enc.arrays
         tracked = chronology is not None
         if tracked and self._run_tracked is None:
-            self._run_tracked = broker_mod.jit(self.run_tracked_fn)
+            self._run_tracked = broker_mod.jit(
+                self.run_tracked_fn,
+                audit={**self.audit_spec(), "label": "gang.run_tracked"},
+            )
         # the eligibility mask feeds host-side pending counts, which only
         # the static auto-resume, the preempt-phase loop, and the record
         # path read — the plain dynamic path must not pay the two [P]
@@ -1401,7 +1423,8 @@ class GangScheduler:
             # chunks are padded by repeating the first pod (evaluation
             # is read-only, duplicates are discarded host-side)
             self._eval_rec = broker_mod.jit(
-                jax.vmap(rec._attempt, in_axes=(None, None, None, 0))
+                jax.vmap(rec._attempt, in_axes=(None, None, None, 0)),
+                audit={**self.audit_spec(), "label": "gang.eval_record"},
             )
         CH = max(1, min(128, P))
 
@@ -1427,7 +1450,10 @@ class GangScheduler:
                             final_sel[qi] = committed
 
         state = enc.state0
-        bind_all_j = broker_mod.jit(self._bind_all)
+        bind_all_j = broker_mod.jit(
+            self._bind_all,
+            audit={**self.audit_spec(), "label": "gang.bind_all"},
+        )
         for entry in self._chronology:
             kind = entry[0]
             if kind == "rounds":

@@ -255,6 +255,24 @@ def main(argv: "list[str] | None" = None) -> int:
                 "message": "post-run cluster has no nodes or no pods",
             }
 
+    from ..utils.broker import jaxpr_audit_enabled
+
+    if jaxpr_audit_enabled():
+        # KSS7xx (docs/static-analysis.md): persist this run's compile
+        # fingerprints next to the compile cache and surface the audit
+        # verdict in the headline — drift against the previous baseline
+        # and any program-contract finding turn the run's summary red
+        # without failing the run (the tier-1 gate asserts on them)
+        from ..analysis.jaxpr_audit import AUDITOR
+
+        drift = AUDITOR.persist()
+        audit_findings = AUDITOR.findings()
+        result["jaxprAudit"] = {
+            "programs": len(AUDITOR.records),
+            "findings": [f.render() for f in audit_findings],
+            "fingerprintDrift": [f.message for f in drift],
+        }
+
     json.dump(result, sys.stdout, indent=2, sort_keys=True)
     print()
     phase = result.get("phase")

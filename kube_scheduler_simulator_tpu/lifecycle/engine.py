@@ -480,7 +480,7 @@ class LifecycleEngine:
         # deterministic fields only, and the encode path is an
         # implementation detail of the serving stack, not the timeline
         timing = {"t": t, "wallSeconds": round(wall, 6)}
-        info = self.scheduler.last_encode_info
+        info = self.scheduler.encode_info()
         if info:
             timing["encodeMode"] = info["mode"]
         self.timings.append(timing)

@@ -98,10 +98,15 @@ class FaultSweep:
             )
 
         # broker_mod.jit, not jax.jit: every engine compile goes through
-        # the broker's cache arming (analyzer KSS301)
-        self._one = broker_mod.jit(one_scenario)
+        # the broker's cache arming (analyzer KSS301). The scenario axis
+        # is caller-chosen, so the KSS713 bucket check is waived.
+        aud = {"enc": self.gang.enc, "exempt": "all"}
+        self._one = broker_mod.jit(
+            one_scenario, audit={**aud, "label": "faultsweep.one"}
+        )
         self._vrun = broker_mod.jit(
-            jax.vmap(one_scenario, in_axes=(None, None, None, None, 0))
+            jax.vmap(one_scenario, in_axes=(None, None, None, None, 0)),
+            audit={**aud, "label": "faultsweep.vrun"},
         )
 
     # -- construction helpers ----------------------------------------------
@@ -159,7 +164,10 @@ class FaultSweep:
                     )
                 sel[p_idx] = node_idx[node_name]
                 mask[p_idx] = True
-        bind = broker_mod.jit(self.gang._bind_all)
+        bind = broker_mod.jit(
+            self.gang._bind_all,
+            audit={**self.gang.audit_spec(), "label": "faultsweep.bind_all"},
+        )
         return bind(
             enc.state0, enc.arrays, jnp.asarray(mask), jnp.asarray(sel),
             self._order,

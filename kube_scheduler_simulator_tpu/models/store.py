@@ -54,6 +54,7 @@ class WatchEvent:
     resource_version: int
 
 
+@locking.guard_inferred
 class ResourceStore:
     """Typed collections with list/watch semantics."""
 

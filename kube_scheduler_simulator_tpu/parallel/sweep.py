@@ -103,20 +103,29 @@ class WeightSweep:
         self.sched = BatchedScheduler(
             enc, record=record, strict=True, preempt_mode="masked"
         )
+        # audit note: the sweep's variant axis is caller-chosen (not
+        # churn-driven), so the bucket check is waived ("all"); the
+        # universal rules (callbacks/f64/donation) still apply, and the
+        # encoding keeps the EXACT-policy f64 waiver accurate
+        aud = {"enc": enc, "exempt": "all"}
         self._vrun = broker_mod.jit(
-            jax.vmap(self.sched.run_fn, in_axes=(None, None, None, 0))
+            jax.vmap(self.sched.run_fn, in_axes=(None, None, None, 0)),
+            audit={**aud, "label": "sweep.vrun"},
         )
         if preempt == "phase":
             until, pre_one = self._build_event_programs()
             # first pass: shared state0/resume; resumes carry [V] state
             self._vuntil0 = broker_mod.jit(
-                jax.vmap(until, in_axes=(None, None, None, 0, None))
+                jax.vmap(until, in_axes=(None, None, None, 0, None)),
+                audit={**aud, "label": "sweep.until0"},
             )
             self._vuntil = broker_mod.jit(
-                jax.vmap(until, in_axes=(None, 0, None, 0, 0))
+                jax.vmap(until, in_axes=(None, 0, None, 0, 0)),
+                audit={**aud, "label": "sweep.until"},
             )
             self._vpreempt1 = broker_mod.jit(
-                jax.vmap(pre_one, in_axes=(None, 0, 0, 0, 0, 0))
+                jax.vmap(pre_one, in_axes=(None, 0, 0, 0, 0, 0)),
+                audit={**aud, "label": "sweep.preempt1"},
             )
         if mesh is not None:
             self._args = shard_encoded(enc, mesh)
@@ -310,18 +319,24 @@ class GangSweep:
             enc, chunk=chunk, compact=False, loop=loop,
             eval_window=eval_window,
         )
+        # variant axis is caller-chosen: bucket check waived (see
+        # WeightSweep) — callbacks/f64/donation rules still apply
+        aud = {"enc": enc, "exempt": "all"}
         self._vrun = broker_mod.jit(
-            jax.vmap(self.gang.run_fn, in_axes=(None, None, None, 0))
+            jax.vmap(self.gang.run_fn, in_axes=(None, None, None, 0)),
+            audit={**aud, "label": "gangsweep.vrun"},
         )
         # resume + phase programs carry per-variant state ([V, ...])
         self._vrun_resume = broker_mod.jit(
-            jax.vmap(self.gang.run_fn, in_axes=(None, 0, None, 0))
+            jax.vmap(self.gang.run_fn, in_axes=(None, 0, None, 0)),
+            audit={**aud, "label": "gangsweep.vrun_resume"},
         )
         self._vphase = (
             broker_mod.jit(
                 jax.vmap(
                     self.gang.preempt_phase_fn, in_axes=(None, 0, 0, None, 0)
-                )
+                ),
+                audit={**aud, "label": "gangsweep.vphase"},
             )
             if self.gang.preempt_phase_fn is not None
             else None

@@ -140,6 +140,7 @@ def _parse_timeout(v) -> float:
     return DEFAULT_TIMEOUT_S
 
 
+@locking.guard_inferred
 class ExtenderService:
     """Extender calls + per-pod result records (reference service.go +
     extender/resultstore)."""

@@ -128,6 +128,7 @@ WATCH_KINDS = {
 }
 
 
+@locking.guard_inferred
 class SimulatorServer:
     """Owns the HTTP server thread over one `SimulatorService`."""
 
@@ -201,7 +202,7 @@ class SimulatorServer:
 
     @property
     def draining(self) -> bool:
-        return self.sessions.draining
+        return self.sessions.is_draining()
 
     def begin_drain(self, deadline_s: "float | None" = None) -> bool:
         """Start the zero-loss drain on a background thread (the route
@@ -209,9 +210,10 @@ class SimulatorServer:
         the drain deadline). False when a drain is already running —
         begin is idempotent, the first caller wins."""
         with self._drain_lock:
-            if self.sessions.draining:
+            # shed + readyz flip NOW — an atomic test-and-set under the
+            # MANAGER's lock (the flag is its claimed state, KSS6xx)
+            if not self.sessions.begin_draining():
                 return False
-            self.sessions.draining = True  # shed + readyz flip NOW
             self._drain_thread = threading.Thread(
                 target=self._drain_run,
                 args=(deadline_s,),
@@ -921,7 +923,10 @@ def _make_handler(server: SimulatorServer):
             return self._error(405, "method not allowed")
 
         def _extender(self, method: str, rest: list[str], svc):
-            ext = server.extender_service or svc.scheduler.extender_service
+            ext = (
+                server.extender_service
+                or svc.scheduler.current_extender_service()
+            )
             if method != "POST" or len(rest) != 2:
                 return self._error(404, "bad extender path")
             verb, id_str = rest
@@ -998,7 +1003,7 @@ def _make_handler(server: SimulatorServer):
                 # the server-wide drain view
                 doc["deviceRung"] = svc.scheduler.device_rung
                 doc["draining"] = server.draining
-                doc["drainedSessions"] = server.sessions.drained
+                doc["drainedSessions"] = server.sessions.drained_sessions()
             if fmt == "prometheus":
                 def entry(session_id, snapshot, cache_cap):
                     return (

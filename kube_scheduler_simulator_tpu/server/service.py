@@ -138,6 +138,7 @@ class SchedulingPassHandle:
             self._service._schedule_lock.release()
 
 
+@locking.guard_inferred
 class SchedulerService:
     """Scheduler lifecycle + batched scheduling passes."""
 
@@ -234,9 +235,12 @@ class SchedulerService:
 
     def _next_pass_id(self) -> int:
         """The next causal pass id — call only with `_schedule_lock`
-        held (passes are serialized, so a plain increment is exact)."""
-        self._pass_seq += 1
-        return self._pass_seq
+        held (passes are serialized, so the increment is exact; the
+        state lock makes the counter safe for out-of-pass readers like
+        `next_pass_id_hint` — guarded-state contract KSS6xx)."""
+        with self._lock:
+            self._pass_seq += 1
+            return self._pass_seq
 
     def next_pass_id_hint(self) -> int:
         """The pass id the NEXT pass will carry — exact only while the
@@ -244,7 +248,38 @@ class SchedulerService:
         is: it owns its service and runs single-threaded). Used to stamp
         host-side work that FEEDS the next pass (event application under
         the async pipeline) with that pass's causal id."""
-        return self._pass_seq + 1
+        with self._lock:
+            return self._pass_seq + 1
+
+    def pass_seq(self) -> int:
+        """The completed-pass counter, read under the state lock — the
+        session checkpoint writer's accessor (the counter is
+        lock-claimed state, KSS6xx: the KSS_RACE_CHECK witness caught
+        the bare cross-class read on the live snapshot path)."""
+        with self._lock:
+            return self._pass_seq
+
+    def restore_pass_seq(self, n: int) -> None:
+        """Restore the pass counter from a session checkpoint (the
+        restored service has no pass in flight; the state lock makes
+        the publication safe for concurrent hint readers)."""
+        with self._lock:
+            self._pass_seq = int(n)
+
+    def encode_info(self) -> "dict | None":
+        """The last pass's encode-path outcome, read under the state
+        lock (the lifecycle engine stamps per-pass encodeMode from it
+        AFTER the pass released the schedule lock — a bare read there is
+        exactly what the KSS_RACE_CHECK witness flags)."""
+        with self._lock:
+            return self.last_encode_info
+
+    def current_extender_service(self) -> ExtenderService:
+        """The live extender service, read under the state lock
+        (restart() swaps it there) — the HTTP extender proxy's
+        accessor."""
+        with self._lock:
+            return self.extender_service
 
     def _session_scope(self) -> ExitStack:
         """The per-pass bulkhead contexts (docs/sessions.md): spans
@@ -292,12 +327,15 @@ class SchedulerService:
 
     @property
     def config(self) -> SchedulerConfiguration:
-        return self._config
+        with self._lock:
+            return self._config
 
     def get_config(self) -> dict:
         if self.disabled:
             raise SchedulerServiceDisabled()
-        return self._config.to_dict()
+        with self._lock:
+            config = self._config
+        return config.to_dict()
 
     def restart(self, new_config: "dict | SchedulerConfiguration") -> None:
         """Swap in a new configuration; on an unusable one, keep the old
@@ -455,8 +493,12 @@ class SchedulerService:
     def device_rung(self) -> str:
         """The execution ladder rung this service dispatches on
         (``device`` / ``shrunk`` / ``cpu``) — surfaced by
-        GET /api/v1/metrics as ``deviceRung``."""
-        return self._device_rung
+        GET /api/v1/metrics as ``deviceRung``. Read under the state
+        lock: rung transitions publish under it (`_try_shrink`,
+        `_engage_cpu_failover`), and the metrics scrape must not block
+        on a whole pass to observe them."""
+        with self._lock:
+            return self._device_rung
 
     def _epoch_sig(self, sig: tuple) -> tuple:
         """Append the device epoch to a broker key once any escalation
@@ -519,9 +561,12 @@ class SchedulerService:
             mesh = surviving_mesh(self._lost_devices, devices=all_devices)
         except ValueError:
             return False
-        self._dispatch_device = survivors[0]
-        self._device_rung = "shrunk"
-        self._device_epoch += 1
+        # rung state publishes under the state lock so out-of-pass
+        # readers (the deviceRung scrape) see it without the pass lock
+        with self._lock:
+            self._dispatch_device = survivors[0]
+            self._device_rung = "shrunk"
+            self._device_epoch += 1
         self._invalidate_encodings()
         self.metrics.record_resilience(mesh_shrinks=1)
         telemetry.instant(
@@ -544,9 +589,10 @@ class SchedulerService:
                 f"device ladder exhausted ({err}) and no CPU backend is "
                 f"available for the failover rung"
             ) from err
-        self._device_rung = "cpu"
-        self._dispatch_device = cpus[0]
-        self._device_epoch += 1
+        with self._lock:
+            self._device_rung = "cpu"
+            self._dispatch_device = cpus[0]
+            self._device_epoch += 1
         self._invalidate_encodings()
         self.metrics.record_resilience(device_failovers=1)
         telemetry.instant("dispatch.cpu_failover", reason=str(err))
@@ -784,7 +830,10 @@ class SchedulerService:
         cache_key = (self.store.latest_rv(),)
         cached = self._enc_cache.get(cache_key, config)
         if cached is not EncodingCache.MISS:
-            self.last_encode_info = {"mode": "cached"}
+            # published under the state lock: out-of-pass readers
+            # (`encode_info`) must not race the write (KSS6xx)
+            with self._lock:
+                self.last_encode_info = {"mode": "cached"}
             self.metrics.record_encode("cached", time.perf_counter() - t0)
             telemetry.complete(
                 "pass.encode", t0, time.perf_counter(), mode="cached"
@@ -792,7 +841,8 @@ class SchedulerService:
             return cached
         enc, info = self._delta.encode(self.store, config)
         self._enc_cache.put(cache_key, config, enc)
-        self.last_encode_info = info
+        with self._lock:
+            self.last_encode_info = info
         self.metrics.record_encode(info["mode"], time.perf_counter() - t0)
         telemetry.complete(
             "pass.encode", t0, time.perf_counter(), mode=info["mode"]
@@ -1074,10 +1124,15 @@ class SchedulerService:
             )
             self._lease_engine(sig)
             holder: dict = {}
+            # one extender-service read per pass, under the state lock
+            # (restart() swaps it there — guarded-state contract KSS6xx):
+            # the whole pass runs against one consistent service
+            with self._lock:
+                ext_service = self.extender_service
 
             def build():
                 t0 = time.perf_counter()
-                es = ExtenderScheduler(enc, self.extender_service)
+                es = ExtenderScheduler(enc, ext_service)
                 holder["built_s"] = time.perf_counter() - t0
                 return es
 
@@ -1091,7 +1146,7 @@ class SchedulerService:
                 if "built_s" in holder:
                     self.metrics.record_engine_build(holder["built_s"])
                 else:
-                    ext_sched.retarget(enc, self.extender_service)
+                    ext_sched.retarget(enc, ext_service)
             t0 = time.perf_counter()
             results = ext_sched.run()
             self.metrics.record_phase_seconds(execute=time.perf_counter() - t0)
@@ -1148,6 +1203,10 @@ class SchedulerService:
         import numpy as np
 
         kind, enc, engine, results = disp
+        # one consistent extender service for the whole finish (swapped
+        # under the state lock by restart() — KSS6xx)
+        with self._lock:
+            ext_service = self.extender_service
         t0 = time.perf_counter()
         if kind == "ext":
             final_assignment = engine.final_state.assignment
@@ -1183,9 +1242,7 @@ class SchedulerService:
         for res in results:
             annotations = res.to_annotations()
             annotations.update(
-                self.extender_service.annotations_for(
-                    res.pod_namespace, res.pod_name
-                )
+                ext_service.annotations_for(res.pod_namespace, res.pod_name)
             )
             patch: dict = {
                 "metadata": {
@@ -1201,13 +1258,14 @@ class SchedulerService:
                 self.store.apply("pods", patch)
             # flushed results are purged, like the reference reflector's
             # DeleteData after AddStoredResultToPod (storereflector.go:70-119)
-            self.extender_service.delete_data(res.pod_namespace, res.pod_name)
+            ext_service.delete_data(res.pod_namespace, res.pod_name)
         self.metrics.record_phase_seconds(
             decode=time.perf_counter() - t_decode
         )
         return results
 
 
+@locking.guard_inferred
 class SimulatorService:
     """Store + scheduler + snapshot composites (the DI container analogue).
 

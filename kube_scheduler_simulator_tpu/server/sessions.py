@@ -172,6 +172,7 @@ class Session:
         return doc
 
 
+@locking.guard_inferred
 class SessionManager:
     """Owns every session, the shared broker, and the admission knobs."""
 
@@ -345,6 +346,33 @@ class SessionManager:
                 )
                 if s.state == "live" and s.service is not None
             ]
+
+    def is_draining(self) -> bool:
+        """The drain flag, read under the manager lock — `draining` is
+        lock-claimed state (KSS6xx): the HTTP layer's shed path and
+        readyz go through here, never through a bare attribute read
+        (the KSS_RACE_CHECK witness caught exactly that on the live
+        serving path)."""
+        with self._lock:
+            return self.draining
+
+    def begin_draining(self) -> bool:
+        """Atomically flip the drain flag; False when a drain was
+        already in progress (the first caller wins — `begin_drain`'s
+        idempotence, now a real test-and-set instead of a two-step
+        read/write on another lock)."""
+        with self._lock:
+            if self.draining:
+                return False
+            self.draining = True
+            return True
+
+    def drained_sessions(self) -> int:
+        """Sessions snapshotted by drains so far, read under the
+        manager lock (the metrics route's accessor — `drained` is
+        lock-claimed state, KSS6xx)."""
+        with self._lock:
+            return self.drained
 
     def stats(self) -> dict:
         with self._lock:
@@ -683,7 +711,7 @@ class SessionManager:
             "store": svc.store.dump_state(),
             "schedulerConfig": cfg,
             "metrics": svc.scheduler.metrics.state_dict(),
-            "passSeq": svc.scheduler._pass_seq,
+            "passSeq": svc.scheduler.pass_seq(),
             "faultInject": sess.fault_spec,
         }
 
@@ -703,7 +731,7 @@ class SessionManager:
         if cfg:
             service.scheduler.restart(cfg)
         service.scheduler.metrics.load_state(doc.get("metrics") or {})
-        service.scheduler._pass_seq = int(doc.get("passSeq", 0))
+        service.scheduler.restore_pass_seq(doc.get("passSeq", 0))
         # reset() now returns to the restored state, not an empty store
         service.store.snapshot_initial()
         return service
@@ -801,7 +829,8 @@ class SessionManager:
         every other snapshot becomes an evicted session that restores
         transparently on first touch. Unreadable files are skipped —
         boot must not die on a stray artifact."""
-        d = self._snapshot_dir
+        with self._lock:
+            d = self._snapshot_dir
         if not d or not os.path.isdir(d):
             return []
         adopted: list[str] = []
@@ -825,7 +854,7 @@ class SessionManager:
                         except SchedulerServiceDisabled:
                             pass
                     svc.scheduler.metrics.load_state(doc.get("metrics") or {})
-                    svc.scheduler._pass_seq = int(doc.get("passSeq", 0))
+                    svc.scheduler.restore_pass_seq(doc.get("passSeq", 0))
                     svc.store.snapshot_initial()
                     os.unlink(path)  # consumed: the live service IS the state
                 else:

@@ -108,7 +108,14 @@ def eager_active() -> bool:
     return getattr(_eager, "on", False)
 
 
-def jit(fn, **kw):
+def jaxpr_audit_enabled() -> bool:
+    """The KSS7xx runtime-audit switch (analysis/jaxpr_audit.py), read
+    at JIT-WRAP time — engine construction — like the lock witness's
+    creation-time contract."""
+    return envcheck.env_truthy(os.environ.get("KSS_JAXPR_AUDIT"))
+
+
+def jit(fn, audit=None, **kw):
     """`jax.jit` with the persistent compile cache armed first — the
     single jit entry point for the engines (engine/engine.py,
     engine/gang.py, parallel/sweep.py, engine/extender_loop.py), so every
@@ -116,7 +123,13 @@ def jit(fn, **kw):
 
     Inside `eager_execution()` this returns `fn` itself (jit kwargs like
     donate_argnums are compile-time hints with no eager meaning): the
-    degradation ladder's eager rung."""
+    degradation ladder's eager rung.
+
+    `audit` (a dict — keys documented atop analysis/jaxpr_audit.py:
+    label/enc/extra_dims/exempt/allow_f64) names and scopes the site
+    for the KSS7xx jaxpr auditor; under ``KSS_JAXPR_AUDIT=1`` the
+    returned callable audits each new argument signature's ClosedJaxpr
+    before executing (docs/static-analysis.md)."""
     global _jit_cache_armed
     if eager_active():
         return fn
@@ -128,7 +141,12 @@ def jit(fn, **kw):
         if not jax.config.jax_compilation_cache_dir:
             enable_compile_cache()
         _jit_cache_armed = True
-    return jax.jit(fn, **kw)
+    jitted = jax.jit(fn, **kw)
+    if jaxpr_audit_enabled():
+        from ..analysis.jaxpr_audit import AuditedJit
+
+        return AuditedJit(jitted, kw, audit)
+    return jitted
 
 
 class CompileDeadlineExceeded(RuntimeError):
@@ -281,6 +299,7 @@ class _Inflight:
         self.engine = None
 
 
+@locking.guard_inferred
 class CompileBroker:
     """Warm-engine map + in-flight dedupe + background speculation.
 

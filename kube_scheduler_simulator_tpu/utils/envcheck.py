@@ -114,6 +114,17 @@ KNOWN: "dict[str, Validator]" = {
     # the serving stack's known locks in the runtime lock-order witness
     # (utils/locking.py) — raises on an acquisition-order inversion
     "KSS_LOCK_CHECK": _bool_validator,
+    # the guarded-state witness (KSS6xx, utils/locking.py): wrap the
+    # statically-inferred lock-claimed attributes in descriptors that
+    # raise UnguardedAccess; SAMPLE checks every Nth access (default 1)
+    "KSS_RACE_CHECK": _bool_validator,
+    "KSS_RACE_CHECK_SAMPLE": _int_validator(1),
+    # the jaxpr auditor (KSS7xx, analysis/jaxpr_audit.py): audit every
+    # broker-jitted program's ClosedJaxpr on first trace
+    "KSS_JAXPR_AUDIT": _bool_validator,
+    # `make lint` / the analysis CLI: missing ruff/mypy and a non-empty
+    # allowlist become hard failures instead of notes (CI honesty)
+    "KSS_LINT_STRICT": _bool_validator,
     # session plane (docs/sessions.md)
     "KSS_MAX_SESSIONS": _int_validator(1),
     "KSS_MAX_PENDING_PODS_PER_SESSION": _int_validator(0),

@@ -77,6 +77,7 @@ class InjectedFault(RuntimeError):
         self.site = site
 
 
+@locking.guard_inferred
 class FaultPlane:
     """One parsed fault-injection spec: per-site rules + seeded streams."""
 

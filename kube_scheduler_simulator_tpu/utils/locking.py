@@ -32,25 +32,66 @@ records nothing (depth bookkeeping only).
 `tests/test_lock_witness.py` drives a concurrent session-plane stress
 under the witness and pins zero inversions; the witness itself is
 negative-tested by forcing an AB/BA pair.
+
+GUARDED-STATE witness — ``KSS_RACE_CHECK=1`` (the runtime half of the
+KSS6xx analyzer, analysis/guarded_state.py): classes decorated with
+`guard_inferred` get their lock-claimed attributes wrapped in checking
+descriptors when the knob is set at construction time. The claims come
+from the SAME static inference the analyzer runs (an attribute written
+under ``with self._lock`` in one method is protected by that lock
+everywhere), so the two halves cannot drift. Each descriptor access
+verifies some claiming lock is currently held — by ANY thread: the
+dispatch→resolve pass-handle shape legally accesses state on a thread
+other than the acquirer — and raises `UnguardedAccess` otherwise.
+``KSS_RACE_CHECK_SAMPLE=N`` checks every Nth access (default 1: all)
+to bound the overhead on hot paths. Arming KSS_RACE_CHECK also arms
+the witness lock wrappers (held-state tracking needs them), so the
+lock-order inversion check rides along.
 """
 
 from __future__ import annotations
 
+import functools
 import os
 import threading
 import traceback
+from typing import Any, Callable, Mapping
 
 from . import envcheck
 
 ENV_VAR = "KSS_LOCK_CHECK"
+RACE_ENV_VAR = "KSS_RACE_CHECK"
+RACE_SAMPLE_ENV_VAR = "KSS_RACE_CHECK_SAMPLE"
 
 
-def lock_check_enabled(env: "dict | None" = None) -> bool:
+def lock_check_enabled(env: "Mapping[str, str] | None" = None) -> bool:
     """The witness switch, read at LOCK CREATION time (wrapping is a
     construction-time decision; flipping the env mid-process affects
     only locks created afterwards)."""
     env = os.environ if env is None else env
     return envcheck.env_truthy(env.get(ENV_VAR))
+
+
+def race_check_enabled(env: "Mapping[str, str] | None" = None) -> bool:
+    """The guarded-state witness switch (``KSS_RACE_CHECK``), read at
+    OBJECT CONSTRUCTION time — instances built while it is unset are
+    never checked, exactly like the lock witness's creation-time
+    contract."""
+    env = os.environ if env is None else env
+    return envcheck.env_truthy(env.get(RACE_ENV_VAR))
+
+
+def race_sample_rate(env: "Mapping[str, str] | None" = None) -> int:
+    """Check every Nth guarded access (``KSS_RACE_CHECK_SAMPLE``,
+    default 1 = every access). Lenient parse: a malformed value must
+    not take a witnessed run down."""
+    env = os.environ if env is None else env
+    raw = env.get(RACE_SAMPLE_ENV_VAR, "")
+    try:
+        n = int(raw) if raw else 1
+    except ValueError:
+        return 1
+    return n if n >= 1 else 1
 
 
 class LockOrderInversion(RuntimeError):
@@ -158,7 +199,7 @@ class LockWitness:
         path: RLocks are owner-released by contract)."""
         self.on_released_list(self._held_list(), role)
 
-    def snapshot(self) -> dict:
+    def snapshot(self) -> "dict[str, Any]":
         with self._graph_lock:
             return {
                 "edges": {
@@ -190,11 +231,11 @@ class _WitnessBase:
         self.role = role
         self.witness = witness if witness is not None else WITNESS
 
-    def __enter__(self):
+    def __enter__(self) -> "_WitnessBase":
         self.acquire()
         return self
 
-    def __exit__(self, *exc) -> bool:
+    def __exit__(self, *exc: object) -> bool:
         self.release()
         return False
 
@@ -233,6 +274,13 @@ class WitnessLock(_WitnessBase):
     def locked(self) -> bool:
         return self._inner.locked()
 
+    def held_anywhere(self) -> bool:
+        """Is the lock currently held by ANY thread — the guarded-state
+        witness's probe (a plain Lock may be held on one thread and
+        released on another, so owner identity is not part of the
+        contract)."""
+        return self._inner.locked()
+
 
 class WitnessRLock(_WitnessBase):
     """RLock wrapper: re-entrant re-acquisition records nothing (depth
@@ -245,6 +293,10 @@ class WitnessRLock(_WitnessBase):
         super().__init__(role, witness)
         self._inner = threading.RLock()
         self._depth = threading.local()
+        # True while any thread's outer acquisition is live — a plain
+        # boolean store/load (atomic under the GIL; only the owning
+        # thread flips it, RLocks being owner-released by contract)
+        self._held_flag = False
 
     def _depth_add(self, delta: int) -> int:
         n = getattr(self._depth, "n", 0) + delta
@@ -261,6 +313,7 @@ class WitnessRLock(_WitnessBase):
                     self._depth_add(-1)
                     self._inner.release()
                     raise
+                self._held_flag = True
         return ok
 
     def release(self) -> None:
@@ -271,16 +324,213 @@ class WitnessRLock(_WitnessBase):
             return
         if self._depth_add(-1) == 0:
             self.witness.on_released(self.role)
+            self._held_flag = False
         self._inner.release()
 
+    def held_anywhere(self) -> bool:
+        """Is some thread inside an outer acquire of this RLock — the
+        guarded-state witness's probe."""
+        return self._held_flag
 
-def make_lock(role: str):
-    """A `threading.Lock` — witness-wrapped when KSS_LOCK_CHECK is set
-    at creation time. `role` is the stable order-graph node name."""
-    return WitnessLock(role) if lock_check_enabled() else threading.Lock()
+
+def make_lock(role: str) -> "threading.Lock | WitnessLock":
+    """A `threading.Lock` — witness-wrapped when KSS_LOCK_CHECK (or
+    KSS_RACE_CHECK, whose held-state probes need the wrapper) is set at
+    creation time. `role` is the stable order-graph node name."""
+    if lock_check_enabled() or race_check_enabled():
+        return WitnessLock(role)
+    return threading.Lock()
 
 
-def make_rlock(role: str):
-    """A `threading.RLock` — witness-wrapped when KSS_LOCK_CHECK is set
-    at creation time (re-entrant re-acquisition records nothing)."""
-    return WitnessRLock(role) if lock_check_enabled() else threading.RLock()
+def make_rlock(role: str) -> "threading.RLock | WitnessRLock":
+    """A `threading.RLock` — witness-wrapped when KSS_LOCK_CHECK or
+    KSS_RACE_CHECK is set at creation time (re-entrant re-acquisition
+    records nothing)."""
+    if lock_check_enabled() or race_check_enabled():
+        return WitnessRLock(role)
+    return threading.RLock()
+
+
+# -- guarded-state witness (KSS_RACE_CHECK=1; analysis/guarded_state.py) -----
+
+
+class UnguardedAccess(RuntimeError):
+    """A lock-claimed attribute was touched while NO claiming lock was
+    held — the race the KSS6xx static pass flags lexically, caught at
+    runtime on the paths the static view cannot follow."""
+
+
+class GuardedAttr:
+    """Data descriptor standing in for one claimed instance attribute.
+
+    The real value lives in the instance ``__dict__`` under the same
+    name (``vars(obj)`` and state-dump code keep working); every load
+    and store first verifies that at least one of the claiming lock
+    attributes is currently held — by any thread (see
+    `WitnessLock.held_anywhere`). Instances are only checked once
+    construction finished (`guard_inferred` arms the instance after
+    ``__init__`` returns) and only when they were built in an armed
+    process; a claiming lock that is NOT a witness wrapper (created
+    while the knob was off) fails open. A shadowed plain class-level
+    value (the dataclass simple-default shape) is preserved as the
+    read fallback, so the witness observes without ever changing what
+    an attribute read returns."""
+
+    __slots__ = (
+        "name", "owner_name", "lock_attrs", "default", "_tick", "_sample",
+    )
+
+    #: sentinel: no class-level default was shadowed
+    MISSING: Any = object()
+
+    def __init__(
+        self,
+        name: str,
+        owner_name: str,
+        lock_attrs: "tuple[str, ...]",
+        default: Any = MISSING,
+    ):
+        self.name = name
+        self.owner_name = owner_name
+        self.lock_attrs = lock_attrs
+        self.default = default
+        self._tick = 0
+        self._sample = race_sample_rate()
+
+    def _check(self, obj: Any, what: str) -> None:
+        d = obj.__dict__
+        if not d.get("_kss_guard_armed"):
+            return
+        # sampling: benign data race on the tick — it only shifts WHICH
+        # accesses get checked, never whether violations are possible
+        self._tick += 1
+        if self._tick % self._sample:
+            return
+        witnessed = False
+        for lname in self.lock_attrs:
+            lk = d.get(lname)
+            if lk is None:
+                lk = getattr(type(obj), lname, None)
+            held = getattr(lk, "held_anywhere", None)
+            if held is None:
+                # not a witness wrapper — a Condition alias (its
+                # acquisitions flow through the wrapped lock, which IS
+                # checked) or a lock created while disarmed. Skip it;
+                # fail open only when NO claimer is witnessable.
+                continue
+            witnessed = True
+            if held():
+                return
+        if witnessed:
+            raise UnguardedAccess(
+                f"unguarded {what} of {self.owner_name}.{self.name}: "
+                f"claimed by lock attr(s) {', '.join(self.lock_attrs)} "
+                f"but none is held (KSS_RACE_CHECK; see "
+                f"docs/static-analysis.md KSS6xx)"
+            )
+
+    def __get__(self, obj: Any, objtype: "type | None" = None) -> Any:
+        if obj is None:
+            return self
+        self._check(obj, "read")
+        try:
+            return obj.__dict__[self.name]
+        except KeyError:
+            if self.default is not GuardedAttr.MISSING:
+                return self.default  # the shadowed class-level default
+            raise AttributeError(self.name) from None
+
+    def __set__(self, obj: Any, value: Any) -> None:
+        self._check(obj, "write")
+        obj.__dict__[self.name] = value
+
+    def __delete__(self, obj: Any) -> None:
+        self._check(obj, "delete")
+        try:
+            del obj.__dict__[self.name]
+        except KeyError:
+            raise AttributeError(self.name) from None
+
+
+def install_guards(cls: type, claims: "dict[str, tuple[str, ...]]") -> None:
+    """Install `GuardedAttr` descriptors on `cls` for each ``attr ->
+    (claiming lock attrs)`` entry. Idempotent per attribute. The direct
+    entry point for tests and for classes whose map is hand-declared;
+    `guard_inferred` derives `claims` from the static analyzer."""
+    for attr, lock_attrs in sorted(claims.items()):
+        missing = object()
+        existing = cls.__dict__.get(attr, missing)
+        if isinstance(existing, GuardedAttr):
+            continue
+        if existing is not missing and hasattr(existing, "__get__"):
+            # the name is already a DESCRIPTOR at class level (a
+            # property, a function, a custom descriptor): shadowing it
+            # would change behavior, and the witness must only observe
+            # — skip, unwitnessed but faithful
+            continue
+        default = GuardedAttr.MISSING if existing is missing else existing
+        setattr(
+            cls,
+            attr,
+            GuardedAttr(attr, cls.__name__, tuple(lock_attrs), default),
+        )
+
+
+def _rel_of_module(module: str) -> "str | None":
+    """'kube_scheduler_simulator_tpu.utils.broker' -> 'utils/broker.py'
+    (None for classes outside the package — nothing to infer from)."""
+    parts = module.split(".")
+    if len(parts) < 2:
+        return None
+    return "/".join(parts[1:]) + ".py"
+
+
+@functools.lru_cache(maxsize=1)
+def _inferred_maps() -> "dict[tuple[str, str], Any]":
+    """The static analyzer's protection map over the LIVE package —
+    parsed once per process, only ever on an armed construction path."""
+    from ..analysis import guarded_state
+    from ..analysis.core import SourceTree
+
+    return guarded_state.protection_map(SourceTree.load())
+
+
+def _instrument_from_inference(cls: type) -> None:
+    rel = _rel_of_module(cls.__module__)
+    if rel is None:
+        return
+    cmap = _inferred_maps().get((rel, cls.__name__))
+    if cmap is None:
+        return
+    claims = {
+        attr: tuple(
+            sorted(
+                a
+                for a, role in cmap.lock_attrs.items()
+                if role in roles
+            )
+        )
+        for attr, roles in cmap.claims.items()
+    }
+    install_guards(cls, {a: la for a, la in claims.items() if la})
+
+
+def guard_inferred(cls: type) -> type:
+    """Class decorator: under ``KSS_RACE_CHECK=1`` (checked at each
+    construction), wrap the class's statically-inferred lock-claimed
+    attributes in `GuardedAttr` witnesses and arm the new instance once
+    its ``__init__`` has returned (construction writes are exempt, like
+    the static pass's ``__init__`` exemption). A no-op wrapper when the
+    knob is off — one env probe per construction."""
+    orig_init: "Callable[..., None]" = cls.__init__
+
+    @functools.wraps(orig_init)
+    def __init__(self: Any, *args: Any, **kwargs: Any) -> None:
+        orig_init(self, *args, **kwargs)
+        if race_check_enabled():
+            _instrument_from_inference(cls)
+            self.__dict__["_kss_guard_armed"] = True
+
+    cls.__init__ = __init__  # type: ignore[method-assign]
+    cls._kss_guarded_class = True  # type: ignore[attr-defined]
+    return cls

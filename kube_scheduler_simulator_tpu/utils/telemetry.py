@@ -89,6 +89,7 @@ def ring_capacity_from_env() -> int:
     return cap if cap >= 1 else DEFAULT_RING_CAP
 
 
+@locking.guard_inferred
 class SpanRecorder:
     """A bounded ring buffer of Chrome-trace events + live subscribers.
 

@@ -39,6 +39,13 @@ for _var in (
     # KSS_LOCK_CHECK=1 would wrap every lock the suite creates; the
     # witness tests arm it explicitly with monkeypatch
     "KSS_LOCK_CHECK",
+    # the guarded-state witness + jaxpr auditor (docs/static-analysis.md
+    # KSS6xx/KSS7xx): ambient arming would instrument every class /
+    # re-trace every program the suite builds; their tests opt in
+    "KSS_RACE_CHECK",
+    "KSS_RACE_CHECK_SAMPLE",
+    "KSS_JAXPR_AUDIT",
+    "KSS_LINT_STRICT",
     # the session plane (server/sessions.py): ambient admission knobs
     # would change quota/limit behavior under test
     "KSS_MAX_SESSIONS",

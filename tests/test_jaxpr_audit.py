@@ -1,0 +1,275 @@
+"""The KSS7xx jaxpr auditor, runtime half (analysis/jaxpr_audit.py +
+the utils/broker.jit hook, KSS_JAXPR_AUDIT=1).
+
+The acceptance gate: a tier-1 chaos run of EVERY engine kind
+(sequential + gang, sync + async pipelines) under the armed auditor
+must produce zero findings — no host callbacks, no f64, every shape on
+the bucket grid, donations consumed — and two identically-seeded runs
+must produce IDENTICAL compile-fingerprint sets (recompile risk as an
+assertion, not a bench postmortem). Negative tests hand the auditor
+synthetic violating programs and require each rule to fire.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kube_scheduler_simulator_tpu.analysis import jaxpr_audit
+from kube_scheduler_simulator_tpu.analysis.jaxpr_audit import (
+    AUDITOR,
+    diff_fingerprints,
+    load_fingerprints,
+)
+from kube_scheduler_simulator_tpu.lifecycle.engine import LifecycleEngine
+from kube_scheduler_simulator_tpu.scenario.chaos import ChaosSpec
+from kube_scheduler_simulator_tpu.utils import broker as broker_mod
+
+from helpers import node, pod
+
+
+@pytest.fixture
+def audit(monkeypatch):
+    """Arm the auditor for engines built inside the test, over a clean
+    registry; reset afterwards so records never leak across tests."""
+    monkeypatch.setenv(jaxpr_audit.ENV_VAR, "1")
+    AUDITOR.reset()
+    yield AUDITOR
+    AUDITOR.reset()
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# -- the broker hook ----------------------------------------------------------
+
+
+def test_hook_off_by_default(monkeypatch):
+    monkeypatch.delenv(jaxpr_audit.ENV_VAR, raising=False)
+    j = broker_mod.jit(lambda x: x + 1, audit={"label": "t.off"})
+    assert not isinstance(j, jaxpr_audit.AuditedJit)
+
+
+def test_hook_audits_once_per_signature(audit):
+    j = broker_mod.jit(lambda x: x * 2, audit={"label": "t.once"})
+    assert isinstance(j, jaxpr_audit.AuditedJit)
+    j(jnp.ones((8,), jnp.float32))
+    j(jnp.zeros((8,), jnp.float32))  # same signature: no second record
+    j(jnp.ones((16,), jnp.float32))  # new bucket: second record
+    assert [r.label for r in AUDITOR.records] == ["t.once", "t.once"]
+    assert AUDITOR.findings() == []
+
+
+def test_eager_rung_bypasses_the_hook(audit):
+    with broker_mod.eager_execution():
+        f = broker_mod.jit(lambda x: x + 1, audit={"label": "t.eager"})
+    assert not isinstance(f, jaxpr_audit.AuditedJit)
+
+
+# -- negative tests: each runtime rule fires on a synthetic violation ---------
+
+
+def test_callback_bearing_jaxpr_fires_kss711(audit):
+    def f(x):
+        jax.debug.print("x={x}", x=x)
+        return x * 2
+
+    j = broker_mod.jit(f, audit={"label": "t.callback"})
+    j(jnp.ones((8,), jnp.float32))
+    assert "KSS711" in rules_of(AUDITOR.findings())
+
+
+def test_f64_leak_fires_kss712(audit):
+    j = broker_mod.jit(
+        lambda x: x.astype(jnp.float64), audit={"label": "t.f64"}
+    )
+    j(jnp.ones((8,), jnp.float32))
+    (f,) = [f for f in AUDITOR.findings() if f.rule == "KSS712"]
+    assert "float64" in f.message
+
+
+def test_f64_waived_under_exact_policy(audit):
+    j = broker_mod.jit(
+        lambda x: x.astype(jnp.float64),
+        audit={"label": "t.f64ok", "allow_f64": True},
+    )
+    j(jnp.ones((8,), jnp.float32))
+    assert AUDITOR.findings() == []
+
+
+def test_off_bucket_shape_fires_kss713(audit):
+    j = broker_mod.jit(
+        lambda x: x + 1,
+        audit={"label": "t.bucket", "exempt": lambda a, k: ()},
+    )
+    j(jnp.ones((24,)))  # 24 > 8, not a power of two, not declared
+    (f,) = [f for f in AUDITOR.findings() if f.rule == "KSS713"]
+    assert "24" in f.message
+
+
+def test_bucket_check_skipped_without_basis(audit):
+    # no enc/exempt declared: the universal rules still run, the bucket
+    # rule does not (the audit-spec contract, jaxpr_audit.py)
+    j = broker_mod.jit(lambda x: x + 1, audit={"label": "t.nobasis"})
+    j(jnp.ones((24,), jnp.float32))
+    assert AUDITOR.findings() == []
+
+
+def test_declared_static_dims_pass_kss713(audit):
+    j = broker_mod.jit(
+        lambda x: x + 1,
+        audit={
+            "label": "t.static",
+            "exempt": lambda a, k: (),
+            "extra_dims": (24,),
+        },
+    )
+    j(jnp.ones((24,), jnp.float32))
+    assert AUDITOR.findings() == []
+
+
+def test_dropped_donation_fires_kss714(audit):
+    # the donated f32[8] can alias no output (shape+dtype change):
+    # lowering warns, the auditor turns it into a finding
+    j = broker_mod.jit(
+        lambda x: x[:4].astype(jnp.int32),
+        donate_argnums=(0,),
+        audit={"label": "t.drop"},
+    )
+    j(jnp.ones((8,), jnp.float32))
+    assert "KSS714" in rules_of(AUDITOR.findings())
+
+
+def test_consumed_donation_is_clean(audit):
+    j = broker_mod.jit(
+        lambda x, y: x + y,
+        donate_argnums=(0,),
+        audit={"label": "t.keep"},
+    )
+    j(jnp.ones((8,), jnp.float32), jnp.ones((8,), jnp.float32))
+    assert AUDITOR.findings() == []
+
+
+def test_auditor_internal_failure_never_raises(audit):
+    # the never-raise contract: a broken audit spec (here: a raising
+    # exempt callable) must not crash the serving pass — it becomes a
+    # KSS719 finding in the registry instead
+    j = broker_mod.jit(
+        lambda x: x + 1,
+        audit={"label": "t.boom", "exempt": lambda a, k: 1 // 0},
+    )
+    out = j(jnp.ones((8,), jnp.float32))  # the call itself succeeds
+    assert float(out[0]) == 2.0
+    (f,) = AUDITOR.findings()
+    assert f.rule == "KSS719"
+    assert "ZeroDivisionError" in f.message
+
+
+def test_fingerprint_drift_fires_kss715():
+    old = {"seq.run": ["aaaa"], "gang.run": ["bbbb"]}
+    new = {"seq.run": ["aaaa", "cccc"], "gang.run": ["bbbb"], "x": ["d"]}
+    findings = diff_fingerprints(old, new)
+    assert rules_of(findings) == {"KSS715"}
+    (f,) = findings
+    assert "seq.run" in f.message and "cccc" in f.message
+    # a NEW label is growth, not drift
+    assert not any("'x'" in g.message for g in findings)
+
+
+def test_fingerprint_persist_round_trip(audit, tmp_path):
+    j = broker_mod.jit(lambda x: x + 1, audit={"label": "t.persist"})
+    j(jnp.ones((8,), jnp.float32))
+    path = str(tmp_path / "fp" / "kss-fingerprints.json")
+    assert AUDITOR.persist(path) == []  # no baseline yet: no drift
+    loaded = load_fingerprints(path)
+    assert loaded == AUDITOR.fingerprints()
+    # same programs again: persisting is drift-free
+    assert AUDITOR.persist(path) == []
+    # a changed digest for a known label IS drift
+    mutated = {"t.persist": ["0" * 16]}
+    assert rules_of(diff_fingerprints(loaded, mutated)) == {"KSS715"}
+
+
+def test_load_rejects_foreign_documents(tmp_path):
+    p = tmp_path / "kss-fingerprints.json"
+    p.write_text('{"format": "something-else", "fingerprints": {"a": ["b"]}}')
+    assert load_fingerprints(str(p)) == {}
+    p.write_text("not json")
+    assert load_fingerprints(str(p)) == {}
+
+
+# -- the acceptance gate: chaos runs of every engine kind ---------------------
+
+
+def _chaos(mode: str, pipeline: str, seed: int = 7) -> ChaosSpec:
+    nodes = [node(f"n{i}", cpu="8", mem="16Gi", pods="110") for i in range(3)]
+    pods = [pod(f"seed-{i}", cpu="200m", node_name=f"n{i % 3}") for i in range(5)]
+    return ChaosSpec.from_dict(
+        {
+            "name": f"audit-{mode}-{pipeline}",
+            "seed": seed,
+            "horizon": 20.0,
+            "schedulerMode": mode,
+            "pipeline": pipeline,
+            "snapshot": {"nodes": nodes, "pods": pods},
+            "arrivals": [
+                {
+                    "kind": "poisson",
+                    "rate": 0.5,
+                    "count": 6,
+                    "template": {
+                        "metadata": {"name": "churn"},
+                        "spec": {
+                            "containers": [
+                                {
+                                    "name": "c",
+                                    "resources": {
+                                        "requests": {
+                                            "cpu": "100m",
+                                            "memory": "64Mi",
+                                        }
+                                    },
+                                }
+                            ]
+                        },
+                    },
+                }
+            ],
+            "faults": [
+                {"at": 8.0, "action": "fail", "node": "n1"},
+                {"at": 14.0, "action": "recover", "node": "n1"},
+            ],
+        }
+    )
+
+
+def test_chaos_run_audits_every_engine_kind_clean(audit):
+    # sequential + gang, sync + async: every program every engine kind
+    # builds is traced and audited — and comes back clean (the KSS7xx
+    # acceptance criterion: zero callbacks, zero f64, bucket-aligned
+    # shapes, donations consumed)
+    for mode in ("sequential", "gang"):
+        for pipeline in ("sync", "async"):
+            result = LifecycleEngine(_chaos(mode, pipeline)).run()
+            assert result["phase"] == "Succeeded", (mode, pipeline, result)
+    labels = AUDITOR.labels()
+    assert "seq.run" in labels, labels
+    assert any(lb.startswith("gang.") for lb in labels), labels
+    assert AUDITOR.records, "nothing audited"
+    bad = AUDITOR.findings()
+    assert bad == [], "\n" + "\n".join(f.render() for f in bad)
+
+
+def test_fingerprints_deterministic_across_identical_runs(audit):
+    # two identically-seeded runs must compile-fingerprint identically:
+    # a difference means a supposedly-deterministic churn run lowered a
+    # DIFFERENT program set — exactly the recompile-risk regression the
+    # auditor exists to catch
+    LifecycleEngine(_chaos("sequential", "sync")).run()
+    first = AUDITOR.fingerprints()
+    AUDITOR.reset()
+    LifecycleEngine(_chaos("sequential", "sync")).run()
+    second = AUDITOR.fingerprints()
+    assert first == second
+    assert diff_fingerprints(first, second) == []
+    assert first, "no fingerprints recorded"

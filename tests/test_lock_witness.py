@@ -220,7 +220,32 @@ def witness(monkeypatch):
     locking.WITNESS.reset()
 
 
+@pytest.fixture
+def race_witness(monkeypatch):
+    """Arm BOTH witnesses — lock order and guarded state
+    (KSS_RACE_CHECK=1) — for objects built inside the test: the session
+    stress must hold zero inversions AND zero UnguardedAccess (the
+    KSS6xx acceptance gate)."""
+    monkeypatch.setenv(locking.ENV_VAR, "1")
+    monkeypatch.setenv(locking.RACE_ENV_VAR, "1")
+    locking.WITNESS.reset()
+    yield locking.WITNESS
+    locking.WITNESS.reset()
+
+
 def test_concurrent_sessions_zero_inversions(witness):
+    _run_session_stress(witness)
+
+
+def test_concurrent_sessions_zero_unguarded_access(race_witness):
+    # the KSS6xx runtime gate: the same 4-thread create/schedule/fork/
+    # evict/restore/delete stress, with every inferred lock-claimed
+    # attribute wrapped in a checking descriptor — an access with no
+    # claiming lock held raises UnguardedAccess into `errors`
+    _run_session_stress(race_witness)
+
+
+def _run_session_stress(witness):
     from kube_scheduler_simulator_tpu.server.service import SimulatorService
     from kube_scheduler_simulator_tpu.server.sessions import (
         SessionBusy,

@@ -17,6 +17,8 @@ import pytest
 from kube_scheduler_simulator_tpu.analysis import core
 from kube_scheduler_simulator_tpu.analysis import (
     env_registry,
+    guarded_state,
+    jaxpr_audit,
     jit_purity,
     lock_order,
     metrics_registry,
@@ -82,8 +84,22 @@ def test_live_lock_graph_is_populated(live_tree):
 
 def test_live_env_registry_is_populated(live_tree):
     known = env_registry.registry_names(live_tree)
-    assert "KSS_LOCK_CHECK" in known  # dogfood: registered in this PR
+    assert "KSS_LOCK_CHECK" in known  # dogfood: registered in PR 7
+    assert "KSS_RACE_CHECK" in known  # dogfood: registered in this PR
+    assert "KSS_JAXPR_AUDIT" in known
     assert len(known) >= 15
+
+
+def test_live_protection_map_is_populated(live_tree):
+    # the guarded-state inference must be analyzing something real: the
+    # broker's warm-engine map and the service's config are documented
+    # lock-claimed state
+    pm = guarded_state.protection_map(live_tree)
+    broker = pm[("utils/broker.py", "CompileBroker")]
+    assert "broker.lock" in broker.claims["_engines"]
+    service = pm[("server/service.py", "SchedulerService")]
+    assert "service.state" in service.claims["_config"]
+    assert sum(len(c.claims) for c in pm.values()) >= 40
 
 
 # -- negative tests: each analyzer fires on a synthetic violation -------------
@@ -368,6 +384,272 @@ def test_span_balance_fires_on_raw_begin_emit():
     )
     findings = span_balance.run(tree, RepoContext())
     assert rules_of(findings) == {"KSS502"}
+
+
+# -- guarded-state (KSS6xx) ---------------------------------------------------
+
+
+GUARDED_PRELUDE = (
+    "from ..utils import locking\n"
+    "class T:\n"
+    "    def __init__(self):\n"
+    "        self._lock = locking.make_lock('t.lock')\n"
+    "        self._items = {}\n"
+)
+
+
+def test_guarded_state_fires_on_unguarded_write():
+    tree = SourceTree.from_sources(
+        {
+            "server/thing.py": GUARDED_PRELUDE
+            + (
+                "    def put(self, k, v):\n"
+                "        with self._lock:\n"
+                "            self._items[k] = v\n"
+                "    def wipe(self):\n"
+                "        self._items = {}\n"  # claimed, no lock: KSS601
+            )
+        }
+    )
+    findings = guarded_state.run(tree, RepoContext())
+    assert rules_of(findings) == {"KSS601"}
+    (f,) = findings
+    assert "T._items" in f.message and "wipe" in f.message
+
+
+def test_guarded_state_fires_on_unguarded_read():
+    tree = SourceTree.from_sources(
+        {
+            "server/thing.py": GUARDED_PRELUDE
+            + (
+                "    def put(self, k, v):\n"
+                "        with self._lock:\n"
+                "            self._items[k] = v\n"
+                "    def peek(self, k):\n"
+                "        return self._items.get(k)\n"  # KSS602
+            )
+        }
+    )
+    findings = guarded_state.run(tree, RepoContext())
+    assert rules_of(findings) == {"KSS602"}
+
+
+def test_guarded_state_locked_context_fixpoint_is_clean():
+    # the _store_locked shape: a helper whose every call site holds the
+    # lock is itself a guarded context — claims flow, checks pass
+    tree = SourceTree.from_sources(
+        {
+            "server/thing.py": GUARDED_PRELUDE
+            + (
+                "    def _store_locked(self, k, v):\n"
+                "        self._items[k] = v\n"
+                "    def put(self, k, v):\n"
+                "        with self._lock:\n"
+                "            self._store_locked(k, v)\n"
+                "    def get(self, k):\n"
+                "        with self._lock:\n"
+                "            return self._items.get(k)\n"
+            )
+        }
+    )
+    assert guarded_state.run(tree, RepoContext()) == []
+
+
+def test_guarded_state_acquire_method_counts_as_guarded():
+    # the begin_pass shape: a method that .acquire()s the lock is
+    # treated as guarded end-to-end (lenient, flow-insensitive)
+    tree = SourceTree.from_sources(
+        {
+            "server/thing.py": GUARDED_PRELUDE
+            + (
+                "    def put(self, k, v):\n"
+                "        with self._lock:\n"
+                "            self._items[k] = v\n"
+                "    def begin(self):\n"
+                "        self._lock.acquire()\n"
+                "        self._items['x'] = 1\n"
+            )
+        }
+    )
+    assert guarded_state.run(tree, RepoContext()) == []
+
+
+def test_guarded_state_condition_alias_guards():
+    # broker._idle = threading.Condition(self._lock): with self._idle
+    # IS holding self._lock
+    tree = SourceTree.from_sources(
+        {
+            "server/thing.py": (
+                "import threading\n"
+                "from ..utils import locking\n"
+                "class T:\n"
+                "    def __init__(self):\n"
+                "        self._lock = locking.make_lock('t.lock')\n"
+                "        self._idle = threading.Condition(self._lock)\n"
+                "        self._busy = 0\n"
+                "    def work(self):\n"
+                "        with self._lock:\n"
+                "            self._busy += 1\n"
+                "    def drain(self):\n"
+                "        with self._idle:\n"
+                "            while self._busy:\n"
+                "                self._idle.wait(1)\n"
+            )
+        }
+    )
+    assert guarded_state.run(tree, RepoContext()) == []
+
+
+def test_guarded_state_mutator_named_helper_is_a_call_edge():
+    # `self.put(...)` is a method CALL on self — a call-graph edge —
+    # not a container mutation, even though "put" is a mutator name:
+    # the locked call site must keep the helper a guarded context
+    tree = SourceTree.from_sources(
+        {
+            "server/thing.py": GUARDED_PRELUDE
+            + (
+                "    def put(self, k, v):\n"
+                "        self._items[k] = v\n"
+                "    def store(self, k, v):\n"
+                "        with self._lock:\n"
+                "            self.put(k, v)\n"
+                "    def get(self, k):\n"
+                "        with self._lock:\n"
+                "            return self._items.get(k)\n"
+            )
+        }
+    )
+    assert guarded_state.run(tree, RepoContext()) == []
+
+
+def test_guarded_state_mutator_call_is_a_write():
+    tree = SourceTree.from_sources(
+        {
+            "server/thing.py": GUARDED_PRELUDE
+            + (
+                "    def put(self, k, v):\n"
+                "        with self._lock:\n"
+                "            self._items.update({k: v})\n"
+                "    def evil(self):\n"
+                "        self._items.clear()\n"  # mutator, no lock
+            )
+        }
+    )
+    findings = guarded_state.run(tree, RepoContext())
+    assert rules_of(findings) == {"KSS601"}
+
+
+def test_guarded_state_closures_are_exempt():
+    # nested defs run on other threads / under caller-held locks: the
+    # static pass leaves them to the KSS_RACE_CHECK runtime witness
+    tree = SourceTree.from_sources(
+        {
+            "server/thing.py": GUARDED_PRELUDE
+            + (
+                "    def put(self, k, v):\n"
+                "        with self._lock:\n"
+                "            self._items[k] = v\n"
+                "    def deferred(self):\n"
+                "        def finish():\n"
+                "            return self._items\n"
+                "        return finish\n"
+            )
+        }
+    )
+    assert guarded_state.run(tree, RepoContext()) == []
+
+
+# -- jaxpr-audit static rules (KSS70x) ----------------------------------------
+
+
+def test_jaxpr_audit_fires_on_callback_api():
+    tree = SourceTree.from_sources(
+        {
+            "engine/thing.py": (
+                "import jax\n"
+                "def f(x):\n"
+                "    jax.debug.print('x={x}', x=x)\n"
+                "    return jax.pure_callback(abs, x, x)\n"
+            )
+        }
+    )
+    findings = jaxpr_audit.run(tree, RepoContext())
+    assert rules_of(findings) == {"KSS701"}
+    msgs = "\n".join(f.message for f in findings)
+    assert "jax.debug.print" in msgs and "pure_callback" in msgs
+
+
+def test_jaxpr_audit_fires_on_f64_outside_policy():
+    tree = SourceTree.from_sources(
+        {
+            "engine/thing.py": (
+                "import jax.numpy as jnp\n"
+                "def f(x):\n"
+                "    return x.astype(jnp.float64)\n"
+            ),
+            # the policy module itself may spell f64
+            "engine/encode.py": (
+                "import jax.numpy as jnp\nEXACT_F = jnp.float64\n"
+            ),
+            # EXACT-policy helpers (named *exact*) may too
+            "engine/kern.py": (
+                "import jax.numpy as jnp\n"
+                "def _exact_isqrt64(x):\n"
+                "    return x.astype(jnp.float64)\n"
+            ),
+        }
+    )
+    findings = jaxpr_audit.run(tree, RepoContext())
+    assert rules_of(findings) == {"KSS702"}
+    assert all(f.path == "engine/thing.py" for f in findings)
+
+
+# -- stale allowlist + strict mode (CLI satellites) ---------------------------
+
+
+def test_stale_waivers_listed_and_nonzero(monkeypatch, capsys):
+    from kube_scheduler_simulator_tpu.analysis.__main__ import main
+
+    monkeypatch.setitem(
+        core.ALLOWLIST, "KSS999", ("nowhere/ghost.py:1",)
+    )
+    try:
+        rc = main([])
+    finally:
+        core.ALLOWLIST.pop("KSS999", None)
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "STALE allowlist entry" in err
+    assert "nowhere/ghost.py:1" in err
+
+
+def test_stale_waivers_helper():
+    f = Finding("KSS101", "a.py", 3, "live")
+    stale = core.stale_waivers(
+        [f], {"KSS101": ("a.py:3", "b.py:9"), "KSS202": ("c.py:1",)}
+    )
+    assert stale == ["KSS101: b.py:9", "KSS202: c.py:1"]
+
+
+def test_lint_strict_fails_on_nonempty_allowlist(monkeypatch, capsys, tmp_path):
+    from kube_scheduler_simulator_tpu.analysis.__main__ import main
+
+    # a synthetic tree with one real finding, waived: non-strict passes
+    # (0 findings survive, the waiver is live), strict refuses
+    pkg = tmp_path / "pkg"
+    (pkg / "engine").mkdir(parents=True)
+    (pkg / "engine" / "bad.py").write_text(
+        "import jax\ng = jax.jit(lambda x: x)\n"
+    )
+    monkeypatch.setitem(core.ALLOWLIST, "KSS301", ("engine/bad.py:2",))
+    try:
+        monkeypatch.delenv("KSS_LINT_STRICT", raising=False)
+        assert main(["--package-dir", str(pkg)]) == 0
+        monkeypatch.setenv("KSS_LINT_STRICT", "1")
+        assert main(["--package-dir", str(pkg)]) == 1
+    finally:
+        core.ALLOWLIST.pop("KSS301", None)
+    assert "KSS_LINT_STRICT: failing" in capsys.readouterr().err
 
 
 # -- framework plumbing -------------------------------------------------------

@@ -51,8 +51,13 @@ import time
 import urllib.request
 
 # armed BEFORE the package imports so every lock the soak touches is
-# witness-wrapped (utils/locking.py decides at lock creation)
+# witness-wrapped (utils/locking.py decides at lock creation). The
+# guarded-state witness (KSS_RACE_CHECK, docs/static-analysis.md
+# KSS6xx) rides along: every inferred lock-claimed attribute is
+# descriptor-checked for the whole soak — an unguarded access raises
+# UnguardedAccess into a stage's problems instead of racing silently
 os.environ["KSS_LOCK_CHECK"] = "1"
+os.environ["KSS_RACE_CHECK"] = "1"
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("KSS_NO_SPECULATIVE_COMPILE", "1")
 for _var in ("KSS_FAULT_INJECT", "KSS_DISPATCH_DEADLINE_S",
@@ -252,6 +257,7 @@ def main() -> int:
     killed_trace = os.path.join(tmp, "term-killed.jsonl")
     env = scrubbed_cpu_env()
     env["KSS_LOCK_CHECK"] = "1"
+    env["KSS_RACE_CHECK"] = "1"
     env["KSS_NO_SPECULATIVE_COMPILE"] = "1"
     proc = subprocess.Popen(
         [
