@@ -10,6 +10,19 @@ deleted, not kept), 2 usage error. Under ``KSS_LINT_STRICT=1`` a
 non-empty allowlist is itself a failure — the CI-honesty mode `make
 lint` runs in. `make lint` runs this alongside ruff and the scoped
 strict mypy (gated on availability; strict mode fails loudly instead).
+
+The ``ledger-diff`` subcommand is the program-ledger perf-regression
+gate (utils/ledger.py, docs/observability.md):
+
+    python -m kube_scheduler_simulator_tpu.analysis ledger-diff \
+        BASELINE.json [CURRENT.json]
+
+diffs two ``kss-program-ledger/v1`` documents (CURRENT defaults to the
+auto-persisted ledger next to the compile cache) and exits 1 on
+compile-seconds regressions (KSS731, label-aggregate), FLOPs drift
+(KSS732), vanished/new programs (KSS733/734), or fingerprint churn
+under a surviving label (KSS735) — two identically-seeded runs diff
+clean. ``tools/perf_smoke.py`` runs it as a gate.
 """
 
 from __future__ import annotations
@@ -31,7 +44,87 @@ from .core import (
 )
 
 
+def ledger_diff_main(argv: "list[str]") -> int:
+    """`analysis ledger-diff BASELINE [CURRENT]`: the perf-regression
+    gate over two persisted program-ledger documents."""
+    from ..utils import ledger as ledger_mod
+
+    ap = argparse.ArgumentParser(
+        prog="kube_scheduler_simulator_tpu.analysis ledger-diff",
+        description="Diff two kss-program-ledger/v1 documents: exit 1 "
+        "on compile-seconds regressions, FLOPs drift, or vanished/new "
+        "programs (docs/observability.md).",
+    )
+    ap.add_argument("baseline", help="the baseline ledger JSON")
+    ap.add_argument(
+        "current",
+        nargs="?",
+        help="the ledger to judge (default: the auto-persisted ledger "
+        "next to the compile cache)",
+    )
+    ap.add_argument(
+        "--ratio",
+        type=float,
+        default=ledger_mod.DRIFT_RATIO,
+        help="compile-seconds regression ratio bar (default %(default)s)",
+    )
+    ap.add_argument(
+        "--floor",
+        type=float,
+        default=ledger_mod.DRIFT_FLOOR_S,
+        help="compile-seconds absolute regression floor in seconds "
+        "(default %(default)s)",
+    )
+    ap.add_argument(
+        "--format", choices=("text", "json"), default="text", dest="fmt"
+    )
+    args = ap.parse_args(argv)
+    previous = ledger_mod.load_ledger(args.baseline)
+    if previous is None:
+        print(
+            f"ledger-diff: {args.baseline}: not a readable "
+            f"{ledger_mod.LEDGER_FORMAT} document",
+            file=sys.stderr,
+        )
+        return 2
+    current_path = args.current or ledger_mod.ledger_path()
+    current = ledger_mod.load_ledger(current_path)
+    if current is None:
+        print(
+            f"ledger-diff: {current_path}: not a readable "
+            f"{ledger_mod.LEDGER_FORMAT} document",
+            file=sys.stderr,
+        )
+        return 2
+    findings = ledger_mod.diff_ledger(
+        previous, current, ratio=args.ratio, floor_s=args.floor
+    )
+    if args.fmt == "json":
+        print(
+            json.dumps(
+                [
+                    {"rule": f.rule, "site": f.path, "message": f.message}
+                    for f in findings
+                ]
+            )
+        )
+    else:
+        for f in findings:
+            print(f.render())
+        if findings:
+            print(f"\nledger-diff: {len(findings)} drift finding(s)")
+        else:
+            print(
+                f"ledger-diff: clean "
+                f"({len(current.get('programs', []))} program(s))"
+            )
+    return 1 if findings else 0
+
+
 def main(argv: "list[str] | None" = None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "ledger-diff":
+        return ledger_diff_main(argv[1:])
     names = sorted(all_analyzers())
     ap = argparse.ArgumentParser(
         prog="kube_scheduler_simulator_tpu.analysis",

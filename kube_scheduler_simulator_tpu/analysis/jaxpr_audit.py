@@ -590,10 +590,22 @@ def _sub_jaxprs(eqn: Any):
 
 
 class AuditedJit:
-    """The broker's audit wrapper around one ``jax.jit`` object: calls
-    pass straight through after a first-signature audit; everything
-    else (``trace``/``lower``/attributes) delegates to the jitted
-    object."""
+    """The broker's observation wrapper around one ``jax.jit`` object —
+    the shared hook of BOTH program-contract families:
+
+      * **audit** (KSS71x, ``KSS_JAXPR_AUDIT=1``): calls pass straight
+        through after a first-signature audit;
+      * **ledger** (``KSS_PROGRAM_LEDGER=1``, utils/ledger.py): the
+        first call of each signature goes through the timed AOT path
+        (lowering vs backend-compile split, cost/memory analysis), and
+        later calls dispatch through the compiled executable — so the
+        split costs no second compile. ``KSS_PROGRAM_TIMING_SAMPLE=N``
+        additionally blocks on every Nth result for a warm device wall.
+
+    Everything else (``trace``/``lower``/attributes) delegates to the
+    jitted object. Both observers share the never-raise contract: an
+    observability failure degrades to plain jit dispatch, never a
+    crashed pass."""
 
     def __init__(
         self,
@@ -601,20 +613,149 @@ class AuditedJit:
         jit_kw: "dict[str, Any]",
         sp: "dict[str, Any] | None",
         auditor: "JaxprAuditor | None" = None,
+        *,
+        audit_enabled: bool = True,
+        ledger: Any = None,
     ):
         self._jitted = jitted
         self._jit_kw = dict(jit_kw)
         self._spec = sp
         self._auditor = AUDITOR if auditor is None else auditor
+        self._audit_enabled = audit_enabled
+        self._ledger = ledger
+        if ledger is not None:
+            from ..utils.ledger import timing_sample_every
+
+            # per-signature (ProgramRecord, compiled-or-None): the
+            # wrapper IS the AOT dispatch cache while the ledger is on
+            self._programs: "dict[tuple, tuple[Any, Any]]" = {}
+            self._sample_every = timing_sample_every()
 
     def __call__(self, *args: Any, **kwargs: Any) -> Any:
-        self._auditor.audit_call(
-            self._jitted, self._jit_kw, self._spec, args, kwargs
+        if self._audit_enabled:
+            self._auditor.audit_call(
+                self._jitted, self._jit_kw, self._spec, args, kwargs
+            )
+        if self._ledger is None:
+            return self._jitted(*args, **kwargs)
+        return self._ledger_call(args, kwargs)
+
+    # -- the ledger dispatch path (utils/ledger.py) --------------------------
+
+    def _ledger_call(self, args: tuple, kwargs: dict) -> Any:
+        import time
+
+        from ..utils import telemetry
+
+        sig = tuple(_aval_sig(a) for a in _flatten(args, kwargs))
+        entry = self._programs.get(sig)
+        if entry is None:
+            entry = self._ledger_first_call(sig, args, kwargs)
+        record, compiled = entry
+        calls_before = record.calls
+        degraded = False
+        t0 = time.perf_counter()
+        out = _SENTINEL
+        if compiled is not None:
+            try:
+                out = compiled(*args, **kwargs)
+            except Exception:  # noqa: BLE001 — degrade, never fail the pass
+                # an aval/static mismatch the signature key missed (weak
+                # types, committed devices): this signature falls back to
+                # plain jit dispatch for good — correctness over split
+                self._programs[sig] = (record, None)
+                degraded = True
+        if out is _SENTINEL:
+            out = self._jitted(*args, **kwargs)
+        dispatch_s = time.perf_counter() - t0
+        warm_s = None
+        if (
+            self._sample_every
+            and calls_before > 0
+            and calls_before % self._sample_every == 0
+        ):
+            # the sampled warm device wall: block on THIS call's result
+            # (the first, compile-bearing call is never sampled)
+            try:
+                import jax
+
+                jax.block_until_ready(out)
+                warm_s = time.perf_counter() - t0
+            except Exception:  # noqa: BLE001 — sampling must not fail the pass
+                pass
+        self._ledger.record_call(
+            record,
+            dispatch_s,
+            session=telemetry.current_session_id(),
+            warm_s=warm_s,
+            degraded=degraded,
         )
-        return self._jitted(*args, **kwargs)
+        return out
+
+    def _ledger_first_call(self, sig: tuple, args: tuple, kwargs: dict):
+        """Open this signature's ledger row: timed trace+lower, timed
+        backend compile, cost/memory analysis, and the same compile
+        fingerprint the KSS715 baseline uses. Failures leave a row with
+        whatever was measured and fall back to plain jit dispatch."""
+        from ..utils import ledger as ledger_mod
+
+        label = (self._spec or {}).get("label") or getattr(
+            getattr(self._jitted, "__wrapped__", None), "__qualname__", None
+        ) or "<unlabeled>"
+        compiled = None
+        lowering_s = backend_s = 0.0
+        cost = memory = None
+        in_avals: tuple = ()
+        out_avals: tuple = ()
+        fingerprint = ""
+        try:
+            probe = ledger_mod.aot_probe(self._jitted, args, kwargs)
+            if probe is not None:
+                compiled, info, traced = probe
+                lowering_s = info["lowering_s"]
+                backend_s = info["backend_s"]
+                if info["flops"] is not None:
+                    cost = {"flops": info["flops"], "bytes": info["bytes"]}
+                memory = info.get("memory")
+                closed = traced.jaxpr
+                in_avals = tuple(
+                    _aval_sig(v.aval) for v in closed.jaxpr.invars
+                )
+                out_avals = tuple(
+                    _aval_sig(v.aval) for v in closed.jaxpr.outvars
+                )
+                fingerprint = JaxprAuditor._fingerprint(
+                    label, self._jit_kw, args, in_avals, out_avals
+                )
+        except Exception:  # noqa: BLE001 — the never-raise contract
+            compiled = None
+        if not fingerprint:
+            import hashlib
+            import json as json_mod
+
+            fingerprint = hashlib.sha256(
+                json_mod.dumps([label, sig], sort_keys=True, default=repr).encode()
+            ).hexdigest()[:16]
+        record = self._ledger.open_program(
+            label,
+            fingerprint,
+            in_avals=in_avals,
+            out_avals=out_avals,
+            lowering_s=lowering_s,
+            backend_s=backend_s,
+            cost=cost,
+            memory=memory,
+        )
+        entry = (record, compiled)
+        self._programs[sig] = entry
+        return entry
 
     def __getattr__(self, name: str) -> Any:
         return getattr(self._jitted, name)
+
+
+# marks "no AOT result": None is a legal program output
+_SENTINEL = object()
 
 
 AUDITOR = JaxprAuditor()

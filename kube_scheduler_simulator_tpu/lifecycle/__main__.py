@@ -165,8 +165,13 @@ def main(argv: "list[str] | None" = None) -> int:
 
     from ..scenario.chaos import ChaosSpec
     from ..utils import telemetry
+    from ..utils.ledger import COLD_START
     from .checkpoint import load_checkpoint
     from .engine import LifecycleEngine
+
+    # cold-start phase accounting (utils/ledger.py): the boot probe is
+    # behind us (ran, skipped, or re-exec'd onto CPU)
+    COLD_START.mark("bootProbe")
 
     # --perfetto-out forces the flight recorder on for this run; an
     # env-armed recorder (KSS_TRACE=1) is reused so the export carries
@@ -271,6 +276,22 @@ def main(argv: "list[str] | None" = None) -> int:
             "programs": len(AUDITOR.records),
             "findings": [f.render() for f in audit_findings],
             "fingerprintDrift": [f.message for f in drift],
+        }
+
+    from ..utils import ledger as ledger_mod
+
+    if ledger_mod.ledger_enabled():
+        # the program performance ledger (docs/observability.md): like
+        # the fingerprint baseline above, an armed run auto-persists
+        # next to the compile cache and surfaces the regression diff
+        # in its headline without failing the run (`analysis
+        # ledger-diff` is the gating entry point)
+        ledger_drift = ledger_mod.LEDGER.persist()
+        result["programLedger"] = {
+            "programs": ledger_mod.LEDGER.totals()["count"],
+            "path": ledger_mod.ledger_path(),
+            "drift": [f.render() for f in ledger_drift],
+            "coldStart": COLD_START.snapshot(),
         }
 
     json.dump(result, sys.stdout, indent=2, sort_keys=True)

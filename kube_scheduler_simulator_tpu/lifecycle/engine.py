@@ -673,6 +673,13 @@ class LifecycleEngine:
                             self._resolve_inflight()
                             self._apply_fault(t, dict(ev_payload))
                 self._converge(t)
+                if telemetry.enabled():
+                    # Perfetto counter track: queue depth alongside the
+                    # pass/event spans (docs/observability.md) — the
+                    # load the timeline's work is answering
+                    telemetry.counter(
+                        "pending_pods", self.store.count_pending_pods()
+                    )
                 self.events_consumed += len(batch)
                 self._maybe_checkpoint(t)
                 if self._stop_requested or (

@@ -93,6 +93,13 @@ def main(argv: "list[str] | None" = None) -> int:
                     probe_why(error, PROBE_TIMEOUT_S),
                 )
 
+    # cold-start phase accounting (utils/ledger.py): the boot probe is
+    # over (ran, was skipped, or re-exec'd us onto CPU) — everything
+    # from here to the first scheduled pass is encode + compile wall
+    from ..utils.ledger import COLD_START
+
+    COLD_START.mark("bootProbe")
+
     cfg = envconfig.from_env()
     if args.port is not None:
         cfg.port = args.port

@@ -84,6 +84,7 @@ from urllib.parse import parse_qs, urlparse
 
 from ..models.store import KINDS, NAMESPACED, StaleResourceVersion
 from ..utils import locking
+from ..utils import ledger as ledger_mod
 from ..utils import metrics as metrics_mod
 from ..utils import telemetry
 from ..utils.broker import CompileDeadlineExceeded, CompileUnavailable
@@ -641,6 +642,20 @@ def _make_handler(server: SimulatorServer):
                 )
                 doc["otherData"]["tracingEnabled"] = rec is not None
                 return self._json(200, doc)
+            if rest == ["debug", "programs"] and method == "GET":
+                # the per-program performance ledger (utils/ledger.py,
+                # docs/observability.md): every broker-jitted program's
+                # compile wall (lowering/backend split), cost-model
+                # FLOPs/bytes, memory bytes, call count, dispatch
+                # seconds, sampled warm wall, and derived MFU — keyed
+                # (site label, compile fingerprint), with per-session
+                # call attribution. Nested routes filter to programs
+                # the addressed session's passes dispatched. Armed by
+                # KSS_PROGRAM_LEDGER=1; unarmed servers answer an
+                # empty (but honest) document.
+                doc = ledger_mod.LEDGER.snapshot(session=sid)
+                doc["enabled"] = ledger_mod.ledger_enabled()
+                return self._json(200, doc)
             if rest == ["debug", "profile"] and method == "POST":
                 return self._debug_profile(self._body() or {})
             if rest == ["events"] and method == "GET":
@@ -1004,6 +1019,13 @@ def _make_handler(server: SimulatorServer):
                 doc["deviceRung"] = svc.scheduler.device_rung
                 doc["draining"] = server.draining
                 doc["drainedSessions"] = server.sessions.drained_sessions()
+                # the observatory blocks (schema v3, utils/ledger.py):
+                # process-wide cold-start phase accounting (boot probe →
+                # first encode → first compile → first pass, summarized
+                # as timeToFirstPassSeconds) and the per-program ledger
+                # summary (full detail at GET /api/v1/debug/programs)
+                doc["coldStart"] = ledger_mod.COLD_START.snapshot()
+                doc["programs"] = ledger_mod.LEDGER.totals()
             if fmt == "prometheus":
                 def entry(session_id, snapshot, cache_cap):
                     return (
@@ -1038,7 +1060,7 @@ def _make_handler(server: SimulatorServer):
                 else:
                     entries = [entry(sid, doc, doc["encodingCacheCapacity"])]
                 mgr_stats = server.sessions.stats()
-                body = metrics_mod.render_prometheus_sessions(
+                text = metrics_mod.render_prometheus_sessions(
                     entries,
                     global_counters={
                         "kss_sse_dropped_events_total": (
@@ -1070,7 +1092,12 @@ def _make_handler(server: SimulatorServer):
                             1 if mgr_stats["draining"] else 0,
                         ),
                     },
-                ).encode()
+                )
+                # the per-program ledger families (kss_program_*, one
+                # series per (program, fingerprint) — utils/ledger.py);
+                # empty string while the ledger has recorded nothing
+                text += ledger_mod.LEDGER.render_prometheus()
+                body = text.encode()
                 self.send_response(200)
                 self._cors_headers()
                 self.send_header(

@@ -48,6 +48,7 @@ from ..sched.extender import ExtenderService
 from ..sched.results import PodSchedulingResult
 from ..utils import devices as devices_mod
 from ..utils import faultinject, locking
+from ..utils import ledger as ledger_mod
 from ..utils import metrics as metrics_mod
 from ..utils import telemetry
 from ..utils.broker import (
@@ -847,6 +848,10 @@ class SchedulerService:
         telemetry.complete(
             "pass.encode", t0, time.perf_counter(), mode=info["mode"]
         )
+        if enc is not None:
+            # cold-start accounting (utils/ledger.py): the process's
+            # first real cluster encode just landed (latched)
+            ledger_mod.COLD_START.mark("firstEncode")
         return enc
 
     # -- predictive compilation --------------------------------------------

@@ -57,6 +57,7 @@ from ..lifecycle.checkpoint import (
     write_checkpoint,
 )
 from ..utils import faultinject, locking
+from ..utils import ledger as ledger_mod
 from ..utils.broker import CompileBroker
 from .service import SchedulerServiceDisabled, SimulatorService
 
@@ -484,6 +485,10 @@ class SessionManager:
         # /api/v1/readyz degraded forever (nothing re-probes a scope
         # that can no longer issue passes)
         self.broker.drop_scope(sid)
+        # and its call attribution from the program ledger — the
+        # programs (and their compile cost) outlive the tenant, the
+        # per-session labels must not (utils/ledger.py)
+        ledger_mod.LEDGER.drop_session(sid)
         if path and os.path.exists(path):
             os.unlink(path)
 

@@ -78,6 +78,7 @@ import weakref
 from contextlib import contextmanager, nullcontext as _null_context
 
 from . import envcheck, faultinject, locking, telemetry
+from . import ledger as ledger_mod
 from .compilecache import enable_compile_cache
 
 _log = logging.getLogger("kube_scheduler_simulator_tpu.broker")
@@ -142,10 +143,21 @@ def jit(fn, audit=None, **kw):
             enable_compile_cache()
         _jit_cache_armed = True
     jitted = jax.jit(fn, **kw)
-    if jaxpr_audit_enabled():
+    audit_on = jaxpr_audit_enabled()
+    ledger_on = ledger_mod.ledger_enabled()
+    if audit_on or ledger_on:
         from ..analysis.jaxpr_audit import AuditedJit
 
-        return AuditedJit(jitted, kw, audit)
+        # ONE wrapper serves both program observers: the KSS7xx audit
+        # and the performance ledger (utils/ledger.py) share the
+        # first-signature hook and the per-site audit labels
+        return AuditedJit(
+            jitted,
+            kw,
+            audit,
+            audit_enabled=audit_on,
+            ledger=ledger_mod.LEDGER if ledger_on else None,
+        )
     return jitted
 
 
@@ -384,6 +396,15 @@ class CompileBroker:
             self.stall_seconds += stall_s
             self.compile_retries += retries
             self.worker_crashes += worker_crashes
+            total_stall = self.stall_seconds
+        if misses or speculative:
+            # cold-start accounting (utils/ledger.py): the process's
+            # first engine compile just completed on this broker
+            ledger_mod.COLD_START.mark("firstCompile")
+        if stall_s:
+            # Perfetto counter track: cumulative request-thread stall
+            # alongside the compile spans (no-op when tracing is off)
+            telemetry.counter("stallSeconds", total_stall)
         sink = metrics if metrics is not None else self.metrics
         if sink is not None:
             if hits or misses or speculative or stall_s:

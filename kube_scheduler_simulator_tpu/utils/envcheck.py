@@ -122,6 +122,12 @@ KNOWN: "dict[str, Validator]" = {
     # the jaxpr auditor (KSS7xx, analysis/jaxpr_audit.py): audit every
     # broker-jitted program's ClosedJaxpr on first trace
     "KSS_JAXPR_AUDIT": _bool_validator,
+    # the program performance ledger (utils/ledger.py): record every
+    # broker-jitted program's compile wall split, cost-model FLOPs/
+    # bytes, memory bytes, calls, and dispatch seconds; SAMPLE blocks
+    # on every Nth call for a warm device wall (0 = never block)
+    "KSS_PROGRAM_LEDGER": _bool_validator,
+    "KSS_PROGRAM_TIMING_SAMPLE": _int_validator(0),
     # `make lint` / the analysis CLI: missing ruff/mypy and a non-empty
     # allowlist become hard failures instead of notes (CI honesty)
     "KSS_LINT_STRICT": _bool_validator,

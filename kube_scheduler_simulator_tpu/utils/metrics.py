@@ -24,8 +24,11 @@ from . import locking
 # The /api/v1/metrics JSON document's schema version: bumped whenever a
 # field changes meaning or disappears (additions don't bump it). v2
 # introduced the version stamp itself, uptimeSeconds, and the
-# histograms block (docs/observability.md).
-METRICS_SCHEMA_VERSION = 2
+# histograms block; v3 marks the observatory document shape — the
+# `coldStart` (phase accounting, timeToFirstPassSeconds) and `programs`
+# (per-program ledger summary) blocks the serving layer attaches
+# (docs/observability.md).
+METRICS_SCHEMA_VERSION = 3
 
 
 class Histogram:
@@ -231,6 +234,16 @@ class SchedulingMetrics:
             self._total_scheduled += rec.scheduled
             self._total_wall_s += rec.wall_s
             self._hist["passLatencySeconds"].observe(rec.wall_s)
+        # cold-start accounting (utils/ledger.py): every pass — any
+        # registry, any driver — lands here, so the FIRST one that
+        # actually placed a pod closes the process's
+        # timeToFirstPassSeconds window (latched; one dict probe per
+        # pass afterwards). Empty passes don't count: the headline is
+        # time-to-first-SCHEDULED-pod, not time-to-first-no-op.
+        if rec.scheduled > 0:
+            from .ledger import COLD_START
+
+            COLD_START.mark("firstPass")
 
     def record_disruption(
         self,
@@ -894,25 +907,23 @@ def cost_analysis(jitted, *args) -> "dict | None":
     """FLOPs + bytes of one execution of `jitted(*args)` from XLA's own
     compiled-program cost model.
 
-    Uses the AOT path (`.lower(*args).compile().cost_analysis()`) which
-    shares the jit compilation cache, so calling this after the program
-    already ran is cheap. Returns {"flops": float, "bytes": float} or
-    None when the backend doesn't expose a cost model (the experimental
-    axon backend may not) — callers must treat None as "unavailable",
-    never as zero work."""
-    try:
-        compiled = jitted.lower(*args).compile()
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else {}
-        if not isinstance(ca, dict):
-            return None
-        return {
-            "flops": float(ca.get("flops", 0.0) or 0.0),
-            "bytes": float(ca.get("bytes accessed", 0.0) or 0.0),
-        }
-    except Exception:  # noqa: BLE001 — cost telemetry must never break a run
+    Routed through the program ledger's shared AOT probe
+    (`utils/ledger.aot_probe` — the same lower/compile/cost path the
+    serving-side ledger wrapper times), which shares the jit
+    compilation cache, so calling this after the program already ran is
+    cheap. Returns {"flops": float, "bytes": float} or None when the
+    backend doesn't expose a cost model (the experimental axon backend
+    may not) — callers must treat None as "unavailable", never as zero
+    work."""
+    from .ledger import aot_probe
+
+    probe = aot_probe(jitted, args)
+    if probe is None:
         return None
+    _compiled, info, _traced = probe
+    if info["flops"] is None:
+        return None
+    return {"flops": info["flops"], "bytes": info["bytes"]}
 
 
 def mfu(flops: "float | None", seconds: float, platform: str) -> "float | None":
@@ -929,18 +940,39 @@ def mfu(flops: "float | None", seconds: float, platform: str) -> "float | None":
 
 def cost_fields(
     jitted, args: tuple, seconds: "float | None" = None,
-    platform: str = "", per: str = "",
+    platform: str = "", per: str = "", label: "str | None" = None,
+    variants: "int | None" = None,
 ) -> dict:
     """The shared cost-telemetry block of every bench program: run
     `cost_analysis`, and when it answers emit `flops`/`bytes` (suffixed
     `_per_<per>` when given) plus — with a measured wall `seconds` —
     `flops_per_s` and, on a known accelerator, `mfu`. Empty dict when
-    the backend exposes no cost model (callers merge it and move on)."""
-    cost = cost_analysis(jitted, *args)
+    the backend exposes no cost model (callers merge it and move on).
+
+    `label` additionally records the probe into the process ledger
+    (`utils/ledger.LEDGER`) so bench and the serving path share one
+    accounting. `variants` marks a VMAPPED program: the emitted
+    `flops` stays the whole-program cost-model number, and
+    `flops_per_variant` spells out the per-variant share — the MFU
+    denominator note every headline carries (docs/benchmarking.md:
+    the cost model's vmapped totals have been observed inconsistent
+    with variants x the single-variant program, BENCH_r05_chip)."""
+    if label is not None:
+        from .ledger import LEDGER
+
+        info = LEDGER.observe(label, jitted, args)
+        cost = (
+            {"flops": info["flops"], "bytes": info["bytes"]} if info else None
+        )
+    else:
+        cost = cost_analysis(jitted, *args)
     if not cost:
         return {}
     sfx = f"_per_{per}" if per else ""
     out = {f"flops{sfx}": cost["flops"], f"bytes{sfx}": cost["bytes"]}
+    if variants and variants > 1:
+        out["flops_per_variant"] = cost["flops"] / variants
+        out["variants"] = variants
     if seconds is not None and seconds > 0:
         out["flops_per_s"] = round(cost["flops"] / seconds, 1)
         m = mfu(cost["flops"], seconds, platform)

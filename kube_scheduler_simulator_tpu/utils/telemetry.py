@@ -396,6 +396,28 @@ def complete(
     )
 
 
+def counter(name: str, value: float, tid: "int | None" = None) -> None:
+    """A Chrome counter-track sample (`C` event): Perfetto renders the
+    series of `value`s under `name` as a stepped area alongside the
+    span tracks — load (pending pods, cumulative stall/dispatch
+    seconds) next to the work that caused it. One series per name (the
+    args key is always ``value``), no-op when tracing is off."""
+    rec = active()
+    if rec is None:
+        return
+    rec.emit(
+        {
+            "ph": "C",
+            "name": name,
+            "cat": "kss",
+            "ts": _now_us(),
+            "pid": _PID,
+            "tid": threading.get_ident() if tid is None else tid,
+            "args": {"value": float(value)},
+        }
+    )
+
+
 def instant(name: str, pass_id: "int | None" = None, **attrs) -> None:
     """A point event on the calling thread's track (injected faults,
     sim-time marks)."""

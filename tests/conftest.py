@@ -46,6 +46,11 @@ for _var in (
     "KSS_RACE_CHECK_SAMPLE",
     "KSS_JAXPR_AUDIT",
     "KSS_LINT_STRICT",
+    # the program performance ledger (utils/ledger.py): ambient arming
+    # would AOT-probe every program the suite compiles (and sampling
+    # would synchronize the async pipeline); ledger tests opt in
+    "KSS_PROGRAM_LEDGER",
+    "KSS_PROGRAM_TIMING_SAMPLE",
     # the session plane (server/sessions.py): ambient admission knobs
     # would change quota/limit behavior under test
     "KSS_MAX_SESSIONS",

@@ -1,0 +1,538 @@
+"""The program performance observatory (utils/ledger.py + the
+utils/broker.jit hook + the serving routes, docs/observability.md).
+
+The acceptance gates: a CPU chaos run under the armed ledger must
+populate ≥1 program entry carrying fingerprint, compile seconds (with
+the lowering/backend split), FLOPs/bytes, and call count; `analysis
+ledger-diff` must exit non-zero on an injected compile-seconds
+regression and zero on identical documents; `/api/v1/metrics` must
+report a `coldStart` block with `timeToFirstPassSeconds`; and the
+sampled-timing path must be placement-invariant (sampling on/off →
+identical placements).
+"""
+
+import json
+import urllib.request
+
+import jax.numpy as jnp
+import pytest
+
+from kube_scheduler_simulator_tpu.analysis.__main__ import main as analysis_main
+from kube_scheduler_simulator_tpu.analysis.jaxpr_audit import AuditedJit
+from kube_scheduler_simulator_tpu.models.store import ResourceStore
+from kube_scheduler_simulator_tpu.server import SimulatorServer, SimulatorService
+from kube_scheduler_simulator_tpu.server.service import SchedulerService
+from kube_scheduler_simulator_tpu.utils import broker as broker_mod
+from kube_scheduler_simulator_tpu.utils import ledger as ledger_mod
+from kube_scheduler_simulator_tpu.utils import metrics as metrics_mod
+from kube_scheduler_simulator_tpu.utils import telemetry
+
+from helpers import node, pod
+
+
+@pytest.fixture
+def ledger(monkeypatch):
+    """Arm the ledger for programs jitted inside the test, over a clean
+    registry; reset afterwards so records never leak across tests."""
+    monkeypatch.setenv(ledger_mod.ENV_VAR, "1")
+    ledger_mod.LEDGER.reset()
+    yield ledger_mod.LEDGER
+    ledger_mod.LEDGER.reset()
+
+
+def _churn_store(n_nodes=4, n_pods=12) -> ResourceStore:
+    store = ResourceStore()
+    for i in range(n_nodes):
+        store.apply("nodes", node(f"n{i}", cpu="16", mem="32Gi", pods="110"))
+    for i in range(n_pods):
+        store.apply("pods", pod(f"p{i}", cpu="100m"))
+    return store
+
+
+# -- the broker hook ----------------------------------------------------------
+
+
+def test_hook_off_by_default(monkeypatch):
+    monkeypatch.delenv(ledger_mod.ENV_VAR, raising=False)
+    monkeypatch.delenv("KSS_JAXPR_AUDIT", raising=False)
+    j = broker_mod.jit(lambda x: x + 1, audit={"label": "t.off"})
+    assert not isinstance(j, AuditedJit)
+
+
+def test_ledger_records_compile_split_cost_and_calls(ledger):
+    j = broker_mod.jit(lambda x: x * 2, audit={"label": "t.rec"})
+    assert isinstance(j, AuditedJit)
+    out = j(jnp.ones((8,), jnp.float32))
+    assert float(out[0]) == 2.0  # the AOT dispatch answers correctly
+    j(jnp.zeros((8,), jnp.float32))
+    j(jnp.ones((16,), jnp.float32))  # new bucket: second program
+    snap = ledger.snapshot()
+    assert len(snap["programs"]) == 2
+    by_calls = sorted(snap["programs"], key=lambda p: -p["calls"])
+    first = by_calls[0]
+    assert first["label"] == "t.rec"
+    assert first["fingerprint"]
+    assert first["calls"] == 2
+    assert first["compileSeconds"]["total"] > 0
+    assert first["compileSeconds"]["lowering"] > 0
+    assert first["compileSeconds"]["backend"] > 0
+    # the CPU backend exposes the cost + memory models
+    assert first["flops"] is not None and first["bytes"] is not None
+    assert first["memory"]["argumentBytes"] > 0
+    assert first["dispatchSeconds"] > 0
+    assert ledger.totals()["calls"] == 3
+
+
+def test_warm_sampling_every_nth_call(ledger, monkeypatch):
+    monkeypatch.setenv(ledger_mod.SAMPLE_VAR, "1")
+    j = broker_mod.jit(lambda x: x + 1, audit={"label": "t.warm"})
+    for _ in range(4):
+        j(jnp.ones((8,), jnp.float32))
+    (p,) = ledger.snapshot()["programs"]
+    # the first (compile-bearing) call is never sampled
+    assert p["warm"]["samples"] == 3
+    assert p["warm"]["meanSeconds"] is not None
+
+
+def test_sampling_off_never_blocks(ledger, monkeypatch):
+    monkeypatch.delenv(ledger_mod.SAMPLE_VAR, raising=False)
+    j = broker_mod.jit(lambda x: x + 1, audit={"label": "t.nowarm"})
+    for _ in range(3):
+        j(jnp.ones((8,), jnp.float32))
+    (p,) = ledger.snapshot()["programs"]
+    assert p["warm"]["samples"] == 0
+    assert p["mfu"] is None  # no warm wall, no MFU claim
+
+
+def test_session_attribution_and_drop(ledger):
+    j = broker_mod.jit(lambda x: x + 1, audit={"label": "t.sess"})
+    with telemetry.session_context("s-a"):
+        j(jnp.ones((8,), jnp.float32))
+        j(jnp.ones((8,), jnp.float32))
+    j(jnp.ones((8,), jnp.float32))  # sessionless -> "default"
+    (p,) = ledger.snapshot()["programs"]
+    assert p["sessions"] == {"s-a": 2, "default": 1}
+    # the nested-route filter: only programs the session dispatched
+    assert ledger.snapshot(session="s-a")["programs"]
+    assert ledger.snapshot(session="s-zzz")["programs"] == []
+    ledger.drop_session("s-a")
+    (p,) = ledger.snapshot()["programs"]
+    assert p["sessions"] == {"default": 1}
+
+
+def test_rebuild_accumulates_compile_wall(ledger):
+    # two engines jitting the SAME program (label + fingerprint) merge
+    # into one row whose builds/compile wall accumulate — recompile
+    # cost must never be hidden by deduplication
+    for _ in range(2):
+        j = broker_mod.jit(lambda x: x * 3, audit={"label": "t.rebuild"})
+        j(jnp.ones((8,), jnp.float32))
+    (p,) = ledger.snapshot()["programs"]
+    assert p["builds"] == 2
+    assert p["calls"] == 2
+
+
+# -- placement parity ---------------------------------------------------------
+
+
+def _placements(sample: "str | None", monkeypatch) -> dict:
+    monkeypatch.delenv(ledger_mod.ENV_VAR, raising=False)
+    monkeypatch.delenv(ledger_mod.SAMPLE_VAR, raising=False)
+    if sample is not None:
+        monkeypatch.setenv(ledger_mod.ENV_VAR, "1")
+        if sample:
+            monkeypatch.setenv(ledger_mod.SAMPLE_VAR, sample)
+    svc = SchedulerService(_churn_store())
+    placements, _, _ = svc.schedule_gang(record=False)
+    # drive a second pass so the sampled (post-compile) path runs too
+    svc.store.apply("pods", pod("late-1", cpu="100m"))
+    second, _, _ = svc.schedule_gang(record=False)
+    return {**placements, **second}
+
+
+def test_sampled_timing_path_is_placement_invariant(monkeypatch):
+    # the two extremes cover both switches: ledger fully off vs ledger
+    # on with every call sampled (block_until_ready on the hot path)
+    ledger_mod.LEDGER.reset()
+    try:
+        off = _placements(None, monkeypatch)  # ledger off entirely
+        sampled = _placements("1", monkeypatch)  # ledger on, sample every call
+    finally:
+        ledger_mod.LEDGER.reset()
+    assert off == sampled
+    assert any(v for v in off.values())  # the pass actually scheduled
+
+
+# -- persistence + diff -------------------------------------------------------
+
+
+def test_persist_round_trip_and_self_diff_clean(ledger, tmp_path):
+    j = broker_mod.jit(lambda x: x + 1, audit={"label": "t.persist"})
+    j(jnp.ones((8,), jnp.float32))
+    path = str(tmp_path / "ledger" / "kss-program-ledger.json")
+    assert ledger.persist(path) == []  # no baseline yet: no drift
+    doc = ledger_mod.load_ledger(path)
+    assert doc is not None and doc["format"] == ledger_mod.LEDGER_FORMAT
+    assert doc["programs"][0]["label"] == "t.persist"
+    # identical state re-persisted: drift-free
+    assert ledger.persist(path) == []
+    assert ledger_mod.diff_ledger(doc, doc) == []
+
+
+def test_load_rejects_foreign_documents(tmp_path):
+    p = tmp_path / "kss-program-ledger.json"
+    p.write_text('{"format": "something-else", "programs": []}')
+    assert ledger_mod.load_ledger(str(p)) is None
+    p.write_text("not json")
+    assert ledger_mod.load_ledger(str(p)) is None
+    assert ledger_mod.load_ledger(str(tmp_path / "absent.json")) is None
+
+
+def _doc(programs):
+    return {"format": ledger_mod.LEDGER_FORMAT, "programs": programs}
+
+
+def _prog(label, fp, compile_s=0.5, flops=100.0):
+    return {
+        "label": label,
+        "fingerprint": fp,
+        "compileSeconds": {"total": compile_s},
+        "flops": flops,
+    }
+
+
+def test_diff_flags_compile_regression_not_improvement():
+    base = _doc([_prog("seq.run", "aa", compile_s=1.0)])
+    slower = _doc([_prog("seq.run", "aa", compile_s=4.0)])
+    faster = _doc([_prog("seq.run", "aa", compile_s=0.2)])
+    assert [f.rule for f in ledger_mod.diff_ledger(base, slower)] == ["KSS731"]
+    assert ledger_mod.diff_ledger(base, faster) == []
+    # jitter below the absolute floor never flags, whatever the ratio
+    tiny = _doc([_prog("seq.run", "aa", compile_s=0.01)])
+    tiny_slower = _doc([_prog("seq.run", "aa", compile_s=0.5)])
+    assert ledger_mod.diff_ledger(tiny, tiny_slower) == []
+
+
+def test_diff_catches_regression_hidden_behind_a_changed_fingerprint():
+    # the blind-spot case: the label survives but its fingerprint
+    # changed (an avals/static-arg drift — the recompile class the
+    # gate exists for), so no (label, fingerprint) key is shared.
+    # The churn itself must flag (KSS735) AND the label-aggregate
+    # compile comparison must still see the 25x regression (KSS731).
+    base = _doc([_prog("seq.run", "f1", compile_s=2.0)])
+    cur = _doc([_prog("seq.run", "f2", compile_s=50.0)])
+    rules = sorted(f.rule for f in ledger_mod.diff_ledger(base, cur))
+    assert rules == ["KSS731", "KSS735"]
+
+
+def test_diff_flags_flops_drift_and_program_churn():
+    base = _doc([_prog("seq.run", "aa"), _prog("gang.run", "bb")])
+    drifted = _doc(
+        [_prog("seq.run", "aa", flops=999.0), _prog("new.site", "cc")]
+    )
+    rules = sorted(f.rule for f in ledger_mod.diff_ledger(base, drifted))
+    assert rules == ["KSS732", "KSS733", "KSS734"]
+
+
+def test_ledger_diff_cli_gate(tmp_path, capsys):
+    base = _doc([_prog("seq.run", "aa", compile_s=1.0)])
+    bad = _doc([_prog("seq.run", "aa", compile_s=30.0)])
+    base_p, bad_p = tmp_path / "base.json", tmp_path / "bad.json"
+    base_p.write_text(json.dumps(base))
+    bad_p.write_text(json.dumps(bad))
+    assert analysis_main(["ledger-diff", str(base_p), str(base_p)]) == 0
+    assert analysis_main(["ledger-diff", str(base_p), str(bad_p)]) == 1
+    out = capsys.readouterr().out
+    assert "KSS731" in out
+    # unreadable baseline is a usage error, not "clean"
+    assert analysis_main(
+        ["ledger-diff", str(tmp_path / "nope.json"), str(base_p)]
+    ) == 2
+
+
+# -- cold-start phase accounting ----------------------------------------------
+
+
+def test_cold_start_marks_order_and_latch():
+    ledger_mod.COLD_START.reset()
+    try:
+        svc = SchedulerService(_churn_store())
+        placements, _, _ = svc.schedule_gang(record=False)
+        assert any(v for v in placements.values())
+        snap = ledger_mod.COLD_START.snapshot()
+        assert snap["complete"] is True
+        assert snap["timeToFirstPassSeconds"] > 0
+        phases = snap["phases"]
+        # encode precedes the engine compile precedes the first pass
+        assert phases["firstEncode"] <= phases["firstCompile"]
+        assert phases["firstCompile"] <= phases["firstPass"]
+        first = snap["timeToFirstPassSeconds"]
+        # a second pass never moves the latched marks
+        svc.store.apply("pods", pod("late", cpu="100m"))
+        svc.schedule_gang(record=False)
+        assert (
+            ledger_mod.COLD_START.snapshot()["timeToFirstPassSeconds"]
+            == first
+        )
+    finally:
+        ledger_mod.COLD_START.reset()
+
+
+def test_empty_pass_does_not_complete_cold_start():
+    ledger_mod.COLD_START.reset()
+    try:
+        store = ResourceStore()
+        store.apply("nodes", node("n0", cpu="16", mem="32Gi", pods="110"))
+        svc = SchedulerService(store)  # no pods: nothing schedulable
+        svc.schedule_gang(record=False)
+        snap = ledger_mod.COLD_START.snapshot()
+        assert snap["complete"] is False
+        assert snap["timeToFirstPassSeconds"] is None
+    finally:
+        ledger_mod.COLD_START.reset()
+
+
+# -- the HTTP surface ---------------------------------------------------------
+
+
+def _req(port, method, path, body=None, timeout=300):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        raw = resp.read()
+        return resp.status, json.loads(raw) if raw else None
+
+
+def _raw(port, path, timeout=300):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=timeout
+    ) as resp:
+        return resp.status, resp.read().decode()
+
+
+@pytest.fixture
+def armed_server(ledger):
+    ledger_mod.COLD_START.reset()
+    srv = SimulatorServer(SimulatorService(), port=0).start()
+    yield srv
+    srv.shutdown()
+    ledger_mod.COLD_START.reset()
+
+
+def _chaos_body():
+    return {
+        "name": "obs",
+        "seed": 7,
+        "horizon": 10.0,
+        "schedulerMode": "gang",
+        "snapshot": {
+            "nodes": [
+                node(f"n{i}", cpu="16", mem="32Gi", pods="110")
+                for i in range(3)
+            ]
+        },
+        "arrivals": [
+            {
+                "kind": "poisson",
+                "rate": 1.0,
+                "count": 5,
+                "template": {
+                    "metadata": {"name": "churn"},
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "c",
+                                "resources": {
+                                    "requests": {
+                                        "cpu": "100m",
+                                        "memory": "64Mi",
+                                    }
+                                },
+                            }
+                        ]
+                    },
+                },
+            }
+        ],
+    }
+
+
+def test_debug_programs_populated_by_chaos_run(armed_server):
+    # the acceptance criterion: a CPU-only chaos run populates the
+    # ledger, and GET /api/v1/debug/programs answers ≥1 program entry
+    # carrying fingerprint, compile seconds, FLOPs/bytes, call count
+    code, result = _req(
+        armed_server.port, "POST", "/api/v1/lifecycle", _chaos_body()
+    )
+    assert code == 200 and result["phase"] == "Succeeded"
+    code, doc = _req(armed_server.port, "GET", "/api/v1/debug/programs")
+    assert code == 200
+    assert doc["format"] == ledger_mod.LEDGER_FORMAT
+    assert doc["enabled"] is True
+    assert len(doc["programs"]) >= 1
+    p = doc["programs"][0]
+    assert p["fingerprint"]
+    assert p["compileSeconds"]["total"] > 0
+    assert p["flops"] is not None and p["bytes"] is not None
+    assert p["calls"] >= 1
+
+    # the metrics document carries the observatory blocks (schema v3)
+    code, m = _req(armed_server.port, "GET", "/api/v1/metrics")
+    assert code == 200
+    assert m["schemaVersion"] == metrics_mod.METRICS_SCHEMA_VERSION
+    assert m["programs"]["enabled"] is True
+    assert m["programs"]["count"] >= 1
+    cold = m["coldStart"]
+    assert cold["complete"] is True
+    assert cold["timeToFirstPassSeconds"] > 0
+    assert "firstEncode" in cold["phases"]
+
+    # and the Prometheus exposition gains the program families,
+    # surviving the strict text-format parse
+    code, text = _raw(
+        armed_server.port, "/api/v1/metrics?format=prometheus"
+    )
+    assert code == 200
+    families = metrics_mod.parse_prometheus_text(text)
+    assert "kss_program_compile_seconds" in families
+    assert "kss_program_calls_total" in families
+    sample = families["kss_program_calls_total"]["samples"][0]
+    assert sample[1]["program"] and sample[1]["fingerprint"]
+
+    # per-session attribution over the same server: a tenant's passes
+    # dispatch programs under its session label, the nested route
+    # filters to them, and DELETE drops the attribution
+    code, sess = _req(
+        armed_server.port, "POST", "/api/v1/sessions", {"name": "tenant-a"}
+    )
+    assert code == 201
+    sid = sess["id"]
+    base = f"/api/v1/sessions/{sid}"
+    _req(armed_server.port, "PUT", f"{base}/resources/nodes", node("n0"))
+    _req(
+        armed_server.port,
+        "PUT",
+        f"{base}/resources/pods",
+        pod("p0", cpu="100m"),
+    )
+    code, _ = _req(
+        armed_server.port, "POST", f"{base}/schedule?mode=gang&record=0"
+    )
+    assert code == 200
+    code, doc = _req(armed_server.port, "GET", f"{base}/debug/programs")
+    assert code == 200 and len(doc["programs"]) >= 1
+    assert all(sid in p["sessions"] for p in doc["programs"])
+    code, _ = _req(armed_server.port, "DELETE", f"/api/v1/sessions/{sid}")
+    assert code == 200
+    code, doc = _req(armed_server.port, "GET", "/api/v1/debug/programs")
+    assert code == 200
+    assert all(sid not in p["sessions"] for p in doc["programs"])
+
+
+def test_cold_start_block_on_fresh_unarmed_server():
+    # the coldStart block is part of the metrics document even with
+    # the ledger OFF — phase accounting is always-on (cheap latches)
+    ledger_mod.COLD_START.reset()
+    srv = SimulatorServer(SimulatorService(), port=0).start()
+    try:
+        code, m = _req(srv.port, "GET", "/api/v1/metrics")
+        assert code == 200
+        assert m["coldStart"]["complete"] is False
+        assert m["programs"]["enabled"] is False
+        _req(srv.port, "PUT", "/api/v1/resources/nodes", node("n0"))
+        _req(
+            srv.port, "PUT", "/api/v1/resources/pods", pod("p0", cpu="100m")
+        )
+        code, _ = _req(srv.port, "POST", "/api/v1/schedule?mode=gang&record=0")
+        assert code == 200
+        code, m = _req(srv.port, "GET", "/api/v1/metrics")
+        assert m["coldStart"]["complete"] is True
+        assert m["coldStart"]["timeToFirstPassSeconds"] > 0
+    finally:
+        srv.shutdown()
+        ledger_mod.COLD_START.reset()
+
+
+# -- telemetry counter tracks -------------------------------------------------
+
+
+def test_lifecycle_emits_pending_pods_counter_track(monkeypatch):
+    from kube_scheduler_simulator_tpu.lifecycle.engine import LifecycleEngine
+    from kube_scheduler_simulator_tpu.scenario.chaos import ChaosSpec
+
+    rec = telemetry.SpanRecorder(capacity=4096)
+    telemetry.activate(rec)
+    try:
+        spec = ChaosSpec.from_dict(
+            {
+                "name": "counter",
+                "seed": 3,
+                "horizon": 6.0,
+                "schedulerMode": "gang",
+                "snapshot": {
+                    "nodes": [node("n0", cpu="16", mem="32Gi", pods="110")]
+                },
+                "arrivals": [
+                    {
+                        "kind": "poisson",
+                        "rate": 1.0,
+                        "count": 3,
+                        "template": {
+                            "metadata": {"name": "churn"},
+                            "spec": {
+                                "containers": [
+                                    {
+                                        "name": "c",
+                                        "resources": {
+                                            "requests": {
+                                                "cpu": "100m",
+                                                "memory": "64Mi",
+                                            }
+                                        },
+                                    }
+                                ]
+                            },
+                        },
+                    }
+                ],
+            }
+        )
+        result = LifecycleEngine(spec).run()
+        assert result["phase"] == "Succeeded"
+        events = rec.snapshot()
+    finally:
+        telemetry.deactivate()
+    pending = [
+        e
+        for e in events
+        if e.get("ph") == "C" and e["name"] == "pending_pods"
+    ]
+    assert pending, "no pending_pods counter samples in the trace"
+    assert all(e["args"]["value"] >= 0 for e in pending)
+    telemetry.check_nesting(events)
+
+
+def test_counter_events_ride_the_flight_recorder(ledger):
+    rec = telemetry.SpanRecorder(capacity=256)
+    telemetry.activate(rec)
+    try:
+        j = broker_mod.jit(lambda x: x + 1, audit={"label": "t.counter"})
+        j(jnp.ones((8,), jnp.float32))
+        j(jnp.ones((8,), jnp.float32))
+        events = rec.snapshot()
+    finally:
+        telemetry.deactivate()
+    counters = [e for e in events if e.get("ph") == "C"]
+    assert any(e["name"] == "ledger.dispatchSeconds" for e in counters)
+    values = [
+        e["args"]["value"]
+        for e in counters
+        if e["name"] == "ledger.dispatchSeconds"
+    ]
+    assert values == sorted(values)  # cumulative, monotone
+    # counter events never disturb span well-formedness
+    telemetry.check_nesting(events)

@@ -1,6 +1,6 @@
 """`make perf-smoke`: tiny CPU-only lifecycle throughput sanity check.
 
-Two gates, one JSON line:
+Three gates, one JSON line:
 
 1. **Churn is O(Δ)** — a small seeded churn timeline (Poisson arrivals +
    a cordon flap against a 6-node cluster) through the full service
@@ -18,6 +18,14 @@ Two gates, one JSON line:
    start's 1, the crossing served by the `speculativeCompiles == 1`
    warm engine).
 
+3. **The program ledger answers and diffs clean** — the whole run
+   executes under `KSS_PROGRAM_LEDGER=1` (utils/ledger.py): the ledger
+   must be populated (≥1 program carrying compile seconds, FLOPs, and
+   a call count), `analysis ledger-diff` of the persisted ledger
+   against itself must exit 0, and a doctored copy with an injected
+   compile-seconds regression must exit 1 — the perf-regression gate
+   gating itself (docs/observability.md).
+
 Exit 0 on pass. Small enough for tier-1 (seconds, CPU-only) — this is a
 sanity gate, not a measurement; `python bench.py` owns the numbers.
 """
@@ -27,6 +35,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import tempfile
 
 
 def _crossing_gate() -> "tuple[dict, list[str]]":
@@ -106,8 +115,62 @@ def _crossing_gate() -> "tuple[dict, list[str]]":
     return fields, problems
 
 
+def _ledger_gate() -> "tuple[dict, list[str]]":
+    """Gate 3: the program ledger is populated and its regression diff
+    both passes clean documents and catches an injected regression."""
+    from kube_scheduler_simulator_tpu.analysis.__main__ import (
+        main as analysis_main,
+    )
+    from kube_scheduler_simulator_tpu.utils import ledger as ledger_mod
+
+    problems: list[str] = []
+    snap = ledger_mod.LEDGER.snapshot()
+    populated = [
+        p
+        for p in snap["programs"]
+        if p["compileSeconds"]["total"] > 0
+        and p["flops"] is not None
+        and p["calls"] >= 1
+    ]
+    if not populated:
+        problems.append(
+            "program ledger empty (KSS_PROGRAM_LEDGER armed, but no "
+            "program recorded compile seconds + FLOPs + calls)"
+        )
+    clean_rc = regressed_rc = -1
+    if populated:
+        tmp = tempfile.mkdtemp(prefix="kss-perf-smoke-ledger-")
+        base_path = os.path.join(tmp, "kss-program-ledger.json")
+        ledger_mod.LEDGER.persist(base_path)
+        clean_rc = analysis_main(["ledger-diff", base_path, base_path])
+        if clean_rc != 0:
+            problems.append(
+                f"ledger-diff of the ledger against itself exited {clean_rc}"
+            )
+        doc = ledger_mod.load_ledger(base_path)
+        bad_path = os.path.join(tmp, "regressed.json")
+        bad = json.loads(json.dumps(doc))
+        bad["programs"][0]["compileSeconds"]["total"] += 50.0
+        with open(bad_path, "w") as f:
+            json.dump(bad, f)
+        regressed_rc = analysis_main(["ledger-diff", base_path, bad_path])
+        if regressed_rc != 1:
+            problems.append(
+                f"injected compile-seconds regression was not flagged "
+                f"(ledger-diff exited {regressed_rc}, expected 1)"
+            )
+    fields = {
+        "ledger_programs": len(snap["programs"]),
+        "ledger_diff_clean_rc": clean_rc,
+        "ledger_diff_regressed_rc": regressed_rc,
+    }
+    return fields, problems
+
+
 def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # gate 3 needs the ledger armed for every engine the smoke builds
+    os.environ["KSS_PROGRAM_LEDGER"] = "1"
     # runnable from a bare checkout: the package lives at the repo root
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     from kube_scheduler_simulator_tpu.lifecycle.engine import LifecycleEngine
@@ -189,6 +252,7 @@ def main() -> int:
     phases = snap.get("phases", {})
     wall = result["wallSeconds"]
     crossing_fields, crossing_problems = _crossing_gate()
+    ledger_fields, ledger_problems = _ledger_gate()
     line = {
         "config": "perf_smoke",
         "phase": result["phase"],
@@ -203,9 +267,10 @@ def main() -> int:
         "execute_s": phases.get("executeSeconds", 0.0),
         "pipeline": "async",
         **crossing_fields,
+        **ledger_fields,
     }
     print(json.dumps(line), flush=True)
-    problems = list(crossing_problems)
+    problems = list(crossing_problems) + list(ledger_problems)
     if result["phase"] != "Succeeded":
         problems.append(f"run phase {result['phase']!r}")
     if result["pods"]["arrived"] < 10:
