@@ -407,6 +407,14 @@ def _lifecycle_probe(events: int = 300, n_nodes: int = 64, seed_pods: int = 500)
     SIGKILL) — a child holding an in-flight accelerator compile must
     never be killable that way (the round-4 tunnel-wedge postmortem)."""
     _os.environ["JAX_PLATFORMS"] = "cpu"
+    # the fleet & memory observatory rides the probe (docs/
+    # observability.md): peak HBM bytes + the end-of-run fragmentation
+    # index join the headline so BENCH_r* files carry a memory
+    # trajectory. Sampled every 8th pass — placements are
+    # sampling-invariant (test-pinned), and the cadence keeps the
+    # per-pass host fetch out of the throughput number's noise floor.
+    _os.environ.setdefault("KSS_FLEET_STATS", "1")
+    _os.environ.setdefault("KSS_FLEET_SAMPLE", "8")
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -524,6 +532,26 @@ def _lifecycle_probe(events: int = 300, n_nodes: int = 64, seed_pods: int = 500)
         "degraded_passes": phases["degradedPasses"],
         "broker_worker_crashes": phases["brokerWorkerCrashes"],
     }
+    # the memory trajectory (utils/fleetstats.py): peak device bytes
+    # across the run's samples (allocator stats when the backend
+    # reports them, the live-buffer census on CPU) and the end-of-run
+    # fragmentation index + pending depth
+    from kube_scheduler_simulator_tpu.utils import fleetstats
+
+    frec = fleetstats.active()
+    samples = frec.snapshot() if frec is not None else []
+    if samples:
+        peaks = [
+            s["hbm"].get("peakBytesInUse")
+            or s["hbm"].get("bytesInUse")
+            or s.get("buffers", {}).get("liveBytes", 0)
+            for s in samples
+        ]
+        last = samples[-1]
+        line["fleet_samples"] = frec.emitted
+        line["peak_hbm_bytes"] = max(peaks)
+        line["fragmentation_index"] = last["fleet"]["fragmentationIndex"]
+        line["pending_pods_end"] = last["fleet"]["pendingPods"]
     # flight-recorder accounting when the probe ran under KSS_TRACE=1
     # (off by default: the headline number must measure the untraced
     # serving path — docs/observability.md)
@@ -1292,6 +1320,17 @@ def main(profile_dir: "str | None" = None):
                 # delta/full encode counters (docs/performance.md)
                 "lifecycle": life
                 or {"error": "probe did not complete in its window"},
+                # the memory trajectory hoisted to the headline (the
+                # fleet & memory observatory, docs/observability.md):
+                # peak device bytes over the churn run and how
+                # shattered free capacity ended up
+                "memory": {
+                    "peakHbmBytes": life.get("peak_hbm_bytes"),
+                    "fragmentationIndex": life.get("fragmentation_index"),
+                    "fleetSamples": life.get("fleet_samples"),
+                }
+                if life
+                else None,
                 # cold-process boot → first scheduled pod, with the
                 # bootProbe/firstEncode/firstCompile/firstPass phase
                 # walls (utils/ledger.py cold-start accounting)

@@ -26,6 +26,11 @@ typed store — SURVEY.md §2 #3):
     POST               /api/v1/debug/profile arm/disarm a jax.profiler capture
     GET                /api/v1/events        live telemetry SSE stream
                                              (docs/observability.md)
+    GET                /api/v1/timeseries    fleet & memory observatory
+                                             sample window (per-pass HBM
+                                             + cluster-quality samples,
+                                             utils/fleetstats.py;
+                                             KSS_FLEET_STATS=1)
     POST               /api/v1/lifecycle     run a ChaosSpec chaos timeline
                                              (lifecycle/engine.py, isolated store)
     GET                /api/v1/lifecycle/trace   last run's JSONL event trace
@@ -83,7 +88,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from ..models.store import KINDS, NAMESPACED, StaleResourceVersion
-from ..utils import locking
+from ..utils import fleetstats, locking
 from ..utils import ledger as ledger_mod
 from ..utils import metrics as metrics_mod
 from ..utils import telemetry
@@ -656,6 +661,49 @@ def _make_handler(server: SimulatorServer):
                 doc = ledger_mod.LEDGER.snapshot(session=sid)
                 doc["enabled"] = ledger_mod.ledger_enabled()
                 return self._json(200, doc)
+            if rest == ["timeseries"] and method == "GET":
+                # the fleet & memory observatory's sample window
+                # (utils/fleetstats.py, docs/observability.md): per-pass
+                # device-HBM + cluster-quality samples from the bounded
+                # ring. Nested session routes (and ?session= on the
+                # legacy route) filter to one tenant's samples; ?limit=N
+                # keeps the last N, ?sinceSeq=K resumes past a seen
+                # sequence number. Unarmed servers answer an empty (but
+                # honest) document.
+                q = parse_qs(url.query)
+                session_filter = sid or q.get("session", [None])[0]
+                rec = fleetstats.active()
+                samples = rec.snapshot() if rec is not None else []
+                if session_filter is not None:
+                    samples = [
+                        s
+                        for s in samples
+                        if s.get("session") == session_filter
+                    ]
+                for param, key in (("sinceSeq", "since"), ("limit", "limit")):
+                    raw = q.get(param, [None])[0]
+                    if raw is None:
+                        continue
+                    try:
+                        n = int(raw)
+                    except ValueError:
+                        return self._error(
+                            400, f"{param} must be an integer, got {raw!r}"
+                        )
+                    if key == "since":
+                        samples = [s for s in samples if s["seq"] > n]
+                    elif n >= 0:
+                        samples = samples[-n:] if n else []
+                return self._json(
+                    200,
+                    {
+                        "enabled": rec is not None,
+                        "capacity": rec.capacity if rec is not None else 0,
+                        "emitted": rec.emitted if rec is not None else 0,
+                        "dropped": rec.dropped if rec is not None else 0,
+                        "samples": samples,
+                    },
+                )
             if rest == ["debug", "profile"] and method == "POST":
                 return self._debug_profile(self._body() or {})
             if rest == ["events"] and method == "GET":
@@ -1097,6 +1145,10 @@ def _make_handler(server: SimulatorServer):
                 # series per (program, fingerprint) — utils/ledger.py);
                 # empty string while the ledger has recorded nothing
                 text += ledger_mod.LEDGER.render_prometheus()
+                # the fleet observatory families (kss_device_hbm_* /
+                # kss_fleet_*, utils/fleetstats.py) from the freshest
+                # samples; empty while stats are off or unsampled
+                text += fleetstats.render_prometheus()
                 body = text.encode()
                 self.send_response(200)
                 self._cors_headers()
@@ -1145,6 +1197,7 @@ def _make_handler(server: SimulatorServer):
                     headers={"Retry-After": str(DEGRADED_RETRY_AFTER_S)},
                 )
             rec = telemetry.active()
+            fleet_rec = fleetstats.active()
             # bounded feed: a slow/stalled client must not accumulate
             # every span the process emits (the unbounded growth the
             # ring buffer exists to prevent) — past the bound the
@@ -1161,13 +1214,32 @@ def _make_handler(server: SimulatorServer):
                 ):
                     return  # another tenant's span: filtered, not a drop
                 try:
-                    events.put_nowait(ev)
+                    events.put_nowait(("span", ev))
+                except queue.Full:
+                    server.sse_count_drop()
+                    overflowed.set()
+
+            def fleet_feed(sample: dict) -> None:
+                # the fleet observatory's samples ride the same stream
+                # as `fleet` events (docs/observability.md) — the
+                # dashboard's Observability-tab sparkline source
+                if overflowed.is_set():
+                    return
+                if (
+                    session_filter is not None
+                    and sample.get("session") != session_filter
+                ):
+                    return
+                try:
+                    events.put_nowait(("fleet", sample))
                 except queue.Full:
                     server.sse_count_drop()
                     overflowed.set()
 
             if rec is not None:
                 rec.subscribe(feed)
+            if fleet_rec is not None:
+                fleet_rec.subscribe(fleet_feed)
             try:
                 self.send_response(200)
                 self._cors_headers()
@@ -1211,7 +1283,7 @@ def _make_handler(server: SimulatorServer):
                             idle = 0
                     if ev is not None:
                         idle = 0
-                        push("span", ev)
+                        push(*ev)
                         continue
                     idle += 1
                     if idle >= 15:
@@ -1227,6 +1299,8 @@ def _make_handler(server: SimulatorServer):
             finally:
                 if rec is not None:
                     rec.unsubscribe(feed)
+                if fleet_rec is not None:
+                    fleet_rec.unsubscribe(fleet_feed)
                 server.sse_release()
 
         # -- watch stream ---------------------------------------------------

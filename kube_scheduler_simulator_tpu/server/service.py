@@ -47,7 +47,7 @@ from ..sched.config import SchedulerConfiguration
 from ..sched.extender import ExtenderService
 from ..sched.results import PodSchedulingResult
 from ..utils import devices as devices_mod
-from ..utils import faultinject, locking
+from ..utils import faultinject, fleetstats, locking
 from ..utils import ledger as ledger_mod
 from ..utils import metrics as metrics_mod
 from ..utils import telemetry
@@ -639,6 +639,22 @@ class SchedulerService:
         self._engage_cpu_failover(last)
         return self._run_rung(once)
 
+    def _fleet_sample(self, enc, state, mode: str) -> None:
+        """One fleet-observatory sample over this pass's encoded
+        tensors + final engine state (utils/fleetstats.py): per-device
+        HBM, the live-buffer census, and the jitted cluster-quality
+        reductions. Read-only over the pass's arrays — placements are
+        byte-identical with stats on or off (sampling-invariance,
+        test-pinned) — and never-raise: observability must not fail a
+        pass. No-op unless KSS_FLEET_STATS armed a recorder."""
+        rec = fleetstats.active()
+        if rec is None:
+            return
+        try:
+            rec.sample_pass(self, enc, state, mode)
+        except Exception:  # noqa: BLE001 — a failed sample never fails a pass
+            pass
+
     def _eager_fallback(self, build, err: Exception):
         """The degradation ladder's last rung (docs/resilience.md): run
         the SAME engine pass un-jitted. Inside `eager_execution`,
@@ -814,6 +830,7 @@ class SchedulerService:
         self.metrics.record_phase_seconds(
             decode=time.perf_counter() - t_decode
         )
+        self._fleet_sample(enc, gang._final_state, "gang")
         return placements, rounds, results
 
     def _encode_current(self, config) -> "object | None":
@@ -1266,6 +1283,11 @@ class SchedulerService:
             ext_service.delete_data(res.pod_namespace, res.pod_name)
         self.metrics.record_phase_seconds(
             decode=time.perf_counter() - t_decode
+        )
+        self._fleet_sample(
+            enc,
+            engine.final_state if kind == "ext" else engine._final_state,
+            "extender" if kind == "ext" else "sequential",
         )
         return results
 

@@ -49,6 +49,7 @@ import secrets
 import tempfile
 import threading
 import time
+import weakref
 from contextlib import contextmanager
 
 from ..lifecycle.checkpoint import (
@@ -56,7 +57,7 @@ from ..lifecycle.checkpoint import (
     load_checkpoint,
     write_checkpoint,
 )
-from ..utils import faultinject, locking
+from ..utils import faultinject, fleetstats, locking
 from ..utils import ledger as ledger_mod
 from ..utils.broker import CompileBroker
 from .service import SchedulerServiceDisabled, SimulatorService
@@ -255,6 +256,19 @@ class SessionManager:
                 DEFAULT_SESSION_ID, DEFAULT_SESSION_ID, default_service
             )
         }
+        # the fleet observatory's census + Prometheus exposition read
+        # the known session ids through this hook (the most recent
+        # manager wins — one serving process owns one session plane;
+        # utils/fleetstats.py). Weakref-backed: a shut-down embedded
+        # server must not stay reachable — and its whole session plane
+        # with it — through a module-level global
+        manager_ref = weakref.ref(self)
+
+        def _known_session_ids() -> "list[str] | None":
+            mgr = manager_ref()
+            return None if mgr is None else mgr.session_ids()
+
+        fleetstats.set_session_provider(_known_session_ids)
         self._stop = threading.Event()
         self._sweeper: "threading.Thread | None" = None
         if self.idle_evict_s > 0:
@@ -347,6 +361,14 @@ class SessionManager:
                 )
                 if s.state == "live" and s.service is not None
             ]
+
+    def session_ids(self) -> "list[str]":
+        """Session ids known to the manager (live + evicted), read
+        under the manager lock — the fleet observatory's accessor (the
+        census counts them; the exposition drops series for ids no
+        longer here)."""
+        with self._lock:
+            return list(self._sessions)
 
     def is_draining(self) -> bool:
         """The drain flag, read under the manager lock — `draining` is
@@ -489,6 +511,10 @@ class SessionManager:
         # programs (and their compile cost) outlive the tenant, the
         # per-session labels must not (utils/ledger.py)
         ledger_mod.LEDGER.drop_session(sid)
+        # and its pending-age bookkeeping from the fleet observatory
+        # (utils/fleetstats.py) — first-seen stamps must not accumulate
+        # forever under session churn
+        fleetstats.drop_session(sid)
         if path and os.path.exists(path):
             os.unlink(path)
 

@@ -15,7 +15,11 @@ dependencies), consuming only the public API:
   * inspect per-pod scheduling results (the per-plugin filter/score
     tables from the result annotations),
   * trigger scheduling, edit the scheduler configuration,
-    export / import / reset.
+    export / import / reset,
+  * watch fleet health live (the Observability tab): sparklines over
+    `/api/v1/timeseries` (the fleet & memory observatory's retained
+    window, `KSS_FLEET_STATS=1`) fed by the `/api/v1/events` SSE
+    stream's `fleet` + `metrics` events — docs/observability.md.
 
 Routes consumed:
 
@@ -150,6 +154,12 @@ PAGE = """<!doctype html>
  .pill{display:inline-block;padding:0 .4rem;border-radius:.6rem;font-size:.75rem}
  .ok{background:#d9f2dd}.bad{background:#f8d7da}.pend{background:#fff3cd}
  .del{color:#b00;cursor:pointer}
+ #obspane{display:none}
+ .spark{display:inline-block;margin:.3rem .4rem .3rem 0;border:1px solid #ddd;
+   background:#fff;padding:.3rem .5rem;vertical-align:top}
+ .spark b{font-size:.75rem;display:block}
+ .spark .sv{font-size:.8rem;color:#357}
+ .hint{color:#888;font-size:.75rem}
 </style></head><body>
 <h1>kube-scheduler-simulator-tpu</h1>
 <div id="bar">
@@ -168,6 +178,15 @@ PAGE = """<!doctype html>
  <span id="count"></span>
 </div>
 <table id="grid"><thead></thead><tbody></tbody></table>
+<div id="obspane">
+ <button id="obsbtn" onclick="toggleObs()">Start live telemetry</button>
+ <span id="obsstat" class="hint"></span>
+ <div id="sparks"></div>
+ <div class="hint">sparklines: seeded from /api/v1/timeseries (the fleet
+ &amp; memory observatory's retained window, KSS_FLEET_STATS=1), then live
+ from the /api/v1/events SSE stream (<code>fleet</code> +
+ <code>metrics</code> events)</div>
+</div>
 <div id="editorpane">
  <b id="edtitle"></b><br>
  <textarea id="editor" spellcheck="false"></textarea><br>
@@ -257,10 +276,25 @@ function renderTabs(){
     b.onclick=()=>{activeKind=k; render();};
     t.appendChild(b);
   }
+  const ob=document.createElement('button');
+  ob.textContent='Observability';
+  if(activeKind==='__obs__') ob.className='active';
+  ob.onclick=()=>{activeKind='__obs__'; render();};
+  t.appendChild(ob);
 }
 function render(){
   bucketCache=null;
   renderTabs();
+  const obsActive=activeKind==='__obs__';
+  document.getElementById('obspane').style.display=obsActive?'block':'none';
+  document.getElementById('grid').style.display=obsActive?'none':'';
+  document.getElementById('newbtn').style.display=obsActive?'none':'';
+  if(obsActive){
+    document.getElementById('count').textContent='';
+    if(!obsSource) startObs();
+    drawSparks();
+    return;
+  }
   const spec=KINDS[activeKind];
   document.querySelector('#grid thead').innerHTML=
     '<tr>'+spec.cols.map(c=>`<th>${esc(c)}</th>`).join('')+'<th></th></tr>';
@@ -432,6 +466,100 @@ async function applyCfg(){
   if(r.ok) loadCfg();
 }
 function setStatus(s){document.getElementById('status').textContent=s;}
+// --- the Observability tab (docs/observability.md): sparklines seeded
+// from GET /api/v1/timeseries (the fleet & memory observatory's ring)
+// and fed live by the /api/v1/events SSE stream's `fleet` + `metrics`
+// events — cluster health as a time-series, not an end-of-run snapshot
+const OBS_SERIES={
+  pendingPods:{title:'pending pods'},
+  utilizationMax:{title:'node utilization (max)'},
+  utilizationMean:{title:'node utilization (mean)'},
+  fragmentationIndex:{title:'fragmentation index'},
+  hbmBytesInUse:{title:'device memory in use'},
+  decisionsPerSecond:{title:'decisions/s'},
+};
+const obsData={}; for(const k in OBS_SERIES) obsData[k]=[];
+const OBS_POINTS=120;
+let obsSource=null;
+let obsLastSeq=-1;  // dedupe: seed fetch vs live events may overlap
+function obsPush(k,v){
+  if(v===null||v===undefined||isNaN(v)) return;
+  const a=obsData[k]; a.push(Number(v)); if(a.length>OBS_POINTS) a.shift();
+}
+function obsFromFleet(s){
+  if(s.seq!==undefined){
+    if(s.seq<=obsLastSeq) return;
+    obsLastSeq=s.seq;
+  }
+  const f=s.fleet||{};
+  obsPush('pendingPods',f.pendingPods);
+  obsPush('utilizationMax',(f.utilization||{}).max);
+  obsPush('utilizationMean',(f.utilization||{}).mean);
+  obsPush('fragmentationIndex',f.fragmentationIndex);
+  const hbm=(s.hbm||{}).bytesInUse;
+  obsPush('hbmBytesInUse',hbm!==undefined?hbm:(s.buffers||{}).liveBytes);
+}
+function obsFromMetrics(m){obsPush('decisionsPerSecond',m.decisionsPerSecond);}
+function fmtVal(v){
+  if(Math.abs(v)>=1073741824) return (v/1073741824).toFixed(2)+' GiB';
+  if(Math.abs(v)>=1048576) return (v/1048576).toFixed(1)+' MiB';
+  if(Math.abs(v)<10&&v!==Math.round(v)) return v.toFixed(3);
+  return String(Math.round(v*100)/100);
+}
+function drawSparks(){
+  const host=document.getElementById('sparks');
+  for(const k in OBS_SERIES){
+    let box=document.getElementById('spark-'+k);
+    if(!box){
+      box=document.createElement('div'); box.className='spark';
+      box.id='spark-'+k;
+      box.innerHTML='<b></b><span class="sv"></span>'+
+        '<canvas width="180" height="42"></canvas>';
+      box.querySelector('b').textContent=OBS_SERIES[k].title;
+      host.appendChild(box);
+    }
+    const data=obsData[k];
+    box.querySelector('.sv').textContent=
+      data.length?fmtVal(data[data.length-1]):'\\u2013';
+    const c=box.querySelector('canvas'),g=c.getContext('2d');
+    g.clearRect(0,0,c.width,c.height);
+    if(data.length<2) continue;
+    const min=Math.min(...data),max=Math.max(...data),span=(max-min)||1;
+    g.strokeStyle='#47a'; g.lineWidth=1.2; g.beginPath();
+    data.forEach((v,i)=>{
+      const x=i*(c.width-2)/(OBS_POINTS-1)+1;
+      const y=c.height-3-((v-min)/span)*(c.height-6);
+      i?g.lineTo(x,y):g.moveTo(x,y);
+    });
+    g.stroke();
+  }
+}
+async function startObs(){
+  if(obsSource) return;
+  // connect FIRST, synchronously: the obsSource guard must hold before
+  // any await, or a re-click during the seed fetch leaks a second
+  // EventSource (one SSE subscriber slot each) and Stop is a no-op
+  obsSource=new EventSource('/api/v1/events');
+  obsSource.addEventListener('fleet',
+    ev=>{obsFromFleet(JSON.parse(ev.data)); drawSparks();});
+  obsSource.addEventListener('metrics',
+    ev=>{obsFromMetrics(JSON.parse(ev.data)); drawSparks();});
+  document.getElementById('obsbtn').textContent='Stop live telemetry';
+  try{  // seed history; the seq dedupe keeps live/seed points ordered
+    const r=await fetch('/api/v1/timeseries?limit='+OBS_POINTS);
+    const doc=await r.json();
+    (doc.samples||[]).forEach(obsFromFleet);
+    document.getElementById('obsstat').textContent=doc.enabled
+      ?`observatory armed \\u00b7 ${doc.emitted} samples recorded`
+      :'KSS_FLEET_STATS is off: fleet series idle, metrics series live';
+  }catch(e){document.getElementById('obsstat').textContent='timeseries: '+e;}
+  drawSparks();
+}
+function stopObs(){
+  if(obsSource){obsSource.close(); obsSource=null;}
+  document.getElementById('obsbtn').textContent='Start live telemetry';
+}
+function toggleObs(){obsSource?stopObs():startObs();}
 async function watch(){
   while(true){
     try{

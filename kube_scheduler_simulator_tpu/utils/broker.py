@@ -378,6 +378,9 @@ class CompileBroker:
         self.stall_seconds = 0.0
         self.compile_retries = 0
         self.worker_crashes = 0
+        # speculations skipped by the HBM headroom gate
+        # (KSS_SPEC_MEM_HEADROOM_BYTES, utils/fleetstats.py)
+        self.spec_mem_skips = 0
         _live_brokers.add(self)
 
     # -- accounting ---------------------------------------------------------
@@ -427,6 +430,7 @@ class CompileBroker:
                 "compileRetries": self.compile_retries,
                 "brokerWorkerCrashes": self.worker_crashes,
                 "scopedWorkerCrashes": sum(self._scoped_crashes.values()),
+                "speculationMemSkips": self.spec_mem_skips,
             }
 
     @staticmethod
@@ -796,6 +800,22 @@ class CompileBroker:
         speculativeCompiles count to the ARMING service's registry (on a
         shared broker, the session that armed the build)."""
         if not self.speculative:
+            return False
+        # the HBM headroom gate (utils/fleetstats.py, docs/
+        # observability.md): with KSS_SPEC_MEM_HEADROOM_BYTES set, a
+        # device whose free HBM is below the floor SKIPS speculation —
+        # a background build's XLA workspace must never be the
+        # allocation that OOMs a serving process. Counted + marked so
+        # memory-shed speculation is visible, never silent.
+        from . import fleetstats
+
+        if not fleetstats.speculation_memory_ok():
+            with self._lock:
+                self.spec_mem_skips += 1
+            telemetry.instant(
+                "compile.speculation_skipped", reason="hbm-headroom",
+                token=str(token),
+            )
             return False
         # the causal pass id + session of the ARMING request thread (and
         # its thread-locally scoped fault plane, the session bulkhead)

@@ -98,6 +98,15 @@ KNOWN: "dict[str, Validator]" = {
     # telemetry plane
     "KSS_TRACE": _bool_validator,
     "KSS_TRACE_RING_CAP": _int_validator(1),
+    # the fleet & memory observatory (utils/fleetstats.py): per-pass
+    # device-HBM + cluster-quality sampling into a bounded ring, served
+    # by GET /api/v1/timeseries / Prometheus gauges / the dashboard;
+    # SAMPLE records every Nth pass; HEADROOM_BYTES gates speculative
+    # compiles on free device memory
+    "KSS_FLEET_STATS": _bool_validator,
+    "KSS_FLEET_RING_CAP": _int_validator(1),
+    "KSS_FLEET_SAMPLE": _int_validator(1),
+    "KSS_SPEC_MEM_HEADROOM_BYTES": _int_validator(0),
     # run supervision
     "KSS_COMPILE_DEADLINE_S": _float_validator(0.0),
     "KSS_COMPILE_RETRIES": _int_validator(0),

@@ -1,0 +1,700 @@
+"""The fleet & memory observatory: per-device HBM accounting + per-pass
+cluster-quality time-series (docs/observability.md).
+
+PR 5 gave the serving stack spans (*where a millisecond went*) and PR 10
+per-program cost (*what a compile/dispatch costs*); this module supplies
+the third leg every training/inference stack has — live **resource**
+telemetry: device memory and fleet-quality gauges sampled once per
+scheduling pass, cheap enough to leave on, bounded by construction.
+
+Two sample halves:
+
+  * **device memory** — ``device.memory_stats()`` HBM bytes-in-use /
+    peak / limit per local device (None on backends without an
+    allocator report, e.g. CPU), plus a **live-buffer census** that
+    attributes retained device arrays to their owners: the
+    delta-encoder's retained encoding (`engine/delta.py` keeps the last
+    `EncodedCluster` on device), the broker's warm-engine executables
+    (estimated from the PR 10 ledger's ``memory_analysis`` bytes), the
+    process-wide ``jax.live_arrays()`` total, and the session count —
+    the answer to "who is holding the HBM" that ROADMAP #3's
+    multi-chip sharding decisions are otherwise blind to.
+
+  * **cluster quality** — jitted masked reductions over the pass's
+    already-encoded cluster tensors (`_quality`, routed through
+    ``broker.jit`` with a KSS7xx audit label so the program is
+    contract-checked like every other engine program): a per-node
+    utilization histogram, a **fragmentation index** per resource
+    (``1 - largest-free-block / total-free`` — 0 when one node could
+    absorb the fleet's whole slack, →1 as free capacity shatters into
+    unusably small shards), the pending-queue depth from the encoded
+    assignment, and host-side pending-age percentiles (first-seen
+    tracking per (session, pod)).
+
+Samples land in a bounded ring (`FleetRecorder` — the `SpanRecorder`
+pattern: short lock hold, subscribers notified outside the lock) and
+surface four ways: ``GET /api/v1/timeseries`` (windowed, per-session
+nested routes), the ``kss_device_hbm_*`` / ``kss_fleet_*`` Prometheus
+gauges, Perfetto counter tracks (``fleet.*`` / ``hbm.bytesInUse``), and
+the dashboard's Observability tab sparklines fed by the ``fleet`` SSE
+event (server/webui.py).
+
+One robustness consumer closes the loop: the broker's speculative
+compile worker calls `speculation_memory_ok()` before arming a
+background build — with ``KSS_SPEC_MEM_HEADROOM_BYTES`` set, a device
+whose free HBM is below the floor skips speculation instead of letting
+a background XLA allocation OOM a serving process.
+
+Off by default (``KSS_FLEET_STATS``), like every observer in this tree;
+when armed, a pass pays one warm jitted reduction + one small host
+fetch every ``KSS_FLEET_SAMPLE``-th pass, and placements are pinned
+byte-identical to a stats-off run (the ``KSS_PROGRAM_TIMING_SAMPLE``
+sampling-invariance precedent, tests/test_fleetstats.py).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+
+from . import locking, telemetry
+from .envcheck import TRUTHY as _TRUE
+
+ENV_VAR = "KSS_FLEET_STATS"
+CAP_VAR = "KSS_FLEET_RING_CAP"
+SAMPLE_VAR = "KSS_FLEET_SAMPLE"
+HEADROOM_VAR = "KSS_SPEC_MEM_HEADROOM_BYTES"
+
+DEFAULT_RING_CAP = 1024
+
+# per-node utilization histogram bins: [0, 0.1) ... [0.9, 1.0]
+UTIL_BINS = 10
+
+
+def _lenient_int(raw: str, default: int, minimum: int) -> int:
+    """The shared lenient-knob parse: a typo must never disable the
+    observatory or blow a bound (the telemetry ring-cap contract)."""
+    try:
+        v = int(raw) if raw else default
+    except ValueError:
+        return default
+    return v if v >= minimum else default
+
+
+def ring_capacity_from_env() -> int:
+    return _lenient_int(os.environ.get(CAP_VAR, ""), DEFAULT_RING_CAP, 1)
+
+
+def sample_every_from_env() -> int:
+    """Sample cadence from KSS_FLEET_SAMPLE: record every Nth pass
+    (default 1 — every pass; the quality reduction is one warm program
+    plus a small host fetch)."""
+    return _lenient_int(os.environ.get(SAMPLE_VAR, ""), 1, 1)
+
+
+def spec_mem_headroom_bytes() -> int:
+    """The speculation HBM floor from KSS_SPEC_MEM_HEADROOM_BYTES:
+    0 (the default) disables the gate — speculation arms regardless of
+    memory pressure, the historical behavior."""
+    return _lenient_int(os.environ.get(HEADROOM_VAR, ""), 0, 0)
+
+
+# -- device memory -------------------------------------------------------------
+
+
+def device_memory(devices=None) -> "list[dict]":
+    """Per-device allocator stats: ``{"id", "platform", "bytesInUse",
+    "peakBytesInUse", "bytesLimit"}`` — byte fields present only when
+    the backend reports them (`device.memory_stats()` answers None on
+    CPU). Never raises: a dead backend yields an empty list, not a
+    failed sample."""
+    try:
+        if devices is None:
+            devices = jax.local_devices()
+    except Exception:  # noqa: BLE001 — a dead backend still has a sample
+        return []
+    out: list[dict] = []
+    for d in devices:
+        entry: dict = {
+            "id": int(getattr(d, "id", len(out))),
+            "platform": str(getattr(d, "platform", "")),
+        }
+        try:
+            stats = d.memory_stats()
+        except Exception:  # noqa: BLE001 — optional per backend
+            stats = None
+        if stats:
+            if stats.get("bytes_in_use") is not None:
+                entry["bytesInUse"] = int(stats["bytes_in_use"])
+            if stats.get("peak_bytes_in_use") is not None:
+                entry["peakBytesInUse"] = int(stats["peak_bytes_in_use"])
+            limit = stats.get("bytes_limit")
+            if limit is None:
+                limit = stats.get("bytes_reservable_limit")
+            if limit is not None:
+                entry["bytesLimit"] = int(limit)
+        out.append(entry)
+    return out
+
+
+def hbm_headroom_bytes() -> "int | None":
+    """The tightest device's free HBM — min over devices of
+    (bytesLimit - bytesInUse) — or None when no device reports both
+    (CPU backends): the speculation gate cannot block what it cannot
+    measure."""
+    head: "list[int]" = []
+    for d in device_memory():
+        if "bytesLimit" in d and "bytesInUse" in d:
+            head.append(d["bytesLimit"] - d["bytesInUse"])
+    return min(head) if head else None
+
+
+def speculation_memory_ok() -> bool:
+    """The broker's pre-arm check (utils/broker.py): False when
+    KSS_SPEC_MEM_HEADROOM_BYTES is set and some device's free HBM is
+    below it — a background compile's workspace must never be the
+    allocation that OOMs a serving process. Unmeasurable headroom
+    (no allocator stats) passes: the gate is a guard, not a jailer."""
+    need = spec_mem_headroom_bytes()
+    if need <= 0:
+        return True
+    head = hbm_headroom_bytes()
+    return head is None or head >= need
+
+
+# -- the live-buffer census ----------------------------------------------------
+
+# the session plane registers its id lister here (server/sessions.py)
+# so the census can report "how many tenants share this memory" and the
+# Prometheus exposition can drop deleted tenants' series, without the
+# utils layer importing the server; None until a SessionManager exists
+_session_ids_fn = None
+
+
+def set_session_provider(fn) -> None:
+    """Register the known-session-id lister (the SessionManager's; the
+    most recent manager wins — one serving process owns one plane).
+    `fn()` answers an iterable of session ids, or None when the plane
+    is gone (the manager registers a weakref-backed closure so a
+    shut-down server never stays reachable through this hook)."""
+    global _session_ids_fn
+    _session_ids_fn = fn
+
+
+def known_sessions() -> "set[str] | None":
+    """The session plane's known ids, or None when no plane is
+    registered (standalone services, tests) — callers treat None as
+    "no filter", never as an empty plane."""
+    fn = _session_ids_fn
+    if fn is None:
+        return None
+    try:
+        ids = fn()
+    except Exception:  # noqa: BLE001 — census is best-effort
+        return None
+    return None if ids is None else {str(s) for s in ids}
+
+
+def _tree_bytes(obj) -> int:
+    """Total device bytes of a pytree's array leaves (the retained
+    encoding census)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(obj):
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is not None:
+            total += int(nbytes)
+    return total
+
+
+def buffer_census(service=None) -> dict:
+    """Attribute retained device memory to its owners: the process-wide
+    ``jax.live_arrays()`` total, the delta-encoder's retained encoding
+    (when `service` is given), the broker's warm-engine count plus the
+    ledger's memory-analysis byte estimate of their executables, and
+    the session count. Every field is best-effort — the census
+    describes memory, it must never hold any of it hostage."""
+    out: dict = {}
+    try:
+        live = jax.live_arrays()
+        out["liveArrays"] = len(live)
+        out["liveBytes"] = sum(
+            int(getattr(a, "nbytes", 0) or 0) for a in live
+        )
+    except Exception:  # noqa: BLE001 — census is optional per backend
+        pass
+    if service is not None:
+        try:
+            st = service._delta._st
+            if st is not None:
+                out["deltaRetainedBytes"] = _tree_bytes(
+                    (st.enc.arrays, st.enc.state0)
+                )
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            out["warmEngines"] = service.broker.health()["warmEngines"]
+        except Exception:  # noqa: BLE001
+            pass
+    try:
+        from . import ledger as ledger_mod
+
+        mem = ledger_mod.LEDGER.memory_bytes_total()
+        if mem is not None:
+            out["ledgerMemoryBytes"] = mem
+    except Exception:  # noqa: BLE001
+        pass
+    known = known_sessions()
+    if known is not None:
+        out["sessions"] = len(known)
+    return out
+
+
+# -- the cluster-quality program -----------------------------------------------
+
+
+def _quality(node_alloc, node_mask, requested, assignment, pod_mask):
+    """Masked reductions over one pass's encoded cluster tensors —
+    pure array code (KSS3xx), traced once per shape bucket:
+
+      * per-node utilization = max over resources of requested/alloc
+        (the dominant-resource view), histogrammed into UTIL_BINS;
+      * fragmentation index per resource: 1 - largest-free-block /
+        total-free — how shattered the fleet's slack is;
+      * pending depth: real pods with no assignment.
+    """
+    f = jnp.float32
+    alloc = jnp.asarray(node_alloc, f)
+    used = jnp.asarray(requested, f)
+    nmask = jnp.asarray(node_mask, bool)
+    has = (alloc > 0) & nmask[:, None]
+    ratio = jnp.where(has, used / jnp.maximum(alloc, 1.0), 0.0)
+    util = jnp.clip(jnp.max(ratio, axis=1), 0.0, 1.0)  # [N]
+    n_real = jnp.maximum(jnp.sum(nmask), 1).astype(f)
+    util_mean = jnp.sum(jnp.where(nmask, util, 0.0)) / n_real
+    util_max = jnp.max(jnp.where(nmask, util, 0.0))
+    bins = jnp.clip(
+        (util * UTIL_BINS).astype(jnp.int32), 0, UTIL_BINS - 1
+    )
+    onehot = (bins[:, None] == jnp.arange(UTIL_BINS)[None, :]) & nmask[:, None]
+    hist = jnp.sum(onehot, axis=0).astype(jnp.int32)
+    free = jnp.where(has, jnp.maximum(alloc - used, 0.0), 0.0)
+    largest = jnp.max(free, axis=0)  # [R]
+    total = jnp.sum(free, axis=0)
+    frag = jnp.where(
+        total > 0, 1.0 - largest / jnp.where(total > 0, total, 1.0), 0.0
+    )
+    pending = jnp.sum(
+        jnp.asarray(pod_mask, bool) & (assignment < 0)
+    ).astype(jnp.int32)
+    return hist, util_mean, util_max, frag, pending
+
+
+_quality_jit = None
+_quality_lock = locking.make_lock("fleet.jitwrap")
+
+
+def _quality_program():
+    """The jitted quality program, built once through `broker.jit` (the
+    KSS7xx audit + ledger hook; the jit's internal signature cache
+    handles shape-bucket reuse). Inside an eager-fallback pass the raw
+    function is returned WITHOUT caching — an eager build must never
+    poison the jitted slot (the delta-scatter precedent)."""
+    from . import broker as broker_mod
+
+    if broker_mod.eager_active():
+        return _quality
+    global _quality_jit
+    if _quality_jit is None:
+        with _quality_lock:
+            if _quality_jit is None:
+                _quality_jit = broker_mod.jit(
+                    _quality,
+                    audit={
+                        "label": "fleet.quality",
+                        # the histogram-bin axis is a static constant,
+                        # not a capacity bucket; N/P/R ride the normal
+                        # bucket check
+                        "exempt": lambda args, kwargs: (UTIL_BINS,),
+                        # inputs inherit the pass's dtype policy — under
+                        # EXACT they are legitimately 64-bit (the
+                        # reductions themselves compute in f32)
+                        "allow_f64": True,
+                    },
+                )
+    return _quality_jit
+
+
+def _percentile(sorted_vals: "list[float]", q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = int(round(q * (len(sorted_vals) - 1)))
+    return sorted_vals[idx]
+
+
+# -- the sample ring -----------------------------------------------------------
+
+
+@locking.guard_inferred
+class FleetRecorder:
+    """A bounded ring of fleet samples + live subscribers — the
+    `SpanRecorder` shape: `push` holds the lock only to place the
+    sample and advance the sequence; subscriber callbacks (the SSE
+    route's `fleet` event feed) run OUTSIDE the lock."""
+
+    def __init__(self, capacity: "int | None" = None):
+        cap = ring_capacity_from_env() if capacity is None else int(capacity)
+        if cap < 1:
+            raise ValueError(f"ring capacity must be >= 1, got {cap}")
+        self.capacity = cap
+        self._lock = locking.make_lock("fleet.ring")
+        self._ring: "list[dict | None]" = [None] * cap
+        self._seq = 0
+        self._subs: list = []
+        # per-recorder sampling cadence state (KSS_FLEET_SAMPLE)
+        self._pass_count = 0
+        # (session, ns, name) -> monotonic first-seen-pending stamp:
+        # the pending-age percentile source
+        self._pending_seen: "dict[tuple, float]" = {}
+
+    # -- writing ------------------------------------------------------------
+
+    def push(self, sample: dict) -> None:
+        with self._lock:
+            sample = dict(sample)
+            sample["seq"] = self._seq
+            self._ring[self._seq % self.capacity] = sample
+            self._seq += 1
+            subs = tuple(self._subs) if self._subs else ()
+        for fn in subs:
+            try:
+                fn(sample)
+            except Exception:  # noqa: BLE001 — a dead subscriber never breaks a pass
+                pass
+
+    # -- reading ------------------------------------------------------------
+
+    @property
+    def emitted(self) -> int:
+        with self._lock:
+            return self._seq
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return max(0, self._seq - self.capacity)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return min(self._seq, self.capacity)
+
+    def snapshot(self) -> "list[dict]":
+        with self._lock:
+            n = self._seq
+            if n <= self.capacity:
+                return list(self._ring[:n])
+            i = n % self.capacity
+            return self._ring[i:] + self._ring[:i]
+
+    def drop_session(self, sid: str) -> None:
+        """Purge a deleted session's pending-age bookkeeping (the
+        session-plane DELETE path) — a dead tenant's first-seen stamps
+        must not accumulate forever under session churn. Its historical
+        ring samples stay: the time-series records what happened; the
+        Prometheus exposition separately drops dead tenants via
+        `known_sessions`."""
+        with self._lock:
+            for key in [k for k in self._pending_seen if k[0] == sid]:
+                del self._pending_seen[key]
+
+    def subscribe(self, fn) -> None:
+        with self._lock:
+            self._subs.append(fn)
+
+    def unsubscribe(self, fn) -> None:
+        with self._lock:
+            try:
+                self._subs.remove(fn)
+            except ValueError:
+                pass
+
+    # -- the per-pass sampler -----------------------------------------------
+
+    def sample_pass(self, service, enc, state, mode: str) -> "dict | None":
+        """One per-pass sample over the pass's encoded tensors + final
+        state (server/service.py calls this from the pass finish paths,
+        inside the never-raise `_fleet_sample` guard). Honors the
+        KSS_FLEET_SAMPLE cadence; returns the sample, or None when this
+        pass was skipped. Read-only over the pass's arrays — placements
+        are sampling-invariant by construction (test-pinned)."""
+        with self._lock:
+            self._pass_count += 1
+            if (self._pass_count - 1) % sample_every_from_env():
+                return None
+        outs = _quality_program()(
+            enc.arrays.node_alloc,
+            enc.arrays.node_mask,
+            state.requested,
+            state.assignment,
+            enc.arrays.pod_mask,
+        )
+        hist, util_mean, util_max, frag, pending, assignment = jax.device_get(
+            (*outs, state.assignment)
+        )
+        session = service.session_id or "default"
+        ages = self._pending_ages(session, enc, assignment)
+        frag_by_res = {
+            name: round(float(frag[i]), 6)
+            for i, name in enumerate(enc.resource_names)
+            if i < len(frag)
+        }
+        frag_index = round(max(frag_by_res.values(), default=0.0), 6)
+        devices = device_memory()
+        hbm: dict = {}
+        for key in ("bytesInUse", "peakBytesInUse", "bytesLimit"):
+            vals = [d[key] for d in devices if key in d]
+            if vals:
+                hbm[key] = sum(vals)
+        sample = {
+            "wallTime": round(time.time(), 3),
+            "passId": telemetry.current_pass_id(),
+            "session": session,
+            "mode": mode,
+            "devices": devices,
+            "hbm": hbm,
+            "buffers": buffer_census(service),
+            "fleet": {
+                "nodes": enc.n_nodes,
+                "pendingPods": int(pending),
+                "utilization": {
+                    "mean": round(float(util_mean), 6),
+                    "max": round(float(util_max), 6),
+                    "histogram": [int(x) for x in hist],
+                },
+                "fragmentation": frag_by_res,
+                "fragmentationIndex": frag_index,
+                "pendingAges": ages,
+            },
+        }
+        self.push(sample)
+        # Perfetto counter tracks (no-op when tracing is off): the
+        # fleet gauges next to the pass spans that moved them
+        telemetry.counter("fleet.pendingPods", int(pending))
+        telemetry.counter("fleet.utilizationMax", float(util_max))
+        telemetry.counter("fleet.fragmentationIndex", frag_index)
+        mem = hbm.get("bytesInUse", sample["buffers"].get("liveBytes"))
+        if mem is not None:
+            telemetry.counter("hbm.bytesInUse", float(mem))
+        return sample
+
+    def _pending_ages(self, session: str, enc, assignment) -> dict:
+        """Pending-age percentiles from first-seen tracking: a pod
+        enters the map the first sample it appears pending (keyed by
+        session so tenants never alias) and leaves when it binds or
+        vanishes."""
+        now = time.monotonic()
+        pending_keys = {
+            (session, *enc.pod_keys[p])
+            for p in range(enc.n_pods)
+            if int(assignment[p]) < 0
+        }
+        with self._lock:
+            for key in [
+                k
+                for k in self._pending_seen
+                if k[0] == session and k not in pending_keys
+            ]:
+                del self._pending_seen[key]
+            ages = sorted(
+                now - self._pending_seen.setdefault(key, now)
+                for key in pending_keys
+            )
+        return {
+            "count": len(ages),
+            "p50Seconds": round(_percentile(ages, 0.5), 6),
+            "p90Seconds": round(_percentile(ages, 0.9), 6),
+            "maxSeconds": round(ages[-1], 6) if ages else 0.0,
+        }
+
+
+# -- the process-global active recorder ---------------------------------------
+
+_lock = locking.make_lock("fleet.config")
+# (KSS_FLEET_STATS, KSS_FLEET_RING_CAP) raw strings -> recorder; the
+# same lock-free fast path as telemetry.active(): both globals hold one
+# immutable tuple swapped whole under the GIL
+_cached: "tuple[tuple[str, str], FleetRecorder | None] | None" = None
+_override_state: "tuple[bool, FleetRecorder | None]" = (False, None)
+
+
+def active() -> "FleetRecorder | None":
+    """The active fleet recorder, or None (the default: stats off).
+    Re-reads KSS_FLEET_STATS / KSS_FLEET_RING_CAP per call but rebuilds
+    only when the raw strings change — the disabled path is two dict
+    probes and a tuple compare."""
+    global _cached
+    overridden, override = _override_state
+    if overridden:
+        return override
+    key = (os.environ.get(ENV_VAR, ""), os.environ.get(CAP_VAR, ""))
+    cached = _cached
+    if cached is not None and cached[0] == key:
+        return cached[1]
+    with _lock:
+        overridden, override = _override_state
+        if overridden:
+            return override
+        cached = _cached
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        rec = (
+            FleetRecorder(ring_capacity_from_env())
+            if key[0].strip().lower() in _TRUE
+            else None
+        )
+        _cached = (key, rec)
+        return rec
+
+
+def enabled() -> bool:
+    return active() is not None
+
+
+def activate(recorder: "FleetRecorder | None") -> None:
+    """Install `recorder` regardless of the environment (None = stats
+    explicitly off) until `deactivate` — tests and the smoke tooling."""
+    global _override_state
+    with _lock:
+        _override_state = (True, recorder)
+
+
+def deactivate() -> None:
+    global _override_state
+    with _lock:
+        _override_state = (False, None)
+
+
+def drop_session(sid: str) -> None:
+    """Forward a session deletion to the active recorder's bookkeeping
+    (the session plane's DELETE path, next to the ledger's
+    `drop_session`); no-op with stats off."""
+    rec = active()
+    if rec is not None:
+        rec.drop_session(sid)
+
+
+# -- Prometheus exposition -----------------------------------------------------
+
+
+def render_prometheus(recorder: "FleetRecorder | None" = None) -> str:
+    """The ``kss_device_hbm_*`` / ``kss_fleet_*`` gauge families from
+    the recorder's freshest samples — device families from the latest
+    sample overall, fleet families one series per session (each
+    session's latest sample). Appended to the metrics exposition by the
+    serving layer (server/httpserver.py); empty string when stats are
+    off or nothing has been sampled yet."""
+    rec = active() if recorder is None else recorder
+    if rec is None:
+        return ""
+    samples = rec.snapshot()
+    if not samples:
+        return ""
+    from .metrics import _fmt_value
+
+    latest = samples[-1]
+    by_session: "dict[str, dict]" = {}
+    for s in samples:
+        by_session[s.get("session") or "default"] = s
+    lines: list[str] = []
+
+    def device_family(name: str, help_text: str, key: str) -> None:
+        rows = [
+            (d["id"], d[key]) for d in latest.get("devices", ()) if key in d
+        ]
+        if not rows:
+            return
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} gauge")
+        for dev_id, v in rows:
+            lines.append(f'{name}{{device="{dev_id}"}} {_fmt_value(v)}')
+
+    device_family(
+        "kss_device_hbm_bytes_in_use",
+        "Device memory in use (device.memory_stats bytes_in_use).",
+        "bytesInUse",
+    )
+    device_family(
+        "kss_device_hbm_peak_bytes",
+        "Peak device memory in use since process start.",
+        "peakBytesInUse",
+    )
+    device_family(
+        "kss_device_hbm_bytes_limit",
+        "Device memory limit reported by the allocator.",
+        "bytesLimit",
+    )
+
+    # dead tenants' series must not outlive them in the exposition: a
+    # deleted session's last sample lingers in the ring (history), but
+    # its frozen gauges would mislead alerting — filter to the session
+    # plane's known ids (no plane registered = no filter)
+    known = known_sessions()
+
+    def fleet_family(name: str, help_text: str, value_of) -> None:
+        rows = []
+        for sid in sorted(by_session):
+            if known is not None and sid not in known:
+                continue
+            v = value_of(by_session[sid])
+            if v is not None:
+                rows.append((sid, v))
+        if not rows:
+            return
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} gauge")
+        for sid, v in rows:
+            lines.append(f'{name}{{session="{sid}"}} {_fmt_value(v)}')
+
+    fleet_family(
+        "kss_fleet_pending_pods",
+        "Pending-queue depth at the session's last sampled pass.",
+        lambda s: s["fleet"]["pendingPods"],
+    )
+    fleet_family(
+        "kss_fleet_utilization_mean",
+        "Mean per-node dominant-resource utilization (last sample).",
+        lambda s: s["fleet"]["utilization"]["mean"],
+    )
+    fleet_family(
+        "kss_fleet_utilization_max",
+        "Max per-node dominant-resource utilization (last sample).",
+        lambda s: s["fleet"]["utilization"]["max"],
+    )
+    fleet_family(
+        "kss_fleet_fragmentation_index",
+        "1 - largest-free-block / total-free, worst resource "
+        "(last sample).",
+        lambda s: s["fleet"]["fragmentationIndex"],
+    )
+    def global_sample(name: str, mtype: str, help_text: str, value) -> None:
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {mtype}")
+        lines.append(f"{name} {_fmt_value(value)}")
+
+    live = latest.get("buffers", {}).get("liveBytes")
+    if live is not None:
+        global_sample(
+            "kss_fleet_live_buffer_bytes",
+            "gauge",
+            "Total bytes of live jax arrays (the buffer census).",
+            live,
+        )
+    global_sample(
+        "kss_fleet_samples_total",
+        "counter",
+        "Fleet samples recorded since the recorder was born.",
+        rec.emitted,
+    )
+    return "\n".join(lines) + "\n"

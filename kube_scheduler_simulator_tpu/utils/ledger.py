@@ -245,6 +245,20 @@ class ProgramLedger:
 
     # -- reading -------------------------------------------------------------
 
+    def memory_bytes_total(self) -> "int | None":
+        """Summed `memory_analysis` bytes across every recorded program
+        — the fleet observatory's estimate of what the broker's warm
+        executables hold (utils/fleetstats.py buffer census). None when
+        no program carries a memory model (the backend may expose
+        none); never zero-as-unknown."""
+        with self._lock:
+            vals = [
+                sum(rec.memory.values())
+                for rec in self._records.values()
+                if rec.memory
+            ]
+        return sum(vals) if vals else None
+
     def totals(self) -> dict:
         """The small summary block ``GET /api/v1/metrics`` embeds."""
         with self._lock:

@@ -35,6 +35,15 @@ for _var in (
     # zero-emission test would fail for the wrong reason)
     "KSS_TRACE",
     "KSS_TRACE_RING_CAP",
+    # the fleet & memory observatory (utils/fleetstats.py): ambient
+    # KSS_FLEET_STATS=1 would make every pass in the suite pay the
+    # quality reduction + host fetch, and an ambient headroom floor
+    # would silently veto the speculation tests; observatory tests arm
+    # these explicitly
+    "KSS_FLEET_STATS",
+    "KSS_FLEET_RING_CAP",
+    "KSS_FLEET_SAMPLE",
+    "KSS_SPEC_MEM_HEADROOM_BYTES",
     # the lock-order witness (utils/locking.py): an ambient
     # KSS_LOCK_CHECK=1 would wrap every lock the suite creates; the
     # witness tests arm it explicitly with monkeypatch

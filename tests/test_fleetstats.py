@@ -1,0 +1,465 @@
+"""The fleet & memory observatory (utils/fleetstats.py,
+docs/observability.md): the sample ring stays bounded under concurrent
+writers, a stats-off run emits nothing AND places byte-identically to a
+stats-on run (sampling invariance — the KSS_PROGRAM_TIMING_SAMPLE
+precedent), the serving surface exposes the samples
+(`GET /api/v1/timeseries`, the `kss_fleet_*`/`kss_device_hbm_*` gauges,
+the dashboard's Observability tab), and the broker's speculation
+headroom gate (`KSS_SPEC_MEM_HEADROOM_BYTES`) skips background builds
+when the devices report no room."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kube_scheduler_simulator_tpu.models.store import ResourceStore
+from kube_scheduler_simulator_tpu.server import SimulatorServer, SimulatorService
+from kube_scheduler_simulator_tpu.server.service import SchedulerService
+from kube_scheduler_simulator_tpu.utils import broker as broker_mod
+from kube_scheduler_simulator_tpu.utils import fleetstats
+from kube_scheduler_simulator_tpu.utils import metrics as metrics_mod
+from kube_scheduler_simulator_tpu.utils import telemetry
+
+from helpers import node, pod
+
+
+@pytest.fixture()
+def recorder():
+    rec = fleetstats.FleetRecorder(capacity=64)
+    fleetstats.activate(rec)
+    try:
+        yield rec
+    finally:
+        fleetstats.deactivate()
+
+
+def _store(n_nodes=2, n_pods=4) -> ResourceStore:
+    store = ResourceStore()
+    for i in range(n_nodes):
+        store.apply("nodes", node(f"fn{i}", cpu="4", mem="8Gi"))
+    for i in range(n_pods):
+        store.apply("pods", pod(f"fp{i}", cpu="100m"))
+    return store
+
+
+# -- the ring -----------------------------------------------------------------
+
+
+def test_ring_bounded_under_concurrent_writers():
+    rec = fleetstats.FleetRecorder(capacity=16)
+    threads = [
+        threading.Thread(
+            target=lambda k=k: [
+                rec.push({"session": f"s{k}", "fleet": {}, "i": i})
+                for i in range(200)
+            ]
+        )
+        for k in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert rec.emitted == 8 * 200
+    assert len(rec) == 16
+    assert rec.dropped == 8 * 200 - 16
+    window = rec.snapshot()
+    assert len(window) == 16
+    # seq stamps are the ring's order: the window is the newest suffix
+    seqs = [s["seq"] for s in window]
+    assert seqs == sorted(seqs)
+    assert seqs[-1] == 8 * 200 - 1
+
+
+def test_ring_capacity_and_cadence_env_parse(monkeypatch):
+    monkeypatch.setenv(fleetstats.CAP_VAR, "not-a-number")
+    assert fleetstats.ring_capacity_from_env() == fleetstats.DEFAULT_RING_CAP
+    monkeypatch.setenv(fleetstats.CAP_VAR, "-3")
+    assert fleetstats.ring_capacity_from_env() == fleetstats.DEFAULT_RING_CAP
+    monkeypatch.setenv(fleetstats.SAMPLE_VAR, "0")
+    assert fleetstats.sample_every_from_env() == 1
+    monkeypatch.setenv(fleetstats.SAMPLE_VAR, "4")
+    assert fleetstats.sample_every_from_env() == 4
+
+
+def test_subscribers_receive_samples_and_never_break_the_push():
+    rec = fleetstats.FleetRecorder(capacity=4)
+    seen: list = []
+    rec.subscribe(seen.append)
+    rec.subscribe(lambda s: 1 / 0)  # a dead subscriber must be contained
+    rec.push({"session": "default", "fleet": {}})
+    assert len(seen) == 1 and seen[0]["seq"] == 0
+
+
+# -- sampling -----------------------------------------------------------------
+
+
+def test_off_by_default_emits_nothing(monkeypatch):
+    monkeypatch.delenv(fleetstats.ENV_VAR, raising=False)
+    assert fleetstats.active() is None
+    svc = SchedulerService(_store())
+    placements, _, _ = svc.schedule_gang(record=False)
+    assert any(v for v in placements.values())
+    assert fleetstats.active() is None  # still off: nothing armed a ring
+
+
+def test_pass_sampling_populates_ring(recorder):
+    svc = SchedulerService(_store(n_nodes=2, n_pods=3))
+    svc.schedule_gang(record=False)
+    svc.store.apply("pods", pod("fp-late"))
+    svc.schedule()  # the sequential finish path samples too
+    assert recorder.emitted == 2
+    s = recorder.snapshot()[0]
+    assert s["session"] == "default"
+    assert s["mode"] == "gang"
+    assert s["passId"] == 1
+    fleet = s["fleet"]
+    assert fleet["nodes"] == 2
+    assert fleet["pendingPods"] == 0  # everything placed
+    assert sum(fleet["utilization"]["histogram"]) == 2  # one slot per node
+    assert 0.0 <= fleet["utilization"]["mean"] <= fleet["utilization"]["max"] <= 1.0
+    # two equally-loaded nodes split free capacity: the largest free
+    # block is half the total -> fragmentation index 0.5 per resource
+    assert fleet["fragmentationIndex"] == pytest.approx(0.5, abs=0.05)
+    assert "cpu" in fleet["fragmentation"]
+    buffers = s["buffers"]
+    assert buffers["liveBytes"] > 0
+    assert buffers["deltaRetainedBytes"] > 0
+    assert buffers["warmEngines"] >= 1
+    assert s["devices"], "device list must not be empty on a live backend"
+
+
+def test_sample_cadence_skips_passes(recorder, monkeypatch):
+    monkeypatch.setenv(fleetstats.SAMPLE_VAR, "3")
+    store = _store()
+    store.apply("pods", pod("never-fits", cpu="100"))  # stays pending
+    svc = SchedulerService(store)
+    for _ in range(4):
+        svc.schedule_gang(record=False)
+    # every pass reaches the finish path (the queue never empties);
+    # passes 1 and 4 sample, 2 and 3 skip the cadence
+    assert recorder.emitted == 2
+
+
+def test_pending_age_tracking_across_samples(recorder):
+    store = _store(n_nodes=1, n_pods=0)
+    store.apply("pods", pod("huge", cpu="100"))  # can never fit
+    svc = SchedulerService(store)
+    svc.schedule_gang(record=False)
+    svc.schedule_gang(record=False)
+    first, second = recorder.snapshot()
+    assert first["fleet"]["pendingPods"] == 1
+    ages1 = first["fleet"]["pendingAges"]
+    ages2 = second["fleet"]["pendingAges"]
+    assert ages1["count"] == ages2["count"] == 1
+    # the pod was first seen pending at sample 1: its age grows
+    assert ages2["maxSeconds"] >= ages1["maxSeconds"]
+
+
+def test_counter_tracks_emitted_when_tracing_on(recorder):
+    span_rec = telemetry.SpanRecorder(capacity=4096)
+    telemetry.activate(span_rec)
+    try:
+        svc = SchedulerService(_store())
+        svc.schedule_gang(record=False)
+    finally:
+        telemetry.deactivate()
+    counters = {
+        e["name"] for e in span_rec.snapshot() if e.get("ph") == "C"
+    }
+    assert {"fleet.pendingPods", "fleet.utilizationMax",
+            "fleet.fragmentationIndex"} <= counters
+
+
+# -- sampling invariance (the acceptance pin) ---------------------------------
+
+
+def _placements(armed: bool, monkeypatch) -> dict:
+    monkeypatch.delenv(fleetstats.ENV_VAR, raising=False)
+    monkeypatch.delenv(fleetstats.SAMPLE_VAR, raising=False)
+    if armed:
+        fleetstats.activate(fleetstats.FleetRecorder(capacity=64))
+    else:
+        fleetstats.activate(None)
+    try:
+        svc = SchedulerService(_store(n_nodes=3, n_pods=8))
+        placements, _, _ = svc.schedule_gang(record=False)
+        svc.store.apply("pods", pod("late-1", cpu="100m"))
+        second, _, _ = svc.schedule_gang(record=False)
+    finally:
+        fleetstats.deactivate()
+    return {**placements, **second}
+
+
+def test_stats_on_is_placement_invariant(monkeypatch):
+    off = _placements(False, monkeypatch)
+    on = _placements(True, monkeypatch)
+    assert off == on
+    assert any(v for v in off.values())
+
+
+# -- the speculation headroom gate --------------------------------------------
+
+
+def test_speculation_memory_ok_defaults_open(monkeypatch):
+    monkeypatch.delenv(fleetstats.HEADROOM_VAR, raising=False)
+    assert fleetstats.speculation_memory_ok()
+
+
+def test_headroom_gate_skips_speculation(monkeypatch):
+    b = broker_mod.CompileBroker(speculative=True)
+    monkeypatch.setenv(fleetstats.HEADROOM_VAR, str(1 << 30))
+    monkeypatch.setattr(fleetstats, "hbm_headroom_bytes", lambda: 1024)
+    assert b.speculate(("t", 1), lambda: None) is False
+    assert b.stats()["speculationMemSkips"] == 1
+    # room again: the same broker arms normally
+    monkeypatch.setattr(
+        fleetstats, "hbm_headroom_bytes", lambda: 4 << 30
+    )
+    assert b.speculate(("t", 2), lambda: None) is True
+    b.drain(timeout=10)
+
+
+def test_headroom_gate_passes_when_unmeasurable(monkeypatch):
+    # no allocator stats (CPU): the gate must not block what it cannot
+    # measure
+    monkeypatch.setenv(fleetstats.HEADROOM_VAR, str(1 << 30))
+    monkeypatch.setattr(fleetstats, "hbm_headroom_bytes", lambda: None)
+    assert fleetstats.speculation_memory_ok()
+
+
+# -- the serving surface ------------------------------------------------------
+
+
+def _get(port: int, path: str):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=300
+    ) as r:
+        return r.status, r.read().decode()
+
+
+@pytest.fixture()
+def armed_server(recorder):
+    server = SimulatorServer(SimulatorService(), port=0).start()
+    try:
+        server.service.store.apply("nodes", node("wn0"))
+        server.service.store.apply("nodes", node("wn1"))
+        server.service.store.apply("pods", pod("wp0"))
+        server.service.scheduler.schedule()
+        yield server
+    finally:
+        server.shutdown()
+
+
+def test_timeseries_route_serves_the_window(armed_server):
+    _, body = _get(armed_server.port, "/api/v1/timeseries")
+    doc = json.loads(body)
+    assert doc["enabled"] is True
+    assert doc["emitted"] >= 1
+    assert doc["samples"], "a scheduled pass must have produced a sample"
+    s = doc["samples"][-1]
+    assert s["session"] == "default"
+    assert "fleet" in s and "buffers" in s and "devices" in s
+    # windowing: limit keeps the newest suffix, sinceSeq resumes
+    _, body = _get(armed_server.port, "/api/v1/timeseries?limit=0")
+    assert json.loads(body)["samples"] == []
+    seq = s["seq"]
+    _, body = _get(
+        armed_server.port, f"/api/v1/timeseries?sinceSeq={seq}"
+    )
+    assert json.loads(body)["samples"] == []
+    status, _ = _get_error(
+        armed_server.port, "/api/v1/timeseries?limit=bogus"
+    )
+    assert status == 400
+
+
+def _get_error(port: int, path: str):
+    try:
+        return _get(port, path)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_timeseries_nested_session_route_filters(armed_server):
+    # a second session's pass lands its own labeled samples
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{armed_server.port}/api/v1/sessions",
+        data=json.dumps({"name": "tenant"}).encode(),
+        method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=300) as r:
+        sid = json.loads(r.read())["id"]
+    for kind, obj in (("nodes", node("tn0")), ("pods", pod("tp0"))):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{armed_server.port}"
+            f"/api/v1/sessions/{sid}/resources/{kind}",
+            data=json.dumps(obj).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        urllib.request.urlopen(req, timeout=300).read()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{armed_server.port}"
+        f"/api/v1/sessions/{sid}/schedule",
+        data=b"",
+        method="POST",
+    )
+    urllib.request.urlopen(req, timeout=300).read()
+    _, body = _get(
+        armed_server.port, f"/api/v1/sessions/{sid}/timeseries"
+    )
+    doc = json.loads(body)
+    assert doc["samples"]
+    assert all(s["session"] == sid for s in doc["samples"])
+    # the legacy route still carries every session's samples
+    _, body = _get(armed_server.port, "/api/v1/timeseries")
+    sessions = {s["session"] for s in json.loads(body)["samples"]}
+    assert {"default", sid} <= sessions
+
+
+def test_prometheus_gauges_render_and_parse(armed_server):
+    _, text = _get(armed_server.port, "/api/v1/metrics?format=prometheus")
+    families = metrics_mod.parse_prometheus_text(text)
+    for fam in (
+        "kss_fleet_pending_pods",
+        "kss_fleet_utilization_mean",
+        "kss_fleet_utilization_max",
+        "kss_fleet_fragmentation_index",
+        "kss_fleet_live_buffer_bytes",
+        "kss_fleet_samples_total",
+    ):
+        assert fam in families, f"{fam} missing from the exposition"
+    samples = families["kss_fleet_pending_pods"]["samples"]
+    assert any(labels.get("session") == "default" for _n, labels, _v in samples)
+
+
+def test_unarmed_server_answers_honest_empty_documents():
+    fleetstats.activate(None)
+    server = SimulatorServer(SimulatorService(), port=0).start()
+    try:
+        _, body = _get(server.port, "/api/v1/timeseries")
+        doc = json.loads(body)
+        assert doc == {
+            "enabled": False,
+            "capacity": 0,
+            "emitted": 0,
+            "dropped": 0,
+            "samples": [],
+        }
+        _, text = _get(server.port, "/api/v1/metrics?format=prometheus")
+        assert "kss_fleet_" not in text
+    finally:
+        server.shutdown()
+        fleetstats.deactivate()
+
+
+def test_dashboard_serves_the_observability_tab(armed_server):
+    _, html = _get(armed_server.port, "/")
+    assert "Observability" in html
+    assert "/api/v1/timeseries" in html
+    assert "/api/v1/events" in html
+    assert "obspane" in html and "drawSparks" in html
+
+
+def test_sse_stream_carries_fleet_events(armed_server):
+    import time
+
+    # a pending pod so the triggered pass is non-empty (empty passes
+    # never reach the finish path and sample nothing)
+    armed_server.service.store.apply("pods", pod("wp-sse"))
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{armed_server.port}/api/v1/events"
+    )
+    with urllib.request.urlopen(req, timeout=300) as r:
+        # drain the connect-time metrics event, then trigger a pass and
+        # expect its fleet sample on the stream
+        first = None
+        for _ in range(16):
+            line = r.readline().decode()
+            if line.startswith("event:"):
+                first = line.split(":", 1)[1].strip()
+                break
+        assert first == "metrics"
+        t = threading.Thread(
+            target=armed_server.service.scheduler.schedule, daemon=True
+        )
+        t.start()
+        saw_fleet = False
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            line = r.readline().decode()
+            if not line:
+                break
+            if line.startswith("event:") and "fleet" in line:
+                saw_fleet = True
+                break
+        t.join(timeout=60)
+        assert saw_fleet, "no fleet event arrived after a scheduled pass"
+
+
+# -- the census helpers -------------------------------------------------------
+
+
+def test_device_memory_never_raises_and_shapes_entries():
+    devices = fleetstats.device_memory()
+    assert isinstance(devices, list) and devices
+    for d in devices:
+        assert "id" in d and "platform" in d
+
+
+def test_buffer_census_reports_ledger_and_sessions(monkeypatch):
+    from kube_scheduler_simulator_tpu.utils import ledger as ledger_mod
+
+    monkeypatch.setattr(
+        ledger_mod.LEDGER, "memory_bytes_total", lambda: 12345
+    )
+    fleetstats.set_session_provider(lambda: ["default", "s-a", "s-b"])
+    try:
+        census = fleetstats.buffer_census()
+    finally:
+        fleetstats.set_session_provider(None)
+    assert census["ledgerMemoryBytes"] == 12345
+    assert census["sessions"] == 3
+
+
+def test_deleted_session_drops_ages_and_exposition_series():
+    rec = fleetstats.FleetRecorder(capacity=16)
+    rec._pending_seen[("s-dead", "default", "p0")] = 0.0
+    rec._pending_seen[("s-live", "default", "p1")] = 0.0
+    rec.push({"session": "s-dead", "fleet": {"pendingPods": 9,
+              "utilization": {"mean": 0.1, "max": 0.2},
+              "fragmentationIndex": 0.3}, "buffers": {}, "devices": []})
+    rec.push({"session": "s-live", "fleet": {"pendingPods": 1,
+              "utilization": {"mean": 0.1, "max": 0.2},
+              "fragmentationIndex": 0.3}, "buffers": {}, "devices": []})
+    rec.drop_session("s-dead")
+    assert list(rec._pending_seen) == [("s-live", "default", "p1")]
+    # the exposition drops the dead tenant's frozen gauges but keeps
+    # the ring history (the time-series records what happened)
+    fleetstats.set_session_provider(lambda: ["s-live"])
+    try:
+        text = fleetstats.render_prometheus(rec)
+    finally:
+        fleetstats.set_session_provider(None)
+    assert 's-live' in text and 's-dead' not in text
+    assert len(rec.snapshot()) == 2
+
+
+def test_manager_provider_is_weakref_backed():
+    import gc
+
+    from kube_scheduler_simulator_tpu.server.sessions import SessionManager
+
+    mgr = SessionManager(SimulatorService())
+    assert fleetstats.known_sessions() == {"default"}
+    mgr.shutdown()
+    del mgr
+    gc.collect()
+    # the dead manager must not stay reachable through the hook: the
+    # weakref-backed provider answers None (= no plane, no filter)
+    assert fleetstats.known_sessions() is None

@@ -15,6 +15,12 @@ on CPU (docs/observability.md). Three gates, one JSON line:
 
 3. **SSE** — `GET /api/v1/events` yields at least one event.
 
+4. **Fleet & memory observatory** (docs/observability.md) — the traced
+   chaos run is armed with fleet stats and must leave ≥1 `fleet.*`
+   counter track in the Perfetto export; against the live server,
+   `GET /api/v1/timeseries` must answer a non-empty window and the new
+   `kss_fleet_*` gauges must survive the real Prometheus parse.
+
 Exit 0 on pass. Small enough for CI (seconds, CPU-only).
 """
 
@@ -104,11 +110,15 @@ def _async_overlap(intervals: list[dict]) -> "float | None":
 def _trace_gate() -> "tuple[dict, list[str]]":
     from kube_scheduler_simulator_tpu.lifecycle.engine import LifecycleEngine
     from kube_scheduler_simulator_tpu.scenario.chaos import ChaosSpec
-    from kube_scheduler_simulator_tpu.utils import telemetry
+    from kube_scheduler_simulator_tpu.utils import fleetstats, telemetry
 
     problems: list[str] = []
     recorder = telemetry.SpanRecorder(capacity=65536)
     telemetry.activate(recorder)
+    # the fleet observatory rides the same traced run: per-pass samples
+    # must land in the ring AND emit fleet.* counter tracks
+    fleet_rec = fleetstats.FleetRecorder(capacity=1024)
+    fleetstats.activate(fleet_rec)
     try:
         eng = LifecycleEngine(ChaosSpec.from_dict(_chaos_spec_dict()))
         result = eng.run()
@@ -118,6 +128,9 @@ def _trace_gate() -> "tuple[dict, list[str]]":
         n = telemetry.dump_chrome_trace(out, recorder)
     finally:
         telemetry.deactivate()
+        fleetstats.deactivate()
+    if fleet_rec.emitted < 1:
+        problems.append("fleet observatory recorded no samples")
     with open(out) as f:
         doc = json.load(f)  # raises on malformed JSON: the gate
     events = [e for e in doc["traceEvents"] if e.get("ph") != "M"]
@@ -137,10 +150,21 @@ def _trace_gate() -> "tuple[dict, list[str]]":
             "no device-execute span of pass k overlaps a host "
             "lifecycle.events span of pass k+1"
         )
+    # fleet counter tracks in the export: Perfetto renders these as
+    # stepped areas next to the pass spans (docs/observability.md)
+    fleet_counters = {
+        e["name"]
+        for e in events
+        if e.get("ph") == "C" and str(e.get("name", "")).startswith("fleet.")
+    }
+    if not fleet_counters:
+        problems.append("no fleet.* counter track in the Perfetto export")
     fields = {
         "trace_file": out,
         "trace_events": len(events),
         "async_overlap_s": round(overlap_s, 6) if overlap_s else 0.0,
+        "fleet_samples": fleet_rec.emitted,
+        "fleet_counter_tracks": sorted(fleet_counters),
     }
     return fields, problems
 
@@ -149,11 +173,13 @@ def _server_gates() -> "tuple[dict, list[str]]":
     import urllib.request
 
     from kube_scheduler_simulator_tpu.server.httpserver import SimulatorServer
+    from kube_scheduler_simulator_tpu.utils import fleetstats
     from kube_scheduler_simulator_tpu.utils.metrics import (
         parse_prometheus_text,
     )
 
     problems: list[str] = []
+    fleetstats.activate(fleetstats.FleetRecorder(capacity=256))
     server = SimulatorServer(port=0).start()
     try:
         base = f"http://127.0.0.1:{server.port}"
@@ -194,6 +220,12 @@ def _server_gates() -> "tuple[dict, list[str]]":
             "kss_passes_total",
             "kss_pass_latency_seconds",
             "kss_uptime_seconds",
+            # the fleet observatory gauges (utils/fleetstats.py) must
+            # render AND survive the strict parse above
+            "kss_fleet_pending_pods",
+            "kss_fleet_utilization_mean",
+            "kss_fleet_fragmentation_index",
+            "kss_fleet_samples_total",
         ):
             if needed not in families:
                 problems.append(f"metric family {needed} missing")
@@ -201,6 +233,20 @@ def _server_gates() -> "tuple[dict, list[str]]":
             0
         ][2] < 1:
             problems.append("kss_passes_total did not count the pass")
+        # the observatory's sample window must be non-empty after a pass
+        with urllib.request.urlopen(
+            f"{base}/api/v1/timeseries", timeout=30
+        ) as r:
+            ts = json.loads(r.read().decode())
+        if not ts.get("enabled"):
+            problems.append("/api/v1/timeseries reports stats disabled")
+        if not ts.get("samples"):
+            problems.append("/api/v1/timeseries window is empty after a pass")
+        else:
+            s = ts["samples"][-1]
+            for field in ("devices", "buffers", "fleet"):
+                if field not in s:
+                    problems.append(f"timeseries sample missing {field!r}")
         # SSE: the stream must yield >= 1 event promptly
         req = urllib.request.Request(f"{base}/api/v1/events")
         sse_event = None
@@ -215,10 +261,12 @@ def _server_gates() -> "tuple[dict, list[str]]":
         fields = {
             "prometheus_families": len(families),
             "sse_first_event": sse_event or "",
+            "timeseries_samples": len(ts.get("samples") or ()),
         }
         return fields, problems
     finally:
         server.shutdown()
+        fleetstats.deactivate()
 
 
 def main() -> int:
