@@ -5,7 +5,7 @@
 PORT ?= 1212
 PY ?= python
 
-.PHONY: test test-fast lint start bench dryrun batch lifecycle-smoke perf-smoke resilience-smoke observability-smoke session-smoke soak-smoke docker docker-up clean
+.PHONY: test test-fast lint start bench dryrun batch lifecycle-smoke perf-smoke resilience-smoke observability-smoke session-smoke soak-smoke bundle-smoke docker docker-up clean
 
 # full suite on the 8-device virtual CPU mesh (tests/conftest.py pins it)
 test:
@@ -94,6 +94,13 @@ session-smoke:
 # throughout; one JSON line. Minutes on CPU, deliberately not tier-1.
 soak-smoke:
 	env JAX_PLATFORMS=cpu $(PY) tools/soak_smoke.py
+
+# AOT-bundle cross-process reuse gate (docs/performance.md): the probe
+# workload twice in fresh subprocesses sharing one bundle dir — the
+# second process must compile ZERO engine programs (bundleMisses == 0,
+# bundleLoads >= 1) with byte-identical placements; one JSON line
+bundle-smoke:
+	env JAX_PLATFORMS=cpu $(PY) tools/bundle_smoke.py
 
 # containerized dev flow (reference `make docker_build_and_up`, one service)
 docker:

@@ -627,19 +627,36 @@ def _cold_start_probe(n_nodes: int = 32, n_pods: int = 128):
     svc = SchedulerService(store)
     placements, _, _ = svc.schedule_gang(record=False)
     snap = ledger_mod.COLD_START.snapshot()
-    print(
-        json.dumps(
-            {
-                "cold_start_s": snap["timeToFirstPassSeconds"],
-                "cold_start_phases": snap["phases"],
-                "scheduled": sum(1 for v in placements.values() if v),
-                "pods": n_pods,
-                "shape": f"{n_pods}x{n_nodes}",
-                "platform": platform,
-            }
-        ),
-        flush=True,
+    line = {
+        "cold_start_s": snap["timeToFirstPassSeconds"],
+        "cold_start_phases": snap["phases"],
+        "scheduled": sum(1 for v in placements.values() if v),
+        "pods": n_pods,
+        "shape": f"{n_pods}x{n_nodes}",
+        "platform": platform,
+        # the byte-deterministic placement digest: the AOT-bundle gate
+        # compares it across the empty-dir and warm-dir runs
+        "placements_sha256": _placements_digest(placements),
+    }
+    # AOT-bundle accounting (utils/bundles.py): with KSS_AOT_BUNDLES=1
+    # the line proves WHICH path served the boot — loads on a warm
+    # bundle dir, saves on an empty one — and the flush guarantees the
+    # warm dir is complete before the parent launches the second run
+    from kube_scheduler_simulator_tpu.utils import bundles
+
+    if bundles.bundles_enabled():
+        bundles.STORE.flush(60.0)
+        line["bundles"] = bundles.STORE.stats()
+    print(json.dumps(line), flush=True)
+
+
+def _placements_digest(placements: dict) -> str:
+    import hashlib
+
+    doc = json.dumps(
+        sorted((ns, name, node) for (ns, name), node in placements.items())
     )
+    return hashlib.sha256(doc.encode()).hexdigest()[:16]
 
 
 def _sweep_preempt_probe():
@@ -695,7 +712,12 @@ def _sweep_preempt_probe():
 
 
 def _probe_json_subprocess(
-    argv, timeout_s: float, key: str, *, device: bool = False
+    argv,
+    timeout_s: float,
+    key: str,
+    *,
+    device: bool = False,
+    extra_env: "dict[str, str] | None" = None,
 ) -> "dict | None":
     """Run `bench.py <argv...>` isolated and return the last stdout JSON
     line carrying `key` — the shared contract of every wedge-contained
@@ -722,13 +744,16 @@ def _probe_json_subprocess(
     if device and _tunnel_wedged_since() is not None:
         return None
     fd, out_path = tempfile.mkstemp(prefix="kss_bench_probe_", suffix=".out")
+    env = _os.environ.copy()
+    if extra_env:
+        env.update(extra_env)
     with _os.fdopen(fd, "w") as outf:
         proc = subprocess.Popen(
             [sys.executable, __file__, *argv],
             stdout=outf,
             stderr=subprocess.STDOUT,
             text=True,
-            env=_os.environ.copy(),
+            env=env,
         )
     def last_json_line(path):
         try:
@@ -1310,6 +1335,69 @@ def main(profile_dir: "str | None" = None):
         device=not platform.startswith("cpu"),
     )
 
+    # the AOT-BUNDLE gate (ROADMAP #1, docs/performance.md): the same
+    # cold-start probe twice, in fresh subprocesses sharing one empty
+    # bundle dir and one empty XLA compile-cache dir. Run 1 IS the
+    # honest empty-everything cold start (it compiles and saves
+    # bundles); run 2 boots against the now-warm bundle dir and must
+    # deserialize instead of compiling — time-to-first-scheduled-pod
+    # must improve >= 5x, with byte-identical placements. Both numbers
+    # ride the headline.
+    cold_bundled = None
+    _gate_dirs: "list[str]" = []
+    try:
+        import tempfile as _tempfile
+
+        bundle_env = {
+            "KSS_AOT_BUNDLES": "1",
+            "KSS_BUNDLE_DIR": _tempfile.mkdtemp(prefix="kss-bench-bundles-"),
+            "KSS_JAX_CACHE_DIR": _tempfile.mkdtemp(prefix="kss-bench-cache-"),
+            # deterministic program set: both runs compile/load exactly
+            # the serving pass's programs, nothing speculative
+            "KSS_NO_SPECULATIVE_COMPILE": "1",
+        }
+        _gate_dirs = [bundle_env["KSS_BUNDLE_DIR"], bundle_env["KSS_JAX_CACHE_DIR"]]
+        is_device = not platform.startswith("cpu")
+        cold_empty = _probe_json_subprocess(
+            ["--cold-start"], 900.0, "cold_start_s",
+            device=is_device, extra_env=bundle_env,
+        )
+        warm = (
+            _probe_json_subprocess(
+                ["--cold-start"], 900.0, "cold_start_s",
+                device=is_device, extra_env=bundle_env,
+            )
+            if cold_empty
+            else None
+        )
+        if cold_empty and warm:
+            cold_bundled = {
+                "emptyDirColdStartS": cold_empty["cold_start_s"],
+                "bundledColdStartS": warm["cold_start_s"],
+                "speedup": round(
+                    cold_empty["cold_start_s"] / warm["cold_start_s"], 2
+                )
+                if warm["cold_start_s"]
+                else None,
+                "bundleLoads": (warm.get("bundles") or {}).get("bundleLoads"),
+                "bundleBypasses": (warm.get("bundles") or {}).get(
+                    "bundleBypasses"
+                ),
+                "placementsIdentical": (
+                    cold_empty.get("placements_sha256")
+                    == warm.get("placements_sha256")
+                ),
+            }
+    except Exception:  # noqa: BLE001 — the gate must not sink the headline
+        cold_bundled = None
+    finally:
+        # the gate's bundle + compile-cache dirs hold serialized
+        # executables (tens of MB per campaign) — never leak them
+        import shutil as _shutil
+
+        for d in _gate_dirs:
+            _shutil.rmtree(d, ignore_errors=True)
+
     print(
         json.dumps(
             {
@@ -1336,6 +1424,11 @@ def main(profile_dir: "str | None" = None):
                 # walls (utils/ledger.py cold-start accounting)
                 "coldStart": cold
                 or {"error": "probe did not complete in its window"},
+                # the AOT-bundle gate (docs/performance.md): empty-dir
+                # vs warm-bundle-dir cold start over isolated caches —
+                # the >= 5x time-to-first-scheduled-pod headline
+                "coldStartBundled": cold_bundled
+                or {"error": "bundle probes did not complete"},
                 "unit": (
                     f"decisions/s on {platform}; sweep {N_VARIANTS}x{N_PODS}pods"
                     f"x{N_NODES}nodes={round(sweep_dps, 1)}/s (default set "

@@ -246,6 +246,20 @@ class BatchedScheduler:
             lambda arrays, state, p, sel, qi: self._bind(state, arrays, p, sel, qi),
             audit={**aud, "label": "seq.bind"},
         )
+        # the FUSED single-pod step: filter→score→normalize→select→bind
+        # in ONE dispatched program — half the per-pod dispatches of the
+        # attempt_fn/bind_fn pair wherever control need not return to
+        # the host between select and bind (the extender loop's
+        # no-extender-interest fast path). The select is the program's
+        # own argmax (lowest-index tie-break, identical to the host
+        # rule), and an unschedulable pod's bind is the engine's exact
+        # no-op, so placements and trace bytes match the split pair.
+        self.attempt_bind_fn = broker_mod.jit(
+            lambda arrays, state, weights, p, qi: self._attempt_bind(
+                state, arrays, weights, p, qi
+            ),
+            audit={**aud, "label": "seq.step"},
+        )
         self._trace = None
         self._final_state = None
 
@@ -484,11 +498,24 @@ class BatchedScheduler:
                 bound_seq=jnp.where(mask, -1, state.bound_seq),
             )
 
+        def attempt_bind(state, a, weights, p, qi):
+            """The fused single-pod step (seq.step): one dispatch for
+            the whole filter→score→normalize→select→bind chain. The
+            attempt outputs ride out unchanged (the host decode reads
+            the same tensors the split path returned), and `bind` is
+            already an exact no-op for sel == -1."""
+            pf_codes, codes, raw, final, sel, pf_ok = attempt(
+                state, a, weights, p
+            )
+            new_state = bind(state, a, p, sel, qi)
+            return pf_codes, codes, raw, final, sel, pf_ok, new_state
+
         # Exposed segment programs: the extender loop (extender_loop.py)
         # schedules pod-by-pod with HTTP callbacks between these device
         # segments (SURVEY.md §7 hard part #6); the gang scheduler's
         # preempt phase (gang.py) reuses attempt/evict with its own bind.
         self._attempt = attempt
+        self._attempt_bind = attempt_bind
         self._bind = bind
         self._evict_all = evict_all
 

@@ -95,6 +95,20 @@ class ExtenderScheduler:
 
     # -- extender interplay -------------------------------------------------
 
+    def _extender_touches(self, pod: dict) -> bool:
+        """True when any configured extender would see this pod on the
+        schedule path (filter/prioritize/bind verbs; the preempt verb
+        only runs on the preemption path, which is split regardless).
+        Pods NO extender touches take the fused single-dispatch step
+        (`attempt_bind_fn`) — control never needs to return to the host
+        between select and bind, so the split segment pair would be
+        pure dispatch overhead."""
+        return any(
+            (ext.filter_verb or ext.prioritize_verb or ext.bind_verb)
+            and ext.is_interested(pod)
+            for ext in self.service.extenders
+        )
+
     def _extender_args(self, pod: dict, ext, node_names: list[str]) -> dict:
         if ext.node_cache_capable:
             return {"Pod": pod, "NodeNames": node_names}
@@ -334,23 +348,14 @@ class ExtenderScheduler:
 
     # -- the loop -----------------------------------------------------------
 
-    def _attempt_once(self, pod, p, qi, res, state, attempt_out=None):
-        """One full framework+extender cycle for pod p against `state`:
-        attempt segment → decode filters/scores into `res` → extender
-        filter/prioritize → select → permit/bind records → (delegated)
-        bind. Returns (state, placed). `attempt_out`: the caller's
-        already-computed `attempt_fn` output for (state, p) — the main
-        loop runs the segment once for the prefilter decode and hands it
-        down; the preemption retry recomputes against the evicted state."""
-        import jax.numpy as jnp
-
+    def _decode_filters_scores(self, res, codes, raw, final):
+        """Decode one attempt's per-node filter codes and (raw, final)
+        score tables into `res` — the ONE definition of the filter/
+        score record format shared by the split segment path and the
+        fused single-dispatch path. Returns (feasible node indices,
+        final scores as ndarray)."""
         enc = self.enc
         sched = self.sched
-        arrays = enc.arrays
-        weights = sched.weights
-        if attempt_out is None:
-            attempt_out = sched.attempt_fn(arrays, state, weights, jnp.int32(p))
-        _, codes, raw, final, sel, _ = attempt_out
         codes = np.asarray(codes)
         raw = np.asarray(raw)
         final = np.asarray(final)
@@ -378,6 +383,54 @@ class ExtenderScheduler:
                     res.add_final_score(
                         enc.node_names[n], sname, int(final[n, j])
                     )
+        return feasible, final
+
+    def _finish_fused(self, p, res, state, fused_out):
+        """Decode the fused step's outputs (the no-extender-interest
+        fast path; `run()` already dispatched `attempt_bind_fn` and
+        handled the prefilter decode). The program's argmax select is
+        the host rule exactly (highest weighted total, lowest node
+        index on ties), and an unschedulable pod's bind is the
+        engine's exact no-op — so the records and the state trajectory
+        are byte-identical to the split attempt_fn/bind_fn path, at
+        half the dispatches. Returns (state, placed): not placed hands
+        the pod to the caller's preemption / Unschedulable path with
+        the pre-step state untouched."""
+        enc = self.enc
+        sched = self.sched
+        _, codes, raw, final, sel, _, new_state = fused_out
+        self._decode_filters_scores(res, codes, raw, final)
+        s = int(np.asarray(sel))
+        if s < 0:
+            return state, False
+        res.selected_node = enc.node_names[s]
+        res.status = "Scheduled"
+        permit = (
+            {n_: h(p, s) for n_, h in sched._permit_handlers.items()}
+            if sched._permit_handlers
+            else None
+        )
+        record_bind_points(enc.config, res, permit=permit)
+        return new_state, True
+
+    def _attempt_once(self, pod, p, qi, res, state, attempt_out=None):
+        """One full framework+extender cycle for pod p against `state`:
+        attempt segment → decode filters/scores into `res` → extender
+        filter/prioritize → select → permit/bind records → (delegated)
+        bind. Returns (state, placed). `attempt_out`: the caller's
+        already-computed `attempt_fn` output for (state, p) — the main
+        loop runs the segment once for the prefilter decode and hands it
+        down; the preemption retry recomputes against the evicted state."""
+        import jax.numpy as jnp
+
+        enc = self.enc
+        sched = self.sched
+        arrays = enc.arrays
+        weights = sched.weights
+        if attempt_out is None:
+            attempt_out = sched.attempt_fn(arrays, state, weights, jnp.int32(p))
+        _, codes, raw, final, sel, _ = attempt_out
+        feasible, final = self._decode_filters_scores(res, codes, raw, final)
         totals = {n: int(final[n].sum()) for n in feasible}
         feasible, totals = self._apply_extenders(pod, feasible, totals)
         if not feasible:
@@ -423,8 +476,22 @@ class ExtenderScheduler:
             pod = enc.pods[p]
             ns, name = enc.pod_keys[p]
             res = PodSchedulingResult(pod_namespace=ns, pod_name=name)
-            attempt_out = sched.attempt_fn(arrays, state, weights, jnp.int32(p))
-            pf_codes = attempt_out[0]
+            # pods no extender touches take the FUSED single-dispatch
+            # step (attempt+select+bind in one program); pods with
+            # extender interplay keep the split segments, because
+            # control must return to the host between Filter/Score and
+            # the bind (the HTTP verbs run in between)
+            fused = not self._extender_touches(pod)
+            if fused:
+                fused_out = sched.attempt_bind_fn(
+                    arrays, state, weights, jnp.int32(p), jnp.int32(qi)
+                )
+                pf_codes = fused_out[0]
+            else:
+                attempt_out = sched.attempt_fn(
+                    arrays, state, weights, jnp.int32(p)
+                )
+                pf_codes = attempt_out[0]
             pf_failed = False
             for pname in sched._prefilter_names:
                 if pname in K.PREFILTER_KERNELS:
@@ -437,12 +504,18 @@ class ExtenderScheduler:
                 )
                 pf_failed = pf_failed or bool(c)
             if pf_failed:
+                # the fused step's bind was an exact no-op (a prefilter
+                # failure empties the feasible set, so sel == -1):
+                # `state` stays the pre-step value on both paths
                 res.status = "Unschedulable"
                 results.append(res)
                 continue
-            state, placed = self._attempt_once(
-                pod, p, qi, res, state, attempt_out=attempt_out
-            )
+            if fused:
+                state, placed = self._finish_fused(p, res, state, fused_out)
+            else:
+                state, placed = self._attempt_once(
+                    pod, p, qi, res, state, attempt_out=attempt_out
+                )
             if placed or res.bind.get("ExtenderBinder"):
                 # scheduled, or a delegated bind failed terminally (the
                 # bind error is this pod's record; no preemption retry)

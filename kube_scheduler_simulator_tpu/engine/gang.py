@@ -422,6 +422,7 @@ class GangScheduler:
         self._run_tracked = None
         self._rec = None
         self._eval_rec = None
+        self._replay_round = None
         self._chronology = None
         self._trace = None
         self._recorded_weights = None
@@ -1426,7 +1427,41 @@ class GangScheduler:
                 jax.vmap(rec._attempt, in_axes=(None, None, None, 0)),
                 audit={**self.audit_spec(), "label": "gang.eval_record"},
             )
+        if self._replay_round is None:
+            # the FUSED replay round: evaluate one pod chunk AND
+            # scatter-bind the whole round's commits in ONE dispatched
+            # program — the replay loop's eval+bind pair collapses to a
+            # single dispatch per chunk (the per-pass dispatch-count
+            # lever; tests pin the ledger call counts). The eval reads
+            # the pre-bind carry exactly like the split form, so the
+            # emitted trace rows are byte-identical.
+            bind_all = self._bind_all
+
+            def replay_round(state, a, w, pods, mask, selv, order_v):
+                pf, cd, rw, fn, _s, _ok = jax.vmap(
+                    rec._attempt, in_axes=(None, None, None, 0)
+                )(state, a, w, pods)
+                return pf, cd, rw, fn, bind_all(state, a, mask, selv, order_v)
+
+            self._replay_round = broker_mod.jit(
+                replay_round,
+                audit={**self.audit_spec(), "label": "gang.replay_round"},
+            )
         CH = max(1, min(128, P))
+
+        def write_rows(chunk, pf, cd, rw, fn, assign_after):
+            pf, cd, rw, fn = (np.asarray(x) for x in (pf, cd, rw, fn))
+            for j, p in enumerate(chunk):
+                qi = qpos[int(p)]
+                pf_codes[qi] = pf[j]
+                codes[qi] = cd[j]
+                raw[qi] = rw[j]
+                final[qi] = fn[j]
+                if assign_after is not None:
+                    committed = np.int32(assign_after[int(p)])
+                    sel[qi] = committed
+                    if has_pf:
+                        final_sel[qi] = committed
 
         def record_eval(state, pod_ids, assign_after):
             for i in range(0, len(pod_ids), CH):
@@ -1436,24 +1471,9 @@ class GangScheduler:
                 pf, cd, rw, fn, _s, _ok = self._eval_rec(
                     state, arrays, wj, jnp.asarray(padded)
                 )
-                pf, cd, rw, fn = (np.asarray(x) for x in (pf, cd, rw, fn))
-                for j, p in enumerate(chunk):
-                    qi = qpos[int(p)]
-                    pf_codes[qi] = pf[j]
-                    codes[qi] = cd[j]
-                    raw[qi] = rw[j]
-                    final[qi] = fn[j]
-                    if assign_after is not None:
-                        committed = np.int32(assign_after[int(p)])
-                        sel[qi] = committed
-                        if has_pf:
-                            final_sel[qi] = committed
+                write_rows(chunk, pf, cd, rw, fn, assign_after)
 
         state = enc.state0
-        bind_all_j = broker_mod.jit(
-            self._bind_all,
-            audit={**self.audit_spec(), "label": "gang.bind_all"},
-        )
         for entry in self._chronology:
             kind = entry[0]
             if kind == "rounds":
@@ -1462,14 +1482,24 @@ class GangScheduler:
                     pods_r = np.nonzero(br == r)[0].astype(np.int32)
                     if pods_r.size == 0:
                         continue
-                    record_eval(state, pods_r, assign_after)
                     mask = np.zeros((P,), bool)
                     mask[pods_r] = True
                     selv = np.where(mask, assign_after, -1).astype(np.int32)
-                    state = bind_all_j(
-                        state, arrays, jnp.asarray(mask), jnp.asarray(selv),
-                        order,
+                    # all chunks evaluate against the round's pre-bind
+                    # state; the LAST chunk rides the fused program,
+                    # which also commits the whole round's binds —
+                    # dispatches per round: ceil(|round|/CH), not +1
+                    head = ((pods_r.size - 1) // CH) * CH
+                    if head:
+                        record_eval(state, pods_r[:head], assign_after)
+                    chunk = pods_r[head:]
+                    padded = np.full((CH,), chunk[0], np.int32)
+                    padded[: len(chunk)] = chunk
+                    pf, cd, rw, fn, state = self._replay_round(
+                        state, arrays, wj, jnp.asarray(padded),
+                        jnp.asarray(mask), jnp.asarray(selv), order,
                     )
+                    write_rows(chunk, pf, cd, rw, fn, assign_after)
             elif kind == "phase":
                 # the sequential engine's record segments replay the
                 # phase pod-by-pod (phase semantics ARE the sequential
@@ -1585,4 +1615,5 @@ class GangScheduler:
         self._recorded_weights = None
         self._rec = None
         self._eval_rec = None
+        self._replay_round = None
         return self

@@ -88,6 +88,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from ..models.store import KINDS, NAMESPACED, StaleResourceVersion
+from ..utils import bundles as bundles_mod
 from ..utils import fleetstats, locking
 from ..utils import ledger as ledger_mod
 from ..utils import metrics as metrics_mod
@@ -1074,6 +1075,10 @@ def _make_handler(server: SimulatorServer):
                 # summary (full detail at GET /api/v1/debug/programs)
                 doc["coldStart"] = ledger_mod.COLD_START.snapshot()
                 doc["programs"] = ledger_mod.LEDGER.totals()
+                # the AOT bundle store (utils/bundles.py): process-wide
+                # load/save/bypass counts + the deserialize wall — the
+                # per-session attribution rides the phases block
+                doc["bundles"] = bundles_mod.STORE.stats()
             if fmt == "prometheus":
                 def entry(session_id, snapshot, cache_cap):
                     return (

@@ -77,6 +77,7 @@ import time
 import weakref
 from contextlib import contextmanager, nullcontext as _null_context
 
+from . import bundles as bundles_mod
 from . import envcheck, faultinject, locking, telemetry
 from . import ledger as ledger_mod
 from .compilecache import enable_compile_cache
@@ -145,7 +146,21 @@ def jit(fn, audit=None, **kw):
     jitted = jax.jit(fn, **kw)
     audit_on = jaxpr_audit_enabled()
     ledger_on = ledger_mod.ledger_enabled()
-    if audit_on or ledger_on:
+    bundles_on = bundles_mod.bundles_enabled()
+    if bundles_on:
+        # the AOT bundle store (utils/bundles.py): the first call of
+        # each signature deserializes a persisted executable — or
+        # AOT-compiles and persists one — instead of letting jit
+        # re-lower. When the ledger is also armed, the bundle wrapper
+        # IS its dispatch path (it already splits resolve cost into
+        # deserialize vs lowering/backend), so the AuditedJit below
+        # runs audit-only — two AOT dispatch caches would double-pay
+        # every first call.
+        jitted = bundles_mod.BundledJit(
+            jitted, kw, audit,
+            ledger=ledger_mod.LEDGER if ledger_on else None,
+        )
+    if audit_on or (ledger_on and not bundles_on):
         from ..analysis.jaxpr_audit import AuditedJit
 
         # ONE wrapper serves both program observers: the KSS7xx audit
@@ -156,7 +171,9 @@ def jit(fn, audit=None, **kw):
             kw,
             audit,
             audit_enabled=audit_on,
-            ledger=ledger_mod.LEDGER if ledger_on else None,
+            ledger=(
+                ledger_mod.LEDGER if (ledger_on and not bundles_on) else None
+            ),
         )
     return jitted
 
@@ -494,6 +511,26 @@ class CompileBroker:
                 else:
                     del self._abandoned[ck]
 
+    # -- AOT bundle scope ----------------------------------------------------
+
+    def _scoped_build(self, key: tuple, build, metrics=None):
+        """Wrap `build` so the engine key and the building service's
+        metrics registry ride the AOT-bundle thread-local while it runs
+        (utils/bundles.py): every program jit-WRAPPED inside the build
+        keys its bundle on the broker key — (kind, compile signature,
+        window) + the device-epoch suffix — and every bundle event
+        attributes to the right tenant. The wrap is a closure (not a
+        with-block here) so the scope follows the build onto whatever
+        thread actually runs it (the watchdog's builder thread, the
+        speculation worker)."""
+        sink = metrics if metrics is not None else self.metrics
+
+        def scoped():
+            with bundles_mod.build_scope(key, sink):
+                return build()
+
+        return scoped
+
     # -- warm-engine map ----------------------------------------------------
 
     def _store_locked(self, key: tuple, engine) -> None:
@@ -540,6 +577,7 @@ class CompileBroker:
         spent blocked on someone else's in-flight compile, which callers
         must exclude from their own execute-phase accounting (it is
         already booked as stallSeconds)."""
+        build = self._scoped_build(key, build, metrics)
         while True:
             with self._lock:
                 eng = self._engines.get(key)
@@ -641,6 +679,7 @@ class CompileBroker:
         map and in-flight dedupe stay cross-scope (the shared-executable
         win). Without a deadline, retries, faults, or failures this is
         exactly `get` (same dedupe, same counters)."""
+        build = self._scoped_build(key, build, metrics)
         ck = (scope, key)
         while True:
             cooled = False
@@ -918,6 +957,7 @@ class CompileBroker:
             )
 
     def _background_build(self, key: tuple, build, metrics=None) -> None:
+        build = self._scoped_build(key, build, metrics)
         with self._lock:
             if key in self._engines or key in self._inflight:
                 return  # already warm / being compiled — nothing to do
@@ -957,8 +997,12 @@ class CompileBroker:
 
     def drain(self, timeout: "float | None" = None) -> bool:
         """Block until the speculation queue is empty and no task is
-        running; True on success, False on timeout. The 'after warm-up'
-        fence the perf-smoke crossing gate stands on."""
+        running — then flush any in-flight AOT bundle writes
+        (utils/bundles.py): a drained process must not abandon a
+        serialized executable mid-save, and flushing AFTER the worker
+        settles covers the bundles its last build enqueued. True on
+        success, False on timeout. The 'after warm-up' fence the
+        perf-smoke crossing gate stands on."""
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._lock:
             while self._busy:
@@ -966,7 +1010,8 @@ class CompileBroker:
                 if remaining is not None and remaining <= 0:
                     return False
                 self._idle.wait(remaining)
-        return True
+        remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+        return bundles_mod.flush(timeout=remaining)
 
 
 # Every live broker, so interpreter exit can quiesce speculation first:

@@ -95,6 +95,13 @@ KNOWN: "dict[str, Validator]" = {
     "KSS_ENCODING_CACHE_CAP": _int_validator(1),
     "KSS_NO_SPECULATIVE_COMPILE": _bool_validator,
     "KSS_JAX_CACHE_DIR": _path_validator,
+    # the persistent AOT bundle store (utils/bundles.py): serialize
+    # every broker-jitted program's compiled executable to disk and
+    # deserialize it on the next boot instead of re-lowering; the
+    # directory defaults to a sibling of kss-fingerprints.json in the
+    # compile cache dir
+    "KSS_AOT_BUNDLES": _bool_validator,
+    "KSS_BUNDLE_DIR": _path_validator,
     # telemetry plane
     "KSS_TRACE": _bool_validator,
     "KSS_TRACE_RING_CAP": _int_validator(1),

@@ -117,6 +117,13 @@ class ProgramRecord:
     builds: int = 0  # how many times this program's compile was paid
     lowering_s: float = 0.0  # cumulative trace+lower wall
     backend_s: float = 0.0  # cumulative XLA backend-compile wall
+    # AOT-bundle accounting (utils/bundles.py): executables served by
+    # deserializing an on-disk bundle instead of compiling — the
+    # deserialization wall is recorded DISTINCTLY from the compile wall
+    # so a bundled boot's ledger shows zero compile seconds, not a
+    # mislabeled fast compile
+    bundle_loads: int = 0
+    deserialize_s: float = 0.0  # cumulative bundle-deserialize wall
     flops: "float | None" = None  # cost_analysis of ONE execution
     bytes: "float | None" = None
     memory: "dict | None" = None  # memory_analysis byte breakdown
@@ -154,13 +161,18 @@ class ProgramLedger:
         out_avals: tuple = (),
         lowering_s: float = 0.0,
         backend_s: float = 0.0,
+        deserialize_s: float = 0.0,
+        loaded: bool = False,
         cost: "dict | None" = None,
         memory: "dict | None" = None,
     ) -> ProgramRecord:
         """Record one compile of ``(label, fingerprint)``; a re-build of
         a known program (broker eviction, device-epoch bump) accumulates
         its compile wall instead of opening a duplicate row — recompile
-        cost is exactly what the ledger must not hide."""
+        cost is exactly what the ledger must not hide. With
+        ``loaded=True`` the program came from an AOT bundle
+        (utils/bundles.py): the deserialize wall accumulates instead of
+        a build — the two costs must never conflate."""
         key = (label, fingerprint)
         with self._lock:
             rec = self._records.get(key)
@@ -168,7 +180,11 @@ class ProgramLedger:
                 rec = self._records[key] = ProgramRecord(
                     label, fingerprint, in_avals, out_avals
                 )
-            rec.builds += 1
+            if loaded:
+                rec.bundle_loads += 1
+                rec.deserialize_s += float(deserialize_s)
+            else:
+                rec.builds += 1
             rec.lowering_s += float(lowering_s)
             rec.backend_s += float(backend_s)
             if cost:
@@ -273,6 +289,12 @@ class ProgramLedger:
                     6,
                 ),
                 "dispatchSeconds": round(self._dispatch_total, 6),
+                "deserializeSeconds": round(
+                    sum(r.deserialize_s for r in self._records.values()), 6
+                ),
+                "bundleLoads": sum(
+                    r.bundle_loads for r in self._records.values()
+                ),
                 "calls": sum(r.calls for r in self._records.values()),
             }
 
@@ -298,6 +320,8 @@ class ProgramLedger:
                     "label": rec.label,
                     "fingerprint": rec.fingerprint,
                     "builds": rec.builds,
+                    "bundleLoads": rec.bundle_loads,
+                    "deserializeSeconds": round(rec.deserialize_s, 6),
                     "compileSeconds": {
                         "lowering": round(rec.lowering_s, 6),
                         "backend": round(rec.backend_s, 6),

@@ -216,6 +216,15 @@ class SchedulingMetrics:
     _dispatch_retries: int = 0
     _device_failovers: int = 0
     _mesh_shrinks: int = 0
+    # AOT-bundle counters (utils/bundles.py, KSS_AOT_BUNDLES=1):
+    # executables deserialized from / serialized to the on-disk bundle
+    # store, bundles present but rejected (version/fingerprint/checksum
+    # mismatch — the silent fallback), and the cumulative deserialize
+    # wall, kept DISTINCT from stallSeconds' compile wall
+    _bundle_loads: int = 0
+    _bundle_saves: int = 0
+    _bundle_bypasses: int = 0
+    _aot_deserialize_s: float = 0.0
     # latency-distribution state (the observability PR): Prometheus-style
     # histograms behind the same lock as the counters, rendered into the
     # JSON snapshot's `histograms` block and the exposition text
@@ -330,6 +339,25 @@ class SchedulingMetrics:
             self._device_failovers += int(device_failovers)
             self._mesh_shrinks += int(mesh_shrinks)
 
+    def record_bundles(
+        self,
+        *,
+        loads: int = 0,
+        saves: int = 0,
+        bypasses: int = 0,
+        deserialize_s: float = 0.0,
+    ) -> None:
+        """AOT-bundle-store accounting (utils/bundles.py): `loads`
+        executables deserialized from disk instead of compiled, `saves`
+        bundles written, `bypasses` bundles present but rejected (the
+        silent fall-back-to-compile path), `deserialize_s` wall seconds
+        spent deserializing — never booked as compile stall."""
+        with self._lock:
+            self._bundle_loads += int(loads)
+            self._bundle_saves += int(saves)
+            self._bundle_bypasses += int(bypasses)
+            self._aot_deserialize_s += float(deserialize_s)
+
     def record_phase_seconds(
         self, execute: float = 0.0, decode: float = 0.0
     ) -> None:
@@ -415,6 +443,10 @@ class SchedulingMetrics:
                     "dispatchRetries": self._dispatch_retries,
                     "deviceFailovers": self._device_failovers,
                     "meshShrinks": self._mesh_shrinks,
+                    "bundleLoads": self._bundle_loads,
+                    "bundleSaves": self._bundle_saves,
+                    "bundleBypasses": self._bundle_bypasses,
+                    "aotDeserializeSeconds": round(self._aot_deserialize_s, 6),
                 },
                 "histograms": {
                     key: h.snapshot() for key, h in self._hist.items()
@@ -451,6 +483,10 @@ class SchedulingMetrics:
             self._dispatch_retries = 0
             self._device_failovers = 0
             self._mesh_shrinks = 0
+            self._bundle_loads = 0
+            self._bundle_saves = 0
+            self._bundle_bypasses = 0
+            self._aot_deserialize_s = 0.0
             self._hist = _new_histograms()
             self._born_monotonic = time.monotonic()
 
@@ -465,6 +501,8 @@ class SchedulingMetrics:
         "_speculative_compiles", "_stall_s", "_compile_retries",
         "_eager_fallbacks", "_degraded_passes", "_worker_crashes",
         "_dispatch_retries", "_device_failovers", "_mesh_shrinks",
+        "_bundle_loads", "_bundle_saves", "_bundle_bypasses",
+        "_aot_deserialize_s",
     )
 
     def state_dict(self) -> dict:
@@ -589,6 +627,26 @@ _PROM_COUNTERS = (
         "kss_mesh_shrinks_total",
         "Engine rebuilds over a shrunken surviving-device mesh.",
         ("phases", "meshShrinks"),
+    ),
+    (
+        "kss_bundle_loads_total",
+        "Engine executables deserialized from the AOT bundle store.",
+        ("phases", "bundleLoads"),
+    ),
+    (
+        "kss_bundle_saves_total",
+        "Engine executables serialized into the AOT bundle store.",
+        ("phases", "bundleSaves"),
+    ),
+    (
+        "kss_bundle_bypasses_total",
+        "Bundles present but rejected (fell back to a fresh compile).",
+        ("phases", "bundleBypasses"),
+    ),
+    (
+        "kss_aot_deserialize_seconds_total",
+        "Wall seconds spent deserializing AOT bundles (not compile stall).",
+        ("phases", "aotDeserializeSeconds"),
     ),
 )
 

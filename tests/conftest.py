@@ -60,6 +60,12 @@ for _var in (
     # would synchronize the async pipeline); ledger tests opt in
     "KSS_PROGRAM_LEDGER",
     "KSS_PROGRAM_TIMING_SAMPLE",
+    # the AOT bundle store (utils/bundles.py): ambient arming would
+    # serialize every program the suite compiles to a shared directory
+    # (and cross-test loads would hide real compile behavior); bundle
+    # tests opt in with monkeypatch + tmp_path
+    "KSS_AOT_BUNDLES",
+    "KSS_BUNDLE_DIR",
     # the session plane (server/sessions.py): ambient admission knobs
     # would change quota/limit behavior under test
     "KSS_MAX_SESSIONS",
