@@ -415,6 +415,11 @@ def _lifecycle_probe(events: int = 300, n_nodes: int = 64, seed_pods: int = 500)
     # per-pass host fetch out of the throughput number's noise floor.
     _os.environ.setdefault("KSS_FLEET_STATS", "1")
     _os.environ.setdefault("KSS_FLEET_SAMPLE", "8")
+    # the SLO plane rides the probe too (utils/slo.py): per-objective
+    # compliance + alerts fired join the headline — placements are
+    # pinned identical with the plane armed or off, so the throughput
+    # number is untouched (tests/test_slo.py)
+    _os.environ.setdefault("KSS_SLO", "1")
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -552,6 +557,12 @@ def _lifecycle_probe(events: int = 300, n_nodes: int = 64, seed_pods: int = 500)
         line["peak_hbm_bytes"] = max(peaks)
         line["fragmentation_index"] = last["fleet"]["fragmentationIndex"]
         line["pending_pods_end"] = last["fleet"]["pendingPods"]
+    # the SLO block (utils/slo.py): per-objective compliance over the
+    # run + alerts fired — the judged view of the same signals the
+    # counters above report raw
+    slo_plane = eng.scheduler.metrics.slo_plane()
+    if slo_plane is not None:
+        line["slo"] = slo_plane.headline()
     # flight-recorder accounting when the probe ran under KSS_TRACE=1
     # (off by default: the headline number must measure the untraced
     # serving path — docs/observability.md)
@@ -1419,6 +1430,10 @@ def main(profile_dir: "str | None" = None):
                 }
                 if life
                 else None,
+                # the judged view (utils/slo.py): per-objective
+                # compliance over the churn run + alerts fired — the
+                # SLO plane riding the same probe
+                "slo": life.get("slo") if life else None,
                 # cold-process boot → first scheduled pod, with the
                 # bootProbe/firstEncode/firstCompile/firstPass phase
                 # walls (utils/ledger.py cold-start accounting)

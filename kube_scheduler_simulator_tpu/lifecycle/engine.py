@@ -437,6 +437,11 @@ class LifecycleEngine:
         accounting — step 2+3 of the event loop. In async mode the pass
         is DISPATCHED here (after resolving any in-flight predecessor)
         and resolved later — at the next fence or the next converge."""
+        # the SLO plane's sim-time tick (utils/slo.py): burn windows
+        # slide and alerts evaluate on the RUN's timeline, so a chaos
+        # run compressing an hour of simulated time walks the full
+        # pending -> firing -> resolved lifecycle. No-op when unarmed.
+        self.scheduler.metrics.slo_tick(t)
         self._resolve_inflight()  # controllers + encode need its bindings
         with telemetry.span(
             "lifecycle.controllers",

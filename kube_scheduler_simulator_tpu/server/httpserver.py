@@ -31,6 +31,17 @@ typed store — SURVEY.md §2 #3):
                                              + cluster-quality samples,
                                              utils/fleetstats.py;
                                              KSS_FLEET_STATS=1)
+    GET                /api/v1/alerts        SLO burn-rate alerts: active
+                                             + per-objective status +
+                                             the bounded transition
+                                             history ring (utils/slo.py;
+                                             KSS_SLO=1 or a PUT /slo
+                                             override)
+    GET/PUT            /api/v1/slo           the session's SLO objective
+                                             set: GET status, PUT a
+                                             declarative per-tenant
+                                             override over the KSS_SLO_*
+                                             defaults
     POST               /api/v1/lifecycle     run a ChaosSpec chaos timeline
                                              (lifecycle/engine.py, isolated store)
     GET                /api/v1/lifecycle/trace   last run's JSONL event trace
@@ -92,6 +103,7 @@ from ..utils import bundles as bundles_mod
 from ..utils import fleetstats, locking
 from ..utils import ledger as ledger_mod
 from ..utils import metrics as metrics_mod
+from ..utils import slo as slo_mod
 from ..utils import telemetry
 from ..utils.broker import CompileDeadlineExceeded, CompileUnavailable
 from .service import (
@@ -570,6 +582,7 @@ def _make_handler(server: SimulatorServer):
                             name=body.get("name"),
                             snapshot=body.get("snapshot"),
                             fault_inject=body.get("faultInject"),
+                            slo=body.get("slo"),
                         )
                     except ValueError as e:
                         # a malformed faultInject spec is the client's
@@ -633,6 +646,19 @@ def _make_handler(server: SimulatorServer):
                 return self._list_watch(parse_qs(url.query), svc)
             if rest == ["metrics"] and method == "GET":
                 return self._metrics(parse_qs(url.query), svc, sid)
+            if rest == ["alerts"] and method == "GET":
+                # the SLO plane's alert surface (utils/slo.py,
+                # docs/observability.md): active alerts + per-objective
+                # status for the addressed session (legacy route: every
+                # live session), and the process-wide bounded history
+                # ring of pending -> firing -> resolved transitions.
+                # Unarmed servers answer an honest empty document.
+                return self._alerts(svc, sid)
+            if rest == ["slo"]:
+                # per-session SLO objectives: GET the current status,
+                # PUT a declarative override (docs/observability.md) —
+                # the per-tenant knob over the KSS_SLO_* defaults
+                return self._slo(method, svc, sid)
             if rest == ["debug", "trace"] and method == "GET":
                 # the flight recorder's retained window as Chrome
                 # trace-event JSON — loadable as-is in Perfetto
@@ -1039,13 +1065,101 @@ def _make_handler(server: SimulatorServer):
                 400, f"action must be start|stop, got {action!r}"
             )
 
+        def _alerts(self, svc, sid):
+            """GET /api/v1/alerts (+ nested session form): the SLO
+            plane's judgement surface — per-objective status (burn
+            rates, compliance, alert state) for the addressed session
+            (legacy route: every live session), the currently
+            pending/firing alerts, and the bounded history ring of
+            transitions. Statuses evaluate BEFORE the history snapshot
+            so a just-crossed threshold's transition is in both."""
+            if sid is None:
+                planes = [
+                    (session_id, service.scheduler.metrics.slo_plane())
+                    for session_id, service in server.sessions.live_services()
+                ]
+            else:
+                planes = [(sid, svc.scheduler.metrics.slo_plane())]
+            sessions_doc: dict = {}
+            active: list = []
+            enabled = False
+            for session_id, plane in planes:
+                if plane is None:
+                    continue
+                enabled = True
+                # status() evaluates first: alert states are current,
+                # and any transition lands in the ring before the
+                # history snapshot below
+                sessions_doc[session_id or DEFAULT_SESSION_ID] = (
+                    plane.status()
+                )
+                active.extend(plane.active_alerts())
+            log = slo_mod.alert_log()
+            history = log.snapshot()
+            if sid is not None:
+                history = [
+                    ev for ev in history if ev.get("session") == sid
+                ]
+            return self._json(
+                200,
+                {
+                    "enabled": enabled,
+                    "active": active,
+                    "sessions": sessions_doc,
+                    "history": history,
+                    "historyEmitted": log.emitted,
+                    "historyDropped": log.dropped,
+                    "counters": log.counters(),
+                },
+            )
+
+        def _slo(self, method: str, svc, sid):
+            """GET/PUT /api/v1/slo (+ nested session form): the
+            per-tenant objective override (docs/observability.md). PUT
+            installs an explicit plane for the session — objectives
+            layered over the defaults, optional window/burn/hold
+            overrides — that survives eviction and drain through the
+            metrics checkpoint state; ``{"reset": true}`` returns the
+            session to the KSS_SLO_* environment's plane, and
+            ``{"enabled": false}`` disarms it."""
+            metrics = svc.scheduler.metrics
+            session = sid or DEFAULT_SESSION_ID
+
+            def plane_doc():
+                plane = metrics.slo_plane()
+                if plane is None:
+                    return {"enabled": False, "session": session}
+                return plane.status()
+
+            if method == "GET":
+                return self._json(200, plane_doc())
+            if method != "PUT":
+                return self._error(405, "method not allowed")
+            body = self._body() or {}
+            if not isinstance(body, dict):
+                return self._error(400, "SLO spec must be a mapping")
+            if body.get("reset"):
+                metrics.clear_slo_override()
+                return self._json(200, plane_doc())
+            try:
+                plane = slo_mod.plane_from_put_spec(body, session)
+            except (ValueError, TypeError) as e:
+                return self._error(400, str(e))
+            metrics.set_slo_plane(plane)
+            if plane is None:  # {"enabled": false}: explicitly disarmed
+                return self._json(200, {"enabled": False, "session": session})
+            return self._json(200, plane.status())
+
         def _metrics(self, q: dict, svc, sid):
             """GET /api/v1/metrics (+ per-session nested form): the
             session's counter snapshot as JSON, or Prometheus text with
-            a `session` label on every sample. The LEGACY (un-prefixed)
-            Prometheus scrape renders EVERY live session in one
-            document — the one endpoint an external Prometheus points
-            at (docs/sessions.md)."""
+            a `session` label on every sample (`?format=openmetrics`
+            additionally attaches histogram bucket exemplars — the
+            pass-id link into the Perfetto trace — and terminates with
+            `# EOF`). The LEGACY (un-prefixed) Prometheus scrape
+            renders EVERY live session in one document — the one
+            endpoint an external Prometheus points at
+            (docs/sessions.md)."""
             fmt = q.get("format", ["json"])[0]
             doc = None
             if fmt == "json" or sid is not None:
@@ -1079,7 +1193,9 @@ def _make_handler(server: SimulatorServer):
                 # load/save/bypass counts + the deserialize wall — the
                 # per-session attribution rides the phases block
                 doc["bundles"] = bundles_mod.STORE.stats()
-            if fmt == "prometheus":
+            if fmt in ("prometheus", "openmetrics"):
+                openmetrics = fmt == "openmetrics"
+
                 def entry(session_id, snapshot, cache_cap):
                     return (
                         {"session": session_id},
@@ -1100,21 +1216,26 @@ def _make_handler(server: SimulatorServer):
                     # and no restore (scrapes must not defeat idle
                     # eviction; an evicted session's counters live in
                     # its snapshot file until the next real touch)
+                    cut = server.sessions.live_services()
                     entries = [
                         entry(
                             session_id,
                             service.scheduler.metrics.snapshot(),
                             service.scheduler.encoding_cache_capacity,
                         )
-                        for session_id, service in (
-                            server.sessions.live_services()
-                        )
+                        for session_id, service in cut
+                    ]
+                    slo_planes = [
+                        (session_id, service.scheduler.metrics.slo_plane())
+                        for session_id, service in cut
                     ]
                 else:
                     entries = [entry(sid, doc, doc["encodingCacheCapacity"])]
+                    slo_planes = [(sid, svc.scheduler.metrics.slo_plane())]
                 mgr_stats = server.sessions.stats()
                 text = metrics_mod.render_prometheus_sessions(
                     entries,
+                    openmetrics=openmetrics,
                     global_counters={
                         "kss_sse_dropped_events_total": (
                             "Events dropped disconnecting slow SSE "
@@ -1154,12 +1275,25 @@ def _make_handler(server: SimulatorServer):
                 # kss_fleet_*, utils/fleetstats.py) from the freshest
                 # samples; empty while stats are off or unsampled
                 text += fleetstats.render_prometheus()
+                # the SLO plane families (kss_slo_* / kss_alert_*,
+                # utils/slo.py): per-(objective, session) gauges from
+                # every live plane — evaluated at scrape time so alert
+                # states are current — plus the process-wide alert-ring
+                # counters (always present, so dashboards can pin them)
+                text += slo_mod.render_prometheus_planes(slo_planes)
+                if openmetrics:
+                    # the OpenMetrics terminator — LAST, after every
+                    # appended observatory family
+                    text += "# EOF\n"
                 body = text.encode()
                 self.send_response(200)
                 self._cors_headers()
                 self.send_header(
                     "Content-Type",
-                    "text/plain; version=0.0.4; charset=utf-8",
+                    "application/openmetrics-text; version=1.0.0; "
+                    "charset=utf-8"
+                    if openmetrics
+                    else "text/plain; version=0.0.4; charset=utf-8",
                 )
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
@@ -1178,8 +1312,12 @@ def _make_handler(server: SimulatorServer):
                 sent immediately on connect (the stream always yields at
                 least one event) and again whenever the counters change;
               * ``span`` — each flight-recorder event as it is emitted
-                (requires `KSS_TRACE=1`; without it the stream carries
-                metrics events only).
+                (requires `KSS_TRACE=1`);
+              * ``fleet`` — each fleet-observatory sample (requires
+                `KSS_FLEET_STATS=1`);
+              * ``alert`` — each SLO alert transition (utils/slo.py;
+                requires an armed plane). Without any switch the stream
+                carries metrics events only.
 
             With `session_filter` (a nested /sessions/<id>/events route,
             or ?session= on the legacy route) only that session's spans
@@ -1241,10 +1379,31 @@ def _make_handler(server: SimulatorServer):
                     server.sse_count_drop()
                     overflowed.set()
 
+            def alert_feed(ev: dict) -> None:
+                # SLO alert transitions ride the stream as `alert`
+                # events (utils/slo.py) — the dashboard's Alerts-panel
+                # source; the ring exists regardless of arming, so the
+                # subscription is unconditional and simply idle when no
+                # plane is armed
+                if overflowed.is_set():
+                    return
+                if (
+                    session_filter is not None
+                    and ev.get("session") != session_filter
+                ):
+                    return
+                try:
+                    events.put_nowait(("alert", ev))
+                except queue.Full:
+                    server.sse_count_drop()
+                    overflowed.set()
+
+            alerts = slo_mod.alert_log()
             if rec is not None:
                 rec.subscribe(feed)
             if fleet_rec is not None:
                 fleet_rec.subscribe(fleet_feed)
+            alerts.subscribe(alert_feed)
             try:
                 self.send_response(200)
                 self._cors_headers()
@@ -1306,6 +1465,7 @@ def _make_handler(server: SimulatorServer):
                     rec.unsubscribe(feed)
                 if fleet_rec is not None:
                     fleet_rec.unsubscribe(fleet_feed)
+                alerts.unsubscribe(alert_feed)
                 server.sse_release()
 
         # -- watch stream ---------------------------------------------------

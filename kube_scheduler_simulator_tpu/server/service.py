@@ -178,6 +178,10 @@ class SchedulerService:
         self.metrics = (
             metrics if metrics is not None else metrics_mod.SchedulingMetrics()
         )
+        # the SLO plane labels this registry's alerts by tenant
+        # (utils/slo.py); a shared/pre-labeled registry keeps its label
+        if self.metrics.session_id is None and session_id is not None:
+            self.metrics.session_id = session_id
         self._initial = initial_config or SchedulerConfiguration.default()
         self._config = self._initial
         self._lock = locking.make_lock("service.state")
@@ -1033,14 +1037,16 @@ class SchedulerService:
                     1 for r in results if r.status == "Scheduled"
                 )
             # distinct pods, like the synchronous pass (a preempting pod
-            # yields two records)
+            # yields two records); the explicit pass_id keeps the
+            # latency histogram's exemplar causal outside pass_context
             self.metrics.record(
                 metrics_mod.PassRecord(
                     mode,
                     len({(r.pod_namespace, r.pod_name) for r in results}),
                     scheduled,
                     time.perf_counter() - t0,
-                )
+                ),
+                pass_id=pass_id,
             )
             return scheduled
 
@@ -1090,7 +1096,8 @@ class SchedulerService:
                 self.metrics.record(
                     metrics_mod.PassRecord(
                         "gang", 0, 0, time.perf_counter() - t0
-                    )
+                    ),
+                    pass_id=pass_id,
                 )
                 return 0
             with self._session_scope(), telemetry.pass_context(
@@ -1105,7 +1112,8 @@ class SchedulerService:
                     scheduled,
                     time.perf_counter() - t0,
                     rounds,
-                )
+                ),
+                pass_id=pass_id,
             )
             return scheduled
 

@@ -59,6 +59,7 @@ from ..lifecycle.checkpoint import (
 )
 from ..utils import faultinject, fleetstats, locking
 from ..utils import ledger as ledger_mod
+from ..utils import slo as slo_mod
 from ..utils.broker import CompileBroker
 from .service import SchedulerServiceDisabled, SimulatorService
 
@@ -421,14 +422,26 @@ class SessionManager:
         name: "str | None" = None,
         snapshot: "dict | None" = None,
         fault_inject: "str | None" = None,
+        slo: "dict | None" = None,
     ) -> "tuple[Session, list[str]]":
         """A fresh session (admission-controlled). `fault_inject` is the
         KSS_FAULT_INJECT grammar scoped to THIS session only — the
         chaos-testing bulkhead; a malformed spec raises ValueError (400).
-        Returns (session, import errors) — `snapshot` is applied like
+        `slo` is the PUT /slo body shape (utils/slo.py
+        `objectives_from_spec`) applied at birth — a tenant arrives with
+        its objectives declared, not defaulted-then-patched. Returns
+        (session, import errors) — `snapshot` is applied like
         POST /api/v1/import."""
         plane = (
             faultinject.FaultPlane.parse(fault_inject) if fault_inject else None
+        )
+        # parse the SLO spec BEFORE any state exists (a malformed spec
+        # is a 400, and an admitted session must never half-exist) —
+        # the SAME parse the PUT /slo route runs, so the two surfaces
+        # honor identical bodies (incl. window/burn/hold overrides and
+        # {"enabled": false} meaning explicitly disarmed)
+        slo_plane = (
+            slo_mod.plane_from_put_spec(slo, None) if slo is not None else None
         )
         # quota-check the boot snapshot BEFORE any state exists: an
         # over-quota create is shed whole, leaving nothing behind
@@ -442,6 +455,10 @@ class SessionManager:
             sess = Session(sid, name or sid, service)
             sess.fault_spec = fault_inject
             self._sessions[sid] = sess
+        if slo is not None:
+            if slo_plane is not None:
+                slo_plane.session_id = sid
+            service.scheduler.metrics.set_slo_plane(slo_plane)
         errors = service.import_(snapshot) if snapshot else []
         return sess, errors
 

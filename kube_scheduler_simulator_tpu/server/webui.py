@@ -186,6 +186,14 @@ PAGE = """<!doctype html>
  &amp; memory observatory's retained window, KSS_FLEET_STATS=1), then live
  from the /api/v1/events SSE stream (<code>fleet</code> +
  <code>metrics</code> events)</div>
+ <h2>Alerts</h2>
+ <table id="alerttable"><thead><tr><th>objective</th><th>session</th>
+  <th>state</th><th>burn fast / slow</th><th>last transition</th></tr>
+ </thead><tbody></tbody></table>
+ <span id="alertstat" class="hint"></span>
+ <div class="hint">SLO burn-rate alerts (KSS_SLO=1 or a PUT /api/v1/slo
+ override): seeded from /api/v1/alerts, then live from the SSE stream's
+ <code>alert</code> events &mdash; pending &rarr; firing &rarr; resolved</div>
 </div>
 <div id="editorpane">
  <b id="edtitle"></b><br>
@@ -534,6 +542,33 @@ function drawSparks(){
     g.stroke();
   }
 }
+// --- the Alerts panel: one row per (objective, session), updated by
+// the latest transition — seeded from /api/v1/alerts, live from the
+// SSE stream's `alert` events (docs/observability.md)
+const alertRows=new Map();
+function onAlert(ev){
+  if(!ev||!ev.objective) return;
+  alertRows.set(ev.objective+'|'+(ev.session||'default'),ev);
+  drawAlerts();
+}
+function drawAlerts(){
+  const tb=document.querySelector('#alerttable tbody'); tb.innerHTML='';
+  const rows=[...alertRows.values()].sort((a,b)=>
+    (a.objective+a.session)<(b.objective+b.session)?-1:1);
+  for(const ev of rows){
+    const cls=ev.state==='firing'?'bad':(ev.state==='pending'?'pend':'ok');
+    const bf=Number(ev.burnFast??0), bs=Number(ev.burnSlow??0);
+    const tr=document.createElement('tr');
+    tr.innerHTML='<td>'+esc(ev.objective)+'</td>'+
+      '<td>'+esc(ev.session||'default')+'</td>'+
+      '<td><span class="pill '+cls+'">'+esc(ev.state)+'</span></td>'+
+      '<td>'+esc(isNaN(bf)?'?':bf.toFixed(1))
+      +' / '+esc(isNaN(bs)?'?':bs.toFixed(1))+'</td>'+
+      '<td>'+esc(ev.wallTime?new Date(ev.wallTime*1000)
+        .toLocaleTimeString():'')+'</td>';
+    tb.appendChild(tr);
+  }
+}
 async function startObs(){
   if(obsSource) return;
   // connect FIRST, synchronously: the obsSource guard must hold before
@@ -544,6 +579,8 @@ async function startObs(){
     ev=>{obsFromFleet(JSON.parse(ev.data)); drawSparks();});
   obsSource.addEventListener('metrics',
     ev=>{obsFromMetrics(JSON.parse(ev.data)); drawSparks();});
+  obsSource.addEventListener('alert',
+    ev=>{onAlert(JSON.parse(ev.data));});
   document.getElementById('obsbtn').textContent='Stop live telemetry';
   try{  // seed history; the seq dedupe keeps live/seed points ordered
     const r=await fetch('/api/v1/timeseries?limit='+OBS_POINTS);
@@ -553,7 +590,15 @@ async function startObs(){
       ?`observatory armed \\u00b7 ${doc.emitted} samples recorded`
       :'KSS_FLEET_STATS is off: fleet series idle, metrics series live';
   }catch(e){document.getElementById('obsstat').textContent='timeseries: '+e;}
-  drawSparks();
+  try{  // seed the alert table from the history ring
+    const r=await fetch('/api/v1/alerts');
+    const doc=await r.json();
+    (doc.history||[]).forEach(onAlert);
+    document.getElementById('alertstat').textContent=doc.enabled
+      ?`SLO plane armed \\u00b7 ${doc.counters.fired} alert(s) fired`
+      :'SLO plane is off (KSS_SLO=1 or PUT /api/v1/slo to arm)';
+  }catch(e){document.getElementById('alertstat').textContent='alerts: '+e;}
+  drawSparks(); drawAlerts();
 }
 function stopObs(){
   if(obsSource){obsSource.close(); obsSource=null;}

@@ -88,6 +88,16 @@ def _path_validator(raw: str) -> "str | None":
     return None  # any string is a path; existence is created on demand
 
 
+def _slo_objectives_validator(raw: str) -> "str | None":
+    from . import slo
+
+    try:
+        slo.parse_objectives(raw)
+    except ValueError as e:
+        return str(e)
+    return None
+
+
 # name -> validator(raw) returning an error string or None. The ONE
 # catalogue of KSS_* configuration (docs/environment-variables.md).
 KNOWN: "dict[str, Validator]" = {
@@ -114,6 +124,23 @@ KNOWN: "dict[str, Validator]" = {
     "KSS_FLEET_RING_CAP": _int_validator(1),
     "KSS_FLEET_SAMPLE": _int_validator(1),
     "KSS_SPEC_MEM_HEADROOM_BYTES": _int_validator(0),
+    # the SLO plane (utils/slo.py, docs/observability.md): per-tenant
+    # objectives over already-recorded signals, multi-window burn-rate
+    # alerts (pending -> firing -> resolved), and the alert history
+    # ring served by GET /api/v1/alerts; OBJECTIVES is a strict grammar
+    # over the default set (e.g. "passLatency:target=0.999,threshold=0.5")
+    "KSS_SLO": _bool_validator,
+    "KSS_SLO_OBJECTIVES": _slo_objectives_validator,
+    "KSS_SLO_WINDOW_FAST_S": _float_validator(1.0),
+    "KSS_SLO_WINDOW_SLOW_S": _float_validator(1.0),
+    "KSS_SLO_BURN_FAST": _float_validator(0.0),
+    "KSS_SLO_BURN_SLOW": _float_validator(0.0),
+    "KSS_SLO_ALERT_FOR_S": _float_validator(0.0),
+    "KSS_SLO_ALERT_RING_CAP": _int_validator(1),
+    # histogram exemplar capture (utils/metrics.py): on by default —
+    # any FALSY spelling disables attaching the causal pass id to
+    # histogram buckets (the ?format=openmetrics exemplar source)
+    "KSS_EXEMPLARS": _bool_validator,
     # run supervision
     "KSS_COMPILE_DEADLINE_S": _float_validator(0.0),
     "KSS_COMPILE_RETRIES": _int_validator(0),

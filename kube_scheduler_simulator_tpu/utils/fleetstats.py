@@ -445,6 +445,13 @@ class FleetRecorder:
         )
         session = service.session_id or "default"
         ages = self._pending_ages(session, enc, assignment)
+        # the SLO plane's pendingAge observation point (utils/slo.py):
+        # queue age is measured exactly once — here — and the plane
+        # judges the p90 against its threshold (no second measurement
+        # path). No-op with the plane off.
+        service.metrics.record_pending_age(
+            ages["p90Seconds"], ages["maxSeconds"]
+        )
         frag_by_res = {
             name: round(float(frag[i]), 6)
             for i, name in enumerate(enc.resource_names)

@@ -14,31 +14,54 @@ TensorBoard/XProf without the caller importing jax.
 from __future__ import annotations
 
 import bisect
+import os
 import threading
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 
-from . import locking
+from . import locking, telemetry
 
 # The /api/v1/metrics JSON document's schema version: bumped whenever a
 # field changes meaning or disappears (additions don't bump it). v2
 # introduced the version stamp itself, uptimeSeconds, and the
 # histograms block; v3 marks the observatory document shape — the
 # `coldStart` (phase accounting, timeToFirstPassSeconds) and `programs`
-# (per-program ledger summary) blocks the serving layer attaches
-# (docs/observability.md).
-METRICS_SCHEMA_VERSION = 3
+# (per-program ledger summary) blocks the serving layer attaches; v4
+# marks the SLO plane shape (docs/observability.md): the `slo` block
+# (per-objective compliance + alert states, utils/slo.py) and the
+# histogram `exemplars` entries the OpenMetrics exposition attaches to
+# buckets.
+METRICS_SCHEMA_VERSION = 4
+
+# Exemplar capture (docs/observability.md): histogram observations
+# remember the causal pass id of a recent observation per bucket, so
+# `?format=openmetrics` can link a latency bucket straight to its
+# Perfetto span. On by default (one dict write per observation); any
+# FALSY spelling of KSS_EXEMPLARS disables capture entirely.
+_EXEMPLARS_VAR = "KSS_EXEMPLARS"
+
+
+def exemplars_enabled() -> bool:
+    from .envcheck import FALSY
+
+    raw = os.environ.get(_EXEMPLARS_VAR)
+    if not raw:
+        return True  # unset/empty = the default: capture on
+    return raw.strip().lower() not in FALSY
 
 
 class Histogram:
     """A fixed-bucket histogram in the Prometheus style: per-bucket
     observation counts over strictly increasing upper bounds plus an
-    implicit +Inf overflow, a running sum, and a total count. NOT
-    itself thread-safe — `SchedulingMetrics` guards every observation
-    and read with its own lock."""
+    implicit +Inf overflow, a running sum, a total count, and the most
+    recent EXEMPLAR per bucket (the observation's causal pass id — the
+    OpenMetrics hook that links a bucket to its Perfetto span,
+    docs/observability.md). NOT itself thread-safe —
+    `SchedulingMetrics` guards every observation and read with its own
+    lock."""
 
-    __slots__ = ("bounds", "counts", "sum", "count")
+    __slots__ = ("bounds", "counts", "sum", "count", "exemplars")
 
     def __init__(self, bounds: "tuple[float, ...]"):
         bounds = tuple(float(b) for b in bounds)
@@ -52,27 +75,50 @@ class Histogram:
         self.counts = [0] * (len(bounds) + 1)  # [-1] is the +Inf bucket
         self.sum = 0.0
         self.count = 0
+        # parallel to counts: the latest exemplar landing in each
+        # bucket — {"labels": {...}, "value": v, "timestamp": wall}
+        self.exemplars: "list[dict | None]" = [None] * (len(bounds) + 1)
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: "dict | None" = None) -> None:
         v = float(value)
-        self.counts[bisect.bisect_left(self.bounds, v)] += 1
+        idx = bisect.bisect_left(self.bounds, v)
+        self.counts[idx] += 1
         self.sum += v
         self.count += 1
+        if exemplar is not None:
+            self.exemplars[idx] = {
+                "labels": dict(exemplar),
+                "value": v,
+                "timestamp": round(time.time(), 3),
+            }
+
+    def _bucket_keys(self) -> "list[str]":
+        return [repr(b) for b in self.bounds] + ["+Inf"]
 
     def snapshot(self) -> dict:
         """JSON shape (the /api/v1/metrics histograms block): CUMULATIVE
-        bucket counts keyed by upper bound, Prometheus-style."""
+        bucket counts keyed by upper bound, Prometheus-style, plus the
+        per-bucket exemplars (NON-cumulative: an exemplar belongs to
+        the bucket its observation landed in)."""
         cum = 0
         buckets = {}
         for bound, n in zip(self.bounds, self.counts):
             cum += n
             buckets[repr(bound)] = cum
         buckets["+Inf"] = self.count
-        return {
+        out = {
             "buckets": buckets,
             "sum": round(self.sum, 9),
             "count": self.count,
         }
+        exemplars = {
+            key: dict(ex)
+            for key, ex in zip(self._bucket_keys(), self.exemplars)
+            if ex is not None
+        }
+        if exemplars:
+            out["exemplars"] = exemplars
+        return out
 
     def state_dict(self) -> dict:
         return {
@@ -80,12 +126,17 @@ class Histogram:
             "counts": list(self.counts),
             "sum": self.sum,
             "count": self.count,
+            "exemplars": [
+                dict(ex) if ex is not None else None for ex in self.exemplars
+            ],
         }
 
     def load_state(self, state: dict) -> None:
         """Restore `state_dict` output. A checkpoint written with
         different bucket bounds cannot be re-bucketed exactly — it is
-        ignored (fresh histogram) rather than loaded wrong."""
+        ignored (fresh histogram) rather than loaded wrong. Exemplar
+        state written before the SLO PR is simply absent and those
+        slots restart empty."""
         if tuple(float(b) for b in state.get("bounds", ())) != self.bounds:
             return
         counts = state.get("counts")
@@ -94,6 +145,11 @@ class Histogram:
         self.counts = [int(c) for c in counts]
         self.sum = float(state.get("sum", 0.0))
         self.count = int(state.get("count", 0))
+        exemplars = state.get("exemplars")
+        if isinstance(exemplars, list) and len(exemplars) == len(self.exemplars):
+            self.exemplars = [
+                dict(ex) if isinstance(ex, dict) else None for ex in exemplars
+            ]
 
 
 # Default bucket bounds. Pass latency and compile stalls are wall-clock
@@ -232,8 +288,97 @@ class SchedulingMetrics:
     # uptime epoch of this registry (monotonic; NOT checkpointed — a
     # resumed run's uptime is the new process's)
     _born_monotonic: float = field(default_factory=time.monotonic, repr=False)
+    # the SLO plane (utils/slo.py): the session id labeling this
+    # registry's alerts (set once by the owning SchedulerService), the
+    # plane itself (env-derived and cached on the raw KSS_SLO_* strings,
+    # or an explicit PUT/test override), and the cache key
+    session_id: "str | None" = None
+    _slo_plane: "object | None" = field(default=None, repr=False)
+    _slo_override: bool = field(default=False, repr=False)
+    _slo_env_key: "tuple | None" = field(default=None, repr=False)
+    # ratio-objective bookkeeping: a degraded/eager pass's BAD event is
+    # emitted by record_resilience (mid-pass), and these counters make
+    # the pass's own record() skip the matching GOOD event — one event
+    # per pass, so an all-degraded run reads compliance 0.0, not 0.5
+    _slo_skip_eager: int = field(default=0, repr=False)
+    _slo_skip_degraded: int = field(default=0, repr=False)
 
-    def record(self, rec: PassRecord) -> None:
+    # -- the SLO plane (utils/slo.py) ----------------------------------------
+
+    def slo_plane(self):
+        """The registry's SLO plane, or None (the default: plane off).
+        An explicit override (`set_slo_plane` — the per-session PUT
+        route, checkpoint restore, tests) wins; otherwise the plane is
+        built from the KSS_SLO_* environment and rebuilt only when
+        those raw strings change (the telemetry `active()` pattern)."""
+        from . import slo as slo_mod
+
+        with self._lock:
+            if self._slo_override:
+                return self._slo_plane
+            key = slo_mod.env_key()
+            if self._slo_env_key == key:
+                return self._slo_plane
+            plane = (
+                slo_mod.SloPlane(session_id=self.session_id)
+                if slo_mod.enabled()
+                else None
+            )
+            self._slo_env_key = key
+            self._slo_plane = plane
+            return plane
+
+    def set_slo_plane(self, plane) -> None:
+        """Install `plane` regardless of the environment (None = plane
+        explicitly off) — the per-session PUT /slo override."""
+        with self._lock:
+            self._slo_plane = plane
+            self._slo_override = True
+
+    def clear_slo_override(self) -> None:
+        """Drop any explicit plane; the environment rules again."""
+        with self._lock:
+            self._slo_plane = None
+            self._slo_override = False
+            self._slo_env_key = None
+
+    def slo_tick(self, sim_t: float) -> None:
+        """Advance the plane's clock to simulated time `sim_t` (the
+        lifecycle engine's per-batch call): windows slide and alerts
+        evaluate on the run's own timeline. No-op with the plane off."""
+        plane = self.slo_plane()
+        if plane is not None:
+            plane.tick_sim(sim_t)
+
+    def record_pending_age(
+        self, p90_s: float, max_s: "float | None" = None
+    ) -> None:
+        """The pending-age observation point (fed by the fleet
+        observatory's per-pass age percentiles, utils/fleetstats.py —
+        the one place queue age is already measured): one SLO event
+        per sampled pass, judged against the pendingAge threshold."""
+        plane = self.slo_plane()
+        if plane is not None:
+            plane.observe("pendingAge", value=float(p90_s))
+
+    def _hist_exemplar(self, pass_id: "int | None" = None) -> "dict | None":
+        """The exemplar attached to a histogram observation: the causal
+        pass id (`span_id` — the id every one of the pass's Perfetto
+        spans carries as args.pass) plus the session label. None when
+        capture is disabled (KSS_EXEMPLARS) or no pass is in context."""
+        if not exemplars_enabled():
+            return None
+        pid = pass_id if pass_id is not None else telemetry.current_pass_id()
+        if pid is None:
+            return None
+        ex = {"span_id": str(pid)}
+        sid = telemetry.current_session_id() or self.session_id
+        if sid is not None:
+            ex["session"] = sid
+        return ex
+
+    def record(self, rec: PassRecord, pass_id: "int | None" = None) -> None:
+        exemplar = self._hist_exemplar(pass_id)
         with self._lock:
             self._passes.append(rec)
             if len(self._passes) > self.keep:
@@ -242,7 +387,18 @@ class SchedulingMetrics:
             self._total_pods += rec.pods
             self._total_scheduled += rec.scheduled
             self._total_wall_s += rec.wall_s
-            self._hist["passLatencySeconds"].observe(rec.wall_s)
+            self._hist["passLatencySeconds"].observe(
+                rec.wall_s, exemplar=exemplar
+            )
+            # this pass's ratio events: a degraded/eager pass already
+            # emitted its BAD event from record_resilience — consume
+            # the skip so the pass contributes exactly one event
+            eager_ok = self._slo_skip_eager <= 0
+            if not eager_ok:
+                self._slo_skip_eager -= 1
+            degraded_ok = self._slo_skip_degraded <= 0
+            if not degraded_ok:
+                self._slo_skip_degraded -= 1
         # cold-start accounting (utils/ledger.py): every pass — any
         # registry, any driver — lands here, so the FIRST one that
         # actually placed a pod closes the process's
@@ -253,6 +409,17 @@ class SchedulingMetrics:
             from .ledger import COLD_START
 
             COLD_START.mark("firstPass")
+        # SLO observation points (utils/slo.py), outside the lock: one
+        # passLatency event per pass, plus the GOOD half of the
+        # eager-fallback / degraded-pass ratio objectives — skipped for
+        # a pass whose bad event record_resilience already emitted
+        plane = self.slo_plane()
+        if plane is not None:
+            plane.observe("passLatency", value=rec.wall_s)
+            if eager_ok:
+                plane.observe("eagerFallback", good=True)
+            if degraded_ok:
+                plane.observe("degradedPass", good=True)
 
     def record_disruption(
         self,
@@ -263,14 +430,23 @@ class SchedulingMetrics:
         """One fault-injection event's disruption tally: pods evicted by
         the fault, pods re-bound afterwards, and per-pod simulated
         time-to-reschedule for the re-binds that happened this event."""
+        times = [float(t) for t in times_to_reschedule_s or ()]
+        exemplar = self._hist_exemplar() if times else None
         with self._lock:
             self._evicted += int(evicted)
             self._rescheduled += int(rescheduled)
-            for t in times_to_reschedule_s or ():
-                self._tts_sum_s += float(t)
-                self._tts_max_s = max(self._tts_max_s, float(t))
+            for t in times:
+                self._tts_sum_s += t
+                self._tts_max_s = max(self._tts_max_s, t)
                 self._tts_count += 1
-                self._hist["timeToRescheduleSeconds"].observe(float(t))
+                self._hist["timeToRescheduleSeconds"].observe(
+                    t, exemplar=exemplar
+                )
+        if times:
+            plane = self.slo_plane()
+            if plane is not None:
+                for t in times:
+                    plane.observe("timeToReschedule", value=t)
 
     def record_encode(self, mode: str, seconds: float = 0.0) -> None:
         """One encode attempt: `mode` is the path that served it
@@ -301,13 +477,16 @@ class SchedulingMetrics:
         on an in-flight build), `misses` compiled synchronously on the
         request thread, `speculative` background builds completed,
         `stall_s` request-thread seconds blocked on compilation."""
+        exemplar = self._hist_exemplar() if stall_s > 0 else None
         with self._lock:
             self._compile_hits += int(hits)
             self._compile_misses += int(misses)
             self._speculative_compiles += int(speculative)
             self._stall_s += float(stall_s)
             if stall_s > 0:
-                self._hist["compileStallSeconds"].observe(float(stall_s))
+                self._hist["compileStallSeconds"].observe(
+                    float(stall_s), exemplar=exemplar
+                )
 
     def record_resilience(
         self,
@@ -338,6 +517,25 @@ class SchedulingMetrics:
             self._dispatch_retries += int(dispatch_retries)
             self._device_failovers += int(device_failovers)
             self._mesh_shrinks += int(mesh_shrinks)
+            # arm the ratio-objective skips: the enclosing pass's
+            # record() must not also count a GOOD event for a pass
+            # whose bad event lands right here
+            self._slo_skip_eager += int(eager_fallbacks)
+            self._slo_skip_degraded += int(degraded_passes)
+        # the bad halves of the ratio objectives (utils/slo.py),
+        # emitted immediately — a terminally-degraded pass that never
+        # reaches record() still burns its budget
+        if eager_fallbacks or degraded_passes:
+            plane = self.slo_plane()
+            if plane is not None:
+                if eager_fallbacks:
+                    plane.observe(
+                        "eagerFallback", good=False, count=int(eager_fallbacks)
+                    )
+                if degraded_passes:
+                    plane.observe(
+                        "degradedPass", good=False, count=int(degraded_passes)
+                    )
 
     def record_bundles(
         self,
@@ -387,7 +585,7 @@ class SchedulingMetrics:
     def snapshot(self) -> dict:
         with self._lock:
             recent = self._passes[-16:]
-            return {
+            doc = {
                 "schemaVersion": METRICS_SCHEMA_VERSION,
                 "uptimeSeconds": round(
                     time.monotonic() - self._born_monotonic, 3
@@ -452,6 +650,12 @@ class SchedulingMetrics:
                     key: h.snapshot() for key, h in self._hist.items()
                 },
             }
+        # the SLO block (schema v4, utils/slo.py) attaches OUTSIDE the
+        # registry lock — the plane has its own lock, and the two never
+        # nest (lock-order discipline, docs/static-analysis.md)
+        plane = self.slo_plane()
+        doc["slo"] = plane.summary() if plane is not None else {"enabled": False}
+        return doc
 
     def reset(self) -> None:
         with self._lock:
@@ -487,6 +691,8 @@ class SchedulingMetrics:
             self._bundle_saves = 0
             self._bundle_bypasses = 0
             self._aot_deserialize_s = 0.0
+            self._slo_skip_eager = 0
+            self._slo_skip_degraded = 0
             self._hist = _new_histograms()
             self._born_monotonic = time.monotonic()
 
@@ -508,7 +714,10 @@ class SchedulingMetrics:
     def state_dict(self) -> dict:
         """The cumulative counters as one JSON-able dict — what a
         lifecycle checkpoint persists so a resumed run's final metrics
-        report the WHOLE run, not just the post-resume suffix."""
+        report the WHOLE run, not just the post-resume suffix. With the
+        SLO plane armed, its window + alert state rides along
+        (`_slo`), so a drained/resumed process keeps burning the same
+        error budget instead of starting a fresh one."""
         with self._lock:
             out = {f: getattr(self, f) for f in self._STATE_FIELDS}
             out["_phase_s"] = dict(self._phase_s)
@@ -516,13 +725,20 @@ class SchedulingMetrics:
             out["_histograms"] = {
                 key: h.state_dict() for key, h in self._hist.items()
             }
-            return out
+        plane = self.slo_plane()
+        if plane is not None:
+            out["_slo"] = plane.state_dict()
+        return out
 
     def load_state(self, state: dict) -> None:
         """Restore counters written by `state_dict` (unknown keys are
         ignored so old checkpoints stay loadable across counter growth;
         histogram state written before the telemetry PR is simply
-        absent and those distributions restart empty)."""
+        absent and those distributions restart empty). A checkpointed
+        SLO plane is restored when it was an explicit override OR the
+        environment still arms the plane — an operator who turned
+        KSS_SLO off must not have a checkpoint re-arm it."""
+        slo_state = state.get("_slo")
         with self._lock:
             for f in self._STATE_FIELDS:
                 if f in state:
@@ -535,6 +751,24 @@ class SchedulingMetrics:
                 for key, h in self._hist.items():
                     if isinstance(hists.get(key), dict):
                         h.load_state(hists[key])
+        if isinstance(slo_state, dict):
+            from . import slo as slo_mod
+
+            explicit = bool(
+                (slo_state.get("config") or {}).get("explicit")
+            )
+            if explicit or slo_mod.enabled():
+                plane = slo_mod.SloPlane.from_state(slo_state)
+                if plane.session_id is None:
+                    plane.session_id = self.session_id
+                # an explicit (PUT-override) plane restores as an
+                # override; an env-derived one restores into the env
+                # cache slot instead — a later KSS_SLO_* change must
+                # still rebuild/disarm it, exactly as before the resume
+                with self._lock:
+                    self._slo_plane = plane
+                    self._slo_override = explicit
+                    self._slo_env_key = None if explicit else slo_mod.env_key()
 
 
 # process-wide shared registry for ad-hoc callers (benchmarks, scripts).
@@ -658,19 +892,30 @@ def _fmt_value(v) -> str:
     return repr(f)
 
 
-def render_prometheus(snapshot: dict, extra_gauges: "dict | None" = None) -> str:
+def render_prometheus(
+    snapshot: dict,
+    extra_gauges: "dict | None" = None,
+    openmetrics: bool = False,
+) -> str:
     """Render a `SchedulingMetrics.snapshot()` document in the
     Prometheus text exposition format (version 0.0.4): counters,
     gauges, and the histogram families, with stable metric names.
     `extra_gauges` maps metric name -> (help, value) for serving-stack
-    extras (the encoding-cache capacity)."""
-    return _render_prometheus([({}, snapshot, extra_gauges)])
+    extras (the encoding-cache capacity). `openmetrics` attaches the
+    snapshot's histogram exemplars to bucket samples
+    (``# {span_id="…"} value ts`` — the OpenMetrics exemplar syntax);
+    the serving route appends the terminating ``# EOF`` itself, after
+    the observatory families."""
+    return _render_prometheus(
+        [({}, snapshot, extra_gauges)], openmetrics=openmetrics
+    )
 
 
 def render_prometheus_sessions(
     entries: "list[tuple[dict, dict, dict | None]]",
     global_counters: "dict | None" = None,
     global_gauges: "dict | None" = None,
+    openmetrics: bool = False,
 ) -> str:
     """Multi-tenant exposition (docs/sessions.md): one document, each
     family declared ONCE, every sample labeled per entry. `entries` is
@@ -679,8 +924,24 @@ def render_prometheus_sessions(
     `global_counters`/`global_gauges` map name -> (help, value) for
     server-wide unlabeled extras (the SSE drop counter, session counts)."""
     return _render_prometheus(
-        entries, global_counters=global_counters, global_gauges=global_gauges
+        entries,
+        global_counters=global_counters,
+        global_gauges=global_gauges,
+        openmetrics=openmetrics,
     )
+
+
+def _fmt_exemplar(ex: dict) -> str:
+    """One OpenMetrics exemplar suffix: ``# {labels} value [timestamp]``
+    appended to a histogram bucket sample line."""
+    labels = ",".join(
+        f'{k}="{v}"' for k, v in (ex.get("labels") or {}).items()
+    )
+    out = f" # {{{labels}}} {_fmt_value(ex.get('value', 0.0))}"
+    ts = ex.get("timestamp")
+    if ts is not None:
+        out += f" {_fmt_value(ts)}"
+    return out
 
 
 def _label_body(labels: dict, extra: "tuple | None" = None) -> str:
@@ -694,6 +955,7 @@ def _render_prometheus(
     entries,
     global_counters: "dict | None" = None,
     global_gauges: "dict | None" = None,
+    openmetrics: bool = False,
 ) -> str:
     lines: list[str] = []
 
@@ -799,11 +1061,15 @@ def _render_prometheus(
             continue
         family(name, "histogram", help_text)
         for labels, h in carrying:
+            exemplars = h.get("exemplars") or {}
             for le, cum in h["buckets"].items():
-                lines.append(
+                line = (
                     f"{name}_bucket{_label_body(labels, (('le', le),))} "
                     f"{_fmt_value(cum)}"
                 )
+                if openmetrics and le in exemplars:
+                    line += _fmt_exemplar(exemplars[le])
+                lines.append(line)
             lines.append(f"{name}_sum{_label_body(labels)} {_fmt_value(h['sum'])}")
             lines.append(
                 f"{name}_count{_label_body(labels)} {_fmt_value(h['count'])}"
@@ -822,7 +1088,14 @@ def parse_prometheus_text(text: str) -> dict:
     with labels as a dict. Raises ValueError on: unparseable lines,
     samples without a preceding TYPE, duplicate TYPE lines, histogram
     families with non-monotonic cumulative buckets, a missing/out-of-
-    order +Inf bucket, or +Inf disagreeing with `_count`."""
+    order +Inf bucket, or +Inf disagreeing with `_count`.
+
+    OpenMetrics round-trip (the `?format=openmetrics` contract): a
+    histogram bucket sample may carry an exemplar suffix
+    (``# {labels} value [timestamp]``), collected into the family's
+    ``"exemplars"`` list as ``(sample_name, labels, exemplar_labels,
+    exemplar_value)``; a malformed exemplar, or one on a non-bucket
+    sample, raises. A terminating ``# EOF`` line is accepted."""
     global _PROM_SAMPLE_RE
     import re
 
@@ -868,8 +1141,26 @@ def parse_prometheus_text(text: str) -> dict:
             fam["type"] = parts[1]
             continue
         if line.startswith("#"):
-            continue  # comment
-        m = sample_re.match(line)
+            continue  # comment (incl. the OpenMetrics "# EOF" terminator)
+        # an OpenMetrics exemplar rides the sample line after " # " —
+        # but '#' is legal inside quoted label values, so the split
+        # point is the first " # " whose PREFIX is a complete sample (a
+        # mid-label '#' leaves an unparseable prefix and is skipped).
+        # Splits are tried BEFORE the whole-line match: the label
+        # regex's greedy braces would otherwise swallow an exemplar's
+        # label body into the sample's
+        exemplar_part = None
+        m = None
+        pos = line.find(" # ")
+        while pos != -1:
+            cand = sample_re.match(line[:pos])
+            if cand is not None:
+                m = cand
+                exemplar_part = line[pos + 3 :]
+                break
+            pos = line.find(" # ", pos + 1)
+        if m is None:
+            m = sample_re.match(line)
         if not m:
             raise ValueError(f"line {lineno}: unparseable sample {line!r}")
         name, label_body, raw_value = m.group(1), m.group(2), m.group(3)
@@ -893,6 +1184,34 @@ def parse_prometheus_text(text: str) -> dict:
                 f"line {lineno}: sample {name!r} has no preceding TYPE"
             )
         fam["samples"].append((name, labels, value))
+        if exemplar_part is not None:
+            if fam["type"] != "histogram" or not name.endswith("_bucket"):
+                raise ValueError(
+                    f"line {lineno}: exemplar on non-bucket sample {name!r}"
+                )
+            em = re.match(
+                r"^\{(.*)\}\s+(-?(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?)"
+                r"(?:\s+(-?(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?))?$",
+                exemplar_part,
+            )
+            if not em:
+                raise ValueError(
+                    f"line {lineno}: malformed exemplar {exemplar_part!r}"
+                )
+            ex_body = em.group(1)
+            consumed = sum(
+                len(lm.group(0)) for lm in label_re.finditer(ex_body)
+            )
+            if ex_body and consumed != len(ex_body):
+                raise ValueError(
+                    f"line {lineno}: malformed exemplar labels {ex_body!r}"
+                )
+            ex_labels = {
+                lm.group(1): lm.group(2) for lm in label_re.finditer(ex_body)
+            }
+            fam.setdefault("exemplars", []).append(
+                (name, labels, ex_labels, float(em.group(2)))
+            )
 
     # histogram semantics: cumulative monotone buckets, +Inf last and
     # equal to _count — validated PER LABEL SET (minus `le`), so a

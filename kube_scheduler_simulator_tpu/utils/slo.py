@@ -1,0 +1,1008 @@
+"""The serving-grade SLO plane: per-tenant objectives, multi-window
+burn-rate alerting, and the alert history ring (docs/observability.md).
+
+The stack records every raw signal production needs — latency
+histograms, per-tenant sessions, per-program cost, fleet time-series —
+but nothing *judges* them. This module closes the operator loop with
+the canonical SRE shape:
+
+  * **Objectives** — a small declarative registry over signals the
+    `SchedulingMetrics` observation points already record (no second
+    measurement path): pass latency, time-to-reschedule, pending-queue
+    age, and the eager-fallback / degraded-pass ratios. Each objective
+    compiles into sliding good/bad event windows; defaults can be
+    overridden by ``KSS_SLO_OBJECTIVES`` (a strict grammar validated at
+    boot) or per session via ``PUT /api/v1/sessions/<id>/slo``.
+
+  * **Burn-rate alerting** — the multi-window evaluation from the SRE
+    workbook: an alert condition holds when the error-budget burn rate
+    exceeds its threshold over BOTH a fast (~5m) and a slow (~1h)
+    window, so a one-off blip (fast only) and a long-ago bad era (slow
+    only) both stay quiet. Conditions walk a pending → firing →
+    resolved state machine (``KSS_SLO_ALERT_FOR_S`` is the pending
+    hold); every transition lands in a bounded process-wide
+    `AlertLog` ring (the `SpanRecorder` pattern), is emitted as an
+    ``alert.<state>`` telemetry instant, streamed as an SSE ``alert``
+    event, and served by ``GET /api/v1/alerts``.
+
+  * **Sim-time awareness** — the plane's clock is
+    ``max(wall monotonic, last sim tick)``: the lifecycle engine ticks
+    `SchedulingMetrics.slo_tick(sim_t)` as its timeline advances, so a
+    chaos run that compresses an hour of simulated time into seconds
+    of wall time still walks alerts through their full lifecycle —
+    the injected-fault smoke gate (tools/observability_smoke.py)
+    demonstrates pending → firing → resolved end-to-end this way.
+
+Off by default (``KSS_SLO``), like every observer in this tree; armed,
+an observation is one short lock hold per pass-level event, and
+placements are byte-identical with the plane armed or off (the
+sampling-invariance contract, pinned in tests/test_slo.py).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import deque
+from dataclasses import dataclass
+
+from . import locking, telemetry
+from .envcheck import env_truthy
+
+ENV_VAR = "KSS_SLO"
+OBJ_VAR = "KSS_SLO_OBJECTIVES"
+FAST_VAR = "KSS_SLO_WINDOW_FAST_S"
+SLOW_VAR = "KSS_SLO_WINDOW_SLOW_S"
+BURN_FAST_VAR = "KSS_SLO_BURN_FAST"
+BURN_SLOW_VAR = "KSS_SLO_BURN_SLOW"
+FOR_VAR = "KSS_SLO_ALERT_FOR_S"
+CAP_VAR = "KSS_SLO_ALERT_RING_CAP"
+
+DEFAULT_WINDOW_FAST_S = 300.0
+DEFAULT_WINDOW_SLOW_S = 3600.0
+# the SRE-workbook page-tier pair: the slow window proves budget is
+# really burning, the fast window proves it is STILL burning
+DEFAULT_BURN_FAST = 14.4
+DEFAULT_BURN_SLOW = 6.0
+DEFAULT_ALERT_FOR_S = 60.0
+DEFAULT_ALERT_RING_CAP = 256
+
+# observation cadence guard: observe-triggered evaluations are
+# rate-limited to one per plane-clock second (explicit evaluate() —
+# route reads, sim ticks — always runs)
+_EVAL_MIN_INTERVAL_S = 1.0
+
+
+def _lenient_float(raw: str, default: float, minimum: float) -> float:
+    """The shared lenient-knob parse (the telemetry ring-cap contract):
+    a typo must never disable the plane or blow a bound — strict
+    rejection happens at boot via envcheck."""
+    try:
+        v = float(raw) if raw else default
+    except ValueError:
+        return default
+    return v if v >= minimum else default
+
+
+def _lenient_int(raw: str, default: int, minimum: int) -> int:
+    try:
+        v = int(raw) if raw else default
+    except ValueError:
+        return default
+    return v if v >= minimum else default
+
+
+def enabled() -> bool:
+    """True when KSS_SLO arms the plane process-wide (per-session PUT
+    overrides work either way)."""
+    return env_truthy(os.environ.get(ENV_VAR))
+
+
+def window_fast_from_env() -> float:
+    return _lenient_float(
+        os.environ.get(FAST_VAR, ""), DEFAULT_WINDOW_FAST_S, 1.0
+    )
+
+
+def window_slow_from_env() -> float:
+    return _lenient_float(
+        os.environ.get(SLOW_VAR, ""), DEFAULT_WINDOW_SLOW_S, 1.0
+    )
+
+
+def burn_fast_from_env() -> float:
+    return _lenient_float(os.environ.get(BURN_FAST_VAR, ""), DEFAULT_BURN_FAST, 0.0)
+
+
+def burn_slow_from_env() -> float:
+    return _lenient_float(os.environ.get(BURN_SLOW_VAR, ""), DEFAULT_BURN_SLOW, 0.0)
+
+
+def alert_for_from_env() -> float:
+    return _lenient_float(os.environ.get(FOR_VAR, ""), DEFAULT_ALERT_FOR_S, 0.0)
+
+
+def alert_ring_cap_from_env() -> int:
+    return _lenient_int(os.environ.get(CAP_VAR, ""), DEFAULT_ALERT_RING_CAP, 1)
+
+
+def env_key() -> tuple:
+    """The raw env strings the plane is built from — the metrics-side
+    cache key (`SchedulingMetrics.slo_plane` rebuilds only when one of
+    these changes, the telemetry/fleetstats `active()` pattern)."""
+    return tuple(
+        os.environ.get(var, "")
+        for var in (
+            ENV_VAR, OBJ_VAR, FAST_VAR, SLOW_VAR,
+            BURN_FAST_VAR, BURN_SLOW_VAR, FOR_VAR,
+        )
+    )
+
+
+# -- objectives ----------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Objective:
+    """One service-level objective over an already-recorded signal.
+
+    `target` is the good-event fraction the SLO promises (error budget
+    = 1 - target). `threshold` turns a valued signal (seconds) into a
+    good/bad event: good iff value <= threshold; ratio signals
+    (eager-fallback, degraded-pass) carry no threshold — their
+    observation points declare good/bad directly."""
+
+    name: str
+    signal: str
+    target: float
+    threshold: "float | None" = None
+    description: str = ""
+
+    def judge(self, good: "bool | None", value: "float | None") -> bool:
+        if self.threshold is not None and value is not None:
+            return float(value) <= self.threshold
+        return bool(good)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "signal": self.signal,
+            "target": self.target,
+            "threshold": self.threshold,
+            "description": self.description,
+        }
+
+
+# signal name -> what a good event means (the observation points live
+# in utils/metrics.py; pendingAge rides the fleet sampler's ages)
+SIGNALS = {
+    "passLatency": "wall-clock pass latency within threshold seconds",
+    "timeToReschedule": "an evicted pod re-bound within threshold "
+    "SIMULATED seconds",
+    "pendingAge": "p90 pending-queue age within threshold seconds "
+    "(needs KSS_FLEET_STATS sampling)",
+    "eagerFallback": "a pass NOT served by the un-jitted eager rung",
+    "degradedPass": "a pass served by a compiled engine (not degraded)",
+}
+
+_DEFAULTS = (
+    Objective(
+        "passLatency", "passLatency", 0.99, 1.0,
+        "99% of scheduling passes complete within 1s",
+    ),
+    Objective(
+        "timeToReschedule", "timeToReschedule", 0.95, 60.0,
+        "95% of evicted pods re-bind within 60 simulated seconds",
+    ),
+    Objective(
+        "pendingAge", "pendingAge", 0.90, 300.0,
+        "90% of sampled passes keep p90 pending age under 300s",
+    ),
+    Objective(
+        "eagerFallback", "eagerFallback", 0.99, None,
+        "99% of passes are served jitted (not by the eager rung)",
+    ),
+    Objective(
+        "degradedPass", "degradedPass", 0.99, None,
+        "99% of passes are served by a compiled engine",
+    ),
+)
+
+
+def default_objectives() -> "dict[str, Objective]":
+    return {o.name: o for o in _DEFAULTS}
+
+
+def parse_objectives(raw: str) -> "dict[str, Objective]":
+    """The KSS_SLO_OBJECTIVES grammar, strictly parsed (the envcheck
+    validator runs this, so a typo is a boot error, not a silently
+    ignored override). Semicolon-separated entries over the default
+    set:
+
+        passLatency:target=0.999,threshold=0.5;pendingAge:off
+
+    Each entry names a known signal and either disables it (``off``)
+    or overrides ``target`` (a fraction in (0, 1)) and/or
+    ``threshold`` (seconds, > 0)."""
+    out = default_objectives()
+    if not raw or not raw.strip():
+        return out
+    for entry in raw.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, sep, params = entry.partition(":")
+        name = name.strip()
+        if name not in SIGNALS:
+            raise ValueError(
+                f"SLO objective {name!r}: unknown signal "
+                f"(known: {', '.join(sorted(SIGNALS))})"
+            )
+        if not sep or not params.strip():
+            raise ValueError(
+                f"SLO objective {name!r}: expected "
+                f"'{name}:off' or '{name}:target=...[,threshold=...]'"
+            )
+        if params.strip() == "off":
+            out.pop(name, None)
+            continue
+        base = default_objectives()[name]
+        target, threshold = base.target, base.threshold
+        for kv in params.split(","):
+            key, sep2, value = kv.partition("=")
+            key = key.strip()
+            if not sep2:
+                raise ValueError(
+                    f"SLO objective {name!r}: malformed parameter {kv!r} "
+                    f"(expected key=value)"
+                )
+            try:
+                v = float(value)
+            except ValueError:
+                raise ValueError(
+                    f"SLO objective {name!r}: {key} {value!r} is not a "
+                    f"number"
+                ) from None
+            if key == "target":
+                if not 0.0 < v < 1.0:
+                    raise ValueError(
+                        f"SLO objective {name!r}: target {v} outside (0, 1)"
+                    )
+                target = v
+            elif key == "threshold":
+                if v <= 0:
+                    raise ValueError(
+                        f"SLO objective {name!r}: threshold {v} must be > 0"
+                    )
+                threshold = v
+            else:
+                raise ValueError(
+                    f"SLO objective {name!r}: unknown parameter {key!r} "
+                    f"(target, threshold)"
+                )
+        out[name] = Objective(
+            name, base.signal, target, threshold, base.description
+        )
+    return out
+
+
+def objectives_from_env() -> "dict[str, Objective]":
+    """The effective objective set: defaults overridden by
+    KSS_SLO_OBJECTIVES; a malformed value (already rejected at boot by
+    envcheck) falls back to the defaults at this lenient runtime
+    layer."""
+    raw = os.environ.get(OBJ_VAR, "")
+    try:
+        return parse_objectives(raw)
+    except ValueError:
+        return default_objectives()
+
+
+def objectives_from_spec(spec) -> "dict[str, Objective]":
+    """Objectives from a PUT /slo JSON body: a list of
+    ``{"signal", "target", "threshold"}`` mappings (or a
+    name-keyed mapping of the same), layered over the defaults.
+    Raises ValueError with a client-addressable message (400)."""
+    out = default_objectives()
+    if spec is None:
+        return out
+    if isinstance(spec, dict):
+        spec = [
+            {"signal": name, **(params or {})}
+            for name, params in spec.items()
+        ]
+    if not isinstance(spec, list):
+        raise ValueError("objectives must be a list or a mapping")
+    for item in spec:
+        if not isinstance(item, dict) or "signal" not in item:
+            raise ValueError(
+                f"objective {item!r} must be a mapping with a 'signal'"
+            )
+        name = str(item["signal"])
+        if name not in SIGNALS:
+            raise ValueError(
+                f"unknown SLO signal {name!r} "
+                f"(known: {', '.join(sorted(SIGNALS))})"
+            )
+        if item.get("enabled") is False or item.get("off"):
+            out.pop(name, None)
+            continue
+        base = default_objectives()[name]
+        target = float(item.get("target", base.target))
+        if not 0.0 < target < 1.0:
+            raise ValueError(f"objective {name!r}: target outside (0, 1)")
+        threshold = item.get("threshold", base.threshold)
+        if threshold is not None:
+            threshold = float(threshold)
+            if threshold <= 0:
+                raise ValueError(f"objective {name!r}: threshold must be > 0")
+        out[name] = Objective(
+            name, base.signal, target, threshold, base.description
+        )
+    return out
+
+
+def plane_from_put_spec(body, session_id: "str | None") -> "SloPlane | None":
+    """The ONE parse of the PUT /slo body shape — shared by the HTTP
+    route and session-create's ``"slo"`` key so the two surfaces can't
+    drift: objectives layered over the defaults plus optional
+    window/burn/hold overrides, built into an explicit plane. Returns
+    None for ``{"enabled": false}`` (the caller disarms). Raises
+    ValueError with a client-addressable message (400)."""
+    if not isinstance(body, dict):
+        raise ValueError("SLO spec must be a mapping")
+    if body.get("enabled") is False:
+        return None
+    objectives = objectives_from_spec(body.get("objectives"))
+    kwargs: dict = {}
+    for key, name, minimum in (
+        ("windowFastSeconds", "window_fast_s", 1.0),
+        ("windowSlowSeconds", "window_slow_s", 1.0),
+        ("burnFastThreshold", "burn_fast", 0.0),
+        ("burnSlowThreshold", "burn_slow", 0.0),
+        ("forSeconds", "for_s", 0.0),
+    ):
+        if key in body:
+            try:
+                v = float(body[key])
+            except (TypeError, ValueError):
+                raise ValueError(f"{key} must be a number") from None
+            if v < minimum:
+                raise ValueError(f"{key} must be >= {minimum}, got {v}")
+            kwargs[name] = v
+    return SloPlane(
+        session_id=session_id, objectives=objectives, explicit=True, **kwargs
+    )
+
+
+# -- the alert history ring ----------------------------------------------------
+
+
+@locking.guard_inferred
+class AlertLog:
+    """A bounded process-wide ring of alert transitions + live
+    subscribers — the `SpanRecorder` shape: `emit` holds the lock only
+    to place the event, stamp its sequence, and advance the cumulative
+    counters; subscriber callbacks (the SSE route's ``alert`` feed) run
+    OUTSIDE the lock. One ring serves every session's plane — each
+    event carries its session id, exactly like spans."""
+
+    def __init__(self, capacity: "int | None" = None):
+        cap = alert_ring_cap_from_env() if capacity is None else int(capacity)
+        if cap < 1:
+            raise ValueError(f"ring capacity must be >= 1, got {cap}")
+        self.capacity = cap
+        self._lock = locking.make_lock("slo.alertlog")
+        self._ring: "list[dict | None]" = [None] * cap
+        self._seq = 0
+        self._subs: list = []
+        self._transitions = 0
+        self._fired = 0
+
+    def emit(self, ev: dict) -> None:
+        with self._lock:
+            ev = dict(ev)
+            ev["seq"] = self._seq
+            self._ring[self._seq % self.capacity] = ev
+            self._seq += 1
+            self._transitions += 1
+            if ev.get("state") == "firing":
+                self._fired += 1
+            subs = tuple(self._subs) if self._subs else ()
+        for fn in subs:
+            try:
+                fn(ev)
+            except Exception:  # noqa: BLE001 — a dead subscriber never breaks a pass
+                pass
+
+    @property
+    def emitted(self) -> int:
+        with self._lock:
+            return self._seq
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return max(0, self._seq - self.capacity)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return min(self._seq, self.capacity)
+
+    def snapshot(self) -> "list[dict]":
+        with self._lock:
+            n = self._seq
+            if n <= self.capacity:
+                return list(self._ring[:n])
+            i = n % self.capacity
+            return self._ring[i:] + self._ring[:i]
+
+    def counters(self) -> dict:
+        with self._lock:
+            return {"transitions": self._transitions, "fired": self._fired}
+
+    def subscribe(self, fn) -> None:
+        with self._lock:
+            self._subs.append(fn)
+
+    def unsubscribe(self, fn) -> None:
+        with self._lock:
+            try:
+                self._subs.remove(fn)
+            except ValueError:
+                pass
+
+
+_log_lock = locking.make_lock("slo.logconfig")
+_log: "AlertLog | None" = None
+
+
+def alert_log() -> AlertLog:
+    """The process-wide alert history ring, built lazily (capacity from
+    KSS_SLO_ALERT_RING_CAP at first use)."""
+    global _log
+    log = _log
+    if log is not None:
+        return log
+    with _log_lock:
+        if _log is None:
+            _log = AlertLog(alert_ring_cap_from_env())
+        return _log
+
+
+def reset_alert_log(capacity: "int | None" = None) -> AlertLog:
+    """Swap in a fresh ring (tests, the smoke tooling) and return it."""
+    global _log
+    with _log_lock:
+        _log = AlertLog(capacity)
+        return _log
+
+
+# -- the per-tenant plane ------------------------------------------------------
+
+_ALERT_STATE_VALUES = {"inactive": 0, "pending": 1, "firing": 2}
+
+
+@locking.guard_inferred
+class SloPlane:
+    """One tenant's SLO state: objectives, sliding good/bad event
+    windows, and the per-objective alert state machine. Owned by the
+    session's `SchedulingMetrics` (the observation funnel forwards
+    into `observe`); all mutable state lives under one short-hold
+    lock, and transition side effects (ring emit, telemetry instants)
+    run outside it."""
+
+    def __init__(
+        self,
+        session_id: "str | None" = None,
+        objectives: "dict[str, Objective] | None" = None,
+        *,
+        window_fast_s: "float | None" = None,
+        window_slow_s: "float | None" = None,
+        burn_fast: "float | None" = None,
+        burn_slow: "float | None" = None,
+        for_s: "float | None" = None,
+        explicit: bool = False,
+    ):
+        self.session_id = session_id
+        self.window_fast_s = float(
+            window_fast_from_env() if window_fast_s is None else window_fast_s
+        )
+        self.window_slow_s = float(
+            window_slow_from_env() if window_slow_s is None else window_slow_s
+        )
+        if self.window_slow_s < self.window_fast_s:
+            self.window_slow_s = self.window_fast_s
+        self.burn_fast = float(
+            burn_fast_from_env() if burn_fast is None else burn_fast
+        )
+        self.burn_slow = float(
+            burn_slow_from_env() if burn_slow is None else burn_slow
+        )
+        self.for_s = float(alert_for_from_env() if for_s is None else for_s)
+        # a PUT-override plane: survives checkpoints as configuration,
+        # not just window state (docs/observability.md)
+        self.explicit = bool(explicit)
+        self._bucket_s = max(1.0, self.window_fast_s / 30.0)
+        self._lock = locking.make_lock("slo.plane")
+        objs = (
+            dict(objectives) if objectives is not None else objectives_from_env()
+        )
+        self._objectives: "dict[str, Objective]" = objs
+        # per objective: deque of [bucket_start, good, bad], oldest first
+        self._windows: "dict[str, deque]" = {n: deque() for n in objs}
+        self._totals: "dict[str, list]" = {n: [0, 0] for n in objs}
+        self._alerts: "dict[str, dict]" = {
+            n: {"state": "inactive", "since": None, "firedAt": None}
+            for n in objs
+        }
+        # sim-time clock: once ticked, now() = max(wall, base + sim_t)
+        self._sim_base: "float | None" = None
+        self._sim_now: "float | None" = None
+        self._last_eval: "float | None" = None
+        self._fired = 0
+
+    # -- clock ---------------------------------------------------------------
+
+    def _now_locked(self) -> float:
+        t = time.monotonic()
+        sim = self._sim_now
+        return sim if sim is not None and sim > t else t
+
+    def tick_sim(self, sim_t: float) -> None:
+        """Advance the plane's clock to simulated time `sim_t` (the
+        lifecycle engine's per-batch tick via
+        `SchedulingMetrics.slo_tick`): windows slide and alerts
+        resolve on the run's own timeline, so a compressed chaos run
+        walks the full pending → firing → resolved lifecycle."""
+        with self._lock:
+            if self._sim_base is None:
+                self._sim_base = time.monotonic()
+            cand = self._sim_base + float(sim_t)
+            if self._sim_now is None or cand > self._sim_now:
+                self._sim_now = cand
+        self.evaluate()
+
+    # -- observation ---------------------------------------------------------
+
+    def observe(
+        self,
+        signal: str,
+        value: "float | None" = None,
+        good: "bool | None" = None,
+        count: int = 1,
+    ) -> None:
+        """One signal observation, fanned into every objective watching
+        it. `value` signals judge against their threshold; ratio
+        signals pass `good` directly. Forwarded by the
+        `SchedulingMetrics` observation points — the ONE measurement
+        path."""
+        due = False
+        with self._lock:
+            hit = False
+            for name, obj in self._objectives.items():
+                if obj.signal != signal:
+                    continue
+                self._push_locked(name, obj.judge(good, value), count)
+                hit = True
+            if hit:
+                now = self._now_locked()
+                due = (
+                    self._last_eval is None
+                    or now - self._last_eval >= _EVAL_MIN_INTERVAL_S
+                )
+        if due:
+            self.evaluate()
+
+    def _push_locked(self, name: str, ok: bool, count: int) -> None:
+        now = self._now_locked()
+        b0 = now - (now % self._bucket_s)
+        dq = self._windows[name]
+        if dq and dq[-1][0] == b0:
+            dq[-1][1 if ok else 2] += count
+        else:
+            dq.append([b0, count if ok else 0, 0 if ok else count])
+        horizon = now - self.window_slow_s - self._bucket_s
+        while dq and dq[0][0] < horizon:
+            dq.popleft()
+        self._totals[name][0 if ok else 1] += count
+
+    def _window_counts_locked(
+        self, name: str, now: float, window_s: float
+    ) -> "tuple[int, int]":
+        lo = now - window_s
+        good = bad = 0
+        for b0, g, b in self._windows[name]:
+            if b0 + self._bucket_s > lo:
+                good += g
+                bad += b
+        return good, bad
+
+    # -- evaluation / the alert state machine --------------------------------
+
+    def _burns_locked(self, name: str, obj: Objective, now: float):
+        budget = max(1e-9, 1.0 - obj.target)
+        fg, fb = self._window_counts_locked(name, now, self.window_fast_s)
+        sg, sb = self._window_counts_locked(name, now, self.window_slow_s)
+        bf = (fb / (fg + fb)) / budget if (fg + fb) else 0.0
+        bs = (sb / (sg + sb)) / budget if (sg + sb) else 0.0
+        return (fg, fb, bf), (sg, sb, bs)
+
+    def evaluate(self) -> "list[dict]":
+        """Walk every objective's burn rates and state machine; emit
+        each transition to the alert ring + a telemetry instant
+        (outside the lock). Called on sim ticks, route reads, the
+        Prometheus render, and (rate-limited) observations."""
+        transitions: list[dict] = []
+        with self._lock:
+            now = self._now_locked()
+            self._last_eval = now
+            session = self.session_id or "default"
+            for name, obj in self._objectives.items():
+                (fg, fb, bf), (sg, sb, bs) = self._burns_locked(name, obj, now)
+                cond = (
+                    fb > 0 and bf >= self.burn_fast and bs >= self.burn_slow
+                )
+                st = self._alerts[name]
+                prev = st["state"]
+                new = prev
+                if cond:
+                    if prev == "inactive":
+                        new = "pending"
+                        st.update(state="pending", since=now, firedAt=None)
+                    elif (
+                        prev == "pending" and now - st["since"] >= self.for_s
+                    ):
+                        new = "firing"
+                        st.update(state="firing", firedAt=now)
+                        self._fired += 1
+                elif prev in ("pending", "firing"):
+                    new = "inactive"
+                    st.update(state="inactive", since=None, firedAt=None)
+                if new == prev:
+                    continue
+                transitions.append(
+                    {
+                        "objective": name,
+                        "signal": obj.signal,
+                        "session": session,
+                        # the wire states: inactive publishes as
+                        # "resolved" — the lifecycle's terminal name
+                        "state": "resolved" if new == "inactive" else new,
+                        "previous": prev,
+                        "fired": prev == "firing",
+                        "wallTime": round(time.time(), 3),
+                        "sloTime": round(now, 6),
+                        "target": obj.target,
+                        "threshold": obj.threshold,
+                        "burnFast": round(bf, 4),
+                        "burnSlow": round(bs, 4),
+                        "windowFast": {"good": fg, "bad": fb},
+                        "windowSlow": {"good": sg, "bad": sb},
+                    }
+                )
+        log = alert_log()
+        for ev in transitions:
+            log.emit(ev)
+            telemetry.instant(
+                f"alert.{ev['state']}",
+                objective=ev["objective"],
+                session=ev["session"],
+                burnFast=ev["burnFast"],
+                burnSlow=ev["burnSlow"],
+            )
+        return transitions
+
+    # -- reading -------------------------------------------------------------
+
+    def status(self) -> dict:
+        """The full per-objective document (GET /slo, GET /alerts):
+        windows, burn rates, compliance, and alert states. Evaluate
+        first for a current view."""
+        self.evaluate()
+        with self._lock:
+            now = self._now_locked()
+            objectives = {}
+            for name, obj in self._objectives.items():
+                (fg, fb, bf), (sg, sb, bs) = self._burns_locked(name, obj, now)
+                st = self._alerts[name]
+                objectives[name] = {
+                    "signal": obj.signal,
+                    "target": obj.target,
+                    "threshold": obj.threshold,
+                    "description": obj.description,
+                    "windows": {
+                        "fast": {
+                            "seconds": self.window_fast_s,
+                            "good": fg,
+                            "bad": fb,
+                            "burnRate": round(bf, 4),
+                        },
+                        "slow": {
+                            "seconds": self.window_slow_s,
+                            "good": sg,
+                            "bad": sb,
+                            "burnRate": round(bs, 4),
+                        },
+                    },
+                    "compliance": round(sg / (sg + sb), 6) if (sg + sb) else 1.0,
+                    "events": {
+                        "good": self._totals[name][0],
+                        "bad": self._totals[name][1],
+                    },
+                    "alert": {
+                        "state": st["state"],
+                        "sinceSeconds": round(now - st["since"], 3)
+                        if st["since"] is not None
+                        else None,
+                    },
+                }
+            return {
+                "enabled": True,
+                "session": self.session_id or "default",
+                "explicit": self.explicit,
+                "windowFastSeconds": self.window_fast_s,
+                "windowSlowSeconds": self.window_slow_s,
+                "burnFastThreshold": self.burn_fast,
+                "burnSlowThreshold": self.burn_slow,
+                "forSeconds": self.for_s,
+                "alertsFired": self._fired,
+                "objectives": objectives,
+            }
+
+    def summary(self) -> dict:
+        """The compact block the metrics snapshot embeds (schema v4):
+        per-objective compliance + alert state, and the fired count."""
+        with self._lock:
+            now = self._now_locked()
+            objectives = {}
+            for name, obj in self._objectives.items():
+                _fast, (sg, sb, bs) = self._burns_locked(name, obj, now)
+                objectives[name] = {
+                    "target": obj.target,
+                    "compliance": round(sg / (sg + sb), 6) if (sg + sb) else 1.0,
+                    "burnSlow": round(bs, 4),
+                    "alertState": self._alerts[name]["state"],
+                }
+            return {
+                "enabled": True,
+                "alertsFired": self._fired,
+                "objectives": objectives,
+            }
+
+    def headline(self) -> dict:
+        """The bench --lifecycle-probe block: per-objective compliance
+        + alerts fired (hoisted into the campaign headline as "slo")."""
+        summary = self.summary()
+        return {
+            "objectives": {
+                name: o["compliance"]
+                for name, o in summary["objectives"].items()
+            },
+            "alertsFired": summary["alertsFired"],
+            "firing": sorted(
+                name
+                for name, o in summary["objectives"].items()
+                if o["alertState"] == "firing"
+            ),
+        }
+
+    def active_alerts(self) -> "list[dict]":
+        with self._lock:
+            now = self._now_locked()
+            session = self.session_id or "default"
+            out = []
+            for name, st in self._alerts.items():
+                if st["state"] == "inactive":
+                    continue
+                out.append(
+                    {
+                        "objective": name,
+                        "session": session,
+                        "state": st["state"],
+                        "sinceSeconds": round(now - st["since"], 3)
+                        if st["since"] is not None
+                        else None,
+                    }
+                )
+            return out
+
+    # -- checkpoint state (SchedulingMetrics.state_dict rides this) ----------
+
+    def state_dict(self) -> dict:
+        """Window + alert state as one JSON-able dict: bucket times and
+        alert 'since' stamps serialize as AGES (seconds before now), so
+        a resumed process reconstructs them against its own clock —
+        checkpoint/drain/resume continuity (docs/resilience.md)."""
+        with self._lock:
+            now = self._now_locked()
+            return {
+                "config": {
+                    "sessionId": self.session_id,
+                    "explicit": self.explicit,
+                    "windowFastSeconds": self.window_fast_s,
+                    "windowSlowSeconds": self.window_slow_s,
+                    "burnFastThreshold": self.burn_fast,
+                    "burnSlowThreshold": self.burn_slow,
+                    "forSeconds": self.for_s,
+                    "objectives": [
+                        o.to_dict() for o in self._objectives.values()
+                    ],
+                },
+                "windows": {
+                    name: [
+                        [round(now - b0, 6), g, b] for b0, g, b in dq
+                    ]
+                    for name, dq in self._windows.items()
+                },
+                "totals": {n: list(v) for n, v in self._totals.items()},
+                "alerts": {
+                    name: {
+                        "state": st["state"],
+                        "sinceAge": round(now - st["since"], 6)
+                        if st["since"] is not None
+                        else None,
+                    }
+                    for name, st in self._alerts.items()
+                },
+                "fired": self._fired,
+            }
+
+    @classmethod
+    def from_state(cls, state: dict) -> "SloPlane":
+        cfg = state.get("config") or {}
+        objectives = {}
+        for od in cfg.get("objectives") or []:
+            name = od.get("name") or od.get("signal")
+            if name not in SIGNALS:
+                continue
+            objectives[name] = Objective(
+                name,
+                od.get("signal", name),
+                float(od.get("target", 0.99)),
+                od.get("threshold"),
+                od.get("description", ""),
+            )
+        plane = cls(
+            session_id=cfg.get("sessionId"),
+            objectives=objectives or None,
+            window_fast_s=cfg.get("windowFastSeconds"),
+            window_slow_s=cfg.get("windowSlowSeconds"),
+            burn_fast=cfg.get("burnFastThreshold"),
+            burn_slow=cfg.get("burnSlowThreshold"),
+            for_s=cfg.get("forSeconds"),
+            explicit=bool(cfg.get("explicit")),
+        )
+        plane.load_state(state)
+        return plane
+
+    def load_state(self, state: dict) -> None:
+        """Restore `state_dict` output into this plane (unknown
+        objectives are ignored so old checkpoints stay loadable)."""
+        with self._lock:
+            now = self._now_locked()
+            for name, rows in (state.get("windows") or {}).items():
+                if name not in self._windows or not isinstance(rows, list):
+                    continue
+                dq = self._windows[name]
+                dq.clear()
+                for row in rows:
+                    try:
+                        age, g, b = row
+                    except (TypeError, ValueError):
+                        continue
+                    b0 = now - float(age)
+                    dq.append([b0 - (b0 % self._bucket_s), int(g), int(b)])
+            for name, pair in (state.get("totals") or {}).items():
+                if name in self._totals and isinstance(pair, list):
+                    self._totals[name] = [int(pair[0]), int(pair[1])]
+            for name, st in (state.get("alerts") or {}).items():
+                if name not in self._alerts or not isinstance(st, dict):
+                    continue
+                alert_state = st.get("state", "inactive")
+                if alert_state not in _ALERT_STATE_VALUES:
+                    continue
+                since = st.get("sinceAge")
+                self._alerts[name] = {
+                    "state": alert_state,
+                    "since": now - float(since) if since is not None else None,
+                    "firedAt": None,
+                }
+            self._fired = int(state.get("fired", 0))
+
+
+# -- Prometheus exposition -----------------------------------------------------
+
+
+def render_prometheus_planes(
+    planes: "list[tuple[str, SloPlane | None]]",
+) -> str:
+    """The ``kss_slo_*`` / ``kss_alert_*`` families for the serving
+    layer's scrape (server/httpserver.py): one labeled series per
+    (objective, session) from each live plane, plus the process-wide
+    alert-ring counters. Planes are evaluated first so alert states
+    are current at scrape time. Empty-plane entries contribute
+    nothing; the global counters always render."""
+    from .metrics import _fmt_value
+
+    rows: "list[tuple[str, str, dict]]" = []  # (session, name, status row)
+    for session_id, plane in planes:
+        if plane is None:
+            continue
+        status = plane.status()
+        for name, obj in status["objectives"].items():
+            rows.append((session_id or "default", name, obj))
+    lines: list[str] = []
+
+    def family(name: str, mtype: str, help_text: str, value_of) -> None:
+        if not rows:
+            return
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {mtype}")
+        for session, objective, obj in rows:
+            lines.append(
+                f'{name}{{objective="{objective}",session="{session}"}} '
+                f"{_fmt_value(value_of(obj))}"
+            )
+
+    family(
+        "kss_slo_objective_target",
+        "gauge",
+        "The objective's promised good-event fraction.",
+        lambda o: o["target"],
+    )
+    family(
+        "kss_slo_compliance",
+        "gauge",
+        "Good-event fraction over the slow window (1.0 with no events).",
+        lambda o: o["compliance"],
+    )
+    family(
+        "kss_slo_burn_rate_fast",
+        "gauge",
+        "Error-budget burn rate over the fast window.",
+        lambda o: o["windows"]["fast"]["burnRate"],
+    )
+    family(
+        "kss_slo_burn_rate_slow",
+        "gauge",
+        "Error-budget burn rate over the slow window.",
+        lambda o: o["windows"]["slow"]["burnRate"],
+    )
+    family(
+        "kss_alert_state",
+        "gauge",
+        "Alert state machine: 0 inactive, 1 pending, 2 firing.",
+        lambda o: _ALERT_STATE_VALUES.get(o["alert"]["state"], 0),
+    )
+    if rows:
+        name = "kss_slo_events_total"
+        lines.append(
+            f"# HELP {name} Good/bad events observed per objective."
+        )
+        lines.append(f"# TYPE {name} counter")
+        for session, objective, obj in rows:
+            for result in ("good", "bad"):
+                lines.append(
+                    f'{name}{{objective="{objective}",result="{result}",'
+                    f'session="{session}"}} '
+                    f"{_fmt_value(obj['events'][result])}"
+                )
+    log = alert_log()
+    counters = log.counters()
+    for name, help_text, value in (
+        (
+            "kss_alert_transitions_total",
+            "Alert state transitions recorded in the history ring.",
+            counters["transitions"],
+        ),
+        (
+            "kss_alerts_fired_total",
+            "Alerts that reached the firing state.",
+            counters["fired"],
+        ),
+    ):
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name} {_fmt_value(value)}")
+    return "\n".join(lines) + "\n"

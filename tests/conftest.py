@@ -44,6 +44,21 @@ for _var in (
     "KSS_FLEET_RING_CAP",
     "KSS_FLEET_SAMPLE",
     "KSS_SPEC_MEM_HEADROOM_BYTES",
+    # the SLO plane (utils/slo.py): ambient arming would make every
+    # pass in the suite pay observation + evaluation (and ambient
+    # objective/window overrides would skew the state-machine tests);
+    # SLO tests arm planes explicitly. KSS_EXEMPLARS is default-ON —
+    # scrubbed so a shell exporting KSS_EXEMPLARS=0 can't silently
+    # empty the exemplar round-trip tests
+    "KSS_SLO",
+    "KSS_SLO_OBJECTIVES",
+    "KSS_SLO_WINDOW_FAST_S",
+    "KSS_SLO_WINDOW_SLOW_S",
+    "KSS_SLO_BURN_FAST",
+    "KSS_SLO_BURN_SLOW",
+    "KSS_SLO_ALERT_FOR_S",
+    "KSS_SLO_ALERT_RING_CAP",
+    "KSS_EXEMPLARS",
     # the lock-order witness (utils/locking.py): an ambient
     # KSS_LOCK_CHECK=1 would wrap every lock the suite creates; the
     # witness tests arm it explicitly with monkeypatch

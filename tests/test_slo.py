@@ -1,0 +1,765 @@
+"""The SLO plane (utils/slo.py, docs/observability.md): the objective
+grammar, the multi-window burn-rate alert state machine on a sim-time
+clock, the SchedulingMetrics observation funnel, exemplar capture +
+OpenMetrics round trip, the HTTP/SSE surfaces, checkpoint continuity,
+and the armed-vs-off placement parity pin."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kube_scheduler_simulator_tpu.server import (
+    SimulatorServer,
+    SimulatorService,
+)
+from kube_scheduler_simulator_tpu.utils import envcheck
+from kube_scheduler_simulator_tpu.utils import metrics as metrics_mod
+from kube_scheduler_simulator_tpu.utils import slo, telemetry
+from kube_scheduler_simulator_tpu.utils.metrics import (
+    METRICS_SCHEMA_VERSION,
+    PassRecord,
+    SchedulingMetrics,
+    parse_prometheus_text,
+    render_prometheus,
+)
+
+from helpers import node, pod
+
+
+@pytest.fixture(autouse=True)
+def fresh_alert_log():
+    log = slo.reset_alert_log(64)
+    yield log
+    slo.reset_alert_log()
+
+
+def make_plane(**kw):
+    kw.setdefault("session_id", "t")
+    kw.setdefault("window_fast_s", 10.0)
+    kw.setdefault("window_slow_s", 100.0)
+    kw.setdefault("burn_fast", 2.0)
+    kw.setdefault("burn_slow", 1.0)
+    kw.setdefault("for_s", 0.0)
+    return slo.SloPlane(**kw)
+
+
+# -- the objective grammar ----------------------------------------------------
+
+
+def test_default_objectives_cover_the_signal_set():
+    objs = slo.default_objectives()
+    assert set(objs) == set(slo.SIGNALS)
+    assert objs["passLatency"].threshold == 1.0
+    assert objs["eagerFallback"].threshold is None
+
+
+def test_parse_objectives_override_and_off():
+    objs = slo.parse_objectives(
+        "passLatency:target=0.999,threshold=0.5;pendingAge:off"
+    )
+    assert objs["passLatency"].target == 0.999
+    assert objs["passLatency"].threshold == 0.5
+    assert "pendingAge" not in objs
+    # untouched entries keep their defaults
+    assert objs["degradedPass"].target == 0.99
+
+
+@pytest.mark.parametrize(
+    "raw",
+    [
+        "noSuchSignal:target=0.9",
+        "passLatency",  # bare name: no params
+        "passLatency:target",  # missing =
+        "passLatency:target=nope",
+        "passLatency:target=1.5",  # outside (0,1)
+        "passLatency:threshold=0",  # must be > 0
+        "passLatency:color=red",  # unknown key
+    ],
+)
+def test_parse_objectives_rejects(raw):
+    with pytest.raises(ValueError):
+        slo.parse_objectives(raw)
+
+
+def test_envcheck_validates_the_slo_surface():
+    ok = {
+        "KSS_SLO": "1",
+        "KSS_SLO_OBJECTIVES": "passLatency:target=0.999",
+        "KSS_SLO_WINDOW_FAST_S": "60",
+        "KSS_SLO_ALERT_FOR_S": "0",
+        "KSS_EXEMPLARS": "off",
+    }
+    assert envcheck.check_env(ok) == []
+    bad = envcheck.check_env({"KSS_SLO_OBJECTIVES": "bogusSignal:off"})
+    assert any("KSS_SLO_OBJECTIVES" in p for p in bad)
+    bad = envcheck.check_env({"KSS_SLO_WINDOW_FAST_S": "0.1"})
+    assert any("KSS_SLO_WINDOW_FAST_S" in p for p in bad)
+
+
+def test_objectives_from_spec_mapping_and_rejects():
+    objs = slo.objectives_from_spec(
+        {"passLatency": {"target": 0.9, "threshold": 0.5},
+         "pendingAge": {"off": True}}
+    )
+    assert objs["passLatency"].target == 0.9
+    assert "pendingAge" not in objs
+    with pytest.raises(ValueError):
+        slo.objectives_from_spec([{"signal": "nope"}])
+    with pytest.raises(ValueError):
+        slo.objectives_from_spec("not-a-list")
+
+
+# -- the alert state machine on the sim clock ---------------------------------
+
+
+def test_alert_lifecycle_pending_firing_resolved(fresh_alert_log):
+    plane = make_plane()
+    plane.tick_sim(0.0)
+    # target 0.99 -> budget 0.01; one bad event burns 100x >> thresholds
+    plane.observe("passLatency", value=99.0)
+    plane.tick_sim(2.0)  # condition true -> pending
+    plane.tick_sim(3.0)  # still true, for_s=0 -> firing
+    st = plane.status()["objectives"]["passLatency"]["alert"]["state"]
+    assert st == "firing"
+    # the fast window (10s) slides past the bad bucket -> resolved
+    plane.tick_sim(50.0)
+    st = plane.status()["objectives"]["passLatency"]["alert"]["state"]
+    assert st == "inactive"
+    states = [
+        ev["state"]
+        for ev in fresh_alert_log.snapshot()
+        if ev["objective"] == "passLatency"
+    ]
+    assert states == ["pending", "firing", "resolved"]
+    assert fresh_alert_log.counters()["fired"] == 1
+    # transitions carry the judgement context
+    firing = [
+        ev for ev in fresh_alert_log.snapshot() if ev["state"] == "firing"
+    ][0]
+    assert firing["session"] == "t"
+    assert firing["burnFast"] > 2.0
+    assert firing["windowFast"]["bad"] >= 1
+
+
+def test_pending_hold_and_cancel(fresh_alert_log):
+    plane = make_plane(for_s=20.0)
+    plane.tick_sim(0.0)
+    plane.observe("passLatency", value=99.0)
+    plane.tick_sim(2.0)
+    assert (
+        plane.status()["objectives"]["passLatency"]["alert"]["state"]
+        == "pending"
+    )
+    plane.tick_sim(5.0)  # hold not elapsed: still pending, not firing
+    assert (
+        plane.status()["objectives"]["passLatency"]["alert"]["state"]
+        == "pending"
+    )
+    # the condition clears before the hold elapses: resolved, never fired
+    plane.tick_sim(50.0)
+    states = [
+        ev["state"]
+        for ev in fresh_alert_log.snapshot()
+        if ev["objective"] == "passLatency"
+    ]
+    assert states == ["pending", "resolved"]
+    assert fresh_alert_log.counters()["fired"] == 0
+
+
+def test_both_windows_must_burn():
+    # slow window clean -> a fast-only blip must not alert: force the
+    # slow burn threshold above what one bad event among many can reach
+    plane = make_plane(burn_fast=2.0, burn_slow=60.0)
+    plane.tick_sim(0.0)
+    for _ in range(99):
+        plane.observe("passLatency", value=0.0)
+    plane.observe("passLatency", value=99.0)
+    plane.tick_sim(2.0)
+    # slow burn = (1/100)/0.01 = 1.0 < 60 -> no alert despite fast burn
+    assert (
+        plane.status()["objectives"]["passLatency"]["alert"]["state"]
+        == "inactive"
+    )
+
+
+def test_alert_log_ring_bounded_under_writers():
+    log = slo.AlertLog(capacity=8)
+    threads = [
+        threading.Thread(
+            target=lambda k=k: [
+                log.emit({"objective": f"o{k}", "state": "firing"})
+                for _ in range(50)
+            ]
+        )
+        for k in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert log.emitted == 200
+    assert len(log) == 8
+    assert log.dropped == 192
+    assert log.counters() == {"transitions": 200, "fired": 200}
+    seqs = [ev["seq"] for ev in log.snapshot()]
+    assert seqs == sorted(seqs) and seqs[-1] == 199
+
+
+# -- the SchedulingMetrics observation funnel ---------------------------------
+
+
+def test_metrics_forwarding_covers_every_signal():
+    m = SchedulingMetrics()
+    plane = make_plane()
+    m.set_slo_plane(plane)
+    m.record(PassRecord("sequential", 1, 1, 0.01))  # healthy, good latency
+    # a degraded pass: the fallback fires MID-pass (the production
+    # ordering), its record lands after — ONE ratio event per pass
+    m.record_resilience(eager_fallbacks=1, degraded_passes=1)
+    m.record(PassRecord("sequential", 1, 1, 9.0))  # bad latency
+    m.record_disruption(
+        evicted=2, rescheduled=2, times_to_reschedule_s=[1.0, 999.0]
+    )
+    m.record_pending_age(5.0)
+    status = plane.status()["objectives"]
+    assert status["passLatency"]["events"] == {"good": 1, "bad": 1}
+    # ratio signals: the healthy pass counts good, the degraded pass
+    # counts ONLY its bad event (no self-cancelling good)
+    assert status["eagerFallback"]["events"] == {"good": 1, "bad": 1}
+    assert status["degradedPass"]["events"] == {"good": 1, "bad": 1}
+    assert status["timeToReschedule"]["events"] == {"good": 1, "bad": 1}
+    assert status["pendingAge"]["events"] == {"good": 1, "bad": 0}
+
+
+def test_all_degraded_run_reads_zero_compliance():
+    """A 100%-degraded run must report compliance 0.0 (one event per
+    pass), not the 0.5 a good+bad double count would floor it at."""
+    m = SchedulingMetrics()
+    plane = make_plane()
+    m.set_slo_plane(plane)
+    for _ in range(4):
+        m.record_resilience(eager_fallbacks=1, degraded_passes=1)
+        m.record(PassRecord("sequential", 1, 1, 0.01))
+    status = plane.status()["objectives"]
+    assert status["degradedPass"]["events"] == {"good": 0, "bad": 4}
+    assert status["degradedPass"]["compliance"] == 0.0
+    assert status["eagerFallback"]["compliance"] == 0.0
+    # latency stayed healthy: the skip is per-objective, not per-pass
+    assert status["passLatency"]["events"] == {"good": 4, "bad": 0}
+
+
+def test_snapshot_slo_block_and_schema_version():
+    m = SchedulingMetrics()
+    assert METRICS_SCHEMA_VERSION == 4
+    snap = m.snapshot()
+    assert snap["schemaVersion"] == 4
+    assert snap["slo"] == {"enabled": False}
+    m.set_slo_plane(make_plane())
+    m.record(PassRecord("sequential", 1, 1, 9.0))
+    block = m.snapshot()["slo"]
+    assert block["enabled"] is True
+    assert block["objectives"]["passLatency"]["compliance"] == 0.0
+    assert block["objectives"]["passLatency"]["alertState"] in (
+        "inactive", "pending", "firing",
+    )
+
+
+def test_env_arming_builds_and_drops_the_plane(monkeypatch):
+    m = SchedulingMetrics(session_id="envtest")
+    assert m.slo_plane() is None
+    monkeypatch.setenv("KSS_SLO", "1")
+    plane = m.slo_plane()
+    assert plane is not None and plane.session_id == "envtest"
+    assert m.slo_plane() is plane  # cached while the env is stable
+    monkeypatch.delenv("KSS_SLO")
+    assert m.slo_plane() is None
+    # explicit override beats the environment
+    monkeypatch.setenv("KSS_SLO", "1")
+    m.set_slo_plane(None)
+    assert m.slo_plane() is None
+    m.clear_slo_override()
+    assert m.slo_plane() is not None
+
+
+def test_state_dict_roundtrip_restores_windows_and_alerts(monkeypatch):
+    m = SchedulingMetrics()
+    plane = make_plane(explicit=True)
+    m.set_slo_plane(plane)
+    plane.tick_sim(0.0)
+    m.record(PassRecord("sequential", 1, 1, 9.0))
+    m.record(PassRecord("sequential", 1, 1, 0.01))
+    plane.tick_sim(2.0)
+    plane.tick_sim(3.0)
+    assert (
+        plane.status()["objectives"]["passLatency"]["alert"]["state"]
+        == "firing"
+    )
+    state = m.state_dict()
+    assert "_slo" in state
+    # a fresh registry in a "new process" restores the explicit plane
+    m2 = SchedulingMetrics()
+    m2.load_state(json.loads(json.dumps(state)))  # through JSON, like disk
+    p2 = m2.slo_plane()
+    assert p2 is not None and p2.explicit
+    status = p2.status()["objectives"]["passLatency"]
+    assert status["events"] == {"good": 1, "bad": 1}
+    assert status["alert"]["state"] == "firing"
+    assert p2.status()["alertsFired"] == 1
+    # a non-explicit plane's state only restores while the env arms it
+    m3 = SchedulingMetrics()
+    st = json.loads(json.dumps(state))
+    st["_slo"]["config"]["explicit"] = False
+    m3.load_state(st)
+    assert m3.slo_plane() is None
+
+
+def test_restored_env_plane_still_follows_the_env(monkeypatch):
+    """A checkpointed ENV-derived plane restores into the env cache
+    slot, not as an override: a later KSS_SLO change must still
+    rebuild/disarm it (the env-key contract survives resume)."""
+    m = SchedulingMetrics()
+    plane = make_plane()  # not explicit
+    m.set_slo_plane(plane)
+    m.record(PassRecord("sequential", 1, 1, 9.0))
+    state = json.loads(json.dumps(m.state_dict()))
+    assert state["_slo"]["config"]["explicit"] is False
+    monkeypatch.setenv("KSS_SLO", "1")
+    m2 = SchedulingMetrics()
+    m2.load_state(state)
+    p2 = m2.slo_plane()
+    assert p2 is not None and not p2.explicit
+    # the restored window state is live...
+    assert (
+        p2.status()["objectives"]["passLatency"]["events"]["bad"] == 1
+    )
+    # ...and turning the env off disarms it — no permanent pin
+    monkeypatch.delenv("KSS_SLO")
+    assert m2.slo_plane() is None
+
+
+# -- exemplars ----------------------------------------------------------------
+
+
+def test_exemplar_capture_and_openmetrics_roundtrip():
+    m = SchedulingMetrics(session_id="ex")
+    with telemetry.pass_context(7):
+        m.record(PassRecord("sequential", 1, 1, 0.15))
+    snap = m.snapshot()
+    ex = snap["histograms"]["passLatencySeconds"]["exemplars"]
+    (le, entry), = ex.items()
+    assert entry["labels"] == {"span_id": "7", "session": "ex"}
+    assert entry["value"] == 0.15
+    text = render_prometheus(snap, openmetrics=True)
+    fams = parse_prometheus_text(text)
+    exemplars = fams["kss_pass_latency_seconds"]["exemplars"]
+    assert len(exemplars) == 1
+    name, labels, ex_labels, ex_value = exemplars[0]
+    assert name == "kss_pass_latency_seconds_bucket"
+    assert labels["le"] == le
+    assert ex_labels == {"span_id": "7", "session": "ex"}
+    assert ex_value == 0.15
+    # the plain prometheus render stays exemplar-free
+    assert " # {" not in render_prometheus(snap)
+
+
+def test_exemplars_disabled_by_env(monkeypatch):
+    monkeypatch.setenv("KSS_EXEMPLARS", "0")
+    m = SchedulingMetrics()
+    with telemetry.pass_context(9):
+        m.record(PassRecord("sequential", 1, 1, 0.15))
+    assert "exemplars" not in m.snapshot()["histograms"]["passLatencySeconds"]
+
+
+def test_exemplar_state_rides_histogram_checkpoints():
+    m = SchedulingMetrics()
+    with telemetry.pass_context(3):
+        m.record(PassRecord("sequential", 1, 1, 0.15))
+    m2 = SchedulingMetrics()
+    m2.load_state(json.loads(json.dumps(m.state_dict())))
+    ex = m2.snapshot()["histograms"]["passLatencySeconds"]["exemplars"]
+    assert list(ex.values())[0]["labels"]["span_id"] == "3"
+
+
+def test_parser_rejects_malformed_exemplars():
+    good = (
+        "# TYPE h histogram\n"
+        'h_bucket{le="1.0"} 1 # {span_id="4"} 0.5 1000.0\n'
+        'h_bucket{le="+Inf"} 1\n'
+        "h_sum 0.5\nh_count 1\n# EOF\n"
+    )
+    fams = parse_prometheus_text(good)
+    assert fams["h"]["exemplars"][0][2] == {"span_id": "4"}
+    with pytest.raises(ValueError, match="malformed exemplar"):
+        parse_prometheus_text(
+            "# TYPE h histogram\n"
+            'h_bucket{le="+Inf"} 1 # not-an-exemplar\n'
+            "h_sum 0.5\nh_count 1\n"
+        )
+    with pytest.raises(ValueError, match="non-bucket"):
+        parse_prometheus_text(
+            "# TYPE c_total counter\n" 'c_total 1 # {span_id="4"} 0.5\n'
+        )
+
+
+def test_parser_tolerates_hash_inside_label_values():
+    """'#' is legal inside quoted label values (the 0.0.4 grammar) —
+    exemplar detection must not split a sample there."""
+    fams = parse_prometheus_text(
+        "# TYPE g gauge\n" 'g{lbl="a # b"} 1\n'
+    )
+    assert fams["g"]["samples"][0][1] == {"lbl": "a # b"}
+    # and both at once: a hash-bearing label AND a real exemplar
+    fams = parse_prometheus_text(
+        "# TYPE h histogram\n"
+        'h_bucket{lbl="a # b",le="+Inf"} 1 # {span_id="4"} 0.5\n'
+        'h_sum{lbl="a # b"} 0.5\nh_count{lbl="a # b"} 1\n'
+    )
+    assert fams["h"]["samples"][0][1]["lbl"] == "a # b"
+    assert fams["h"]["exemplars"][0][2] == {"span_id": "4"}
+
+
+# -- the Prometheus families --------------------------------------------------
+
+
+def test_render_prometheus_planes_through_strict_parse():
+    plane = make_plane(session_id="s-1")
+    plane.observe("passLatency", value=9.0)
+    text = slo.render_prometheus_planes([("s-1", plane), ("s-2", None)])
+    fams = parse_prometheus_text(text)
+    for fam in (
+        "kss_slo_objective_target",
+        "kss_slo_compliance",
+        "kss_slo_burn_rate_fast",
+        "kss_slo_burn_rate_slow",
+        "kss_slo_events_total",
+        "kss_alert_state",
+        "kss_alert_transitions_total",
+        "kss_alerts_fired_total",
+    ):
+        assert fam in fams, fam
+    samples = {
+        (s[1].get("objective"), s[1].get("result")): s[2]
+        for s in fams["kss_slo_events_total"]["samples"]
+    }
+    assert samples[("passLatency", "bad")] == 1
+    # every labeled series names the live session only
+    sessions = {
+        s[1]["session"]
+        for s in fams["kss_slo_compliance"]["samples"]
+    }
+    assert sessions == {"s-1"}
+    # no planes at all: the global ring counters still render
+    fams = parse_prometheus_text(slo.render_prometheus_planes([]))
+    assert "kss_alert_transitions_total" in fams
+    assert "kss_slo_compliance" not in fams
+
+
+# -- the HTTP / SSE surfaces --------------------------------------------------
+
+
+def _get(port: int, path: str):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=300
+    ) as r:
+        return r.status, r.read().decode()
+
+
+def _req(port: int, path: str, body, method: str):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(),
+        method=method,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=300) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+@pytest.fixture()
+def server():
+    srv = SimulatorServer(SimulatorService(), port=0).start()
+    try:
+        srv.service.store.apply("nodes", node("sn0"))
+        srv.service.store.apply("pods", pod("sp0"))
+        yield srv
+    finally:
+        srv.shutdown()
+
+
+def test_http_slo_put_get_and_alert_fires(server):
+    # unarmed: honest empty docs
+    _, body = _get(server.port, "/api/v1/alerts")
+    assert json.loads(body)["enabled"] is False
+    _, body = _get(server.port, "/api/v1/slo")
+    assert json.loads(body)["enabled"] is False
+    # PUT an explicit override with an unmeetable latency objective
+    status, doc = _req(
+        server.port,
+        "/api/v1/slo",
+        {
+            "objectives": {
+                "passLatency": {"target": 0.99, "threshold": 1e-9}
+            },
+            "forSeconds": 0,
+        },
+        "PUT",
+    )
+    assert status == 200 and doc["enabled"] and doc["explicit"]
+    assert doc["objectives"]["passLatency"]["threshold"] == 1e-9
+    # two passes + two evaluations (GET /alerts evaluates) walk the
+    # state machine to firing
+    for _ in range(2):
+        server.service.scheduler.schedule()
+        _get(server.port, "/api/v1/alerts")
+    _, body = _get(server.port, "/api/v1/alerts")
+    doc = json.loads(body)
+    assert doc["enabled"] is True
+    active = {
+        (a["objective"], a["state"]) for a in doc["active"]
+    }
+    assert ("passLatency", "firing") in active
+    states = [
+        ev["state"]
+        for ev in doc["history"]
+        if ev["objective"] == "passLatency"
+    ]
+    assert states[:2] == ["pending", "firing"]
+    # the session doc names the default session
+    assert "default" in doc["sessions"]
+    # prometheus surface carries the families with the firing state
+    _, text = _get(server.port, "/api/v1/metrics?format=prometheus")
+    fams = parse_prometheus_text(text)
+    state_samples = {
+        s[1]["objective"]: s[2]
+        for s in fams["kss_alert_state"]["samples"]
+    }
+    assert state_samples["passLatency"] == 2  # firing
+    assert fams["kss_alerts_fired_total"]["samples"][0][2] >= 1
+    # reset returns to the (unarmed) environment plane
+    status, doc = _req(server.port, "/api/v1/slo", {"reset": True}, "PUT")
+    assert status == 200 and doc["enabled"] is False
+
+
+def test_http_slo_rejects_bad_specs(server):
+    for body in (
+        {"objectives": [{"signal": "nope"}]},
+        {"objectives": {"passLatency": {"target": 2.0}}},
+        {"windowFastSeconds": 0.0},
+    ):
+        try:
+            _req(server.port, "/api/v1/slo", body, "PUT")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+        else:
+            raise AssertionError(f"{body} was accepted")
+
+
+def test_session_create_with_slo_and_nested_routes(server):
+    status, doc = _req(
+        server.port,
+        "/api/v1/sessions",
+        {
+            "name": "tenant",
+            "slo": {
+                "objectives": {
+                    "passLatency": {"target": 0.9, "threshold": 0.5}
+                }
+            },
+        },
+        "POST",
+    )
+    assert status == 201
+    sid = doc["id"]
+    _, body = _get(server.port, f"/api/v1/sessions/{sid}/slo")
+    nested = json.loads(body)
+    assert nested["enabled"] and nested["session"] == sid
+    assert nested["objectives"]["passLatency"]["target"] == 0.9
+    # the nested alerts route scopes to the tenant
+    _, body = _get(server.port, f"/api/v1/sessions/{sid}/alerts")
+    doc = json.loads(body)
+    assert set(doc["sessions"]) == {sid}
+    # the create body honors the FULL PUT /slo shape: forSeconds rides
+    # through, and {"enabled": false} means explicitly disarmed
+    status, doc = _req(
+        server.port,
+        "/api/v1/sessions",
+        {"slo": {"objectives": None, "forSeconds": 5.5}},
+        "POST",
+    )
+    _, body = _get(server.port, f"/api/v1/sessions/{doc['id']}/slo")
+    assert json.loads(body)["forSeconds"] == 5.5
+    status, doc = _req(
+        server.port, "/api/v1/sessions", {"slo": {"enabled": False}}, "POST"
+    )
+    _, body = _get(server.port, f"/api/v1/sessions/{doc['id']}/slo")
+    assert json.loads(body)["enabled"] is False
+    # openmetrics surface stays parseable with the tenant's plane live
+    _, text = _get(server.port, "/api/v1/metrics?format=openmetrics")
+    assert text.rstrip().endswith("# EOF")
+    parse_prometheus_text(text)
+
+
+def test_session_evict_restore_keeps_explicit_plane(server):
+    status, doc = _req(
+        server.port,
+        "/api/v1/sessions",
+        {"slo": {"objectives": {"passLatency": {"threshold": 0.123}}}},
+        "POST",
+    )
+    sid = doc["id"]
+    status, _ = _req(server.port, f"/api/v1/sessions/{sid}/evict", {}, "POST")
+    assert status == 200
+    # the next touch restores the session WITH its explicit plane
+    _, body = _get(server.port, f"/api/v1/sessions/{sid}/slo")
+    doc = json.loads(body)
+    assert doc["enabled"] and doc["explicit"]
+    assert doc["objectives"]["passLatency"]["threshold"] == 0.123
+
+
+def test_sse_alert_event_streams(server):
+    _req(
+        server.port,
+        "/api/v1/slo",
+        {
+            "objectives": {
+                "passLatency": {"target": 0.99, "threshold": 1e-9}
+            },
+            "forSeconds": 0,
+        },
+        "PUT",
+    )
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/api/v1/events"
+    )
+    with urllib.request.urlopen(req, timeout=300) as r:
+        server.service.scheduler.schedule()
+        _get(server.port, "/api/v1/alerts")  # evaluation -> transition
+        event = None
+        payload = None
+        for _ in range(64):
+            line = r.readline().decode()
+            if line.startswith("event: alert"):
+                event = "alert"
+                payload = json.loads(
+                    r.readline().decode().split(":", 1)[1]
+                )
+                break
+        assert event == "alert"
+        assert payload["objective"] == "passLatency"
+        assert payload["state"] in ("pending", "firing")
+
+
+# -- parity + checkpoint continuity over real runs ----------------------------
+
+
+def _chaos_spec():
+    from kube_scheduler_simulator_tpu.scenario.chaos import ChaosSpec
+
+    nodes = [
+        {
+            "metadata": {"name": f"pn{i}"},
+            "status": {
+                "allocatable": {"cpu": "8", "memory": "16Gi", "pods": "110"}
+            },
+        }
+        for i in range(2)
+    ]
+    return ChaosSpec.from_dict(
+        {
+            "name": "slo-parity",
+            "seed": 5,
+            "horizon": 30.0,
+            "schedulerMode": "sequential",
+            "pipeline": "sync",
+            "snapshot": {"nodes": nodes},
+            "arrivals": [
+                {
+                    "kind": "poisson",
+                    "rate": 0.3,
+                    "count": 6,
+                    "template": {
+                        "metadata": {"name": "pchurn"},
+                        "spec": {
+                            "containers": [
+                                {
+                                    "name": "c",
+                                    "resources": {
+                                        "requests": {"cpu": "100m"}
+                                    },
+                                }
+                            ]
+                        },
+                    },
+                }
+            ],
+            "faults": [
+                {"at": 10.0, "action": "cordon", "node": "pn0"},
+                {"at": 20.0, "action": "uncordon", "node": "pn0"},
+            ],
+        }
+    )
+
+
+def _run_chaos():
+    from kube_scheduler_simulator_tpu.lifecycle.engine import (
+        LifecycleEngine,
+        trace_jsonl,
+    )
+
+    eng = LifecycleEngine(_chaos_spec())
+    result = eng.run()
+    assert result["phase"] == "Succeeded"
+    return trace_jsonl(eng.trace), eng
+
+
+def test_placements_byte_identical_armed_vs_off(monkeypatch):
+    off_trace, _ = _run_chaos()
+    monkeypatch.setenv("KSS_SLO", "1")
+    monkeypatch.setenv("KSS_SLO_OBJECTIVES", "passLatency:threshold=0.001")
+    monkeypatch.setenv("KSS_SLO_ALERT_FOR_S", "0")
+    armed_trace, eng = _run_chaos()
+    # the plane observed and judged...
+    block = eng.scheduler.metrics.snapshot()["slo"]
+    assert block["enabled"] is True
+    events = block["objectives"]["passLatency"]
+    assert events["compliance"] < 1.0  # the 1ms threshold was breached
+    # ...and the run's decisions are byte-identical (the
+    # sampling-invariance acceptance pin)
+    assert armed_trace == off_trace
+
+
+def test_lifecycle_checkpoint_resume_carries_slo_state(
+    monkeypatch, tmp_path
+):
+    """The PR 4/8 continuity contract extended to the SLO plane: a
+    checkpointed run's window totals survive into the resumed process's
+    plane (through doc["metrics"] -> SchedulingMetrics.load_state)."""
+    from kube_scheduler_simulator_tpu.lifecycle.checkpoint import (
+        CHECKPOINT_FORMAT,
+        load_checkpoint,
+    )
+    from kube_scheduler_simulator_tpu.lifecycle.engine import LifecycleEngine
+
+    monkeypatch.setenv("KSS_SLO", "1")
+    ckpt = str(tmp_path / "slo-ckpt.json")
+    eng = LifecycleEngine(
+        _chaos_spec(), checkpoint_path=ckpt, stop_after_events=3
+    )
+    result = eng.run()
+    assert result["phase"] == "Interrupted"
+    prefix = eng.scheduler.metrics.slo_plane().status()["objectives"][
+        "passLatency"
+    ]["events"]
+    assert prefix["good"] + prefix["bad"] >= 1
+    doc = load_checkpoint(ckpt, CHECKPOINT_FORMAT)
+    resumed = LifecycleEngine.from_checkpoint(doc)
+    result = resumed.run()
+    assert result["phase"] == "Succeeded"
+    total = resumed.scheduler.metrics.slo_plane().status()["objectives"][
+        "passLatency"
+    ]["events"]
+    # the resumed plane carries the prefix's events plus its own
+    assert total["good"] + total["bad"] > prefix["good"] + prefix["bad"]

@@ -21,6 +21,20 @@ on CPU (docs/observability.md). Three gates, one JSON line:
    `GET /api/v1/timeseries` must answer a non-empty window and the new
    `kss_fleet_*` gauges must survive the real Prometheus parse.
 
+5. **SLO alert lifecycle** (docs/observability.md) — a sim-time chaos
+   run with `compile_slow`/`device_error` faults injected must drive
+   an alert through the FULL pending → firing → resolved lifecycle
+   (the faults make the early compile-bearing passes slow; sim time
+   then slides the burn windows past the bad era). All three surfaces
+   are checked: the transition history at `GET /api/v1/alerts`, the
+   `kss_slo_*`/`kss_alert_*` families through the strict Prometheus
+   parse, and a LIVE SSE `alert` event observed while a PUT-overridden
+   objective breaches in the serving process.
+
+6. **Exemplars** — `?format=openmetrics` exemplars on the pass-latency
+   histogram must resolve to pass ids present as span `args.pass` in
+   the recorder's Perfetto events (the bucket → trace link).
+
 Exit 0 on pass. Small enough for CI (seconds, CPU-only).
 """
 
@@ -107,6 +121,135 @@ def _async_overlap(intervals: list[dict]) -> "float | None":
     return None if best is None else best / 1e6
 
 
+def _slo_chaos_spec_dict() -> dict:
+    """The alert-gate timeline: a sim-time run long enough for the
+    burn windows to slide past the injected-fault era. The early
+    compile-bearing passes are slow (compile_slow + the device-error
+    ladder walk), breaching the tightened passLatency objective; warm
+    passes are fast, and the late sim-time ticks carry the windows
+    clear — pending → firing → resolved on one seeded run."""
+    nodes = [
+        {
+            "metadata": {"name": f"a{i}"},
+            "status": {
+                "allocatable": {"cpu": "16", "memory": "32Gi", "pods": "110"}
+            },
+        }
+        for i in range(4)
+    ]
+    return {
+        "name": "slo-alert-smoke",
+        "seed": 11,
+        "horizon": 700.0,
+        "schedulerMode": "gang",
+        "pipeline": "sync",
+        "snapshot": {"nodes": nodes},
+        "arrivals": [
+            {
+                "kind": "poisson",
+                "rate": 0.05,
+                "count": 30,
+                "template": {
+                    "metadata": {"name": "slochurn"},
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "c",
+                                "resources": {
+                                    "requests": {
+                                        "cpu": "100m",
+                                        "memory": "64Mi",
+                                    }
+                                },
+                            }
+                        ]
+                    },
+                },
+            }
+        ],
+        # late cordon flap: guarantees sim-time ticks well past the
+        # fast window even if the arrival tail lands early
+        "faults": [
+            {"at": 620.0, "action": "cordon", "node": "a0"},
+            {"at": 640.0, "action": "uncordon", "node": "a0"},
+        ],
+    }
+
+
+def _slo_alert_gate() -> "tuple[dict, list[str]]":
+    """Gate 5: injected compile_slow/device_error faults drive an SLO
+    alert through pending → firing → resolved on a sim-time chaos run
+    (the plane's clock follows the timeline, so the 5-minute fast
+    window slides in simulated seconds)."""
+    from kube_scheduler_simulator_tpu.lifecycle.engine import LifecycleEngine
+    from kube_scheduler_simulator_tpu.scenario.chaos import ChaosSpec
+    from kube_scheduler_simulator_tpu.utils import slo
+
+    problems: list[str] = []
+    log = slo.reset_alert_log(256)
+    overrides = {
+        "KSS_SLO": "1",
+        # tight latency objective: the compile-bearing passes (plus the
+        # injected 0.3s compile_slow and the device-error ladder walk)
+        # breach it; warm gang passes (~tens of ms) satisfy it
+        "KSS_SLO_OBJECTIVES": "passLatency:target=0.97,threshold=0.25",
+        # softened burn thresholds: the gate's bad era is a handful of
+        # compile-bearing passes, and the default page-tier 14.4x would
+        # dilute below the condition before the pending hold elapses
+        "KSS_SLO_BURN_FAST": "5",
+        "KSS_SLO_BURN_SLOW": "2",
+        "KSS_SLO_ALERT_FOR_S": "10",
+        "KSS_FAULT_INJECT": "compile_slow:0.3s,device_error:1.0",
+        "KSS_FAULT_INJECT_SEED": "7",
+    }
+    saved = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    try:
+        eng = LifecycleEngine(ChaosSpec.from_dict(_slo_chaos_spec_dict()))
+        result = eng.run()
+        if result["phase"] != "Succeeded":
+            problems.append(f"slo chaos run phase {result['phase']!r}")
+        # one explicit final evaluation at the horizon: the resolved
+        # transition must not depend on the last timeline tick's timing
+        eng.scheduler.metrics.slo_tick(max(float(eng.sim_time), 700.0))
+        slo_doc = eng.scheduler.metrics.snapshot()["slo"]
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    states = [
+        ev["state"]
+        for ev in log.snapshot()
+        if ev.get("objective") == "passLatency"
+    ]
+    for needed in ("pending", "firing", "resolved"):
+        if needed not in states:
+            problems.append(
+                f"alert lifecycle missing {needed!r} (saw {states})"
+            )
+    firsts = [
+        states.index(s)
+        for s in ("pending", "firing", "resolved")
+        if s in states
+    ]
+    if firsts != sorted(firsts):
+        problems.append(f"alert lifecycle out of order: {states}")
+    if not slo_doc.get("enabled"):
+        problems.append("metrics snapshot carries no armed slo block")
+    fields = {
+        "alert_transitions": states,
+        "alerts_fired": log.counters()["fired"],
+        "slo_compliance_pass_latency": (
+            slo_doc.get("objectives", {})
+            .get("passLatency", {})
+            .get("compliance")
+        ),
+    }
+    return fields, problems
+
+
 def _trace_gate() -> "tuple[dict, list[str]]":
     from kube_scheduler_simulator_tpu.lifecycle.engine import LifecycleEngine
     from kube_scheduler_simulator_tpu.scenario.chaos import ChaosSpec
@@ -178,8 +321,14 @@ def _server_gates() -> "tuple[dict, list[str]]":
         parse_prometheus_text,
     )
 
+    from kube_scheduler_simulator_tpu.utils import telemetry
+
     problems: list[str] = []
     fleetstats.activate(fleetstats.FleetRecorder(capacity=256))
+    # a live recorder over the server's passes: the exemplar gate
+    # resolves openmetrics span_ids against these events' args.pass
+    recorder = telemetry.SpanRecorder(capacity=16384)
+    telemetry.activate(recorder)
     server = SimulatorServer(port=0).start()
     try:
         base = f"http://127.0.0.1:{server.port}"
@@ -258,14 +407,115 @@ def _server_gates() -> "tuple[dict, list[str]]":
                     break
         if sse_event is None:
             problems.append("SSE stream yielded no event")
+        # gate 6 — exemplars: the openmetrics exposition's pass-latency
+        # bucket exemplars must resolve to pass ids present as span
+        # args.pass in the recorder (the bucket -> Perfetto link)
+        with urllib.request.urlopen(
+            f"{base}/api/v1/metrics?format=openmetrics", timeout=30
+        ) as r:
+            om_ctype = r.headers.get("Content-Type", "")
+            om_text = r.read().decode()
+        om_families = parse_prometheus_text(om_text)
+        if "openmetrics-text" not in om_ctype:
+            problems.append(f"openmetrics content-type {om_ctype!r}")
+        if not om_text.rstrip().endswith("# EOF"):
+            problems.append("openmetrics exposition lacks the # EOF terminator")
+        exemplars = om_families.get("kss_pass_latency_seconds", {}).get(
+            "exemplars", []
+        )
+        span_ids = {
+            ex_labels.get("span_id")
+            for _n, _l, ex_labels, _v in exemplars
+            if ex_labels.get("span_id")
+        }
+        if not span_ids:
+            problems.append(
+                "no exemplar on the pass-latency histogram buckets"
+            )
+        trace_pass_ids = {
+            str((e.get("args") or {}).get("pass"))
+            for e in recorder.snapshot()
+            if (e.get("args") or {}).get("pass") is not None
+        }
+        unresolved = span_ids - trace_pass_ids
+        if span_ids and unresolved:
+            problems.append(
+                f"exemplar span ids {sorted(unresolved)} absent from the "
+                f"Perfetto trace's span pass ids"
+            )
+        # gate 5's SSE surface: a LIVE alert event while a
+        # PUT-overridden objective breaches in the serving process
+        put = urllib.request.Request(
+            f"{base}/api/v1/slo",
+            data=json.dumps(
+                {
+                    "objectives": {
+                        "passLatency": {"target": 0.99, "threshold": 1e-9}
+                    },
+                    "forSeconds": 0,
+                }
+            ).encode(),
+            method="PUT",
+        )
+        with urllib.request.urlopen(put, timeout=30) as r:
+            json.loads(r.read().decode())
+        sse_alert = None
+        req = urllib.request.Request(f"{base}/api/v1/events")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            # breach while subscribed: two passes + two evaluations
+            # (GET /alerts evaluates) walk pending then firing
+            for _ in range(2):
+                server.service.scheduler.schedule()
+                with urllib.request.urlopen(
+                    f"{base}/api/v1/alerts", timeout=30
+                ) as ar:
+                    json.loads(ar.read().decode())
+            for _ in range(256):
+                line = r.readline().decode()
+                if not line:
+                    break
+                if line.startswith("event:") and "alert" in line:
+                    sse_alert = line.split(":", 1)[1].strip()
+                    break
+        if sse_alert != "alert":
+            problems.append("no live SSE alert event observed")
+        with urllib.request.urlopen(
+            f"{base}/api/v1/alerts", timeout=30
+        ) as r:
+            alerts_doc = json.loads(r.read().decode())
+        if not alerts_doc.get("enabled"):
+            problems.append("/api/v1/alerts reports the plane unarmed")
+        http_states = {
+            ev.get("state") for ev in alerts_doc.get("history") or ()
+        }
+        if "firing" not in http_states:
+            problems.append(
+                f"/api/v1/alerts history carries no firing transition "
+                f"(states {sorted(http_states)})"
+            )
+        with urllib.request.urlopen(
+            f"{base}/api/v1/metrics?format=prometheus", timeout=30
+        ) as r:
+            post_alert = parse_prometheus_text(r.read().decode())
+        for fam in (
+            "kss_slo_compliance",
+            "kss_slo_burn_rate_fast",
+            "kss_alert_state",
+            "kss_alerts_fired_total",
+        ):
+            if fam not in post_alert:
+                problems.append(f"metric family {fam} missing post-alert")
         fields = {
             "prometheus_families": len(families),
             "sse_first_event": sse_event or "",
+            "sse_alert_event": sse_alert or "",
             "timeseries_samples": len(ts.get("samples") or ()),
+            "exemplar_span_ids": sorted(span_ids),
         }
         return fields, problems
     finally:
         server.shutdown()
+        telemetry.deactivate()
         fleetstats.deactivate()
 
 
@@ -281,9 +531,19 @@ def main() -> int:
 
     enable_compile_cache()
     trace_fields, problems = _trace_gate()
+    # the alert gate runs BEFORE the server gates: its transition
+    # history stays in the process-wide ring, so GET /api/v1/alerts
+    # against the live server serves the full injected-fault lifecycle
+    slo_fields, slo_problems = _slo_alert_gate()
+    problems += slo_problems
     server_fields, server_problems = _server_gates()
     problems += server_problems
-    line = {"config": "observability_smoke", **trace_fields, **server_fields}
+    line = {
+        "config": "observability_smoke",
+        **trace_fields,
+        **slo_fields,
+        **server_fields,
+    }
     print(json.dumps(line), flush=True)
     if problems:
         print(
