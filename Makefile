@@ -5,7 +5,7 @@
 PORT ?= 1212
 PY ?= python
 
-.PHONY: test test-fast lint start bench dryrun batch lifecycle-smoke perf-smoke resilience-smoke observability-smoke session-smoke soak-smoke bundle-smoke docker docker-up clean
+.PHONY: test test-fast lint start bench dryrun batch lifecycle-smoke perf-smoke resilience-smoke observability-smoke session-smoke soak-smoke bundle-smoke batch-smoke docker docker-up clean
 
 # full suite on the 8-device virtual CPU mesh (tests/conftest.py pins it)
 test:
@@ -101,6 +101,14 @@ soak-smoke:
 # bundleLoads >= 1) with byte-identical placements; one JSON line
 bundle-smoke:
 	env JAX_PLATFORMS=cpu $(PY) tools/bundle_smoke.py
+
+# cross-tenant continuous-batching gate (docs/sessions.md): N
+# bucket-compatible sessions scheduling concurrently must be served by
+# ONE ledger-pinned device dispatch with per-session results
+# byte-identical to solo dispatch, and a lone tenant's added latency
+# stays bounded by one collection window; one JSON line
+batch-smoke:
+	env JAX_PLATFORMS=cpu $(PY) tools/batch_smoke.py
 
 # containerized dev flow (reference `make docker_build_and_up`, one service)
 docker:

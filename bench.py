@@ -670,6 +670,179 @@ def _placements_digest(placements: dict) -> str:
     return hashlib.sha256(doc.encode()).hexdigest()[:16]
 
 
+def _concurrency_probe(
+    n_nodes: int = 16, n_pods: int = 48, rounds: int = 3
+):
+    """Subprocess mode (`bench.py --concurrency-probe`): **aggregate
+    decisions/s/process vs concurrent-session count** with cross-tenant
+    continuous batching armed (server/batchplane.py, docs/sessions.md)
+    — ROADMAP #2's headline, the curve that says "millions of users".
+
+    A serialized solo baseline (one tenant, batching off) anchors the
+    comparison; then 1/2/4/8 bucket-compatible sessions schedule
+    concurrently through one SessionManager + BatchPlane, each level
+    re-pending its pods between timed rounds so every round schedules
+    the full queue. Decisions = pods evaluated; the wall is the
+    concurrent phase's wall-clock (barrier-aligned), so the reported
+    number is per-PROCESS aggregate throughput, exactly what one more
+    concurrent tenant should no longer flatten. One JSON line.
+
+    Pinned to the CPU backend when launched by the campaign on CPU;
+    on an accelerator the parent gives it device-probe containment
+    (the batched program's compile is part of what it measures)."""
+    import threading
+
+    from kube_scheduler_simulator_tpu.server.batchplane import BatchPlane
+    from kube_scheduler_simulator_tpu.server.service import SimulatorService
+    from kube_scheduler_simulator_tpu.server.sessions import SessionManager
+
+    if _os.environ.get("_KSS_BENCH_CPU_FALLBACK"):
+        n_nodes, n_pods, rounds = 8, 24, 2
+
+    def node_doc(j):
+        return {
+            "metadata": {"name": f"cn{j}"},
+            "status": {
+                "allocatable": {
+                    "cpu": "64", "memory": "128Gi", "pods": "110"
+                }
+            },
+        }
+
+    def pod_doc(i, j):
+        return {
+            "metadata": {"name": f"cp{j}", "namespace": "default"},
+            "spec": {
+                "containers": [
+                    {
+                        "name": "c",
+                        "resources": {
+                            "requests": {
+                                "cpu": f"{100 + 10 * i + (j % 7) * 20}m",
+                                "memory": "256Mi",
+                            }
+                        },
+                    }
+                ]
+            },
+        }
+
+    def snapshot(i):
+        # identical shapes across tenants (one batch key), distinct
+        # request values (distinct placements — no degenerate sharing)
+        return {
+            "nodes": [node_doc(j) for j in range(n_nodes)],
+            "pods": [pod_doc(i, j) for j in range(n_pods)],
+        }
+
+    def repend(svc, i):
+        for j in range(n_pods):
+            svc.store.delete("pods", f"cp{j}", "default")
+        svc.import_({"pods": snapshot(i)["pods"]})
+
+    # -- serialized solo baseline (batching off) -------------------------
+    mgr = SessionManager(
+        SimulatorService(), max_sessions=12, max_concurrent_passes=8
+    )
+    sess, _ = mgr.create(name="solo", snapshot=snapshot(0))
+    sess.service.scheduler.schedule()  # warm: compile + caches
+    solo_wall = 0.0
+    for _r in range(rounds):
+        repend(sess.service, 0)
+        t0 = time.perf_counter()
+        sess.service.scheduler.schedule()
+        solo_wall += time.perf_counter() - t0
+    baseline_dps = rounds * n_pods / solo_wall if solo_wall > 0 else 0.0
+    mgr.shutdown()
+
+    # -- batched concurrency ladder --------------------------------------
+    levels = (1, 2, 4, 8)
+    curve: dict = {}
+    for conc in levels:
+        mgr = SessionManager(
+            SimulatorService(),
+            max_sessions=conc + 2,
+            max_concurrent_passes=max(8, conc),
+        )
+        # a generous window so barrier-aligned arrivals reliably form
+        # FULL windows (a full window flushes immediately, so the
+        # window length is an upper bound, not a per-pass tax; partial
+        # windows would also scatter fills across batch buckets and
+        # re-pay the vmapped compile mid-measurement)
+        plane = BatchPlane(
+            window_ms=150.0,
+            max_sessions=conc,
+            metrics=mgr.get("default").service.scheduler.metrics,
+        )
+        mgr.batch_plane = plane
+        mgr.get("default").service.scheduler.batch_plane = plane
+        sessions = [
+            mgr.create(name=f"t{i}", snapshot=snapshot(i))[0]
+            for i in range(conc)
+        ]
+
+        def one_round(timed: bool) -> float:
+            start = threading.Barrier(conc + 1)
+            errors: list = []
+
+            def run(i):
+                try:
+                    start.wait(timeout=120)
+                    with mgr.pass_slot():
+                        sessions[i].service.scheduler.schedule()
+                except Exception as e:  # noqa: BLE001 — surfaced below
+                    errors.append(repr(e))
+
+            threads = [
+                threading.Thread(target=run, args=(i,)) for i in range(conc)
+            ]
+            for t in threads:
+                t.start()
+            start.wait(timeout=120)
+            t0 = time.perf_counter()
+            for t in threads:
+                t.join(timeout=900)
+            wall = time.perf_counter() - t0
+            if errors:
+                raise RuntimeError(f"concurrency {conc}: {errors}")
+            return wall if timed else 0.0
+
+        for i in range(conc):
+            repend(sessions[i].service, i)
+        one_round(timed=False)  # warm: the batched program's compile
+        total_wall = 0.0
+        for _r in range(rounds):
+            for i in range(conc):
+                repend(sessions[i].service, i)
+            total_wall += one_round(timed=True)
+        agg_dps = (
+            rounds * conc * n_pods / total_wall if total_wall > 0 else 0.0
+        )
+        default_snap = (
+            mgr.get("default").service.scheduler.metrics.snapshot()
+        )
+        curve[str(conc)] = {
+            "aggregate_dps": round(agg_dps, 1),
+            "speedup_vs_solo": round(agg_dps / baseline_dps, 2)
+            if baseline_dps
+            else None,
+            "batch_windows": default_snap["phases"]["batchWindows"],
+            "batch_occupancy": default_snap["batching"]["batchOccupancy"],
+        }
+        mgr.shutdown()
+    print(
+        json.dumps(
+            {
+                "baseline_solo_dps": round(baseline_dps, 1),
+                "pods_per_session": n_pods,
+                "nodes": n_nodes,
+                "rounds": rounds,
+                "concurrency": curve,
+            }
+        )
+    )
+
+
 def _sweep_preempt_probe():
     """Subprocess mode (`bench.py --sweep-preempt-probe`): the
     Monte-Carlo sweep WITH the full default set incl. DefaultPreemption,
@@ -1336,6 +1509,16 @@ def main(profile_dir: "str | None" = None):
         ["--lifecycle-probe"], 600.0, "lifecycle_events_per_s", device=False
     )
 
+    # aggregate decisions/s/process vs concurrent-session count with
+    # cross-tenant continuous batching armed (server/batchplane.py) —
+    # ROADMAP #2's "millions of users" curve. The batched program's
+    # compile is part of the measurement, so on an accelerator it gets
+    # device-probe containment like the cold-start probe.
+    batching = _probe_json_subprocess(
+        ["--concurrency-probe"], 900.0, "baseline_solo_dps",
+        device=not platform.startswith("cpu"),
+    )
+
     # time-to-first-scheduled-pod from a cold process (ROADMAP #1's
     # wished-for headline, docs/performance.md): a fresh subprocess
     # boots the serving path from nothing and reports its cold-start
@@ -1418,6 +1601,13 @@ def main(profile_dir: "str | None" = None):
                 # service stack + the encode-time fraction and the
                 # delta/full encode counters (docs/performance.md)
                 "lifecycle": life
+                or {"error": "probe did not complete in its window"},
+                # aggregate decisions/s/process vs concurrent sessions
+                # with continuous batching armed (docs/sessions.md):
+                # per-level aggregate dps, speedup vs the serialized
+                # solo baseline, and the windows/occupancy that prove
+                # one dispatch served N tenants
+                "batching": batching
                 or {"error": "probe did not complete in its window"},
                 # the memory trajectory hoisted to the headline (the
                 # fleet & memory observatory, docs/observability.md):
@@ -1529,6 +1719,9 @@ if __name__ == "__main__":
     _enable_compile_cache()
     if "--lifecycle-probe" in sys.argv:
         _lifecycle_probe()
+        sys.exit(0)
+    if "--concurrency-probe" in sys.argv:
+        _concurrency_probe()
         sys.exit(0)
     if "--sweep-preempt-probe" in sys.argv:
         _sweep_preempt_probe()

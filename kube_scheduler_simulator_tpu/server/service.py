@@ -236,6 +236,20 @@ class SchedulerService:
         # joins broker keys once non-zero, so rebuilt engines never
         # collide with a warm engine compiled for a dead device
         self._device_epoch = 0
+        # the cross-tenant micro-batch dispatch plane
+        # (server/batchplane.py), shared across every session of one
+        # SessionManager and assigned by it at session wiring time;
+        # None (the default) = solo dispatch, the historical path
+        self.batch_plane = None
+        # per-pass batching bookkeeping (both only touched inside the
+        # schedule-lock window): whether THIS pass already counted its
+        # soloFallbacks tick (supervised-dispatch retries re-enter the
+        # dispatch closure — one pass must count once), and the reusable
+        # decode-engine for batched passes, keyed by broker sig (the
+        # broker's retarget pattern: construction is paid on signature
+        # change, not per pass)
+        self._batch_fallback_counted = False
+        self._batch_decode_cache: "tuple | None" = None
         self.extender_service = ExtenderService(self._config.extenders)
 
     def _next_pass_id(self) -> int:
@@ -531,6 +545,10 @@ class SchedulerService:
         the new rung's placement."""
         self._enc_cache = EncodingCache(capacity=self.encoding_cache_capacity)
         self._delta = DeltaEncoder()
+        # the batched-pass decode engine retains its encoding too — on
+        # the failed device; drop it (batch eligibility already excludes
+        # escalated rungs, this just releases the dead buffers)
+        self._batch_decode_cache = None
 
     def _try_shrink(self) -> bool:
         """The ladder's mesh-shrink rung: mark the dispatch device lost,
@@ -684,12 +702,24 @@ class SchedulerService:
         engine._kss_eager_fallback = True
         return engine
 
+    def _count_solo_fallback(self) -> None:
+        """One ``soloFallbacks`` tick per PASS: the supervised dispatch
+        re-enters the dispatch closure on device-fault retries and
+        ladder rungs, and a retried pass must not inflate the counter
+        (per-pass flag, reset by the dispatch wrappers under the
+        schedule lock)."""
+        if self.batch_plane is None or self._batch_fallback_counted:
+            return
+        self._batch_fallback_counted = True
+        self.metrics.record_batching(solo_fallbacks=1)
+
     def _gang_dispatch(self, config, record: bool, window=None):
         """One gang dispatch under the execution ladder: the full
         encode + engine-acquire + run closure walks
         `_supervised_dispatch`, so a device fault anywhere inside is
         retried, mesh-shrunk, or failed over to CPU — with the SAME
         pass re-encoded and re-run, never a changed answer."""
+        self._batch_fallback_counted = False
         return self._supervised_dispatch(
             lambda: self._gang_dispatch_once(config, record, window)
         )
@@ -704,6 +734,10 @@ class SchedulerService:
         enc = self._encode_current(config)
         if enc is None:
             return None
+        # gang passes are not batch-eligible (the fixpoint resume and
+        # preempt-phase host loops iterate per-session); they keep
+        # today's solo dispatch, counted as the fallback
+        self._count_solo_fallback()
         self._fire_device_dispatch()
         # the window joins the broker key as the CANONICAL chunk-rounded
         # value program identity actually depends on (raw windows that
@@ -1129,6 +1163,7 @@ class SchedulerService:
         """One sequential dispatch under the execution ladder (see
         `_gang_dispatch`): device faults inside the closure escalate
         through retry → mesh shrink → CPU failover."""
+        self._batch_fallback_counted = False
         return self._supervised_dispatch(
             lambda: self._seq_dispatch_once(config)
         )
@@ -1147,6 +1182,9 @@ class SchedulerService:
             # with the same compiled-program reuse as the batch path.
             # Inherently synchronous (the extenders answer over HTTP
             # mid-pass), so the run happens here; only write-backs defer.
+            # Extender-touched passes are never batch-eligible (the
+            # mid-pass HTTP callbacks are per-session): solo, counted.
+            self._count_solo_fallback()
             from ..engine.extender_loop import ExtenderScheduler
 
             sig = self._epoch_sig(
@@ -1184,6 +1222,13 @@ class SchedulerService:
         # reuse the previous pass's compiled program when the encoding
         # is compile-compatible (same padded shapes + baked statics)
         sig = self._epoch_sig(("seq", BatchedScheduler.compile_signature(enc)))
+        # cross-tenant continuous batching (server/batchplane.py): a
+        # batch-compatible pass may be served by ONE device dispatch
+        # shared with other sessions' concurrent passes; None falls
+        # through to today's solo dispatch
+        disp = self._maybe_batched_dispatch(sig, enc)
+        if disp is not None:
+            return disp
         self._lease_engine(sig)
         t0 = time.perf_counter()
         holder = {}
@@ -1219,6 +1264,63 @@ class SchedulerService:
             )
         self._maybe_speculate(enc, config, "seq")
         return ("batch", enc, sched, None)
+
+    def _maybe_batched_dispatch(self, sig: tuple, enc):
+        """Try to serve this sequential pass through the cross-tenant
+        batch plane (server/batchplane.py): eligible passes enroll in a
+        collection window under the broker key `sig` and come back with
+        their slice of ONE vmapped device dispatch — placements and
+        trace bytes identical to solo. Returns the same opaque tuple
+        `_seq_dispatch_once` builds, or None for solo dispatch.
+
+        Ineligible (counted ``soloFallbacks``): a session-scoped or
+        process fault plane (injected faults are per-tenant semantics a
+        shared dispatch would conflate — the bulkhead contract), or an
+        escalated device rung (rung overrides pin dispatch devices per
+        session; escalated sessions also key differently via the epoch
+        suffix). A window that closes with one enrollee, a draining
+        plane, or a failed batched execution also return solo — the
+        plane can degrade throughput, never correctness."""
+        import numpy as np
+
+        plane = self.batch_plane
+        if plane is None:
+            return None
+        if (
+            self.fault_plane is not None
+            or faultinject.active() is not None
+            or self.device_rung != "device"
+        ):
+            self._count_solo_fallback()
+            return None
+        # the decode-engine for THIS pass: its jitted programs are never
+        # invoked (the batch slice lands in _final_state and _trace
+        # before results() could trigger a run), so it costs kernel
+        # closures, not an XLA compile — and a signature-stable session
+        # reuses the previous pass's instance via retarget (the broker's
+        # warm-engine pattern), paying construction only when the
+        # bucket/config actually moves
+        cached = self._batch_decode_cache
+        if cached is not None and cached[0] == sig:
+            engine = cached[1].retarget(enc)
+        else:
+            engine = BatchedScheduler(enc, record=True, strict=True)
+            self._batch_decode_cache = (sig, engine)
+        queue = np.asarray(enc.queue, np.int32)
+        bucket = BatchedScheduler.queue_bucket(len(queue))
+        if bucket > len(queue):
+            queue = np.concatenate(
+                [queue, np.full(bucket - len(queue), -1, np.int32)]
+            )
+        out = plane.submit(
+            sig, engine, queue,
+            metrics=self.metrics, session_id=self.session_id,
+        )
+        if out is None:
+            self._count_solo_fallback()
+            return None
+        engine._final_state, engine._trace = out
+        return ("batch", enc, engine, None)
 
     def _seq_finish(self, disp) -> list[PodSchedulingResult]:
         """The deferred tail of a sequential pass: trace decode (batched

@@ -61,6 +61,7 @@ from ..utils import faultinject, fleetstats, locking
 from ..utils import ledger as ledger_mod
 from ..utils import slo as slo_mod
 from ..utils.broker import CompileBroker
+from . import batchplane as batchplane_mod
 from .service import SchedulerServiceDisabled, SimulatorService
 
 DEFAULT_SESSION_ID = "default"
@@ -247,11 +248,20 @@ class SessionManager:
         # sessions snapshotted by drain() (kss_drained_sessions_total)
         self.draining = False
         self.drained = 0
+        # cross-tenant continuous batching (server/batchplane.py,
+        # KSS_BATCH=1): bucket-compatible concurrent passes from
+        # different sessions stack onto ONE device dispatch through the
+        # shared plane; window/occupancy counters fall back to the
+        # default session's registry, like the broker's
+        self.batch_plane = batchplane_mod.from_env(
+            metrics=default_service.scheduler.metrics
+        )
         # adopt the boot service as the implicit default session: it
         # joins the shared compile plane and gains the session label,
         # and every legacy route keeps hitting it unchanged
         default_service.scheduler.session_id = DEFAULT_SESSION_ID
         default_service.scheduler.broker = self.broker
+        default_service.scheduler.batch_plane = self.batch_plane
         self._sessions: "dict[str, Session]" = {
             DEFAULT_SESSION_ID: Session(
                 DEFAULT_SESSION_ID, DEFAULT_SESSION_ID, default_service
@@ -413,6 +423,11 @@ class SessionManager:
                 "draining": self.draining,
                 "drainedSessions": self.drained,
                 "drainDeadlineSeconds": self.drain_deadline_s,
+                # the continuous-batching plane's config + live windows
+                # (server/batchplane.py); {"armed": False} when off
+                "batching": self.batch_plane.stats()
+                if self.batch_plane is not None
+                else {"armed": False},
             }
 
     # -- create / fork / delete ---------------------------------------------
@@ -452,6 +467,7 @@ class SessionManager:
             service = SimulatorService(
                 broker=self.broker, session_id=sid, fault_plane=plane
             )
+            service.scheduler.batch_plane = self.batch_plane
             sess = Session(sid, name or sid, service)
             sess.fault_spec = fault_inject
             self._sessions[sid] = sess
@@ -774,6 +790,7 @@ class SessionManager:
         service = SimulatorService(
             broker=self.broker, session_id=sid, fault_plane=plane
         )
+        service.scheduler.batch_plane = self.batch_plane
         service.store.load_state(doc["store"])
         cfg = doc.get("schedulerConfig")
         if cfg:
@@ -816,6 +833,12 @@ class SessionManager:
                 if s.state == "live" and s.service is not None
             ]
         self._stop.set()  # the idle sweeper must not race the snapshots
+        if self.batch_plane is not None:
+            # flush partially-filled collection windows NOW: in-flight
+            # passes waiting out a batch window would otherwise pad the
+            # drain by up to one window each, and new enrollments shed
+            # straight to solo dispatch (server/batchplane.py)
+            self.batch_plane.begin_drain()
         deadline = time.monotonic() + deadline_total
         drained: list[str] = []
         forced: list[str] = []
@@ -939,5 +962,7 @@ class SessionManager:
 
     def shutdown(self) -> None:
         self._stop.set()
+        if self.batch_plane is not None:
+            self.batch_plane.begin_drain()
         if self._sweeper is not None:
             self._sweeper.join(timeout=2)

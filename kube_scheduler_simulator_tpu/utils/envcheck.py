@@ -174,6 +174,15 @@ KNOWN: "dict[str, Validator]" = {
     # `make lint` / the analysis CLI: missing ruff/mypy and a non-empty
     # allowlist become hard failures instead of notes (CI honesty)
     "KSS_LINT_STRICT": _bool_validator,
+    # cross-tenant continuous batching (server/batchplane.py,
+    # docs/sessions.md): stack bucket-compatible concurrent sessions'
+    # passes onto ONE device dispatch; WINDOW_MS is the collection
+    # window, MAX_WAIT_MS bounds any enrollee's added latency (default:
+    # one window), MAX_SESSIONS caps the batch axis
+    "KSS_BATCH": _bool_validator,
+    "KSS_BATCH_WINDOW_MS": _float_validator(0.0),
+    "KSS_BATCH_MAX_WAIT_MS": _float_validator(0.0),
+    "KSS_BATCH_MAX_SESSIONS": _int_validator(1),
     # session plane (docs/sessions.md)
     "KSS_MAX_SESSIONS": _int_validator(1),
     "KSS_MAX_PENDING_PODS_PER_SESSION": _int_validator(0),

@@ -133,6 +133,10 @@ class ProgramRecord:
     warm_s: float = 0.0  # cumulative sampled warm device wall
     sessions: "dict[str, int]" = field(default_factory=dict)
     degraded: bool = False  # AOT dispatch fell back to plain jit
+    # monotonic stamp of this record's most recent call — how a batched
+    # dispatch's session fan-out (attribute_sessions) finds the record
+    # that actually dispatched when several fingerprints share a label
+    last_call_seq: int = 0
 
 
 @locking.guard_inferred
@@ -149,6 +153,7 @@ class ProgramLedger:
         self._lock = locking.make_lock("ledger.records")
         self._records: "dict[tuple[str, str], ProgramRecord]" = {}
         self._dispatch_total = 0.0
+        self._call_seq = 0
 
     # -- writing -------------------------------------------------------------
 
@@ -204,6 +209,8 @@ class ProgramLedger:
     ) -> None:
         sid = session if session is not None else DEFAULT_SESSION_KEY
         with self._lock:
+            self._call_seq += 1
+            rec.last_call_seq = self._call_seq
             rec.calls += 1
             rec.dispatch_s += float(dispatch_s)
             rec.sessions[sid] = rec.sessions.get(sid, 0) + 1
@@ -249,6 +256,32 @@ class ProgramLedger:
         with self._lock:
             self._records.clear()
             self._dispatch_total = 0.0
+
+    def attribute_sessions(self, label: str, sids: "list[str | None]") -> None:
+        """Fan one batched dispatch's attribution out to every enrolled
+        tenant (server/batchplane.py): the window's single device
+        dispatch was recorded under the LEADER's session context; the
+        other enrolled sessions' passes were served by the same call.
+        For ``batch.*`` programs the per-session counts are therefore
+        PASSES SERVED and may exceed `calls` (device dispatches) — the
+        gap IS the batching win, and `make batch-smoke` pins it.
+
+        Several fingerprints can share a label (one per batch bucket /
+        cluster shape): the fan-out lands on the record that MOST
+        RECENTLY dispatched — the caller attributes immediately after
+        its own call, so the freshest stamp is that dispatch (a
+        concurrent other-key window can at worst swap two same-label
+        attributions, never invent one)."""
+        with self._lock:
+            matching = [
+                rec for rec in self._records.values() if rec.label == label
+            ]
+            if not matching:
+                return
+            rec = max(matching, key=lambda r: r.last_call_seq)
+            for sid in sids:
+                key = sid if sid is not None else DEFAULT_SESSION_KEY
+                rec.sessions[key] = rec.sessions.get(key, 0) + 1
 
     def drop_session(self, sid: str) -> None:
         """Purge a deleted session's call attribution (the session-plane

@@ -31,7 +31,8 @@ from . import locking, telemetry
 # marks the SLO plane shape (docs/observability.md): the `slo` block
 # (per-objective compliance + alert states, utils/slo.py) and the
 # histogram `exemplars` entries the OpenMetrics exposition attaches to
-# buckets.
+# buckets. The `batching` block (continuous batching, PR 14) is a pure
+# ADDITION — per this contract it does not bump the version.
 METRICS_SCHEMA_VERSION = 4
 
 # Exemplar capture (docs/observability.md): histogram observations
@@ -281,6 +282,17 @@ class SchedulingMetrics:
     _bundle_saves: int = 0
     _bundle_bypasses: int = 0
     _aot_deserialize_s: float = 0.0
+    # cross-tenant continuous-batching counters (server/batchplane.py,
+    # KSS_BATCH=1): passes served by a batched device dispatch and
+    # passes that fell back to solo dispatch land on each SESSION's own
+    # registry; windows executed and the cumulative window fill (the
+    # occupancy numerator — mean fill = batchOccupancySum/batchWindows,
+    # derived in the snapshot's `batching` block) land on the plane's
+    # default registry
+    _batched_passes: int = 0
+    _batch_windows: int = 0
+    _batch_occupancy_sum: int = 0
+    _solo_fallbacks: int = 0
     # latency-distribution state (the observability PR): Prometheus-style
     # histograms behind the same lock as the counters, rendered into the
     # JSON snapshot's `histograms` block and the exposition text
@@ -556,6 +568,27 @@ class SchedulingMetrics:
             self._bundle_bypasses += int(bypasses)
             self._aot_deserialize_s += float(deserialize_s)
 
+    def record_batching(
+        self,
+        *,
+        batched_passes: int = 0,
+        windows: int = 0,
+        occupancy: int = 0,
+        solo_fallbacks: int = 0,
+    ) -> None:
+        """Continuous-batching accounting (server/batchplane.py):
+        `batched_passes` passes this registry's session had served by a
+        batched device dispatch, `solo_fallbacks` passes that fell back
+        to solo dispatch (incompatible, lone window, fault-scoped, or a
+        failed batched execution), `windows` batched windows executed
+        and `occupancy` the window's fill — the latter two recorded on
+        the plane's default registry."""
+        with self._lock:
+            self._batched_passes += int(batched_passes)
+            self._batch_windows += int(windows)
+            self._batch_occupancy_sum += int(occupancy)
+            self._solo_fallbacks += int(solo_fallbacks)
+
     def record_phase_seconds(
         self, execute: float = 0.0, decode: float = 0.0
     ) -> None:
@@ -645,6 +678,20 @@ class SchedulingMetrics:
                     "bundleSaves": self._bundle_saves,
                     "bundleBypasses": self._bundle_bypasses,
                     "aotDeserializeSeconds": round(self._aot_deserialize_s, 6),
+                    "batchedPasses": self._batched_passes,
+                    "batchWindows": self._batch_windows,
+                    "batchOccupancySum": self._batch_occupancy_sum,
+                    "soloFallbacks": self._solo_fallbacks,
+                },
+                # derived continuous-batching view (server/batchplane.py):
+                # mean window fill — a ratio, so it lives outside the
+                # cumulative `phases` counters the checkpoint carries
+                "batching": {
+                    "batchOccupancy": round(
+                        self._batch_occupancy_sum / self._batch_windows, 3
+                    )
+                    if self._batch_windows
+                    else 0.0,
                 },
                 "histograms": {
                     key: h.snapshot() for key, h in self._hist.items()
@@ -691,6 +738,10 @@ class SchedulingMetrics:
             self._bundle_saves = 0
             self._bundle_bypasses = 0
             self._aot_deserialize_s = 0.0
+            self._batched_passes = 0
+            self._batch_windows = 0
+            self._batch_occupancy_sum = 0
+            self._solo_fallbacks = 0
             self._slo_skip_eager = 0
             self._slo_skip_degraded = 0
             self._hist = _new_histograms()
@@ -709,6 +760,8 @@ class SchedulingMetrics:
         "_dispatch_retries", "_device_failovers", "_mesh_shrinks",
         "_bundle_loads", "_bundle_saves", "_bundle_bypasses",
         "_aot_deserialize_s",
+        "_batched_passes", "_batch_windows", "_batch_occupancy_sum",
+        "_solo_fallbacks",
     )
 
     def state_dict(self) -> dict:
@@ -881,6 +934,26 @@ _PROM_COUNTERS = (
         "kss_aot_deserialize_seconds_total",
         "Wall seconds spent deserializing AOT bundles (not compile stall).",
         ("phases", "aotDeserializeSeconds"),
+    ),
+    (
+        "kss_batched_passes_total",
+        "Passes served by a cross-tenant batched device dispatch.",
+        ("phases", "batchedPasses"),
+    ),
+    (
+        "kss_batch_windows_total",
+        "Batched collection windows executed as one device dispatch.",
+        ("phases", "batchWindows"),
+    ),
+    (
+        "kss_batch_occupancy_total",
+        "Cumulative batched-window fill (mean occupancy numerator).",
+        ("phases", "batchOccupancySum"),
+    ),
+    (
+        "kss_solo_fallbacks_total",
+        "Passes that fell back from the batch plane to solo dispatch.",
+        ("phases", "soloFallbacks"),
     ),
 )
 

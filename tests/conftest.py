@@ -81,6 +81,14 @@ for _var in (
     # tests opt in with monkeypatch + tmp_path
     "KSS_AOT_BUNDLES",
     "KSS_BUNDLE_DIR",
+    # the continuous-batching plane (server/batchplane.py): ambient
+    # KSS_BATCH=1 would route every suite pass through collection
+    # windows (latency + a vmapped compile per shape); batching tests
+    # arm planes explicitly
+    "KSS_BATCH",
+    "KSS_BATCH_WINDOW_MS",
+    "KSS_BATCH_MAX_WAIT_MS",
+    "KSS_BATCH_MAX_SESSIONS",
     # the session plane (server/sessions.py): ambient admission knobs
     # would change quota/limit behavior under test
     "KSS_MAX_SESSIONS",

@@ -1,0 +1,614 @@
+"""Cross-tenant continuous batching (server/batchplane.py).
+
+The parity pin: with batching armed, every session's placements and
+per-pod result records are BYTE-IDENTICAL to solo dispatch — the batch
+plane may change throughput and latency, never an answer. Plus the
+fairness/robustness contracts: a lone tenant never waits more than one
+window, semaphore waiters can't deadlock against the window timer,
+drain flushes partial windows, incompatible/gang/fault-scoped passes
+fall back to solo (counted), and one batched device dispatch lands
+spans / ledger attribution / latency observations on the correct
+session — including when a session is deleted mid-batch.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from kube_scheduler_simulator_tpu.server.batchplane import (
+    BATCH_SEQ_LABEL,
+    BatchPlane,
+    from_env,
+)
+from kube_scheduler_simulator_tpu.server.service import SimulatorService
+from kube_scheduler_simulator_tpu.server.sessions import SessionManager
+from kube_scheduler_simulator_tpu.utils import ledger as ledger_mod
+from kube_scheduler_simulator_tpu.utils import metrics as metrics_mod
+from kube_scheduler_simulator_tpu.utils import telemetry
+
+from helpers import node, pod
+
+N = 3
+
+
+def _snapshot(i: int, preempt: bool = False) -> dict:
+    """Session i's cluster: identical SHAPES (same counts, vocab, node
+    pods-capacity — one compile signature for all) with per-session
+    VALUES, so each tenant's placements differ while the batch key
+    matches."""
+    if preempt:
+        return {
+            "nodes": [node(f"n{j}", cpu="2") for j in range(2)],
+            "pods": [
+                pod("low-a", cpu="1500m", priority=1 + i, node_name="n0"),
+                pod("low-b", cpu="1500m", priority=1, node_name="n1"),
+                pod("high", cpu=f"{1200 + 100 * i}m", priority=100),
+                pod("filler", cpu="300m", priority=50),
+            ],
+        }
+    return {
+        "nodes": [node(f"n{j}", cpu="16") for j in range(3)],
+        "pods": [
+            pod(f"p{j}", cpu=f"{100 + 100 * i + 50 * j}m") for j in range(4)
+        ],
+    }
+
+
+def _results_doc(results) -> str:
+    """One canonical byte string for a pass's full record set (status,
+    placement, and all 13 result annotations)."""
+    return json.dumps(
+        [
+            {
+                "ns": r.pod_namespace,
+                "name": r.pod_name,
+                "status": r.status,
+                "node": r.selected_node,
+                "ann": r.to_annotations(),
+            }
+            for r in results
+        ],
+        sort_keys=True,
+    )
+
+
+def _manager(max_passes: int = 8) -> SessionManager:
+    return SessionManager(
+        SimulatorService(), max_sessions=16, max_concurrent_passes=max_passes
+    )
+
+
+def _armed_manager(
+    window_ms: float = 5000.0,
+    max_sessions: int = N,
+    max_passes: int = 8,
+    max_wait_ms: "float | None" = None,
+) -> "tuple[SessionManager, BatchPlane]":
+    mgr = _manager(max_passes)
+    plane = BatchPlane(
+        window_ms=window_ms,
+        max_wait_ms=max_wait_ms,
+        max_sessions=max_sessions,
+        metrics=mgr.get("default").service.scheduler.metrics,
+    )
+    mgr.batch_plane = plane
+    mgr.get("default").service.scheduler.batch_plane = plane
+    return mgr, plane
+
+
+def _solo_docs(n: int = N, preempt: bool = False) -> "dict[int, str]":
+    mgr = _manager()
+    docs = {}
+    try:
+        for i in range(n):
+            sess, errs = mgr.create(name=f"solo{i}", snapshot=_snapshot(i, preempt))
+            assert not errs
+            docs[i] = _results_doc(sess.service.scheduler.schedule())
+    finally:
+        mgr.shutdown()
+    return docs
+
+
+def _concurrent_schedule(mgr, sessions, mode: str = "sync"):
+    """Drive every session's pass concurrently (barrier-aligned so all
+    enroll in one window — the window only flushes when full, so the
+    batch composition is deterministic). Returns {i: results_doc}."""
+    out, errors = {}, {}
+    barrier = threading.Barrier(len(sessions))
+
+    def run(i):
+        try:
+            barrier.wait(timeout=30)
+            svc = sessions[i].service
+            with mgr.pass_slot():
+                if mode == "async":
+                    handle = svc.scheduler.begin_pass()
+                    handle.resolve()
+                    out[i] = None
+                else:
+                    out[i] = _results_doc(svc.scheduler.schedule())
+        except Exception as e:  # noqa: BLE001 — surfaced by the assert
+            errors[i] = repr(e)
+
+    threads = [
+        threading.Thread(target=run, args=(i,)) for i in range(len(sessions))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    assert not errors, errors
+    assert len(out) == len(sessions)
+    return out
+
+
+class TestBatchedParity:
+    def test_sync_parity_and_counters(self):
+        solo = _solo_docs()
+        mgr, _plane = _armed_manager()
+        try:
+            sessions = [
+                mgr.create(name=f"t{i}", snapshot=_snapshot(i))[0]
+                for i in range(N)
+            ]
+            out = _concurrent_schedule(mgr, sessions)
+            for i in range(N):
+                assert out[i] == solo[i], f"session {i} diverged from solo"
+            # ONE window, filled by all N passes
+            default_phases = (
+                mgr.get("default").service.scheduler.metrics.snapshot()
+            )
+            assert default_phases["phases"]["batchWindows"] == 1
+            assert default_phases["phases"]["batchOccupancySum"] == N
+            assert default_phases["batching"]["batchOccupancy"] == float(N)
+            for s in sessions:
+                phases = s.service.scheduler.metrics.snapshot()["phases"]
+                assert phases["batchedPasses"] == 1
+                assert phases["soloFallbacks"] == 0
+        finally:
+            mgr.shutdown()
+
+    def test_preemption_parity(self):
+        """The masked preempt path under the batch vmap must reproduce
+        the solo cond path's records bit-for-bit — victims, nominations,
+        and the retry attempt included."""
+        solo = _solo_docs(preempt=True)
+        assert any('"Nominated"' in d for d in solo.values()), (
+            "fixture must actually exercise preemption"
+        )
+        mgr, _plane = _armed_manager()
+        try:
+            sessions = [
+                mgr.create(name=f"t{i}", snapshot=_snapshot(i, True))[0]
+                for i in range(N)
+            ]
+            out = _concurrent_schedule(mgr, sessions)
+            for i in range(N):
+                assert out[i] == solo[i], f"session {i} diverged from solo"
+        finally:
+            mgr.shutdown()
+
+    def test_async_begin_pass_parity(self):
+        """begin_pass/resolve (the async pipeline's split) through the
+        batch plane: store write-backs identical to solo."""
+        # solo async baseline
+        mgr1 = _manager()
+        solo_pods = {}
+        try:
+            for i in range(N):
+                sess, _ = mgr1.create(name=f"s{i}", snapshot=_snapshot(i))
+                h = sess.service.scheduler.begin_pass()
+                h.resolve()
+                solo_pods[i] = json.dumps(
+                    sess.service.store.list("pods"), sort_keys=True
+                )
+        finally:
+            mgr1.shutdown()
+        mgr2, _plane = _armed_manager()
+        try:
+            sessions = [
+                mgr2.create(name=f"t{i}", snapshot=_snapshot(i))[0]
+                for i in range(N)
+            ]
+            _concurrent_schedule(mgr2, sessions, mode="async")
+            for i, s in enumerate(sessions):
+                got = json.dumps(s.service.store.list("pods"), sort_keys=True)
+                assert got == solo_pods[i], f"session {i} store diverged"
+                assert (
+                    s.service.scheduler.metrics.snapshot()["phases"][
+                        "batchedPasses"
+                    ]
+                    == 1
+                )
+        finally:
+            mgr2.shutdown()
+
+
+class TestFallbacks:
+    def test_gang_pass_falls_back_solo(self):
+        """Gang passes (sync AND async) keep today's solo dispatch with
+        the plane armed — placements identical to an unarmed manager."""
+        solo_mgr = _manager()
+        try:
+            s, _ = solo_mgr.create(name="g0", snapshot=_snapshot(0))
+            solo_placements, _, _ = s.service.scheduler.schedule_gang()
+        finally:
+            solo_mgr.shutdown()
+        mgr, _plane = _armed_manager()
+        try:
+            sess, _ = mgr.create(name="g", snapshot=_snapshot(0))
+            placements, rounds, results = sess.service.scheduler.schedule_gang()
+            assert placements == solo_placements
+            phases = sess.service.scheduler.metrics.snapshot()["phases"]
+            assert phases["soloFallbacks"] == 1
+            assert phases["batchedPasses"] == 0
+            # async gang (begin_gang_pass/resolve) through the armed
+            # plane: same fallback, pass completes
+            sess2, _ = mgr.create(name="g2", snapshot=_snapshot(0))
+            handle = sess2.service.scheduler.begin_gang_pass()
+            assert handle.resolve() == sum(
+                1 for v in solo_placements.values() if v
+            )
+            phases2 = sess2.service.scheduler.metrics.snapshot()["phases"]
+            assert phases2["soloFallbacks"] == 1
+        finally:
+            mgr.shutdown()
+
+    def test_fault_scoped_session_falls_back_solo(self):
+        """A session with its own fault plane is a bulkhead: its passes
+        never share a device dispatch with other tenants."""
+        mgr, _plane = _armed_manager(window_ms=50.0)
+        try:
+            sess, _ = mgr.create(
+                name="f",
+                snapshot=_snapshot(0),
+                fault_inject="compile_slow:0s",
+            )
+            results = sess.service.scheduler.schedule()
+            assert results
+            phases = sess.service.scheduler.metrics.snapshot()["phases"]
+            assert phases["soloFallbacks"] == 1
+            assert phases["batchedPasses"] == 0
+        finally:
+            mgr.shutdown()
+
+    def test_incompatible_shapes_never_share_a_window(self):
+        """Different compile signatures (different node-capacity bucket)
+        key different windows: both sessions complete, neither batches
+        with the other."""
+        mgr, _plane = _armed_manager(window_ms=150.0, max_sessions=4)
+        try:
+            a, _ = mgr.create(name="a", snapshot=_snapshot(0))
+            big = {
+                "nodes": [node(f"n{j}", cpu="16") for j in range(12)],
+                "pods": [pod(f"p{j}", cpu="100m") for j in range(4)],
+            }
+            b, _ = mgr.create(name="b", snapshot=big)
+            out, errors = {}, {}
+            barrier = threading.Barrier(2)
+
+            def run(key, sess):
+                try:
+                    barrier.wait(timeout=30)
+                    with mgr.pass_slot():
+                        out[key] = _results_doc(sess.service.scheduler.schedule())
+                except Exception as e:  # noqa: BLE001
+                    errors[key] = repr(e)
+
+            ts = [
+                threading.Thread(target=run, args=("a", a)),
+                threading.Thread(target=run, args=("b", b)),
+            ]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=180)
+            assert not errors, errors
+            for sess in (a, b):
+                phases = sess.service.scheduler.metrics.snapshot()["phases"]
+                assert phases["batchedPasses"] == 0
+                assert phases["soloFallbacks"] == 1
+        finally:
+            mgr.shutdown()
+
+
+class TestFairnessAndLiveness:
+    def test_lone_tenant_bounded_by_one_window(self):
+        """A lone tenant's pass waits at most ~one window before the
+        solo fallback serves it warm."""
+        mgr, plane = _armed_manager(window_ms=150.0, max_sessions=4)
+        try:
+            sess, _ = mgr.create(name="lone", snapshot=_snapshot(0))
+            # warm-up: first pass pays the window AND the solo compile
+            sess.service.scheduler.schedule()
+            # re-pend the pods and measure the steady-state pass
+            for p in _snapshot(0)["pods"]:
+                nm = p["metadata"]["name"]
+                sess.service.store.delete("pods", nm, "default")
+            sess.service.import_({"pods": _snapshot(0)["pods"]})
+            t0 = time.monotonic()
+            results = sess.service.scheduler.schedule()
+            elapsed = time.monotonic() - t0
+            assert results
+            # one 150 ms window + a warm solo pass; generous CI slack
+            assert elapsed < 2.0, f"lone tenant waited {elapsed:.2f}s"
+            phases = sess.service.scheduler.metrics.snapshot()["phases"]
+            assert phases["soloFallbacks"] == 2
+            assert phases["batchedPasses"] == 0
+            default = mgr.get("default").service.scheduler.metrics
+            assert default.snapshot()["phases"]["batchWindows"] == 0
+        finally:
+            mgr.shutdown()
+
+    def test_max_wait_caps_the_window(self):
+        plane = BatchPlane(window_ms=60000.0, max_wait_ms=100.0)
+        assert plane.wait_s == pytest.approx(0.1)
+        plane2 = BatchPlane(window_ms=50.0)
+        assert plane2.wait_s == pytest.approx(0.05)
+
+    def test_semaphore_waiters_do_not_deadlock_on_the_window(self):
+        """KSS_MAX_CONCURRENT_PASSES=1: the second session's pass queues
+        on the semaphore while the first sits out its window — the
+        window MUST flush on its timer (never wait for a quorum the
+        semaphore is blocking), so both complete."""
+        mgr, _plane = _armed_manager(
+            window_ms=200.0, max_sessions=4, max_passes=1
+        )
+        try:
+            sessions = [
+                mgr.create(name=f"t{i}", snapshot=_snapshot(i))[0]
+                for i in range(2)
+            ]
+            done, errors = [], {}
+
+            def run(i):
+                try:
+                    # serialize on the slot like the HTTP layer: retry
+                    # the 503-shaped shed until a slot frees
+                    for _ in range(400):
+                        try:
+                            with mgr.pass_slot():
+                                sessions[i].service.scheduler.schedule()
+                            done.append(i)
+                            return
+                        except Exception as e:  # noqa: BLE001
+                            if "concurrent-pass" not in str(e):
+                                raise
+                            time.sleep(0.02)
+                    errors[i] = "never got a slot"
+                except Exception as e:  # noqa: BLE001
+                    errors[i] = repr(e)
+
+            ts = [threading.Thread(target=run, args=(i,)) for i in range(2)]
+            t0 = time.monotonic()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=120)
+            assert not errors, errors
+            assert sorted(done) == [0, 1]
+            assert time.monotonic() - t0 < 60
+        finally:
+            mgr.shutdown()
+
+    def test_drain_flushes_a_partial_window(self):
+        """A pass sitting out a long window must be flushed by drain —
+        the drain path can't afford to sit out collection windows, and
+        new enrollments shed straight to solo."""
+        mgr, plane = _armed_manager(window_ms=30000.0, max_sessions=4)
+        try:
+            sess, _ = mgr.create(name="d", snapshot=_snapshot(0))
+            # pre-warm the solo program so the flushed pass is fast
+            plane.begin_drain()  # temporarily shed to warm solo
+            sess.service.scheduler.schedule()
+            with plane._lock:
+                plane._draining = False  # re-arm for the real assertion
+            for p in _snapshot(0)["pods"]:
+                sess.service.store.delete(
+                    "pods", p["metadata"]["name"], "default"
+                )
+            sess.service.import_({"pods": _snapshot(0)["pods"]})
+
+            state = {}
+
+            def run():
+                t0 = time.monotonic()
+                with mgr.pass_slot():
+                    sess.service.scheduler.schedule()
+                state["elapsed"] = time.monotonic() - t0
+
+            th = threading.Thread(target=run)
+            th.start()
+            time.sleep(0.4)  # let the pass enroll and sit in its window
+            result = mgr.drain(deadline_s=30)
+            th.join(timeout=30)
+            assert "elapsed" in state, "drain left the enrolled pass stuck"
+            assert state["elapsed"] < 10.0, state
+            assert "d" not in result.get("errors", {})
+        finally:
+            mgr.shutdown()
+
+
+class TestAttribution:
+    def test_one_dispatch_attributes_to_every_tenant(self, monkeypatch):
+        """One batched device dispatch serving N pass ids must land the
+        ledger call attribution, telemetry spans, and latency
+        observations on the correct sessions."""
+        monkeypatch.setenv("KSS_PROGRAM_LEDGER", "1")
+        ledger_mod.LEDGER.reset()
+        recorder = telemetry.SpanRecorder(8192)
+        telemetry.activate(recorder)
+        try:
+            mgr, _plane = _armed_manager()
+            try:
+                sessions = [
+                    mgr.create(name=f"t{i}", snapshot=_snapshot(i))[0]
+                    for i in range(N)
+                ]
+                sids = [s.id for s in sessions]
+                _concurrent_schedule(mgr, sessions)
+                # -- ledger: ONE device dispatch, N tenants attributed
+                recs = [
+                    rec
+                    for rec in ledger_mod.LEDGER.snapshot()["programs"]
+                    if rec["label"] == BATCH_SEQ_LABEL
+                ]
+                assert len(recs) == 1
+                assert recs[0]["calls"] == 1
+                for sid in sids:
+                    assert sid in recs[0]["sessions"], (
+                        f"{sid} missing from {recs[0]['sessions']}"
+                    )
+                # passes served == window fill
+                assert sum(recs[0]["sessions"].values()) == N
+                # -- spans: every session's pass spans carry its id
+                events = recorder.snapshot()
+                span_sessions = {
+                    e["args"].get("session")
+                    for e in events
+                    if e.get("name", "").startswith("pass.sequential")
+                    and e.get("args")
+                }
+                for sid in sids:
+                    assert sid in span_sessions
+                assert any(
+                    e.get("name") == "batch.execute" for e in events
+                )
+                # -- per-session latency observation (the SLO plane's
+                # passLatency signal reads this histogram)
+                for s in sessions:
+                    snap = s.service.scheduler.metrics.snapshot()
+                    hist = snap["histograms"]["passLatencySeconds"]
+                    assert hist["count"] == 1
+                # -- DELETE purges the dead tenant's attribution
+                mgr.delete(sids[0])
+                recs = [
+                    rec
+                    for rec in ledger_mod.LEDGER.snapshot()["programs"]
+                    if rec["label"] == BATCH_SEQ_LABEL
+                ]
+                assert sids[0] not in recs[0]["sessions"]
+                for sid in sids[1:]:
+                    assert sid in recs[0]["sessions"]
+            finally:
+                mgr.shutdown()
+        finally:
+            ledger_mod.LEDGER.reset()
+            telemetry.deactivate()
+
+    def test_mid_batch_session_delete(self):
+        """A session DELETEd while its pass waits in a window: the pass
+        still completes (write-backs land on the orphaned store), and
+        every other enrollee's results stay byte-identical to solo."""
+        solo = _solo_docs(2)
+        # max_sessions=3 so a 2-enrollee window stays OPEN (timer flush)
+        mgr, _plane = _armed_manager(window_ms=1500.0, max_sessions=3)
+        try:
+            a, _ = mgr.create(name="a", snapshot=_snapshot(0))
+            b, _ = mgr.create(name="b", snapshot=_snapshot(1))
+            out, errors = {}, {}
+            barrier = threading.Barrier(3)
+
+            def run(i, sess):
+                try:
+                    barrier.wait(timeout=30)
+                    with mgr.pass_slot():
+                        out[i] = _results_doc(sess.service.scheduler.schedule())
+                except Exception as e:  # noqa: BLE001
+                    errors[i] = repr(e)
+
+            def deleter():
+                barrier.wait(timeout=30)
+                time.sleep(0.2)  # mid-window: both passes enrolled
+                mgr.delete(b.id)
+
+            ts = [
+                threading.Thread(target=run, args=(0, a)),
+                threading.Thread(target=run, args=(1, b)),
+                threading.Thread(target=deleter),
+            ]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=120)
+            assert not errors, errors
+            assert out[0] == solo[0]
+            assert out[1] == solo[1]  # the orphaned pass still answered
+            with pytest.raises(Exception):
+                mgr.get(b.id)
+        finally:
+            mgr.shutdown()
+
+
+class TestPlumbing:
+    def test_from_env(self, monkeypatch):
+        monkeypatch.delenv("KSS_BATCH", raising=False)
+        assert from_env() is None
+        monkeypatch.setenv("KSS_BATCH", "1")
+        plane = from_env()
+        assert plane is not None
+        assert plane.window_s == pytest.approx(0.005)
+        assert plane.max_sessions == 8
+        monkeypatch.setenv("KSS_BATCH_WINDOW_MS", "25")
+        monkeypatch.setenv("KSS_BATCH_MAX_WAIT_MS", "10")
+        monkeypatch.setenv("KSS_BATCH_MAX_SESSIONS", "4")
+        plane = from_env()
+        assert plane.window_s == pytest.approx(0.025)
+        assert plane.wait_s == pytest.approx(0.010)
+        assert plane.max_sessions == 4
+        # malformed values fall back (boot-time envcheck is the strict
+        # gate; library reads must not take the stack down)
+        monkeypatch.setenv("KSS_BATCH_WINDOW_MS", "nope")
+        assert from_env().window_s == pytest.approx(0.005)
+
+    def test_session_manager_arms_from_env(self, monkeypatch):
+        monkeypatch.setenv("KSS_BATCH", "1")
+        mgr = _manager()
+        try:
+            assert mgr.batch_plane is not None
+            assert (
+                mgr.get("default").service.scheduler.batch_plane
+                is mgr.batch_plane
+            )
+            sess, _ = mgr.create(name="t")
+            assert sess.service.scheduler.batch_plane is mgr.batch_plane
+            assert mgr.stats()["batching"]["armed"] is True
+        finally:
+            mgr.shutdown()
+
+    def test_stats_unarmed(self):
+        mgr = _manager()
+        try:
+            assert mgr.stats()["batching"] == {"armed": False}
+        finally:
+            mgr.shutdown()
+
+    def test_batching_counters_roundtrip(self):
+        m = metrics_mod.SchedulingMetrics()
+        m.record_batching(batched_passes=3, windows=2, occupancy=5,
+                          solo_fallbacks=1)
+        snap = m.snapshot()
+        assert snap["phases"]["batchedPasses"] == 3
+        assert snap["phases"]["batchWindows"] == 2
+        assert snap["phases"]["batchOccupancySum"] == 5
+        assert snap["phases"]["soloFallbacks"] == 1
+        assert snap["batching"]["batchOccupancy"] == 2.5
+        # checkpoint round trip
+        m2 = metrics_mod.SchedulingMetrics()
+        m2.load_state(m.state_dict())
+        assert m2.snapshot()["phases"]["batchOccupancySum"] == 5
+        # exposition round trip through the strict parser
+        text = metrics_mod.render_prometheus(snap)
+        fams = metrics_mod.parse_prometheus_text(text)
+        for name, want in (
+            ("kss_batched_passes_total", 3),
+            ("kss_batch_windows_total", 2),
+            ("kss_batch_occupancy_total", 5),
+            ("kss_solo_fallbacks_total", 1),
+        ):
+            samples = fams[name]["samples"]
+            assert samples and samples[0][2] == want

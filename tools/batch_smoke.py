@@ -1,0 +1,221 @@
+"""`make batch-smoke`: cross-tenant continuous batching end-to-end on
+CPU (server/batchplane.py, docs/sessions.md). Three gates, one JSON line:
+
+1. **One device dispatch per window** — N bucket-compatible sessions
+   scheduling concurrently must be served by ONE `batch.seq.run`
+   dispatch (program-ledger call count == windows executed == 1, window
+   fill == N), with every tenant attributed on the one call.
+2. **Per-session trace parity** — each tenant's full result-record set
+   (status, placement, all 13 annotations) must be BYTE-IDENTICAL to a
+   solo-dispatch run of the same cluster: batching may change
+   throughput, never an answer.
+3. **Lone-tenant fairness** — a single tenant's pass waits at most
+   ~one `KSS_BATCH_WINDOW_MS` before the solo fallback serves it.
+
+Exit 0 on pass. Small enough for CI (seconds, CPU-only): a sanity gate,
+not a benchmark — the throughput curve lives in
+`bench.py --concurrency-probe` (docs/performance.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("KSS_NO_SPECULATIVE_COMPILE", "1")
+os.environ["KSS_PROGRAM_LEDGER"] = "1"
+
+N = 4
+WINDOW_MS = 150.0
+
+
+def _node(name: str) -> dict:
+    return {
+        "metadata": {"name": name},
+        "status": {
+            "allocatable": {"cpu": "16", "memory": "32Gi", "pods": "110"}
+        },
+    }
+
+
+def _pod(name: str, cpu_m: int) -> dict:
+    return {
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "containers": [
+                {
+                    "name": "c",
+                    "resources": {
+                        "requests": {"cpu": f"{cpu_m}m", "memory": "256Mi"}
+                    },
+                }
+            ]
+        },
+    }
+
+
+def _snapshot(i: int) -> dict:
+    """Tenant i's cluster: identical shapes (one batch key), distinct
+    request values (distinct placements)."""
+    return {
+        "nodes": [_node(f"n{j}") for j in range(4)],
+        "pods": [_pod(f"p{j}", 100 + 100 * i + 50 * j) for j in range(6)],
+    }
+
+
+def _results_doc(results) -> str:
+    return json.dumps(
+        [
+            {
+                "ns": r.pod_namespace,
+                "name": r.pod_name,
+                "status": r.status,
+                "node": r.selected_node,
+                "ann": r.to_annotations(),
+            }
+            for r in results
+        ],
+        sort_keys=True,
+    )
+
+
+def main() -> int:
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    from kube_scheduler_simulator_tpu.server.batchplane import (
+        BATCH_SEQ_LABEL,
+        BatchPlane,
+    )
+    from kube_scheduler_simulator_tpu.server.service import SimulatorService
+    from kube_scheduler_simulator_tpu.server.sessions import SessionManager
+    from kube_scheduler_simulator_tpu.utils import ledger as ledger_mod
+
+    report: dict = {"smoke": "batch", "sessions": N}
+    failures: list[str] = []
+
+    # -- solo baseline (plane off): the parity oracle ----------------------
+    solo_mgr = SessionManager(
+        SimulatorService(), max_sessions=16, max_concurrent_passes=N
+    )
+    solo_docs = {}
+    for i in range(N):
+        sess, errs = solo_mgr.create(name=f"solo{i}", snapshot=_snapshot(i))
+        assert not errs, errs
+        solo_docs[i] = _results_doc(sess.service.scheduler.schedule())
+    solo_mgr.shutdown()
+
+    # -- batched run -------------------------------------------------------
+    ledger_mod.LEDGER.reset()
+    mgr = SessionManager(
+        SimulatorService(), max_sessions=16, max_concurrent_passes=N
+    )
+    plane = BatchPlane(
+        window_ms=10_000.0,  # flushes when FULL: deterministic one-window
+        max_sessions=N,
+        metrics=mgr.get("default").service.scheduler.metrics,
+    )
+    mgr.batch_plane = plane
+    mgr.get("default").service.scheduler.batch_plane = plane
+    sessions = []
+    for i in range(N):
+        sess, errs = mgr.create(name=f"t{i}", snapshot=_snapshot(i))
+        assert not errs, errs
+        sessions.append(sess)
+    out: dict = {}
+    errors: dict = {}
+    barrier = threading.Barrier(N)
+
+    def run(i):
+        try:
+            barrier.wait(timeout=60)
+            with mgr.pass_slot():
+                out[i] = _results_doc(sessions[i].service.scheduler.schedule())
+        except Exception as e:  # noqa: BLE001 — reported below
+            errors[i] = repr(e)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(N)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    if errors:
+        failures.append(f"batched passes raised: {errors}")
+
+    # gate 1: one device dispatch per window, every tenant attributed
+    default_snap = mgr.get("default").service.scheduler.metrics.snapshot()
+    windows = default_snap["phases"]["batchWindows"]
+    occupancy = default_snap["phases"]["batchOccupancySum"]
+    report["batchWindows"] = windows
+    report["batchOccupancySum"] = occupancy
+    report["batchOccupancy"] = default_snap["batching"]["batchOccupancy"]
+    if windows != 1 or occupancy != N:
+        failures.append(
+            f"expected ONE full window, got windows={windows} fill={occupancy}"
+        )
+    batch_recs = [
+        rec
+        for rec in ledger_mod.LEDGER.snapshot()["programs"]
+        if rec["label"] == BATCH_SEQ_LABEL
+    ]
+    calls = sum(rec["calls"] for rec in batch_recs)
+    attributed = {
+        sid for rec in batch_recs for sid in rec["sessions"]
+    }
+    report["batchDispatches"] = calls
+    report["attributedSessions"] = sorted(attributed)
+    if calls != 1:
+        failures.append(f"expected 1 ledger-pinned device dispatch, got {calls}")
+    missing = {s.id for s in sessions} - attributed
+    if missing:
+        failures.append(f"sessions missing from ledger attribution: {missing}")
+
+    # gate 2: per-session trace parity vs solo dispatch
+    mismatches = [i for i in range(N) if out.get(i) != solo_docs[i]]
+    report["parity"] = not mismatches
+    if mismatches:
+        failures.append(f"solo/batched result divergence for sessions {mismatches}")
+
+    # gate 3: a lone tenant is bounded by ~one window
+    lone_mgr = SessionManager(
+        SimulatorService(), max_sessions=4, max_concurrent_passes=2
+    )
+    lone_plane = BatchPlane(
+        window_ms=WINDOW_MS,
+        max_sessions=N,
+        metrics=lone_mgr.get("default").service.scheduler.metrics,
+    )
+    lone_mgr.batch_plane = lone_plane
+    lone_mgr.get("default").service.scheduler.batch_plane = lone_plane
+    lone, errs = lone_mgr.create(name="lone", snapshot=_snapshot(0))
+    assert not errs, errs
+    lone.service.scheduler.schedule()  # warm-up pays window + solo compile
+    for p in _snapshot(0)["pods"]:
+        lone.service.store.delete("pods", p["metadata"]["name"], "default")
+    lone.service.import_({"pods": _snapshot(0)["pods"]})
+    t0 = time.monotonic()
+    lone.service.scheduler.schedule()
+    lone_wait_s = time.monotonic() - t0
+    report["loneTenantPassSeconds"] = round(lone_wait_s, 4)
+    report["loneTenantBoundSeconds"] = round(WINDOW_MS / 1000.0 + 2.0, 4)
+    if lone_wait_s > WINDOW_MS / 1000.0 + 2.0:
+        failures.append(
+            f"lone tenant waited {lone_wait_s:.2f}s "
+            f"(window {WINDOW_MS}ms + 2s CPU slack)"
+        )
+    lone_mgr.shutdown()
+    mgr.shutdown()
+
+    report["ok"] = not failures
+    if failures:
+        report["failures"] = failures
+    print(json.dumps(report))
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
