@@ -5,7 +5,7 @@
 PORT ?= 1212
 PY ?= python
 
-.PHONY: test test-fast lint start bench dryrun batch lifecycle-smoke perf-smoke resilience-smoke observability-smoke session-smoke soak-smoke bundle-smoke batch-smoke docker docker-up clean
+.PHONY: test test-fast lint start bench dryrun batch lifecycle-smoke perf-smoke resilience-smoke observability-smoke session-smoke soak-smoke bundle-smoke batch-smoke fleet-smoke docker docker-up clean
 
 # full suite on the 8-device virtual CPU mesh (tests/conftest.py pins it)
 test:
@@ -109,6 +109,14 @@ bundle-smoke:
 # stays bounded by one collection window; one JSON line
 batch-smoke:
 	env JAX_PLATFORMS=cpu $(PY) tools/batch_smoke.py
+
+# horizontal serving fleet gate (docs/fleet.md): a 2-worker fleet over
+# ONE shared bundle store — worker 2 compiles ZERO engine programs
+# (gate A); kill -TERM one worker mid-session and the session answers
+# from its ring successor with no lost writes (gate B); a full rolling
+# restart stays scrape-answerable throughout (gate C); one JSON line
+fleet-smoke:
+	env JAX_PLATFORMS=cpu $(PY) tools/fleet_smoke.py
 
 # containerized dev flow (reference `make docker_build_and_up`, one service)
 docker:

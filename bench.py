@@ -843,6 +843,251 @@ def _concurrency_probe(
     )
 
 
+def _fleet_probe(n_nodes: int = 8, n_pods: int = 24, rounds: int = 2):
+    """Subprocess mode (`bench.py --fleet-probe`): **aggregate
+    decisions/s/HOST vs fleet width** (fleet/router.py, docs/fleet.md)
+    — what horizontal workers buy on one machine when each session's
+    passes stay affine to one process and all workers share the AOT
+    bundle store.
+
+    A serialized in-process baseline (the single-process server's
+    scheduling path, no HTTP) anchors the comparison; then fleets of
+    1/2/4 REAL spawned workers each serve one session per worker, all
+    sessions scheduling concurrently through the router. Decisions =
+    pods evaluated; the wall is the concurrent phase's (barrier-aligned)
+    wall-clock, so the number is per-host aggregate throughput.
+    Re-pending happens OUTSIDE the timed window — the probe measures
+    scheduling, not pod CRUD.
+
+    The later fleets boot against the bundle dir the first fleet
+    warmed, and the probe's last act measures **time-to-first-scheduled
+    -pod on a bundle-warmed worker**: a fresh 1-worker fleet from
+    process spawn to the first pod bound, everything served from the
+    shared store. Pinned to CPU (host-throughput measurement); one JSON
+    line."""
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from kube_scheduler_simulator_tpu.fleet import FleetRouter
+    from kube_scheduler_simulator_tpu.server.service import SimulatorService
+
+    env = dict(
+        _os.environ,
+        JAX_PLATFORMS="cpu",
+        KSS_AOT_BUNDLES="1",
+        KSS_NO_SPECULATIVE_COMPILE="1",
+        KSS_JAX_CACHE_DIR=tempfile.mkdtemp(prefix="kss-fleet-bench-cache-"),
+    )
+    env.pop("KSS_WORKER_ID", None)
+    bundle_dir = tempfile.mkdtemp(prefix="kss-fleet-bench-bundles-")
+
+    def node_doc(j):
+        return {
+            "metadata": {"name": f"fn{j}"},
+            "status": {
+                "allocatable": {"cpu": "64", "memory": "128Gi", "pods": "110"}
+            },
+        }
+
+    def pod_doc(i, j):
+        return {
+            "metadata": {"name": f"fp{j}", "namespace": "default"},
+            "spec": {
+                "containers": [
+                    {
+                        "name": "c",
+                        "resources": {
+                            "requests": {
+                                "cpu": f"{100 + 10 * i + (j % 7) * 20}m",
+                                "memory": "256Mi",
+                            }
+                        },
+                    }
+                ]
+            },
+        }
+
+    def _req(port, method, path, body=None, timeout=600):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                raw = resp.read()
+                return resp.status, json.loads(raw) if raw else None
+        except urllib.error.HTTPError as e:
+            raw = e.read()
+            return e.code, json.loads(raw) if raw else None
+
+    # -- serialized single-process baseline (no HTTP, no fleet) ----------
+    svc = SimulatorService()
+    for j in range(n_nodes):
+        svc.store.apply("nodes", node_doc(j))
+    svc.import_({"pods": [pod_doc(0, j) for j in range(n_pods)]})
+    svc.scheduler.schedule()  # warm: compile + caches
+
+    def repend_local(i):
+        for j in range(n_pods):
+            svc.store.delete("pods", f"fp{j}", "default")
+        svc.import_({"pods": [pod_doc(i, j) for j in range(n_pods)]})
+
+    solo_wall = 0.0
+    for r in range(rounds):
+        repend_local(r)
+        t0 = time.perf_counter()
+        svc.scheduler.schedule()
+        solo_wall += time.perf_counter() - t0
+    baseline_dps = rounds * n_pods / solo_wall if solo_wall > 0 else 0.0
+
+    # -- the fleet ladder ------------------------------------------------
+    def session_on(router, wid, prefix):
+        for i in range(64):
+            sid = f"{prefix}-{i}"
+            w, _ = router.place_session({"id": sid})
+            if w is not None and w.id == wid:
+                code, _doc = _req(
+                    router.port, "POST", "/api/v1/sessions", {"id": sid}
+                )
+                if code != 201:
+                    raise RuntimeError(f"create {sid}: {code}")
+                return sid
+        raise RuntimeError(f"no id hashed to {wid} in 64 tries")
+
+    def repend_http(router, sid, i):
+        base = f"/api/v1/sessions/{sid}"
+        for j in range(n_pods):
+            _req(
+                router.port, "DELETE", f"{base}/resources/pods/default/fp{j}"
+            )
+            _req(router.port, "PUT", f"{base}/resources/pods", pod_doc(i, j))
+
+    curve: dict = {}
+    for width in (1, 2, 4):
+        router = FleetRouter(
+            n_workers=width,
+            fleet_dir=tempfile.mkdtemp(prefix=f"kss-fleet-bench-{width}-"),
+            bundle_dir=bundle_dir,
+            probe_interval_s=5.0,
+            env=env,
+        ).start()
+        try:
+            sids = [
+                session_on(router, wid, f"b{width}")
+                for wid in router.worker_ids()
+            ]
+            for sid in sids:
+                base = f"/api/v1/sessions/{sid}"
+                for j in range(n_nodes):
+                    _req(
+                        router.port,
+                        "PUT",
+                        f"{base}/resources/nodes",
+                        node_doc(j),
+                    )
+                repend_http(router, sid, 0)
+                code, _doc = _req(router.port, "POST", f"{base}/schedule")
+                if code != 200:
+                    raise RuntimeError(f"warm schedule on {sid}: {code}")
+
+            def one_round() -> float:
+                start = threading.Barrier(width + 1)
+                errors: list = []
+
+                def run(sid):
+                    try:
+                        start.wait(timeout=120)
+                        code, _d = _req(
+                            router.port,
+                            "POST",
+                            f"/api/v1/sessions/{sid}/schedule",
+                        )
+                        if code != 200:
+                            errors.append(f"{sid}: {code}")
+                    except Exception as e:  # noqa: BLE001 — surfaced below
+                        errors.append(repr(e))
+
+                threads = [
+                    threading.Thread(target=run, args=(sid,)) for sid in sids
+                ]
+                for t in threads:
+                    t.start()
+                start.wait(timeout=120)
+                t0 = time.perf_counter()
+                for t in threads:
+                    t.join(timeout=900)
+                wall = time.perf_counter() - t0
+                if errors:
+                    raise RuntimeError(f"fleet width {width}: {errors}")
+                return wall
+
+            total_wall = 0.0
+            for r in range(rounds):
+                for sid in sids:
+                    repend_http(router, sid, r + 1)
+                total_wall += one_round()
+            agg_dps = (
+                rounds * width * n_pods / total_wall
+                if total_wall > 0
+                else 0.0
+            )
+            curve[str(width)] = {
+                "aggregate_dps": round(agg_dps, 1),
+                "speedup_vs_single_process": round(agg_dps / baseline_dps, 2)
+                if baseline_dps
+                else None,
+            }
+        finally:
+            router.shutdown(drain=False)
+
+    # -- time-to-first-scheduled-pod on a bundle-warmed worker -----------
+    t0 = time.perf_counter()
+    router = FleetRouter(
+        n_workers=1,
+        fleet_dir=tempfile.mkdtemp(prefix="kss-fleet-bench-warm-"),
+        bundle_dir=bundle_dir,
+        probe_interval_s=5.0,
+        env=env,
+    ).start()
+    try:
+        # the ladder's exact workload shape, so the warm worker's
+        # engine program resolves from the store instead of compiling
+        # (bundles are keyed by compile signature — a different shape
+        # bucket would be an honest miss)
+        base = "/api/v1/sessions/warm-1"
+        _req(router.port, "POST", "/api/v1/sessions", {"id": "warm-1"})
+        for j in range(n_nodes):
+            _req(router.port, "PUT", f"{base}/resources/nodes", node_doc(j))
+        for j in range(n_pods):
+            _req(router.port, "PUT", f"{base}/resources/pods", pod_doc(0, j))
+        code, out = _req(router.port, "POST", f"{base}/schedule")
+        warm_ttfp = time.perf_counter() - t0
+        if code != 200 or not out.get("scheduled"):
+            raise RuntimeError(f"warm worker scheduled nothing: {code} {out}")
+        _, mdoc = _req(router.port, "GET", "/api/v1/metrics")
+        warm_bundles = (mdoc["workers"].get("w0") or {}).get("bundles") or {}
+    finally:
+        router.shutdown(drain=False)
+
+    print(
+        json.dumps(
+            {
+                "fleet_baseline_dps": round(baseline_dps, 1),
+                "pods_per_session": n_pods,
+                "nodes": n_nodes,
+                "rounds": rounds,
+                "fleet": curve,
+                "warm_worker_first_pod_s": round(warm_ttfp, 3),
+                "warm_worker_bundles": warm_bundles,
+            }
+        )
+    )
+
+
 def _sweep_preempt_probe():
     """Subprocess mode (`bench.py --sweep-preempt-probe`): the
     Monte-Carlo sweep WITH the full default set incl. DefaultPreemption,
@@ -1519,6 +1764,16 @@ def main(profile_dir: "str | None" = None):
         device=not platform.startswith("cpu"),
     )
 
+    # aggregate decisions/s/HOST vs horizontal fleet width (1/2/4 real
+    # spawned workers behind the session-affine router, one shared
+    # bundle store; fleet/router.py, docs/fleet.md), plus
+    # time-to-first-scheduled-pod on a bundle-warmed worker. Pinned to
+    # CPU inside the probe (host-throughput measurement), so
+    # device=False containment suffices.
+    fleet = _probe_json_subprocess(
+        ["--fleet-probe"], 900.0, "fleet_baseline_dps", device=False
+    )
+
     # time-to-first-scheduled-pod from a cold process (ROADMAP #1's
     # wished-for headline, docs/performance.md): a fresh subprocess
     # boots the serving path from nothing and reports its cold-start
@@ -1608,6 +1863,11 @@ def main(profile_dir: "str | None" = None):
                 # solo baseline, and the windows/occupancy that prove
                 # one dispatch served N tenants
                 "batching": batching
+                or {"error": "probe did not complete in its window"},
+                # aggregate decisions/s/host at fleet widths 1/2/4 vs
+                # the single-process baseline, and the bundle-warmed
+                # worker's time-to-first-scheduled-pod (docs/fleet.md)
+                "fleet": fleet
                 or {"error": "probe did not complete in its window"},
                 # the memory trajectory hoisted to the headline (the
                 # fleet & memory observatory, docs/observability.md):
@@ -1722,6 +1982,9 @@ if __name__ == "__main__":
         sys.exit(0)
     if "--concurrency-probe" in sys.argv:
         _concurrency_probe()
+        sys.exit(0)
+    if "--fleet-probe" in sys.argv:
+        _fleet_probe()
         sys.exit(0)
     if "--sweep-preempt-probe" in sys.argv:
         _sweep_preempt_probe()

@@ -1,0 +1,17 @@
+"""The horizontal serving fleet (docs/fleet.md).
+
+One thin router process in front of N workers — each worker the
+existing single-process server (`server/httpserver.py`) on its own port
+with its own ``KSS_SESSION_DIR`` namespace, all sharing ONE
+``KSS_BUNDLE_DIR`` so any worker's compile is every worker's sub-second
+cold start (utils/bundles.py). Sessions shard across workers by
+consistent-hash affinity (`ring.py`); the router proxies by session id,
+federates observability, re-homes a dead worker's sessions to its ring
+successors through the checkpoint/adopt path, and rolls the fleet one
+worker at a time with zero acknowledged-write loss (`router.py`).
+"""
+
+from .ring import HashRing
+from .router import FleetRouter, Worker
+
+__all__ = ["FleetRouter", "HashRing", "Worker"]

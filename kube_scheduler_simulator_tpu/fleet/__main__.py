@@ -1,0 +1,87 @@
+"""CLI driver: boot the fleet router and its workers (docs/fleet.md).
+
+    python -m kube_scheduler_simulator_tpu.fleet [--workers 2]
+                                                 [--port 1212]
+
+The router spawns ``KSS_FLEET_WORKERS`` copies of the single-process
+server (`python -m ...server`), each on its own port with its own
+``KSS_SESSION_DIR`` namespace under ``KSS_FLEET_DIR`` and ONE shared
+``KSS_BUNDLE_DIR``, then serves the fleet surface on `--port`. SIGTERM
+tears the fleet down gracefully: every worker gets its own SIGTERM
+(= the zero-loss drain) before the router exits.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+
+from .router import FleetRouter
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    # strict KSS_* validation up front, same contract as the worker CLI
+    from ..utils import envcheck
+
+    envcheck.fail_fast()
+
+    parser = argparse.ArgumentParser(
+        prog="kube-scheduler-simulator-tpu-fleet"
+    )
+    parser.add_argument(
+        "--workers",
+        type=int,
+        default=None,
+        help="worker count (default: KSS_FLEET_WORKERS, else 2)",
+    )
+    parser.add_argument("--port", type=int, default=1212)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument(
+        "--fleet-dir",
+        default=None,
+        help="root for worker session namespaces, logs, and the shared "
+        "bundle store (default: KSS_FLEET_DIR, else a temp dir)",
+    )
+    parser.add_argument(
+        "--base-port",
+        type=int,
+        default=None,
+        help="first worker port; workers take base..base+N-1 "
+        "(default: KSS_FLEET_BASE_PORT, else ephemeral free ports)",
+    )
+    args = parser.parse_args(argv)
+
+    router = FleetRouter(
+        n_workers=args.workers,
+        host=args.host,
+        port=args.port,
+        fleet_dir=args.fleet_dir,
+        base_port=args.base_port,
+    ).start()
+    workers = ", ".join(router.worker_ids())
+    print(
+        f"fleet router serving on http://{args.host}:{router.port}/api/v1 "
+        f"(workers: {workers})"
+    )
+
+    stop = threading.Event()
+
+    def _term(signum, frame):
+        stop.set()
+
+    try:
+        signal.signal(signal.SIGTERM, _term)
+    except ValueError:  # non-main thread (embedded use): skip
+        pass
+    try:
+        while not stop.wait(0.5):
+            pass
+    except KeyboardInterrupt:
+        pass
+    router.shutdown(drain=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

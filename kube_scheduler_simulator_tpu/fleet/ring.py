@@ -1,0 +1,114 @@
+"""Consistent-hash ring: session → worker affinity (docs/fleet.md).
+
+The router's placement primitive, deliberately classic (Karger et al.,
+STOC'97 — the memcached/libketama shape): each worker contributes
+`replicas` virtual points on a 2^64 ring, a key is owned by the first
+point clockwise of its own hash. The properties the fleet leans on, all
+pinned in tests/test_fleet_ring.py:
+
+  * **deterministic** — ownership is a pure function of (worker set,
+    replicas, key): every router instance, restart, or test re-derives
+    the same map with no coordination state;
+  * **stable affinity** — the same session id maps to the same worker
+    for as long as that worker is in the ring, so a session's every
+    request (and its compile-warmed engines) stay on one process;
+  * **bounded movement** — adding or removing one worker re-homes only
+    the keys in the arcs it gains or loses (~1/N of them): a worker
+    death re-homes *its* sessions, nobody else's, and a join steals
+    only what it now owns.
+
+Hashing is sha256 (stdlib, stable across processes and platforms —
+`hash()` is salted per process and would break determinism).
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+
+DEFAULT_REPLICAS = 64
+
+
+def _hash64(data: str) -> int:
+    return int.from_bytes(
+        hashlib.sha256(data.encode("utf-8")).digest()[:8], "big"
+    )
+
+
+class HashRing:
+    """Virtual-node consistent-hash ring over worker ids (strings).
+
+    Not thread-safe by itself: the router mutates it only under its own
+    lock. Pure stdlib, no time/randomness — fully deterministic."""
+
+    def __init__(
+        self, workers: "tuple | list" = (), replicas: int = DEFAULT_REPLICAS
+    ):
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        self.replicas = replicas
+        self._workers: set[str] = set()
+        # sorted virtual points: parallel arrays (hash, worker id)
+        self._hashes: list[int] = []
+        self._owners: list[str] = []
+        for wid in workers:
+            self.add(wid)
+
+    def __len__(self) -> int:
+        return len(self._workers)
+
+    def __contains__(self, wid: str) -> bool:
+        return wid in self._workers
+
+    def workers(self) -> list[str]:
+        return sorted(self._workers)
+
+    def add(self, wid: str) -> None:
+        """Idempotent join: `wid` contributes its `replicas` points."""
+        if wid in self._workers:
+            return
+        self._workers.add(wid)
+        for i in range(self.replicas):
+            h = _hash64(f"{wid}#{i}")
+            at = bisect.bisect(self._hashes, h)
+            self._hashes.insert(at, h)
+            self._owners.insert(at, wid)
+
+    def remove(self, wid: str) -> None:
+        """Idempotent leave: `wid`'s points vanish; keys in its arcs
+        fall through to their clockwise successors."""
+        if wid not in self._workers:
+            return
+        self._workers.discard(wid)
+        keep = [
+            (h, w)
+            for h, w in zip(self._hashes, self._owners)
+            if w != wid
+        ]
+        self._hashes = [h for h, _ in keep]
+        self._owners = [w for _, w in keep]
+
+    def owner(self, key: str) -> "str | None":
+        """The worker owning `key`, or None on an empty ring."""
+        if not self._hashes:
+            return None
+        at = bisect.bisect(self._hashes, _hash64(key))
+        if at == len(self._hashes):
+            at = 0  # wrap: past the last point, the first owns it
+        return self._owners[at]
+
+    def owners(self, key: str, n: int) -> list[str]:
+        """Up to `n` DISTINCT workers in preference order from `key`'s
+        point clockwise — the re-home successor list: owners(key, 2)[1]
+        is where `key` lands when its primary dies."""
+        if not self._hashes or n < 1:
+            return []
+        found: list[str] = []
+        start = bisect.bisect(self._hashes, _hash64(key))
+        for i in range(len(self._hashes)):
+            wid = self._owners[(start + i) % len(self._hashes)]
+            if wid not in found:
+                found.append(wid)
+                if len(found) >= n:
+                    break
+        return found

@@ -1,0 +1,1203 @@
+"""The fleet router: session-affine proxy over N workers (docs/fleet.md).
+
+One thin stdlib-only HTTP process in front of N single-process servers
+(`server/httpserver.py`). Responsibilities, in order of importance:
+
+  * **Affinity routing** — `/api/v1/sessions/<id>/...` lands on the
+    consistent-hash owner of `<id>` (ring.py); session create picks the
+    ring owner and pins the id there (the worker honors the explicit
+    ``"id"`` in the create body), so a session's every request — and
+    its compile-warmed engines — stay on one worker. The legacy
+    (un-prefixed) surface routes to the owner of ``"default"``.
+  * **Graceful degradation** — worker 503s (admission, cooldown,
+    draining) pass through verbatim with their `Retry-After`; an
+    unreachable worker becomes a router-level shed (503 +
+    `Retry-After`, counted in ``kss_fleet_router_shed_total``), never a
+    hang.
+  * **Failure recovery** — a `readyz` probe loop detects worker death
+    (process exit, or repeated connection failures) and re-homes the
+    dead worker's checkpoint files (``KSS_SESSION_DIR`` namespaces
+    under the fleet dir) to their ring successors, which adopt them via
+    ``POST /api/v1/admin/adopt`` — the PR 8 drain/adopt path, now
+    cross-worker. A SIGTERM'd worker snapshots everything before
+    exiting, so kill-and-re-home loses no acknowledged write.
+  * **Rolling restarts** — ``POST /api/v1/fleet/roll`` drains one
+    worker at a time (SIGTERM → snapshot-everything → exit 0),
+    re-homes its sessions, restarts it, and moves on; scrapes and the
+    other workers' sessions stay answerable throughout.
+  * **Federated observability** — the router merges every worker's
+    Prometheus exposition (each self-labeled via ``KSS_WORKER_ID``;
+    unlabeled adopted workers get the label injected here), appends its
+    own ``kss_fleet_*`` families, and serves fleet-wide
+    ``/api/v1/metrics``, ``/alerts``, ``/timeseries``, plus the fleet
+    status page ``GET /api/v1/fleet``.
+
+Workers are either **spawned** (subprocess children of the router —
+`python -m ...server` on its own port, own session namespace, the ONE
+shared bundle dir) or **adopted** (pre-existing servers handed in by
+URL + session dir — how the in-process tests drive the router without
+paying subprocess boots).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import secrets
+import shutil
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from ..utils import locking
+from ..utils import metrics as metrics_mod
+from .ring import DEFAULT_REPLICAS, HashRing
+
+# Retry-After (seconds) on router-level sheds — matches the worker's
+# DEGRADED_RETRY_AFTER_S so clients back off uniformly
+RETRY_AFTER_S = 2
+
+# consecutive failed probes before an unreachable worker is declared
+# dead (a spawned worker whose process exited is dead immediately)
+DEAD_AFTER_FAILURES = 3
+
+DEFAULT_PROBE_INTERVAL_S = 1.0
+WORKER_BOOT_TIMEOUT_S = 240.0
+# how long a SIGTERM'd worker gets to finish its zero-loss drain before
+# the roll gives up waiting (KSS_DRAIN_DEADLINE_S lives inside this)
+DRAIN_EXIT_TIMEOUT_S = 180.0
+PROXY_TIMEOUT_S = 600.0
+
+# repo root, for spawned workers' PYTHONPATH: the child must import the
+# package regardless of the router's cwd
+_PKG_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+# the router's own exposition families (docs/observability.md), appended
+# after the merged worker documents — names stay standalone literals so
+# the metrics-registry analyzer enforces the docs rows
+_ROUTER_FAMILY_DEFS = (
+    (
+        "kss_fleet_workers",
+        "gauge",
+        "Workers in the fleet (any state).",
+    ),
+    (
+        "kss_fleet_workers_ready",
+        "gauge",
+        "Workers currently ready.",
+    ),
+    (
+        "kss_fleet_rehomed_sessions_total",
+        "counter",
+        "Sessions re-homed to ring successors after worker death or rolls.",
+    ),
+    (
+        "kss_fleet_router_shed_total",
+        "counter",
+        "Requests shed at the router because no worker could serve them.",
+    ),
+)
+
+
+def _free_port(host: str) -> int:
+    import socket
+
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+def _request(
+    host: str,
+    port: int,
+    method: str,
+    path: str,
+    body: "bytes | None" = None,
+    headers: "dict | None" = None,
+    timeout: float = 10.0,
+) -> "tuple[int, dict, bytes]":
+    """One buffered HTTP exchange with a worker; raises OSError family
+    on connection trouble (the caller's shed/death signal)."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request(method, path, body=body, headers=headers or {})
+        resp = conn.getresponse()
+        data = resp.read()
+        return resp.status, dict(resp.getheaders()), data
+    finally:
+        conn.close()
+
+
+class Worker:
+    """One fleet member: identity, base URL, checkpoint namespace, and
+    (for spawned members) the child-process handle. All mutable fields
+    are written by the router under ITS lock — this class is a record,
+    not an actor."""
+
+    def __init__(
+        self,
+        wid: str,
+        url: str,
+        session_dir: str,
+        command: "list[str] | None" = None,
+        env: "dict | None" = None,
+        log_path: "str | None" = None,
+    ):
+        self.id = wid
+        self.url = url.rstrip("/")
+        parsed = urlparse(self.url)
+        self.host = parsed.hostname or "127.0.0.1"
+        self.port = int(parsed.port or 80)
+        self.session_dir = session_dir
+        self.command = list(command) if command else None
+        self.env = dict(env) if env else None
+        self.log_path = log_path
+        self.proc: "subprocess.Popen | None" = None
+        # "booting" | "ready" | "degraded" | "rolling" | "dead"
+        self.state = "booting"
+        self.failures = 0
+        self.health: dict = {}
+
+    @property
+    def spawned(self) -> bool:
+        return self.command is not None
+
+    def info(self) -> dict:
+        doc = {
+            "id": self.id,
+            "url": self.url,
+            "state": self.state,
+            "spawned": self.spawned,
+            "sessionDir": self.session_dir,
+            "health": self.health,
+        }
+        if self.proc is not None:
+            doc["pid"] = self.proc.pid
+        return doc
+
+
+@locking.guard_inferred
+class FleetRouter:
+    """The router process body: worker set + ring + affinity table +
+    probe/roll machinery + the front HTTP server."""
+
+    def __init__(
+        self,
+        n_workers: "int | None" = None,
+        *,
+        adopt: "list[tuple[str, str]] | None" = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        fleet_dir: "str | None" = None,
+        bundle_dir: "str | None" = None,
+        base_port: "int | None" = None,
+        probe_interval_s: "float | None" = None,
+        replicas: int = DEFAULT_REPLICAS,
+        env: "dict | None" = None,
+    ):
+        env = os.environ if env is None else env
+        self.host = host
+        self.fleet_dir = (
+            fleet_dir
+            or env.get("KSS_FLEET_DIR")
+            or tempfile.mkdtemp(prefix="kss-fleet-")
+        )
+        os.makedirs(self.fleet_dir, exist_ok=True)
+        self._probe_interval = (
+            probe_interval_s
+            if probe_interval_s is not None
+            else float(
+                env.get("KSS_FLEET_PROBE_INTERVAL_S")
+                or DEFAULT_PROBE_INTERVAL_S
+            )
+        )
+        self._lock = locking.make_lock("fleet.router")
+        self._ring = HashRing(replicas=replicas)
+        # session id -> worker id: learned placements (creates,
+        # re-homes). Ring ownership is the stateless fallback for ids
+        # the table has never seen (a restarted router re-derives it).
+        self._table: dict[str, str] = {}
+        self._rehomed = 0
+        self._shed = 0
+        self._roll_state: dict = {
+            "rolling": False,
+            "phase": "idle",
+            "rolled": [],
+            "rehomedSessions": 0,
+        }
+        self._workers: dict[str, Worker] = {}
+        if adopt is not None:
+            for i, (url, session_dir) in enumerate(adopt):
+                wid = f"w{i}"
+                self._workers[wid] = Worker(wid, url, session_dir)
+        else:
+            if n_workers is None:
+                n_workers = int(env.get("KSS_FLEET_WORKERS") or 2)
+            if base_port is None:
+                base_port = int(env.get("KSS_FLEET_BASE_PORT") or 0)
+            self.bundle_dir = (
+                bundle_dir
+                or env.get("KSS_BUNDLE_DIR")
+                or os.path.join(self.fleet_dir, "bundles")
+            )
+            os.makedirs(self.bundle_dir, exist_ok=True)
+            log_dir = os.path.join(self.fleet_dir, "logs")
+            os.makedirs(log_dir, exist_ok=True)
+            for i in range(n_workers):
+                wid = f"w{i}"
+                wport = base_port + i if base_port else _free_port(host)
+                session_dir = os.path.join(self.fleet_dir, "sessions", wid)
+                os.makedirs(session_dir, exist_ok=True)
+                child_env = dict(env)
+                child_env["KSS_WORKER_ID"] = wid
+                child_env["KSS_SESSION_DIR"] = session_dir
+                child_env["KSS_BUNDLE_DIR"] = self.bundle_dir
+                child_env.setdefault("KSS_AOT_BUNDLES", "1")
+                child_env["PYTHONPATH"] = _PKG_ROOT + (
+                    os.pathsep + child_env["PYTHONPATH"]
+                    if child_env.get("PYTHONPATH")
+                    else ""
+                )
+                self._workers[wid] = Worker(
+                    wid,
+                    f"http://{host}:{wport}",
+                    session_dir,
+                    command=[
+                        sys.executable,
+                        "-m",
+                        "kube_scheduler_simulator_tpu.server",
+                        "--host",
+                        host,
+                        "--port",
+                        str(wport),
+                    ],
+                    env=child_env,
+                    log_path=os.path.join(log_dir, f"{wid}.log"),
+                )
+        self._stop = threading.Event()
+        self._probe_thread: "threading.Thread | None" = None
+        self._roll_thread: "threading.Thread | None" = None
+        self._started_monotonic = time.monotonic()
+        self.httpd = ThreadingHTTPServer(
+            (host, port), _make_router_handler(self)
+        )
+        self.httpd.daemon_threads = True
+        self._http_thread: "threading.Thread | None" = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def worker_ids(self) -> list[str]:
+        with self._lock:
+            return sorted(self._workers)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "FleetRouter":
+        """Spawn (or probe-adopt) every worker, wait for readiness,
+        seed the ring, and begin serving + probing."""
+        with self._lock:
+            workers = [self._workers[wid] for wid in sorted(self._workers)]
+        for w in workers:
+            if w.spawned:
+                self._spawn(w)
+        boot_deadline = time.monotonic() + WORKER_BOOT_TIMEOUT_S
+        for w in workers:
+            if not self._await_ready(
+                w, max(5.0, boot_deadline - time.monotonic())
+            ):
+                self.shutdown(drain=False)
+                raise RuntimeError(
+                    f"worker {w.id} ({w.url}) did not become ready: "
+                    f"{self._log_tail(w)}"
+                )
+            with self._lock:
+                w.state = "ready"
+                self._ring.add(w.id)
+        with self._lock:
+            owner = self._ring.owner("default")
+            if owner is not None:
+                self._table["default"] = owner
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, name="kss-fleet-probe", daemon=True
+        )
+        self._probe_thread.start()
+        self._http_thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self._http_thread.start()
+        return self
+
+    def shutdown(self, drain: bool = True) -> None:
+        """Stop probing/serving and stop the spawned workers — TERM
+        (each drains + snapshots, the zero-loss exit) when `drain`,
+        KILL otherwise."""
+        self._stop.set()
+        with self._lock:
+            workers = list(self._workers.values())
+        for w in workers:
+            if w.proc is not None and w.proc.poll() is None:
+                try:
+                    w.proc.terminate() if drain else w.proc.kill()
+                except OSError:
+                    pass
+        for w in workers:
+            if w.proc is not None:
+                self._wait_exit(w, DRAIN_EXIT_TIMEOUT_S if drain else 5.0)
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._probe_thread is not None:
+            self._probe_thread.join(timeout=2)
+
+    def _spawn(self, w: Worker) -> None:
+        log = open(w.log_path, "ab") if w.log_path else subprocess.DEVNULL
+        try:
+            proc = subprocess.Popen(
+                w.command,
+                env=w.env,
+                stdout=log,
+                stderr=subprocess.STDOUT,
+                cwd=_PKG_ROOT,
+            )
+        finally:
+            if hasattr(log, "close"):
+                log.close()
+        with self._lock:
+            w.proc = proc
+            w.failures = 0
+
+    def _wait_exit(self, w: Worker, timeout: float) -> bool:
+        try:
+            w.proc.wait(timeout=timeout)
+            return True
+        except subprocess.TimeoutExpired:
+            try:
+                w.proc.kill()
+                w.proc.wait(timeout=5.0)
+            except (OSError, subprocess.TimeoutExpired):
+                pass
+            return False
+
+    def _await_ready(self, w: Worker, timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if w.proc is not None and w.proc.poll() is not None:
+                return False  # exited before it ever served
+            try:
+                status, _, data = _request(
+                    w.host, w.port, "GET", "/api/v1/readyz", timeout=5.0
+                )
+            except OSError:
+                time.sleep(0.25)
+                continue
+            if status == 200:
+                try:
+                    doc = json.loads(data)
+                except ValueError:
+                    doc = {}
+                with self._lock:
+                    w.health = doc
+                return True
+            time.sleep(0.25)
+        return False
+
+    def _log_tail(self, w: Worker, n: int = 15) -> str:
+        if not w.log_path or not os.path.exists(w.log_path):
+            return "(no log)"
+        try:
+            with open(w.log_path, "rb") as f:
+                return b"\n".join(
+                    f.read().splitlines()[-n:]
+                ).decode(errors="replace")
+        except OSError:
+            return "(log unreadable)"
+
+    # -- health probing + death handling -------------------------------------
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self._probe_interval):
+            self.probe_once()
+
+    def probe_once(self) -> None:
+        """One probe round over every worker not already dead or being
+        rolled: readyz → ready/degraded; process exit or repeated
+        connection failure → death handling (re-home)."""
+        with self._lock:
+            targets = [
+                w
+                for w in self._workers.values()
+                if w.state not in ("dead", "rolling")
+            ]
+        for w in targets:
+            dead = False
+            if w.proc is not None and w.proc.poll() is not None:
+                dead = True
+            else:
+                try:
+                    status, _, data = _request(
+                        w.host, w.port, "GET", "/api/v1/readyz", timeout=5.0
+                    )
+                except OSError:
+                    with self._lock:
+                        w.failures += 1
+                        dead = w.failures >= DEAD_AFTER_FAILURES
+                else:
+                    try:
+                        doc = json.loads(data) if data else {}
+                    except ValueError:
+                        doc = {}
+                    with self._lock:
+                        if w.state not in ("dead", "rolling"):
+                            w.failures = 0
+                            w.health = doc
+                            w.state = "ready" if status == 200 else "degraded"
+            if dead:
+                self._handle_worker_death(w)
+
+    def _handle_worker_death(self, w: Worker) -> None:
+        """Declare `w` dead, pull it from the ring, and re-home its
+        checkpoint files to the ring successors. Zero-loss when the
+        worker drained on the way out (SIGTERM snapshots everything);
+        after a hard kill, whatever it last checkpointed (evictions,
+        drains, explicit evicts) survives — acknowledged-and-
+        snapshotted state, the strongest anyone can promise about a
+        SIGKILL."""
+        with self._lock:
+            if w.state == "dead":
+                return
+            w.state = "dead"
+            self._ring.remove(w.id)
+        self._rehome_from(w)
+
+    def _rehome_from(self, w: Worker) -> int:
+        """Move every checkpoint file in `w`'s session namespace to its
+        ring-successor's namespace and have the successor adopt it (the
+        cross-worker PR 8 path). Files with no live successor stay put
+        — the worker's own restart adopts them at boot. Returns the
+        number of sessions re-homed."""
+        d = w.session_dir
+        if not d or not os.path.isdir(d):
+            return 0
+        moves: dict[str, tuple[Worker, list[str]]] = {}
+        for fn in sorted(os.listdir(d)):
+            if not fn.endswith(".json"):
+                continue
+            sid = fn[: -len(".json")]
+            with self._lock:
+                owner = self._ring.owner(sid)
+                target = self._workers.get(owner) if owner else None
+            if target is None or target.id == w.id:
+                continue
+            try:
+                # the successor's namespace may not exist yet — session
+                # managers create their snapshot dir lazily
+                os.makedirs(target.session_dir, exist_ok=True)
+                shutil.move(
+                    os.path.join(d, fn),
+                    os.path.join(target.session_dir, fn),
+                )
+            except OSError:
+                continue
+            moves.setdefault(target.id, (target, []))[1].append(sid)
+        total = 0
+        for target, sids in moves.values():
+            try:
+                _request(
+                    target.host,
+                    target.port,
+                    "POST",
+                    "/api/v1/admin/adopt",
+                    timeout=60.0,
+                )
+            except OSError:
+                # unreachable successor: the files sit in its namespace
+                # and its next boot adopts them — routed-to-it requests
+                # shed until then
+                pass
+            with self._lock:
+                for sid in sids:
+                    self._table[sid] = target.id
+                    self._rehomed += 1
+            total += len(sids)
+        return total
+
+    # -- routing -------------------------------------------------------------
+
+    def worker_for(self, sid: str) -> "Worker | None":
+        """The worker owning `sid`: the affinity table's placement, or
+        the ring's stateless answer for ids never seen. None = nobody
+        can serve it right now (shed upstream)."""
+        with self._lock:
+            wid = self._table.get(sid)
+            w = self._workers.get(wid) if wid else None
+            if w is None or w.state == "dead":
+                # stale or missing placement: the ring's stateless
+                # answer (dead workers have left the ring)
+                wid = self._ring.owner(sid)
+                w = self._workers.get(wid) if wid else None
+            if w is None or w.state == "dead":
+                return None
+            return w
+
+    def place_session(self, body: dict) -> "tuple[Worker | None, str]":
+        """Placement for a session create: take the client's explicit
+        id (or mint one), answer (ring owner, id)."""
+        sid = body.get("id") or ("s-" + secrets.token_hex(4))
+        with self._lock:
+            wid = self._ring.owner(str(sid))
+            w = self._workers.get(wid) if wid else None
+        return w, str(sid)
+
+    def note_session(self, sid: str, wid: str) -> None:
+        with self._lock:
+            self._table[sid] = wid
+
+    def forget_session(self, sid: str) -> None:
+        with self._lock:
+            self._table.pop(sid, None)
+
+    def count_shed(self) -> None:
+        with self._lock:
+            self._shed += 1
+
+    def live_workers(self) -> list[Worker]:
+        with self._lock:
+            return [
+                self._workers[wid]
+                for wid in sorted(self._workers)
+                if self._workers[wid].state in ("ready", "degraded")
+            ]
+
+    # -- rolling restart -----------------------------------------------------
+
+    def begin_roll(self) -> bool:
+        """Start a rolling restart on a background thread; False when
+        one is already running (first caller wins)."""
+        with self._lock:
+            if self._roll_state.get("rolling"):
+                return False
+            self._roll_state = {
+                "rolling": True,
+                "phase": "starting",
+                "rolled": [],
+                "rehomedSessions": 0,
+            }
+            self._roll_thread = threading.Thread(
+                target=self._roll_run, name="kss-fleet-roll", daemon=True
+            )
+            self._roll_thread.start()
+            return True
+
+    def _set_roll(self, **fields) -> None:
+        with self._lock:
+            self._roll_state.update(fields)
+
+    def _roll_run(self) -> None:
+        try:
+            with self._lock:
+                order = [self._workers[wid] for wid in sorted(self._workers)]
+            for w in order:
+                with self._lock:
+                    was_dead = w.state == "dead"
+                if was_dead and not w.spawned:
+                    continue  # nothing to restart
+                self._set_roll(phase=f"rolling {w.id}")
+                if not was_dead:
+                    with self._lock:
+                        self._ring.remove(w.id)
+                        w.state = "rolling"
+                    if w.spawned:
+                        # SIGTERM: the worker's zero-loss drain —
+                        # in-flight passes finish, every session
+                        # snapshots to its namespace, exit 0
+                        try:
+                            w.proc.terminate()
+                        except OSError:
+                            pass
+                        self._wait_exit(w, DRAIN_EXIT_TIMEOUT_S)
+                    else:
+                        self._drain_http(w)
+                    moved = self._rehome_from(w)
+                    with self._lock:
+                        self._roll_state["rehomedSessions"] += moved
+                if w.spawned:
+                    self._spawn(w)
+                    ok = self._await_ready(w, WORKER_BOOT_TIMEOUT_S)
+                    with self._lock:
+                        if ok:
+                            w.state = "ready"
+                            self._ring.add(w.id)
+                        else:
+                            w.state = "dead"
+                else:
+                    # adopted members can't be restarted from here;
+                    # drained + re-homed, they leave the ring until
+                    # their owner brings them back
+                    with self._lock:
+                        w.state = "dead"
+                with self._lock:
+                    self._roll_state["rolled"].append(w.id)
+        finally:
+            self._set_roll(rolling=False, phase="done")
+
+    def _drain_http(self, w: Worker) -> None:
+        try:
+            _request(
+                w.host, w.port, "POST", "/api/v1/admin/drain", timeout=10.0
+            )
+        except OSError:
+            return
+        deadline = time.monotonic() + DRAIN_EXIT_TIMEOUT_S
+        while time.monotonic() < deadline:
+            try:
+                _, _, data = _request(
+                    w.host, w.port, "GET", "/api/v1/admin/drain", timeout=10.0
+                )
+                if json.loads(data).get("done"):
+                    return
+            except (OSError, ValueError):
+                return
+            time.sleep(0.2)
+
+    # -- status + federation -------------------------------------------------
+
+    def health_doc(self) -> dict:
+        with self._lock:
+            return {
+                "ok": True,
+                "router": True,
+                "uptimeSeconds": round(
+                    time.monotonic() - self._started_monotonic, 3
+                ),
+                "workers": {
+                    wid: w.state for wid, w in sorted(self._workers.items())
+                },
+            }
+
+    def ready_doc(self) -> dict:
+        with self._lock:
+            ready = sorted(
+                wid
+                for wid, w in self._workers.items()
+                if w.state == "ready"
+            )
+            total = len(self._workers)
+        return {
+            "ready": bool(ready),
+            "state": "ready" if ready else "no-ready-workers",
+            "readyWorkers": ready,
+            "workersTotal": total,
+        }
+
+    def fleet_doc(self) -> dict:
+        with self._lock:
+            return {
+                "workers": [
+                    self._workers[wid].info()
+                    for wid in sorted(self._workers)
+                ],
+                "ring": {
+                    "replicas": self._ring.replicas,
+                    "workers": self._ring.workers(),
+                },
+                "sessions": dict(self._table),
+                "rehomedSessions": self._rehomed,
+                "shedRequests": self._shed,
+                "roll": dict(self._roll_state),
+            }
+
+    def merged_sessions(self) -> dict:
+        sessions: list[dict] = []
+        workers: dict[str, dict] = {}
+        for w in self.live_workers():
+            try:
+                _, _, data = _request(
+                    w.host, w.port, "GET", "/api/v1/sessions", timeout=30.0
+                )
+                doc = json.loads(data)
+            except (OSError, ValueError):
+                workers[w.id] = {"error": "unreachable"}
+                continue
+            for s in doc.get("sessions") or []:
+                s = dict(s)
+                s["worker"] = w.id
+                sessions.append(s)
+            workers[w.id] = {
+                "broker": doc.get("broker"),
+                "limits": doc.get("limits"),
+            }
+        return {"sessions": sessions, "workers": workers}
+
+    def federated_metrics_json(self) -> dict:
+        workers_doc: dict[str, dict] = {}
+        agg = {"passes": 0, "totalScheduled": 0}
+        for w in self.live_workers():
+            try:
+                _, _, data = _request(
+                    w.host, w.port, "GET", "/api/v1/metrics", timeout=30.0
+                )
+                doc = json.loads(data)
+            except (OSError, ValueError):
+                workers_doc[w.id] = {"error": "unreachable"}
+                continue
+            workers_doc[w.id] = doc
+            # The worker's /metrics doc is scoped to its default session;
+            # fleet traffic lives in named sessions, so the honest
+            # aggregate sums every session's counters (default included)
+            # from the worker's session listing.
+            try:
+                _, _, sdata = _request(
+                    w.host, w.port, "GET", "/api/v1/sessions", timeout=30.0
+                )
+                session_docs = json.loads(sdata).get("sessions") or []
+            except (OSError, ValueError):
+                session_docs = [doc]
+            for sdoc in session_docs:
+                for key in agg:
+                    v = sdoc.get(key)
+                    if isinstance(v, (int, float)):
+                        agg[key] += v
+        with self._lock:
+            total = len(self._workers)
+            ready = sum(
+                1 for w in self._workers.values() if w.state == "ready"
+            )
+            rehomed = self._rehomed
+            shed = self._shed
+        return {
+            "fleet": True,
+            "workersTotal": total,
+            "workersReady": ready,
+            "rehomedSessions": rehomed,
+            "shedRequests": shed,
+            "aggregate": agg,
+            "workers": workers_doc,
+        }
+
+    def federated_metrics_text(self, openmetrics: bool) -> str:
+        """The fleet-wide scrape: every live worker's exposition merged
+        into one document (family headers deduplicated — sample
+        contiguity per family is not required by the 0.0.4 format, and
+        each worker's series are disjoint by their `worker` label),
+        plus the router's own kss_fleet_* families."""
+        texts: list[str] = []
+        for w in self.live_workers():
+            try:
+                status, _, data = _request(
+                    w.host,
+                    w.port,
+                    "GET",
+                    "/api/v1/metrics?format=prometheus",
+                    timeout=30.0,
+                )
+            except OSError:
+                continue
+            if status != 200:
+                continue
+            text = data.decode("utf-8", errors="replace")
+            if 'worker="' not in text:
+                # adopted workers without KSS_WORKER_ID don't self-
+                # label; the router labels them on re-export
+                text = metrics_mod.label_exposition(text, {"worker": w.id})
+            texts.append(text)
+        merged = _merge_expositions(texts)
+        merged += self._router_families()
+        if openmetrics:
+            merged += "# EOF\n"
+        return merged
+
+    def _router_families(self) -> str:
+        with self._lock:
+            total = len(self._workers)
+            ready = sum(
+                1 for w in self._workers.values() if w.state == "ready"
+            )
+            rehomed = self._rehomed
+            shed = self._shed
+        values = {
+            "kss_fleet_workers": total,
+            "kss_fleet_workers_ready": ready,
+            "kss_fleet_rehomed_sessions_total": rehomed,
+            "kss_fleet_router_shed_total": shed,
+        }
+        out = []
+        for name, mtype, help_text in _ROUTER_FAMILY_DEFS:
+            out.append(f"# HELP {name} {help_text}")
+            out.append(f"# TYPE {name} {mtype}")
+            out.append(f"{name} {values[name]}")
+        return "\n".join(out) + "\n"
+
+    def federated_alerts(self) -> dict:
+        enabled = False
+        active: list[dict] = []
+        sessions: dict[str, dict] = {}
+        history: list[dict] = []
+        counters: dict[str, float] = {}
+        for w in self.live_workers():
+            try:
+                _, _, data = _request(
+                    w.host, w.port, "GET", "/api/v1/alerts", timeout=30.0
+                )
+                doc = json.loads(data)
+            except (OSError, ValueError):
+                continue
+            enabled = enabled or bool(doc.get("enabled"))
+            for a in doc.get("active") or []:
+                a = dict(a)
+                a["worker"] = w.id
+                active.append(a)
+            for ev in doc.get("history") or []:
+                ev = dict(ev)
+                ev["worker"] = w.id
+                history.append(ev)
+            for sid, status in (doc.get("sessions") or {}).items():
+                sessions[sid] = status
+            for key, v in (doc.get("counters") or {}).items():
+                if isinstance(v, (int, float)):
+                    counters[key] = counters.get(key, 0) + v
+        return {
+            "fleet": True,
+            "enabled": enabled,
+            "active": active,
+            "sessions": sessions,
+            "history": history,
+            "counters": counters,
+        }
+
+    def federated_timeseries(self, query: str) -> dict:
+        qs = f"?{query}" if query else ""
+        enabled = False
+        samples: list[dict] = []
+        workers: dict[str, dict] = {}
+        for w in self.live_workers():
+            try:
+                _, _, data = _request(
+                    w.host,
+                    w.port,
+                    "GET",
+                    f"/api/v1/timeseries{qs}",
+                    timeout=30.0,
+                )
+                doc = json.loads(data)
+            except (OSError, ValueError):
+                workers[w.id] = {"error": "unreachable"}
+                continue
+            enabled = enabled or bool(doc.get("enabled"))
+            workers[w.id] = {
+                "enabled": doc.get("enabled"),
+                "emitted": doc.get("emitted"),
+                "dropped": doc.get("dropped"),
+            }
+            for s in doc.get("samples") or []:
+                s = dict(s)
+                s["worker"] = w.id
+                samples.append(s)
+        return {
+            "fleet": True,
+            "enabled": enabled,
+            "workers": workers,
+            "samples": samples,
+        }
+
+
+def _merge_expositions(texts: list[str]) -> str:
+    """Concatenate expositions with `# HELP`/`# TYPE` declared once per
+    family and any per-document `# EOF` terminators stripped (the
+    caller re-appends one when serving OpenMetrics)."""
+    seen_help: set[str] = set()
+    seen_type: set[str] = set()
+    out: list[str] = []
+    for text in texts:
+        for line in text.splitlines():
+            if line.startswith("# EOF"):
+                continue
+            if line.startswith("# HELP "):
+                name = line.split(" ", 3)[2] if len(line.split(" ")) > 2 else ""
+                if name in seen_help:
+                    continue
+                seen_help.add(name)
+            elif line.startswith("# TYPE "):
+                parts = line.split(" ")
+                name = parts[2] if len(parts) > 2 else ""
+                if name in seen_type:
+                    continue
+                seen_type.add(name)
+            out.append(line)
+    return "\n".join(out) + ("\n" if out else "")
+
+
+def _make_router_handler(router: FleetRouter):
+    class RouterHandler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quiet, like the worker
+            pass
+
+        def _json(self, code: int, payload=None, headers: "dict | None" = None):
+            body = b"" if payload is None else json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for name, value in (headers or {}).items():
+                self.send_header(name, value)
+            self.end_headers()
+            if body:
+                self.wfile.write(body)
+
+        def _error(
+            self,
+            code: int,
+            msg: str,
+            kind: str = "",
+            headers: "dict | None" = None,
+        ):
+            self._json(
+                code,
+                {
+                    "error": msg,
+                    "kind": kind
+                    or ("client-error" if code < 500 else "server-error"),
+                    "detail": "",
+                    "message": msg,
+                },
+                headers=headers,
+            )
+
+        def _shed(self, why: str):
+            router.count_shed()
+            return self._error(
+                503,
+                why,
+                kind="WorkerUnavailable",
+                headers={"Retry-After": str(RETRY_AFTER_S)},
+            )
+
+        def _read_body(self) -> bytes:
+            length = int(self.headers.get("Content-Length") or 0)
+            return self.rfile.read(length) if length else b""
+
+        def do_GET(self):  # noqa: N802
+            self._route("GET")
+
+        def do_POST(self):  # noqa: N802
+            self._route("POST")
+
+        def do_PUT(self):  # noqa: N802
+            self._route("PUT")
+
+        def do_DELETE(self):  # noqa: N802
+            self._route("DELETE")
+
+        def _route(self, method: str):
+            url = urlparse(self.path)
+            parts = [p for p in url.path.split("/") if p]
+            try:
+                if parts[:2] == ["api", "v1"]:
+                    rest = parts[2:]
+                    if rest == ["fleet"] and method == "GET":
+                        return self._json(200, router.fleet_doc())
+                    if rest == ["fleet", "roll"]:
+                        if method == "POST":
+                            started = router.begin_roll()
+                            doc = dict(router.fleet_doc()["roll"])
+                            doc["started"] = started
+                            return self._json(202, doc)
+                        return self._error(405, "method not allowed")
+                    if rest == ["healthz"] and method == "GET":
+                        return self._json(200, router.health_doc())
+                    if rest == ["readyz"] and method == "GET":
+                        doc = router.ready_doc()
+                        if doc["ready"]:
+                            return self._json(200, doc)
+                        return self._json(
+                            503,
+                            doc,
+                            headers={"Retry-After": str(RETRY_AFTER_S)},
+                        )
+                    if rest == ["metrics"] and method == "GET":
+                        return self._metrics(parse_qs(url.query))
+                    if rest == ["alerts"] and method == "GET":
+                        return self._json(200, router.federated_alerts())
+                    if rest == ["timeseries"] and method == "GET":
+                        return self._json(
+                            200, router.federated_timeseries(url.query)
+                        )
+                    if rest == ["sessions"] and method == "GET":
+                        return self._json(200, router.merged_sessions())
+                    if rest == ["sessions"] and method == "POST":
+                        return self._create_session()
+                    if rest and rest[0] == "sessions" and len(rest) >= 2:
+                        sid = rest[1]
+                        w = router.worker_for(sid)
+                        if w is None:
+                            return self._shed(
+                                f"no worker can serve session {sid!r}; "
+                                f"retry shortly"
+                            )
+                        status = self._proxy(w, method, url)
+                        if (
+                            method == "DELETE"
+                            and len(rest) == 2
+                            and status == 200
+                        ):
+                            router.forget_session(sid)
+                        return None
+                # everything else — the legacy/default surface and the
+                # dashboard — rides with the owner of "default"
+                w = router.worker_for("default")
+                if w is None:
+                    return self._shed(
+                        "no worker can serve the default session; "
+                        "retry shortly"
+                    )
+                self._proxy(w, method, url)
+                return None
+            except BrokenPipeError:
+                raise
+            except Exception as e:  # noqa: BLE001 — boundary
+                return self._error(
+                    500, f"{type(e).__name__}: {e}", kind=type(e).__name__
+                )
+
+        def _metrics(self, q: dict):
+            fmt = q.get("format", ["json"])[0]
+            if fmt == "json":
+                return self._json(200, router.federated_metrics_json())
+            if fmt not in ("prometheus", "openmetrics"):
+                return self._error(400, f"unknown metrics format {fmt!r}")
+            openmetrics = fmt == "openmetrics"
+            body = router.federated_metrics_text(openmetrics).encode()
+            self.send_response(200)
+            self.send_header(
+                "Content-Type",
+                "application/openmetrics-text; version=1.0.0; charset=utf-8"
+                if openmetrics
+                else "text/plain; version=0.0.4; charset=utf-8",
+            )
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return None
+
+        def _create_session(self):
+            raw = self._read_body()
+            body = {}
+            if raw:
+                try:
+                    body = json.loads(raw)
+                except ValueError:
+                    return self._error(
+                        400, "session spec must be a JSON mapping"
+                    )
+            if not isinstance(body, dict):
+                return self._error(400, "session spec must be a mapping")
+            w, sid = router.place_session(body)
+            if w is None or w.state == "dead":
+                return self._shed(
+                    "no worker available for session create; retry shortly"
+                )
+            body["id"] = sid
+            data = json.dumps(body).encode()
+            try:
+                status, headers, resp_body = _request(
+                    w.host,
+                    w.port,
+                    "POST",
+                    "/api/v1/sessions",
+                    body=data,
+                    headers={"Content-Type": "application/json"},
+                    timeout=PROXY_TIMEOUT_S,
+                )
+            except OSError:
+                return self._shed(
+                    f"worker {w.id} unreachable for session create; "
+                    f"retry shortly"
+                )
+            if status == 201:
+                router.note_session(sid, w.id)
+            fwd = {}
+            if headers.get("Retry-After"):
+                fwd["Retry-After"] = headers["Retry-After"]
+            self.send_response(status)
+            self.send_header(
+                "Content-Type",
+                headers.get("Content-Type", "application/json"),
+            )
+            self.send_header("Content-Length", str(len(resp_body)))
+            for name, value in fwd.items():
+                self.send_header(name, value)
+            self.end_headers()
+            if resp_body:
+                self.wfile.write(resp_body)
+            return None
+
+        def _proxy(self, w: Worker, method: str, url) -> "int | None":
+            """Pass the request through to `w` verbatim — buffered for
+            normal routes, streamed for the SSE/watch surfaces — and
+            relay status + Content-Type + Retry-After back. Returns the
+            upstream status (None when shed)."""
+            path_qs = url.path + (f"?{url.query}" if url.query else "")
+            body = self._read_body() or None
+            stream = url.path.rstrip("/").endswith(
+                ("/events", "/listwatchresources")
+            )
+            headers = {}
+            ct = self.headers.get("Content-Type")
+            if ct:
+                headers["Content-Type"] = ct
+            conn = http.client.HTTPConnection(
+                w.host,
+                w.port,
+                timeout=None if stream else PROXY_TIMEOUT_S,
+            )
+            try:
+                try:
+                    conn.request(method, path_qs, body=body, headers=headers)
+                    resp = conn.getresponse()
+                except OSError:
+                    self._shed(f"worker {w.id} unreachable; retry shortly")
+                    return None
+                if stream and resp.status == 200:
+                    self._stream_through(resp)
+                    return 200
+                data = resp.read()
+                self.send_response(resp.status)
+                for name in ("Content-Type", "Retry-After"):
+                    v = resp.getheader(name)
+                    if v:
+                        self.send_header(name, v)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                if data:
+                    self.wfile.write(data)
+                return resp.status
+            finally:
+                conn.close()
+
+        def _stream_through(self, resp) -> None:
+            self.send_response(200)
+            ct = resp.getheader("Content-Type")
+            if ct:
+                self.send_header("Content-Type", ct)
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            try:
+                while True:
+                    chunk = resp.read1(65536)
+                    if not chunk:
+                        break
+                    self.wfile.write(b"%x\r\n%s\r\n" % (len(chunk), chunk))
+                    self.wfile.flush()
+                self.wfile.write(b"0\r\n\r\n")
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                pass
+
+    return RouterHandler

@@ -198,6 +198,25 @@ class SimulatorServer:
         self._drain_thread: "threading.Thread | None" = None
         self._drain_result: "dict | None" = None
         self.drain_done = threading.Event()
+        # birth stamp for healthz/readyz uptimeSeconds (docs/fleet.md):
+        # the fleet router's probe reads structured health bodies, so
+        # liveness, identity, and load ride the endpoints it already
+        # polls instead of a second status surface
+        self._started_monotonic = time.monotonic()
+
+    def health_doc(self) -> dict:
+        """The shared healthz/readyz body fields: worker identity
+        (KSS_WORKER_ID, None outside a fleet), uptime, drain state, and
+        the resident-session count — everything the fleet router's
+        prober needs from the one endpoint it already polls."""
+        return {
+            "workerId": metrics_mod.worker_id(),
+            "uptimeSeconds": round(
+                time.monotonic() - self._started_monotonic, 3
+            ),
+            "draining": self.draining,
+            "activeSessions": len(self.sessions.live_services()),
+        }
 
     @property
     def port(self) -> int:
@@ -439,7 +458,13 @@ def _make_handler(server: SimulatorServer):
                     return self._error(404, "not found")
                 rest = parts[2:]
                 if rest == ["healthz"] and method == "GET":
-                    return self._json(200, {"ok": True})
+                    # structured liveness (docs/fleet.md): the status
+                    # code contract is unchanged (always 200); the body
+                    # carries identity + uptime + drain state so the
+                    # fleet prober needs no second endpoint
+                    doc = {"ok": True}
+                    doc.update(server.health_doc())
+                    return self._json(200, doc)
                 if rest == ["readyz"] and method == "GET":
                     return self._readyz()
                 if rest == ["admin", "drain"]:
@@ -450,6 +475,21 @@ def _make_handler(server: SimulatorServer):
                         return self._json(202, doc)
                     if method == "GET":
                         return self._json(200, server.drain_status())
+                    return self._error(405, "method not allowed")
+                if rest == ["admin", "adopt"] and not server.draining:
+                    # re-scan KSS_SESSION_DIR for checkpoint documents
+                    # and register any new ones — the fleet router's
+                    # re-home path moves a dead worker's snapshots into
+                    # a successor's directory and POSTs here so they go
+                    # live without a restart (docs/fleet.md). Idempotent:
+                    # ids already present are skipped. A DRAINING server
+                    # falls through to the shed below — it must not
+                    # admit tenants its own drain will never snapshot.
+                    if method == "POST":
+                        return self._json(
+                            200,
+                            {"adopted": server.sessions.adopt_snapshots()},
+                        )
                     return self._error(405, "method not allowed")
                 if server.draining and not (
                     method == "GET" and rest == ["metrics"]
@@ -534,6 +574,7 @@ def _make_handler(server: SimulatorServer):
                     "reasons": ["server is draining"],
                     "drain": server.drain_status(),
                 }
+                doc.update(server.health_doc())
                 return self._json(
                     503, doc, headers={"Retry-After": str(DEGRADED_RETRY_AFTER_S)}
                 )
@@ -555,6 +596,7 @@ def _make_handler(server: SimulatorServer):
                 "reasons": reasons,
                 "broker": health,
             }
+            doc.update(server.health_doc())
             if reasons:
                 return self._json(
                     503, doc, headers={"Retry-After": str(DEGRADED_RETRY_AFTER_S)}
@@ -583,6 +625,9 @@ def _make_handler(server: SimulatorServer):
                             snapshot=body.get("snapshot"),
                             fault_inject=body.get("faultInject"),
                             slo=body.get("slo"),
+                            # explicit id: the fleet router pins the id
+                            # it hashed onto this worker (docs/fleet.md)
+                            session_id=body.get("id"),
                         )
                     except ValueError as e:
                         # a malformed faultInject spec is the client's
@@ -1193,6 +1238,12 @@ def _make_handler(server: SimulatorServer):
                 # load/save/bypass counts + the deserialize wall — the
                 # per-session attribution rides the phases block
                 doc["bundles"] = bundles_mod.STORE.stats()
+                # fleet identity (docs/fleet.md): which worker served
+                # this scrape — present only inside a fleet, so the
+                # single-process document shape is unchanged
+                wid = metrics_mod.worker_id()
+                if wid is not None:
+                    doc["workerId"] = wid
             if fmt in ("prometheus", "openmetrics"):
                 openmetrics = fmt == "openmetrics"
 
@@ -1281,6 +1332,15 @@ def _make_handler(server: SimulatorServer):
                 # states are current — plus the process-wide alert-ring
                 # counters (always present, so dashboards can pin them)
                 text += slo_mod.render_prometheus_planes(slo_planes)
+                # the fleet's worker label (KSS_WORKER_ID): injected
+                # into every sample AFTER the whole document — sessions,
+                # ledger, observatory, and SLO families alike — is
+                # assembled, so one rewrite covers every renderer
+                wid = metrics_mod.worker_id()
+                if wid is not None:
+                    text = metrics_mod.label_exposition(
+                        text, {"worker": wid}
+                    )
                 if openmetrics:
                     # the OpenMetrics terminator — LAST, after every
                     # appended observatory family

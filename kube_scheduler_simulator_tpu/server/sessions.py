@@ -438,13 +438,18 @@ class SessionManager:
         snapshot: "dict | None" = None,
         fault_inject: "str | None" = None,
         slo: "dict | None" = None,
+        session_id: "str | None" = None,
     ) -> "tuple[Session, list[str]]":
         """A fresh session (admission-controlled). `fault_inject` is the
         KSS_FAULT_INJECT grammar scoped to THIS session only — the
         chaos-testing bulkhead; a malformed spec raises ValueError (400).
         `slo` is the PUT /slo body shape (utils/slo.py
         `objectives_from_spec`) applied at birth — a tenant arrives with
-        its objectives declared, not defaulted-then-patched. Returns
+        its objectives declared, not defaulted-then-patched.
+        `session_id` pins an explicit id instead of a generated one —
+        the fleet router pre-computes the id so it can place the session
+        on its consistent-hash ring owner (docs/fleet.md); a malformed
+        or already-taken id raises ValueError (400). Returns
         (session, import errors) — `snapshot` is applied like
         POST /api/v1/import."""
         plane = (
@@ -463,7 +468,11 @@ class SessionManager:
         self.admit_import(None, snapshot)
         with self._lock:
             self._admit_session_locked()
-            sid = self._new_sid_locked()
+            sid = (
+                self._claim_sid_locked(session_id)
+                if session_id is not None
+                else self._new_sid_locked()
+            )
             service = SimulatorService(
                 broker=self.broker, session_id=sid, fault_plane=plane
             )
@@ -563,6 +572,28 @@ class SessionManager:
             sid = "s-" + secrets.token_hex(4)
             if sid not in self._sessions:
                 return sid
+
+    # explicit-id grammar: the id lands in URLs, snapshot filenames, and
+    # Prometheus label values, so it is held to the same conservative
+    # charset as generated ids
+    _SID_CHARS = frozenset(
+        "abcdefghijklmnopqrstuvwxyz" "ABCDEFGHIJKLMNOPQRSTUVWXYZ" "0123456789.-_"
+    )
+
+    def _claim_sid_locked(self, session_id: str) -> str:
+        sid = str(session_id).strip()
+        if not sid or len(sid) > 64 or not set(sid) <= self._SID_CHARS:
+            raise ValueError(
+                f"session id {session_id!r} must be 1-64 chars of "
+                f"[A-Za-z0-9._-]"
+            )
+        if sid == DEFAULT_SESSION_ID:
+            raise ValueError(
+                f"session id {DEFAULT_SESSION_ID!r} is reserved"
+            )
+        if sid in self._sessions:
+            raise ValueError(f"session id {sid!r} already exists")
+        return sid
 
     # -- admission (per-request) ----------------------------------------------
 

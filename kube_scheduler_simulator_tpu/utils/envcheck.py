@@ -88,6 +88,20 @@ def _path_validator(raw: str) -> "str | None":
     return None  # any string is a path; existence is created on demand
 
 
+def _label_value_validator(raw: str) -> "str | None":
+    # the value lands verbatim inside Prometheus label bodies and file
+    # paths (the fleet's per-worker session namespace): keep it to a
+    # conservative identifier charset
+    import re
+
+    if not re.fullmatch(r"[A-Za-z0-9._-]{1,64}", raw.strip()):
+        return (
+            "expected a label-safe identifier "
+            "([A-Za-z0-9._-], at most 64 chars), got " + repr(raw)
+        )
+    return None
+
+
 def _slo_objectives_validator(raw: str) -> "str | None":
     from . import slo
 
@@ -183,6 +197,18 @@ KNOWN: "dict[str, Validator]" = {
     "KSS_BATCH_WINDOW_MS": _float_validator(0.0),
     "KSS_BATCH_MAX_WAIT_MS": _float_validator(0.0),
     "KSS_BATCH_MAX_SESSIONS": _int_validator(1),
+    # the horizontal serving fleet (kube_scheduler_simulator_tpu/fleet,
+    # docs/fleet.md): WORKER_ID stamps every exposition sample with a
+    # `worker` label (and the metrics JSON with `workerId`); the router
+    # reads WORKERS (how many workers to spawn), DIR (the fleet state
+    # root: per-worker session namespaces, logs), BASE_PORT (first
+    # worker port; 0 = ephemeral), and PROBE_INTERVAL_S (the readyz
+    # health-probe cadence)
+    "KSS_WORKER_ID": _label_value_validator,
+    "KSS_FLEET_WORKERS": _int_validator(1),
+    "KSS_FLEET_DIR": _path_validator,
+    "KSS_FLEET_BASE_PORT": _int_validator(0),
+    "KSS_FLEET_PROBE_INTERVAL_S": _float_validator(0.05),
     # session plane (docs/sessions.md)
     "KSS_MAX_SESSIONS": _int_validator(1),
     "KSS_MAX_PENDING_PODS_PER_SESSION": _int_validator(0),

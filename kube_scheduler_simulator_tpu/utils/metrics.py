@@ -1004,6 +1004,59 @@ def render_prometheus_sessions(
     )
 
 
+_WORKER_ID_VAR = "KSS_WORKER_ID"
+
+# lazily-compiled sample-line splitter for `label_exposition` (re stays
+# off the import path, like _PROM_SAMPLE_RE below)
+_LABEL_INJECT_RE = None
+
+
+def worker_id(env: "dict | None" = None) -> "str | None":
+    """The process's fleet worker identity (``KSS_WORKER_ID``), or None
+    outside a fleet. The router launches each worker with a distinct id
+    so every exposition self-labels (docs/fleet.md); the value must be
+    Prometheus-label-safe (envcheck validates the charset at boot)."""
+    env = os.environ if env is None else env
+    wid = (env.get(_WORKER_ID_VAR) or "").strip()
+    return wid or None
+
+
+def label_exposition(text: str, labels: "dict[str, str]") -> str:
+    """Inject `labels` into EVERY sample line of a text exposition
+    (0.0.4 or OpenMetrics) — the fleet's `worker` label, applied after
+    the whole document (sessions + ledger + observatory + SLO families)
+    is assembled, so no renderer needs to thread the label through.
+    Comment lines (`# HELP`/`# TYPE`/`# EOF`) and OpenMetrics exemplar
+    suffixes (everything after the sample's value separator) pass
+    through untouched."""
+    if not labels or not text:
+        return text
+    global _LABEL_INJECT_RE
+    if _LABEL_INJECT_RE is None:
+        import re
+
+        _LABEL_INJECT_RE = re.compile(
+            r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?( .*)$"
+        )
+    extra = ",".join(f'{k}="{v}"' for k, v in labels.items())
+    out: list[str] = []
+    for line in text.split("\n"):
+        if not line or line.startswith("#"):
+            out.append(line)
+            continue
+        # split the metric name + optional {label body} off the front;
+        # the rest of the line (value, timestamp, exemplar) is opaque
+        m = _LABEL_INJECT_RE.match(line)
+        if m is None:
+            out.append(line)
+            continue
+        name, body, rest = m.group(1), m.group(2), m.group(3)
+        inner = body[1:-1] if body else ""
+        merged = f"{inner},{extra}" if inner else extra
+        out.append(f"{name}{{{merged}}}{rest}")
+    return "\n".join(out)
+
+
 def _fmt_exemplar(ex: dict) -> str:
     """One OpenMetrics exemplar suffix: ``# {labels} value [timestamp]``
     appended to a histogram bucket sample line."""

@@ -97,6 +97,14 @@ for _var in (
     "KSS_SESSION_IDLE_EVICT_S",
     "KSS_SESSION_DIR",
     "KSS_SSE_MAX_SUBSCRIBERS",
+    # the serving fleet (fleet/router.py): an ambient KSS_WORKER_ID
+    # would stamp a worker label on every exposition the suite parses;
+    # fleet tests set identities with monkeypatch + tmp_path
+    "KSS_WORKER_ID",
+    "KSS_FLEET_WORKERS",
+    "KSS_FLEET_DIR",
+    "KSS_FLEET_BASE_PORT",
+    "KSS_FLEET_PROBE_INTERVAL_S",
 ):
     os.environ.pop(_var, None)
 

@@ -1,0 +1,419 @@
+"""The horizontal serving fleet (fleet/router.py, docs/fleet.md):
+session-affine routing over adopted in-process workers, placement
+parity with the single-process server, structured health bodies, the
+`worker` exposition label, federated scrapes, 503 passthrough, re-home
+on worker death, and the rolling restart — all against in-process
+`SimulatorServer` workers (no subprocess boots; tools/fleet_smoke.py
+exercises the spawned-worker path)."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kube_scheduler_simulator_tpu.fleet import FleetRouter
+from kube_scheduler_simulator_tpu.server import SimulatorServer, SimulatorService
+from kube_scheduler_simulator_tpu.utils.metrics import parse_prometheus_text
+
+from helpers import node, pod
+
+
+def _req(port, method, path, body=None, timeout=300):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            raw = resp.read()
+            return resp.status, json.loads(raw) if raw else None, dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        return e.code, json.loads(raw) if raw else None, dict(e.headers)
+
+
+def _raw(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=300
+    ) as resp:
+        return resp.read()
+
+
+@pytest.fixture()
+def fleet(tmp_path):
+    """Two in-process workers adopted by a router. Probe interval is
+    effectively off (60s): death-detection tests drive probe_once()
+    deterministically by hand."""
+    servers, dirs = [], []
+    for i in range(2):
+        d = str(tmp_path / f"w{i}")
+        srv = SimulatorServer(
+            SimulatorService(),
+            port=0,
+            session_config={"snapshot_dir": d},
+        ).start()
+        servers.append(srv)
+        dirs.append(d)
+    router = FleetRouter(
+        adopt=[
+            (f"http://127.0.0.1:{srv.port}", d)
+            for srv, d in zip(servers, dirs)
+        ],
+        port=0,
+        probe_interval_s=60.0,
+        fleet_dir=str(tmp_path / "fleet"),
+    ).start()
+    yield router, servers
+    router.shutdown(drain=False)
+    for srv in servers:
+        try:
+            srv.shutdown()
+        except Exception:
+            pass
+
+
+def _owner_server(router, servers, sid):
+    w = router.worker_for(sid)
+    idx = int(w.id[1:])  # adopted ids are w0..wN in adoption order
+    return w, servers[idx]
+
+
+class TestFleetRouting:
+    def test_fleet_doc_shows_ready_ring(self, fleet):
+        router, _ = fleet
+        code, doc, _ = _req(router.port, "GET", "/api/v1/fleet")
+        assert code == 200
+        assert [w["id"] for w in doc["workers"]] == ["w0", "w1"]
+        assert all(w["state"] == "ready" for w in doc["workers"])
+        assert doc["ring"]["workers"] == ["w0", "w1"]
+        assert doc["roll"]["rolling"] is False
+        # "default" is pre-placed on its ring owner
+        assert doc["sessions"]["default"] in ("w0", "w1")
+
+    def test_create_pins_ring_owner_and_requests_stick(self, fleet):
+        router, servers = fleet
+        code, doc, _ = _req(
+            router.port, "POST", "/api/v1/sessions", {"id": "aff-1"}
+        )
+        assert code == 201 and doc["id"] == "aff-1"
+        _, fdoc, _ = _req(router.port, "GET", "/api/v1/fleet")
+        owner_wid = fdoc["sessions"]["aff-1"]
+        w, owner_srv = _owner_server(router, servers, "aff-1")
+        assert w.id == owner_wid
+        # the session exists ONLY on the owner worker
+        for i, srv in enumerate(servers):
+            _, sdoc, _ = _req(srv.port, "GET", "/api/v1/sessions")
+            ids = {s["id"] for s in sdoc["sessions"]}
+            assert ("aff-1" in ids) == (f"w{i}" == owner_wid)
+        # scoped requests through the router land there and work
+        base = "/api/v1/sessions/aff-1"
+        _req(router.port, "PUT", f"{base}/resources/nodes", node("n0"))
+        _req(router.port, "PUT", f"{base}/resources/pods", pod("p0"))
+        code, out, _ = _req(router.port, "POST", f"{base}/schedule")
+        assert code == 200 and out["scheduled"] == 1
+        # DELETE through the router evicts the placement record
+        assert _req(router.port, "DELETE", base)[0] == 200
+        _, fdoc, _ = _req(router.port, "GET", "/api/v1/fleet")
+        assert "aff-1" not in fdoc["sessions"]
+
+    def test_minted_id_is_routable(self, fleet):
+        router, _ = fleet
+        code, doc, _ = _req(router.port, "POST", "/api/v1/sessions", {})
+        assert code == 201
+        sid = doc["id"]
+        code, info, _ = _req(router.port, "GET", f"/api/v1/sessions/{sid}")
+        assert code == 200 and info["id"] == sid
+
+    def test_bad_explicit_ids_are_rejected(self, fleet):
+        router, _ = fleet
+        for bad in ("bad id!", "default", "x" * 65):
+            code, _, _ = _req(
+                router.port, "POST", "/api/v1/sessions", {"id": bad}
+            )
+            assert code == 400, bad
+        assert (
+            _req(router.port, "POST", "/api/v1/sessions", {"id": "dup-1"})[0]
+            == 201
+        )
+        assert (
+            _req(router.port, "POST", "/api/v1/sessions", {"id": "dup-1"})[0]
+            == 400
+        )
+
+    def test_legacy_surface_rides_the_default_owner(self, fleet):
+        router, servers = fleet
+        _req(router.port, "PUT", "/api/v1/resources/nodes", node("ln0"))
+        _req(router.port, "PUT", "/api/v1/resources/pods", pod("lp0"))
+        code, out, _ = _req(router.port, "POST", "/api/v1/schedule")
+        assert code == 200 and out["scheduled"] == 1
+        # the write landed on the default session's owner, nowhere else
+        _, owner_srv = _owner_server(router, servers, "default")
+        code, items, _ = _req(
+            owner_srv.port, "GET", "/api/v1/resources/pods"
+        )
+        assert {p["metadata"]["name"] for p in items["items"]} == {"lp0"}
+
+
+class TestPlacementParity:
+    def test_fleet_worker_placements_byte_identical_to_single_process(
+        self, fleet, tmp_path
+    ):
+        """The same op sequence against a fleet-routed session and a
+        bare single-process server must bind the same pods to the same
+        nodes with byte-identical resource documents — fleet membership
+        must not perturb scheduling."""
+        router, _ = fleet
+        solo = SimulatorServer(
+            SimulatorService(),
+            port=0,
+            session_config={"snapshot_dir": str(tmp_path / "solo")},
+        ).start()
+        try:
+            def drive(port):
+                assert (
+                    _req(port, "POST", "/api/v1/sessions", {"id": "parity-1"})[0]
+                    == 201
+                )
+                base = "/api/v1/sessions/parity-1"
+                for i in range(3):
+                    _req(
+                        port,
+                        "PUT",
+                        f"{base}/resources/nodes",
+                        node(f"n{i}", cpu="2", mem="4Gi"),
+                    )
+                for i in range(6):
+                    _req(
+                        port,
+                        "PUT",
+                        f"{base}/resources/pods",
+                        pod(f"p{i}", cpu="500m", mem="512Mi"),
+                    )
+                code, out, _ = _req(port, "POST", f"{base}/schedule")
+                assert code == 200 and out["scheduled"] == 6
+                return _raw(port, f"{base}/resources/pods")
+
+            via_fleet = drive(router.port)
+            via_solo = drive(solo.port)
+        finally:
+            solo.shutdown()
+        assert via_fleet == via_solo
+
+
+class TestHealthBodies:
+    def test_worker_healthz_is_structured(self, fleet):
+        _, servers = fleet
+        code, doc, _ = _req(servers[0].port, "GET", "/api/v1/healthz")
+        assert code == 200 and doc["ok"] is True
+        assert doc["workerId"] is None  # no KSS_WORKER_ID in the suite
+        assert doc["uptimeSeconds"] >= 0
+        assert doc["draining"] is False
+        assert isinstance(doc["activeSessions"], int)
+
+    def test_worker_readyz_is_structured(self, fleet):
+        _, servers = fleet
+        code, doc, _ = _req(servers[0].port, "GET", "/api/v1/readyz")
+        assert code == 200
+        assert doc["draining"] is False
+        assert "uptimeSeconds" in doc and "activeSessions" in doc
+
+    def test_router_healthz_readyz(self, fleet):
+        router, _ = fleet
+        code, doc, _ = _req(router.port, "GET", "/api/v1/healthz")
+        assert code == 200 and doc["router"] is True
+        assert doc["workers"] == {"w0": "ready", "w1": "ready"}
+        code, doc, _ = _req(router.port, "GET", "/api/v1/readyz")
+        assert code == 200 and doc["ready"] is True
+        assert doc["readyWorkers"] == ["w0", "w1"]
+
+
+class TestWorkerLabel:
+    def test_worker_id_labels_every_sample_and_json(
+        self, fleet, monkeypatch
+    ):
+        _, servers = fleet
+        monkeypatch.setenv("KSS_WORKER_ID", "wx")
+        raw = _raw(
+            servers[0].port, "/api/v1/metrics?format=prometheus"
+        ).decode()
+        families = parse_prometheus_text(raw)
+        assert families
+        for fam in families.values():
+            for _name, labels, _value in fam["samples"]:
+                assert labels.get("worker") == "wx"
+        code, doc, _ = _req(servers[0].port, "GET", "/api/v1/metrics")
+        assert code == 200 and doc["workerId"] == "wx"
+
+    def test_without_worker_id_exposition_is_unlabeled(self, fleet):
+        _, servers = fleet
+        raw = _raw(
+            servers[0].port, "/api/v1/metrics?format=prometheus"
+        ).decode()
+        assert 'worker="' not in raw
+        code, doc, _ = _req(servers[0].port, "GET", "/api/v1/metrics")
+        assert code == 200 and "workerId" not in doc
+
+
+class TestFederation:
+    def test_federated_metrics_json(self, fleet):
+        router, _ = fleet
+        code, doc, _ = _req(router.port, "GET", "/api/v1/metrics")
+        assert code == 200 and doc["fleet"] is True
+        assert doc["workersTotal"] == 2 and doc["workersReady"] == 2
+        assert set(doc["workers"]) == {"w0", "w1"}
+        for wdoc in doc["workers"].values():
+            assert "passes" in wdoc
+
+    def test_aggregate_counts_named_session_passes(self, fleet):
+        # the worker-level /metrics doc only sees the default session;
+        # the fleet aggregate must count NAMED sessions' passes too
+        router, _ = fleet
+        _req(router.port, "POST", "/api/v1/sessions", {"id": "agg-1"})
+        base = "/api/v1/sessions/agg-1"
+        _req(router.port, "PUT", f"{base}/resources/nodes", node("n0"))
+        _req(router.port, "PUT", f"{base}/resources/pods", pod("p0"))
+        code, out, _ = _req(router.port, "POST", f"{base}/schedule")
+        assert code == 200 and out["scheduled"] == 1
+        _, doc, _ = _req(router.port, "GET", "/api/v1/metrics")
+        assert doc["aggregate"]["passes"] >= 1
+        assert doc["aggregate"]["totalScheduled"] >= 1
+
+    def test_federated_prometheus_merges_and_labels(self, fleet):
+        router, _ = fleet
+        raw = _raw(router.port, "/api/v1/metrics?format=prometheus").decode()
+        families = parse_prometheus_text(raw)  # strict: merge must hold
+        assert families["kss_fleet_workers"]["samples"][0][2] == 2.0
+        assert families["kss_fleet_workers_ready"]["samples"][0][2] == 2.0
+        seen = {
+            labels.get("worker")
+            for name, fam in families.items()
+            if not name.startswith("kss_fleet_")
+            for _n, labels, _v in fam["samples"]
+        }
+        # adopted workers self-label nothing; the router injected ids
+        assert seen == {"w0", "w1"}
+
+    def test_federated_alerts_and_timeseries(self, fleet):
+        router, _ = fleet
+        code, doc, _ = _req(router.port, "GET", "/api/v1/alerts")
+        assert code == 200 and doc["fleet"] is True
+        assert isinstance(doc["active"], list)
+        code, doc, _ = _req(router.port, "GET", "/api/v1/timeseries")
+        assert code == 200 and doc["fleet"] is True
+        assert set(doc["workers"]) == {"w0", "w1"}
+
+    def test_merged_sessions_tag_workers(self, fleet):
+        router, _ = fleet
+        assert (
+            _req(router.port, "POST", "/api/v1/sessions", {"id": "fed-1"})[0]
+            == 201
+        )
+        code, doc, _ = _req(router.port, "GET", "/api/v1/sessions")
+        assert code == 200
+        by_id = {s["id"]: s for s in doc["sessions"]}
+        assert by_id["fed-1"]["worker"] in ("w0", "w1")
+        # each worker contributes its own default session
+        defaults = [s for s in doc["sessions"] if s["id"] == "default"]
+        assert {s["worker"] for s in defaults} == {"w0", "w1"}
+
+
+class TestDegradation:
+    def test_worker_503_passes_through_with_retry_after(self, fleet):
+        router, servers = fleet
+        for srv in servers:
+            srv.sessions.max_sessions = 1  # default occupies the slot
+        code, doc, headers = _req(
+            router.port, "POST", "/api/v1/sessions", {"id": "full-1"}
+        )
+        assert code == 503
+        assert headers.get("Retry-After")
+        assert doc["kind"] != "WorkerUnavailable"  # the WORKER shed it
+
+    def test_unroutable_session_is_shed_with_retry_after(self, fleet):
+        router, servers = fleet
+        for srv in servers:
+            srv.shutdown()
+        for _ in range(3):
+            router.probe_once()
+        code, doc, headers = _req(
+            router.port, "GET", "/api/v1/sessions/nope-1"
+        )
+        assert code == 503
+        assert doc["kind"] == "WorkerUnavailable"
+        assert headers.get("Retry-After")
+        _, fdoc, _ = _req(router.port, "GET", "/api/v1/fleet")
+        assert fdoc["shedRequests"] >= 1
+        # the router itself also reports not-ready now
+        code, rdoc, _ = _req(router.port, "GET", "/api/v1/readyz")
+        assert code == 503 and rdoc["ready"] is False
+
+
+class TestRehomeOnDeath:
+    def test_dead_workers_sessions_move_to_ring_successor(self, fleet):
+        router, servers = fleet
+        assert (
+            _req(router.port, "POST", "/api/v1/sessions", {"id": "home-1"})[0]
+            == 201
+        )
+        base = "/api/v1/sessions/home-1"
+        _req(router.port, "PUT", f"{base}/resources/nodes", node("hn0"))
+        _req(router.port, "PUT", f"{base}/resources/pods", pod("hp0"))
+        # checkpoint the session (the drain path does this on SIGTERM;
+        # in-process workers have no signal handler, so evict by hand)
+        assert _req(router.port, "POST", f"{base}/evict")[0] == 200
+        owner, owner_srv = _owner_server(router, servers, "home-1")
+        owner_srv.shutdown()  # the worker dies without warning
+        for _ in range(3):
+            router.probe_once()  # 3 failed probes => dead + re-home
+        _, fdoc, _ = _req(router.port, "GET", "/api/v1/fleet")
+        states = {w["id"]: w["state"] for w in fdoc["workers"]}
+        assert states[owner.id] == "dead"
+        successor = fdoc["sessions"]["home-1"]
+        assert successor != owner.id
+        assert fdoc["rehomedSessions"] >= 1
+        # the session answers from the successor with its state intact
+        code, items, _ = _req(
+            router.port, "GET", f"{base}/resources/pods"
+        )
+        assert code == 200
+        assert {p["metadata"]["name"] for p in items["items"]} == {"hp0"}
+
+
+class TestRoll:
+    def test_roll_drains_rehomes_and_reports(self, fleet):
+        router, servers = fleet
+        assert (
+            _req(router.port, "POST", "/api/v1/sessions", {"id": "roll-1"})[0]
+            == 201
+        )
+        base = "/api/v1/sessions/roll-1"
+        _req(router.port, "PUT", f"{base}/resources/pods", pod("rp0"))
+        code, doc, _ = _req(router.port, "POST", "/api/v1/fleet/roll")
+        assert code == 202 and doc["started"] is True
+        # a second roll while one runs is refused
+        code, doc, _ = _req(router.port, "POST", "/api/v1/fleet/roll")
+        assert code == 202 and doc["started"] is False
+        deadline = 30.0
+        import time as _time
+
+        end = _time.monotonic() + deadline
+        while _time.monotonic() < end:
+            _, fdoc, _ = _req(router.port, "GET", "/api/v1/fleet")
+            if not fdoc["roll"]["rolling"]:
+                break
+            _time.sleep(0.1)
+        assert fdoc["roll"]["rolling"] is False
+        assert fdoc["roll"]["rolled"] == ["w0", "w1"]
+        # adopted members cannot be restarted by the router: the roll
+        # drained them (sessions snapshotted) and left them out of the
+        # ring for their embedding owner to bring back
+        states = {w["id"]: w["state"] for w in fdoc["workers"]}
+        assert states == {"w0": "dead", "w1": "dead"}
+        # w0 rolled first, so its sessions re-homed to w1 before w1's
+        # turn; at minimum the default session moved
+        assert fdoc["roll"]["rehomedSessions"] >= 1

@@ -1,0 +1,97 @@
+"""Consistent-hash ring properties (fleet/ring.py) the router's
+affinity, re-home, and roll behavior all lean on: determinism across
+instances, stable ownership while the worker set holds, and bounded
+(~1/N) movement on join/leave."""
+
+from __future__ import annotations
+
+import pytest
+
+from kube_scheduler_simulator_tpu.fleet.ring import HashRing
+
+KEYS = [f"s-{i:04d}" for i in range(400)] + ["default", "tenant-a.prod"]
+
+
+def owners(ring, keys=KEYS):
+    return {k: ring.owner(k) for k in keys}
+
+
+def test_empty_ring_owns_nothing():
+    ring = HashRing()
+    assert len(ring) == 0
+    assert ring.owner("anything") is None
+    assert ring.owners("anything", 3) == []
+
+
+def test_replicas_must_be_positive():
+    with pytest.raises(ValueError):
+        HashRing(replicas=0)
+
+
+def test_deterministic_across_instances_and_insert_order():
+    a = HashRing(["w0", "w1", "w2"])
+    b = HashRing(["w2", "w0", "w1"])  # same set, different join order
+    assert owners(a) == owners(b)
+    # and a third instance built incrementally agrees too
+    c = HashRing()
+    for wid in ("w1", "w2", "w0"):
+        c.add(wid)
+    assert owners(a) == owners(c)
+
+
+def test_affinity_stable_under_reads():
+    ring = HashRing(["w0", "w1", "w2"])
+    first = owners(ring)
+    assert owners(ring) == first  # reads don't perturb ownership
+    assert all(w in ("w0", "w1", "w2") for w in first.values())
+    # every worker owns SOMETHING at this key count (vnodes spread)
+    assert set(first.values()) == {"w0", "w1", "w2"}
+
+
+def test_add_is_idempotent_and_remove_of_absent_is_noop():
+    ring = HashRing(["w0", "w1"])
+    before = owners(ring)
+    ring.add("w0")
+    ring.remove("not-there")
+    assert owners(ring) == before
+
+
+def test_join_moves_only_keys_the_joiner_now_owns():
+    ring = HashRing(["w0", "w1", "w2"])
+    before = owners(ring)
+    ring.add("w3")
+    after = owners(ring)
+    moved = [k for k in KEYS if before[k] != after[k]]
+    # every moved key moved TO the joiner — nobody else gained keys
+    assert all(after[k] == "w3" for k in moved)
+    # bounded movement: ~1/(N+1) of keys, generously bounded at 2x fair
+    assert len(moved) <= len(KEYS) // 2
+
+
+def test_leave_moves_only_the_leavers_keys():
+    ring = HashRing(["w0", "w1", "w2", "w3"])
+    before = owners(ring)
+    ring.remove("w2")
+    after = owners(ring)
+    for k in KEYS:
+        if before[k] == "w2":
+            assert after[k] != "w2"  # re-homed somewhere live
+        else:
+            assert after[k] == before[k]  # everyone else unmoved
+
+
+def test_leave_rehomes_to_the_declared_successor():
+    ring = HashRing(["w0", "w1", "w2"])
+    prefs = {k: ring.owners(k, 2) for k in KEYS}
+    ring.remove("w1")
+    for k in KEYS:
+        if prefs[k][0] == "w1":
+            # the key lands exactly where owners(k, 2)[1] promised
+            assert ring.owner(k) == prefs[k][1]
+
+
+def test_single_worker_owns_everything():
+    ring = HashRing(["only"])
+    assert set(owners(ring).values()) == {"only"}
+    ring.remove("only")
+    assert ring.owner("default") is None
