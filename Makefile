@@ -105,8 +105,10 @@ bundle-smoke:
 # cross-tenant continuous-batching gate (docs/sessions.md): N
 # bucket-compatible sessions scheduling concurrently must be served by
 # ONE ledger-pinned device dispatch with per-session results
-# byte-identical to solo dispatch, and a lone tenant's added latency
-# stays bounded by one collection window; one JSON line
+# byte-identical to solo dispatch, a lone tenant's added latency
+# stays bounded by one collection window, and N gang passes batch into
+# ONE `batch.gang.run` dispatch (all tenants attributed, placements
+# identical to solo, `soloFallbacks` silent); one JSON line
 batch-smoke:
 	env JAX_PLATFORMS=cpu $(PY) tools/batch_smoke.py
 

@@ -415,6 +415,17 @@ class GangScheduler:
             if self.preempt_phase_fn is not None
             else None
         )
+        # the fused whole-pass program (rounds + preempt alternation,
+        # see fixpoint in _build_run): ONE dispatch per untracked
+        # dynamic pass, no host readback between phases — and the unit
+        # the batch plane vmaps for batch.gang.run
+        self._fixpoint = (
+            broker_mod.jit(
+                self.fixpoint_fn, audit={**aud, "label": "gang.fixpoint"}
+            )
+            if self.fixpoint_fn is not None
+            else None
+        )
         self._final_state = None
         self._rounds = None
         # record path (results()) — all built/filled lazily so the
@@ -1190,6 +1201,86 @@ class GangScheduler:
             return state, rounds, br
 
         self.run_tracked_fn = run_tracked
+
+        def fixpoint(arrays, state0, order, weights):
+            """The WHOLE untracked gang pass as one device program:
+            rounds-to-fixpoint, then the preempt-phase/resume
+            alternation `_drive` used to run as a host loop (with an
+            `assignment` readback per iteration — the sync that defeated
+            async pipeline overlap and cost 2k+1 dispatches per pass
+            with preemption enabled). Control flow is the exact device
+            transliteration of the host driver:
+
+              state, rounds = run(state0)
+              while True:                      # outer while_loop
+                  pending = unbound & queued & real
+                  if none pending: break       # phase cond-skipped
+                  state, n = preempt_phase(pending in queue order)
+                  if n == 0: break             # resume cond-skipped
+                  state, r = run(state); rounds += r   # fresh budget
+
+            The phase segment is built on device: a stable argsort of
+            `order` masked to pending pods (identical to the host's
+            `pending[np.argsort(order[pending])]`), -1-padded to fixed
+            length P — pstep rows with p_raw == -1 are exact no-ops, so
+            the fixed-length scan replaces the host path's
+            pow2-padded-segment recompile family with ONE phase shape.
+            Each resume re-enters `run`'s loop with a fresh max_rounds
+            commit budget, matching the host driver's per-call budget.
+
+            Caveats: under vmap (batch.gang.run) the two `lax.cond`
+            guards lower to both-branches-plus-select, so converged
+            sessions in a batch pay (masked, no-op) phase work — the
+            GangSweep tradeoff; and the batched while_loops run until
+            every session converges. Dynamic loop mode only: the static
+            outer scan keeps its host auto-resume driver, and tracked
+            (record) passes keep the host chronology driver that the
+            byte-parity trace replay is built on."""
+            state, rounds = run(arrays, state0, order, weights)
+            if preempt_fn is None:
+                return state, rounds
+            in_queue = order != _NO_ORDER
+
+            def obody(carry):
+                state, rounds, _ = carry
+                pending = (
+                    (state.assignment < 0) & in_queue & arrays.pod_mask
+                )
+                n_pend = pending.sum().astype(jnp.int32)
+                perm = jnp.argsort(
+                    jnp.where(pending, order, _NO_ORDER)
+                ).astype(jnp.int32)
+                seg = jnp.where(
+                    jnp.arange(P, dtype=jnp.int32) < n_pend,
+                    perm,
+                    jnp.int32(-1),
+                )
+                state, n_bound = jax.lax.cond(
+                    n_pend > 0,
+                    lambda s: preempt_phase(arrays, s, seg, order, weights),
+                    lambda s: (s, jnp.int32(0)),
+                    state,
+                )
+                state, r2 = jax.lax.cond(
+                    n_bound > 0,
+                    lambda s: run(arrays, s, order, weights),
+                    lambda s: (s, jnp.int32(0)),
+                    state,
+                )
+                return state, rounds + r2, n_bound > 0
+
+            state, rounds, _ = jax.lax.while_loop(
+                lambda carry: carry[2],
+                obody,
+                (state, rounds, jnp.bool_(True)),
+            )
+            return state, rounds
+
+        # the fused one-dispatch pass exists only for the dynamic loop:
+        # static mode's auto-resume budget is a host decision by design
+        # (backends where while_loop won't compile), and it keeps the
+        # host driver.
+        self.fixpoint_fn = fixpoint if not static else None
         return run
 
     # -- execution ----------------------------------------------------------
@@ -1245,6 +1336,16 @@ class GangScheduler:
         order, in_q = self.order_arrays()
         arrays = self.enc.arrays
         tracked = chronology is not None
+        if not tracked and self._fixpoint is not None:
+            # the fused whole-pass program: rounds + preempt alternation
+            # in ONE dispatch, zero host readbacks before the caller's
+            # decode fetch — this is the serving path (async overlap
+            # depends on it staying sync-free). `rounds` stays a device
+            # scalar; the finish path fetches it with the assignment.
+            state, rounds = self._fixpoint(arrays, self.enc.state0, order, w)
+            self._final_state = state
+            self._rounds = rounds
+            return state, rounds
         if tracked and self._run_tracked is None:
             self._run_tracked = broker_mod.jit(
                 self.run_tracked_fn,

@@ -39,9 +39,20 @@ closes with one enrollee is told to dispatch SOLO (today's path,
 Windows close on the timer, never on a quorum, so semaphore waiters
 (the ``KSS_MAX_CONCURRENT_PASSES`` collection point, server/sessions.py)
 can never deadlock against the window: a window with no second arrival
-always flushes. Incompatible passes — different broker key, gang or
-extender mode, a session-scoped (or process) fault plane, an escalated
-device rung — fall back to solo dispatch, counted per-session.
+always flushes. Incompatible passes — different broker key, extender
+mode, a recorded gang pass (its trace replay is per-session host work),
+a session-scoped (or process) fault plane, an escalated device rung —
+fall back to solo dispatch, counted per-session.
+
+Gang passes batch too (``batch.gang.run``): the fused `gang.fixpoint`
+program made one gang pass ONE broker-keyed dispatch, so bucket- and
+window-compatible gang passes stack exactly like sequential ones — the
+batch axis rides `vmap` over (arrays, state0, order, weights) and each
+session gets back its (final state, rounds) slice. The vmapped
+while_loops run until every session's fixpoint converges; converged
+sessions' extra rounds are masked no-ops and the program's `lax.cond`
+guards lower to both-branches-plus-select (the GangSweep tradeoff,
+docs/performance.md).
 
 Failure containment: ANY error inside the batched execution (compile
 failure, device fault, a torn stack) marks every enrollee solo and each
@@ -76,9 +87,12 @@ from ..utils import ledger as ledger_mod
 from ..utils.compilecache import shape_bucket
 from ..utils.envcheck import env_truthy
 
-# the KSS7xx audit label (and program-ledger key) of the one batched
-# program kind: the vmapped sequential scan
+# the KSS7xx audit labels (and program-ledger keys) of the two batched
+# program kinds: the vmapped sequential scan and the vmapped gang
+# fixpoint (engine/gang.py `gang.fixpoint` — the fused rounds +
+# preempt-alternation pass — over a leading session axis)
 BATCH_SEQ_LABEL = "batch.seq.run"
+BATCH_GANG_LABEL = "batch.gang.run"
 
 # how long a follower waits on the leader's execution before giving up
 # and dispatching solo. The leader ALWAYS signals (results or error) in
@@ -155,10 +169,14 @@ class _Window:
     early when KSS_BATCH_MAX_SESSIONS enrollees arrived; `closed` stops
     late joiners (they open a successor window instead)."""
 
-    __slots__ = ("key", "items", "closed", "full")
+    __slots__ = ("key", "kind", "items", "closed", "full")
 
-    def __init__(self, key):
+    def __init__(self, key, kind="seq"):
         self.key = key
+        # "seq" | "gang" — which batched program the window dispatches.
+        # Uniform per window by construction: the key's leading element
+        # is the engine kind, so mixed-kind enrollment cannot happen.
+        self.kind = kind
         self.items: "list[_Enrollee]" = []
         self.closed = False
         self.full = threading.Event()
@@ -227,15 +245,23 @@ class BatchPlane:
 
     # -- the collection point -------------------------------------------------
 
-    def submit(self, key, engine, queue, *, metrics, session_id=None):
-        """Enroll one sequential pass under batch `key` (the broker
-        engine key: kind, compile signature, queue bucket, device
-        epoch). Blocks until the window executes, then returns
+    def submit(self, key, engine, queue, *, metrics, session_id=None,
+               kind="seq"):
+        """Enroll one pass under batch `key` (the broker engine key:
+        kind, compile signature, queue bucket, device epoch). Blocks
+        until the window executes, then returns
         ``(final_state_slice, trace_slice)`` for THIS pass — or None,
         meaning the caller must dispatch solo (lone window, draining,
         or a failed batched execution). `engine` is the caller's
         decode-engine instance; its encoding supplies the stacked
-        arrays and its `run_fn` shape defines the program."""
+        arrays and its program shape defines the window's dispatch.
+
+        ``kind="seq"`` (the default): `queue` is the bucket-padded pod
+        queue, the program vmaps `run_fn`, and the trace slice is the
+        record trace. ``kind="gang"``: `queue` is the [P] PrioritySort
+        order tensor, the program vmaps `fixpoint_fn`
+        (``batch.gang.run``), and the second slice is the pass's
+        rounds-to-fixpoint scalar."""
         me = _Enrollee(engine, queue, session_id, metrics)
         with self._lock:
             if self._draining:
@@ -246,7 +272,7 @@ class BatchPlane:
             ):
                 win = None  # missed it: open the successor window
             if win is None:
-                win = _Window(key)
+                win = _Window(key, kind)
                 win.items.append(me)
                 self._open[key] = win
                 leader = True
@@ -278,7 +304,7 @@ class BatchPlane:
                     leader=True, outcome="solo",
                 )
                 return None
-            self._execute(key, items)
+            self._execute(win.kind, key, items)
         else:
             if not me.done.wait(_FOLLOWER_TIMEOUT_S):
                 # leader lost (killed thread, a compile beyond even the
@@ -304,10 +330,14 @@ class BatchPlane:
 
     # -- batched execution ----------------------------------------------------
 
-    def _program(self, key, bucket: int, engine):
-        """The vmapped program for (key, batch bucket), built once from
-        a signature-equal template engine and kept warm (FIFO-bounded).
-        Returns (vrun, fresh)."""
+    def _program(self, kind, key, bucket: int, engine):
+        """The vmapped program for (key, batch bucket), built once and
+        kept warm (FIFO-bounded). For ``seq`` windows it is built from
+        a fresh signature-equal template engine (masked preemption —
+        the vmappable form of the solo cond path); for ``gang`` windows
+        it vmaps the enrollee engine's own fused `fixpoint_fn` — the
+        identical program text solo dispatch runs, so batched slices
+        cannot diverge from solo placements. Returns (vrun, fresh)."""
         from ..engine.engine import BatchedScheduler
 
         with self._lock:
@@ -320,17 +350,24 @@ class BatchPlane:
         # is tolerated — last one wins, XLA's caches dedupe the compile.
         import jax
 
-        template = BatchedScheduler(
-            engine.enc, record=True, strict=True, preempt_mode="masked"
-        )
-        aud = template.audit_spec()
+        if kind == "gang":
+            run_fn = engine.fixpoint_fn
+            aud = engine.audit_spec()
+            label = BATCH_GANG_LABEL
+        else:
+            template = BatchedScheduler(
+                engine.enc, record=True, strict=True, preempt_mode="masked"
+            )
+            run_fn = template.run_fn
+            aud = template.audit_spec()
+            label = BATCH_SEQ_LABEL
         # the batch axis joins the audit's static dims (it is pow2 by
         # construction; KSS713 would otherwise read fills 3/5/6/7 as
         # off-bucket) — the sweep's variant-axis waiver, scoped tighter
         aud["extra_dims"] = tuple(aud.get("extra_dims", ())) + (bucket,)
         vrun = broker_mod.jit(
-            jax.vmap(template.run_fn, in_axes=(0, 0, 0, 0)),
-            audit={**aud, "label": BATCH_SEQ_LABEL},
+            jax.vmap(run_fn, in_axes=(0, 0, 0, 0)),
+            audit={**aud, "label": label},
         )
         # only `vrun` is cached, not the template engine: the program
         # closure retains what it retains (the build encoding, via
@@ -345,12 +382,12 @@ class BatchPlane:
                 self._programs.pop(next(iter(self._programs)))
         return vrun, True
 
-    def _execute(self, key, items: "list[_Enrollee]") -> None:
+    def _execute(self, kind, key, items: "list[_Enrollee]") -> None:
         """Run one closed window as ONE device dispatch and scatter the
         slices back. Never raises: any failure marks every enrollee
         solo (their own dispatch ladders take over)."""
         try:
-            self._execute_inner(key, items)
+            self._execute_inner(kind, key, items)
         except Exception as e:  # noqa: BLE001 — contained: everyone solos
             for it in items:
                 it.error = e
@@ -361,7 +398,7 @@ class BatchPlane:
             for it in items:
                 it.done.set()
 
-    def _execute_inner(self, key, items: "list[_Enrollee]") -> None:
+    def _execute_inner(self, kind, key, items: "list[_Enrollee]") -> None:
         import jax
         import jax.numpy as jnp
         import numpy as np
@@ -372,7 +409,7 @@ class BatchPlane:
         # fills 3 and 5..8 reuse the 4- and 8-wide compilations
         bucket = shape_bucket(B, lo=2)
         padded = items + [items[0]] * (bucket - B)
-        vrun, fresh = self._program(key, bucket, items[0].engine)
+        vrun, fresh = self._program(kind, key, bucket, items[0].engine)
         t0 = time.perf_counter()
         arrays_b = jax.tree.map(
             lambda *xs: jnp.stack(xs),
@@ -382,12 +419,15 @@ class BatchPlane:
             lambda *xs: jnp.stack(xs),
             *[it.engine.enc.state0 for it in padded],
         )
+        # seq: the bucket-padded pod queue; gang: the [P] order tensor
         queue_b = jnp.asarray(np.stack([it.queue for it in padded]))
         weights_b = jnp.stack([it.engine.weights for it in padded])
         state_out, trace_out = vrun(arrays_b, state_b, queue_b, weights_b)
         dt = time.perf_counter() - t0
         for i, it in enumerate(items):
             it.state = jax.tree.map(lambda x, i=i: x[i], state_out)
+            # gang's second output is the rounds-to-fixpoint scalar;
+            # tree.map slices both shapes identically
             it.trace = jax.tree.map(lambda x, i=i: x[i], trace_out)
         # -- accounting -----------------------------------------------------
         # enrollees whose done-wait already expired are dispatching solo
@@ -406,6 +446,8 @@ class BatchPlane:
         for it in served:
             if it.metrics is not None:
                 it.metrics.record_batching(batched_passes=1)
+                if kind == "gang":
+                    it.metrics.record_gang(batched_passes=1)
                 if not fresh:
                     it.metrics.record_phase_seconds(execute=dt)
         if self.metrics is not None:
@@ -422,6 +464,7 @@ class BatchPlane:
         # per-session truthfully (calls = dispatches, session counts =
         # passes served)
         if ledger_mod.ledger_enabled():
+            label = BATCH_GANG_LABEL if kind == "gang" else BATCH_SEQ_LABEL
             others = [it.session_id for it in served[1:]]
             if others:
-                ledger_mod.LEDGER.attribute_sessions(BATCH_SEQ_LABEL, others)
+                ledger_mod.LEDGER.attribute_sessions(label, others)

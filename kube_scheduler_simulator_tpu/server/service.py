@@ -46,6 +46,7 @@ from ..models.store import ResourceStore
 from ..sched.config import SchedulerConfiguration
 from ..sched.extender import ExtenderService
 from ..sched.results import PodSchedulingResult
+from ..utils import broker as broker_mod
 from ..utils import devices as devices_mod
 from ..utils import faultinject, fleetstats, locking
 from ..utils import ledger as ledger_mod
@@ -80,6 +81,21 @@ class EngineDegraded(RuntimeError):
 # total work. Must accompany every GangScheduler build AND every
 # effective_window computation here, or engine-cache keys drift.
 GANG_CHUNK = 64
+
+
+def gang_chunk() -> int:
+    """The serving-path gang evaluation chunk: ``KSS_GANG_CHUNK`` when
+    set (>= 1), else the measured `GANG_CHUNK` default. Placements are
+    chunk-invariant, so this is a pure performance knob — compact
+    mode's skip-settled granularity on both the fused fixpoint and the
+    record path's replay evaluation. Lenient coercion (the broker's
+    ladder-knob rule: a malformed value must not take serving down);
+    read per pass so the knob is honored without a restart — the chunk
+    is part of the engine signature, so a changed value simply keys a
+    new engine."""
+    return broker_mod._coerce_env_number(
+        os.environ.get("KSS_GANG_CHUNK", ""), GANG_CHUNK, int, 1
+    )
 
 
 class SchedulerServiceDisabled(RuntimeError):
@@ -250,6 +266,7 @@ class SchedulerService:
         # change, not per pass)
         self._batch_fallback_counted = False
         self._batch_decode_cache: "tuple | None" = None
+        self._batch_gang_decode_cache: "tuple | None" = None
         self.extender_service = ExtenderService(self._config.extenders)
 
     def _next_pass_id(self) -> int:
@@ -545,10 +562,11 @@ class SchedulerService:
         the new rung's placement."""
         self._enc_cache = EncodingCache(capacity=self.encoding_cache_capacity)
         self._delta = DeltaEncoder()
-        # the batched-pass decode engine retains its encoding too — on
-        # the failed device; drop it (batch eligibility already excludes
-        # escalated rungs, this just releases the dead buffers)
+        # the batched-pass decode engines retain their encodings too —
+        # on the failed device; drop them (batch eligibility already
+        # excludes escalated rungs, this just releases the dead buffers)
         self._batch_decode_cache = None
+        self._batch_gang_decode_cache = None
 
     def _try_shrink(self) -> bool:
         """The ladder's mesh-shrink rung: mark the dispatch device lost,
@@ -734,19 +752,27 @@ class SchedulerService:
         enc = self._encode_current(config)
         if enc is None:
             return None
-        # gang passes are not batch-eligible (the fixpoint resume and
-        # preempt-phase host loops iterate per-session); they keep
-        # today's solo dispatch, counted as the fallback
-        self._count_solo_fallback()
         self._fire_device_dispatch()
+        chunk = gang_chunk()
         # the window joins the broker key as the CANONICAL chunk-rounded
         # value program identity actually depends on (raw windows that
         # round to the same WP share one compilation)
         sig = self._epoch_sig((
             "gang",
             GangScheduler.compile_signature(enc),
-            GangScheduler.effective_window(enc, window, GANG_CHUNK),
+            GangScheduler.effective_window(enc, window, chunk),
         ))
+        if not record:
+            # the fused fixpoint made the whole pass one broker-keyed
+            # program, so gang passes enroll in the batch plane exactly
+            # like sequential ones (batch.gang.run)
+            disp = self._maybe_batched_gang_dispatch(sig, enc, chunk, window)
+            if disp is not None:
+                return disp
+        else:
+            # record passes stay solo: the byte-parity trace replay is
+            # per-session host work by design (docs/performance.md)
+            self._count_solo_fallback()
         # cross-session serialization of the (possibly shared) engine:
         # held until _gang_finish (docs/sessions.md)
         self._lease_engine(sig)
@@ -755,7 +781,7 @@ class SchedulerService:
 
         def build():
             g = GangScheduler(
-                enc, strict=True, chunk=GANG_CHUNK, eval_window=window
+                enc, strict=True, chunk=chunk, eval_window=window
             )
             # jit is lazy: the first drive IS the XLA compile, so the
             # broker's miss wall time is the true request-thread stall
@@ -831,6 +857,10 @@ class SchedulerService:
         after = np.asarray(after)
         placements = gang.enc.decode_assignment(after)
         rounds = int(np.asarray(gang._rounds))
+        # booked here, not at dispatch: the rounds scalar stays on
+        # device until this finish-path fetch (async overlap depends on
+        # the dispatch staying sync-free)
+        self.metrics.record_gang(fixpoint_rounds=rounds)
         for p_idx in np.nonzero((before >= 0) & (after < 0))[0]:
             ns, name = enc.pod_keys[int(p_idx)]
             self.store.delete("pods", name, ns)
@@ -998,15 +1028,16 @@ class SchedulerService:
             if kind == "gang":
                 from ..engine.gang import GangScheduler
 
+                chunk = gang_chunk()
                 sig = _sig((
                     "gang",
                     GangScheduler.compile_signature(enc_s),
-                    GangScheduler.effective_window(enc_s, window, GANG_CHUNK),
+                    GangScheduler.effective_window(enc_s, window, chunk),
                 ))
 
                 def build():
                     return GangScheduler(
-                        enc_s, strict=True, chunk=GANG_CHUNK, eval_window=window
+                        enc_s, strict=True, chunk=chunk, eval_window=window
                     ).warmup(record=record)
 
             else:
@@ -1321,6 +1352,66 @@ class SchedulerService:
             return None
         engine._final_state, engine._trace = out
         return ("batch", enc, engine, None)
+
+    def _maybe_batched_gang_dispatch(self, sig: tuple, enc, chunk, window):
+        """Gang-pass twin of `_maybe_batched_dispatch`: enroll this gang
+        pass's fused fixpoint in the batch plane (`batch.gang.run` — the
+        vmapped `gang.fixpoint` over the session axis) and come back
+        with this session's slice of ONE device dispatch. Returns the
+        same `(enc, gang)` tuple `_gang_dispatch_once` builds for solo,
+        so `_gang_finish` is oblivious to how the pass was served, or
+        None for solo dispatch.
+
+        Same ineligibility rules as the sequential path (fault planes,
+        escalated rungs, lone windows, draining or failed planes — all
+        counted ``soloFallbacks``); additionally, record passes never
+        reach here (`_gang_dispatch_once` keeps them solo: the trace
+        replay is per-session host work by design)."""
+        import numpy as np
+
+        from ..engine.gang import GangScheduler
+
+        plane = self.batch_plane
+        if plane is None:
+            return None
+        if (
+            self.fault_plane is not None
+            or faultinject.active() is not None
+            or self.device_rung != "device"
+        ):
+            self._count_solo_fallback()
+            return None
+        # the decode-engine for THIS pass (never dispatched solo: the
+        # batch slice lands in _final_state/_rounds before anything
+        # could trigger a run) — signature-stable sessions reuse it via
+        # retarget, exactly like the sequential decode cache
+        cached = self._batch_gang_decode_cache
+        if cached is not None and cached[0] == sig:
+            gang = cached[1].retarget(enc)
+        else:
+            gang = GangScheduler(
+                enc, strict=True, chunk=chunk, eval_window=window
+            )
+            self._batch_gang_decode_cache = (sig, gang)
+        if gang.fixpoint_fn is None:
+            # no fused program for this configuration (static loop):
+            # nothing to vmap — solo dispatch
+            self._count_solo_fallback()
+            return None
+        # the PrioritySort queue rides the batch axis as the [P] order
+        # tensor (the gang program's queue encoding — fixed length, so
+        # bucket-compatible sessions stack without padding logic)
+        order, _ = gang.order_arrays()
+        out = plane.submit(
+            sig, gang, np.asarray(order, np.int32),
+            metrics=self.metrics, session_id=self.session_id,
+            kind="gang",
+        )
+        if out is None:
+            self._count_solo_fallback()
+            return None
+        gang._final_state, gang._rounds = out
+        return (enc, gang)
 
     def _seq_finish(self, disp) -> list[PodSchedulingResult]:
         """The deferred tail of a sequential pass: trace decode (batched

@@ -117,6 +117,11 @@ def _slo_objectives_validator(raw: str) -> "str | None":
 KNOWN: "dict[str, Validator]" = {
     # serving stack
     "KSS_ENCODING_CACHE_CAP": _int_validator(1),
+    # the gang engine's serving-path evaluation chunk (server/service.py
+    # gang_chunk): compact mode's skip-settled granularity on the fused
+    # fixpoint AND the record path's replay evaluation; placements are
+    # chunk-invariant, so this is a pure performance knob (default 64)
+    "KSS_GANG_CHUNK": _int_validator(1),
     "KSS_NO_SPECULATIVE_COMPILE": _bool_validator,
     "KSS_JAX_CACHE_DIR": _path_validator,
     # the persistent AOT bundle store (utils/bundles.py): serialize

@@ -293,6 +293,12 @@ class SchedulingMetrics:
     _batch_windows: int = 0
     _batch_occupancy_sum: int = 0
     _solo_fallbacks: int = 0
+    # gang-engine counters (engine/gang.py, server/batchplane.py):
+    # cumulative commit rounds the device-resident fixpoint used
+    # (rounds/pass = gangFixpointRounds / gang passes) and gang passes
+    # served by a batched dispatch (batch.gang.run) — both per-session
+    _gang_fixpoint_rounds: int = 0
+    _batched_gang_passes: int = 0
     # latency-distribution state (the observability PR): Prometheus-style
     # histograms behind the same lock as the counters, rendered into the
     # JSON snapshot's `histograms` block and the exposition text
@@ -589,6 +595,19 @@ class SchedulingMetrics:
             self._batch_occupancy_sum += int(occupancy)
             self._solo_fallbacks += int(solo_fallbacks)
 
+    def record_gang(
+        self, *, fixpoint_rounds: int = 0, batched_passes: int = 0
+    ) -> None:
+        """Gang-engine accounting: `fixpoint_rounds` commit rounds the
+        pass's device-resident fixpoint used (engine/gang.py — booked at
+        decode, where the rounds scalar is fetched with the assignment
+        anyway), `batched_passes` gang passes this registry's session
+        had served by a cross-tenant batched dispatch
+        (server/batchplane.py ``batch.gang.run``)."""
+        with self._lock:
+            self._gang_fixpoint_rounds += int(fixpoint_rounds)
+            self._batched_gang_passes += int(batched_passes)
+
     def record_phase_seconds(
         self, execute: float = 0.0, decode: float = 0.0
     ) -> None:
@@ -682,6 +701,8 @@ class SchedulingMetrics:
                     "batchWindows": self._batch_windows,
                     "batchOccupancySum": self._batch_occupancy_sum,
                     "soloFallbacks": self._solo_fallbacks,
+                    "gangFixpointRounds": self._gang_fixpoint_rounds,
+                    "batchedGangPasses": self._batched_gang_passes,
                 },
                 # derived continuous-batching view (server/batchplane.py):
                 # mean window fill — a ratio, so it lives outside the
@@ -742,6 +763,8 @@ class SchedulingMetrics:
             self._batch_windows = 0
             self._batch_occupancy_sum = 0
             self._solo_fallbacks = 0
+            self._gang_fixpoint_rounds = 0
+            self._batched_gang_passes = 0
             self._slo_skip_eager = 0
             self._slo_skip_degraded = 0
             self._hist = _new_histograms()
@@ -761,7 +784,7 @@ class SchedulingMetrics:
         "_bundle_loads", "_bundle_saves", "_bundle_bypasses",
         "_aot_deserialize_s",
         "_batched_passes", "_batch_windows", "_batch_occupancy_sum",
-        "_solo_fallbacks",
+        "_solo_fallbacks", "_gang_fixpoint_rounds", "_batched_gang_passes",
     )
 
     def state_dict(self) -> dict:
@@ -954,6 +977,16 @@ _PROM_COUNTERS = (
         "kss_solo_fallbacks_total",
         "Passes that fell back from the batch plane to solo dispatch.",
         ("phases", "soloFallbacks"),
+    ),
+    (
+        "kss_gang_fixpoint_rounds_total",
+        "Commit rounds used by device-resident gang fixpoint passes.",
+        ("phases", "gangFixpointRounds"),
+    ),
+    (
+        "kss_batched_gang_passes_total",
+        "Gang passes served by a cross-tenant batched dispatch.",
+        ("phases", "batchedGangPasses"),
     ),
 )
 

@@ -89,6 +89,11 @@ for _var in (
     "KSS_BATCH_WINDOW_MS",
     "KSS_BATCH_MAX_WAIT_MS",
     "KSS_BATCH_MAX_SESSIONS",
+    # the gang serving chunk (server/service.py gang_chunk): an ambient
+    # override would re-key every gang engine the suite builds (the
+    # chunk is part of the compile signature) and skew the dispatch-
+    # count pins; chunk tests pass it explicitly
+    "KSS_GANG_CHUNK",
     # the session plane (server/sessions.py): ambient admission knobs
     # would change quota/limit behavior under test
     "KSS_MAX_SESSIONS",

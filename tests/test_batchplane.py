@@ -5,10 +5,19 @@ per-pod result records are BYTE-IDENTICAL to solo dispatch — the batch
 plane may change throughput and latency, never an answer. Plus the
 fairness/robustness contracts: a lone tenant never waits more than one
 window, semaphore waiters can't deadlock against the window timer,
-drain flushes partial windows, incompatible/gang/fault-scoped passes
-fall back to solo (counted), and one batched device dispatch lands
-spans / ledger attribution / latency observations on the correct
+drain flushes partial windows, incompatible/recorded-gang/fault-scoped
+passes fall back to solo (counted), and one batched device dispatch
+lands spans / ledger attribution / latency observations on the correct
 session — including when a session is deleted mid-batch.
+
+Gang passes batch too (``batch.gang.run``, the vmapped fused
+`gang.fixpoint`): that half of the contract — batched gang parity
+(sync + async, preemption included), the mid-batch DELETE and
+batched-failure fallbacks, and per-tenant ledger attribution of the
+one gang window dispatch — lives in test_gang_batchplane.py, which
+shares this file's fixtures. The gang counter plumbing
+(gangFixpointRounds / batchedGangPasses) stays here with the other
+counter round-trips.
 """
 
 from __future__ import annotations
@@ -229,32 +238,34 @@ class TestBatchedParity:
 
 
 class TestFallbacks:
-    def test_gang_pass_falls_back_solo(self):
-        """Gang passes (sync AND async) keep today's solo dispatch with
-        the plane armed — placements identical to an unarmed manager."""
+    def test_recorded_gang_pass_falls_back_solo(self):
+        """record=True gang passes keep today's solo dispatch with the
+        plane armed (their trace replay is per-session host work by
+        design) — counted, never enrolled in a window, and the full
+        result-record bytes stay identical to an unarmed manager."""
         solo_mgr = _manager()
         try:
             s, _ = solo_mgr.create(name="g0", snapshot=_snapshot(0))
-            solo_placements, _, _ = s.service.scheduler.schedule_gang()
+            solo_placements, _, solo_results = (
+                s.service.scheduler.schedule_gang()
+            )
+            solo_doc = _results_doc(solo_results)
         finally:
             solo_mgr.shutdown()
-        mgr, _plane = _armed_manager()
+        # a small window: a wrongly-enrolled record pass would still
+        # flush, but the counter pin below would catch it
+        mgr, _plane = _armed_manager(window_ms=50.0)
         try:
             sess, _ = mgr.create(name="g", snapshot=_snapshot(0))
             placements, rounds, results = sess.service.scheduler.schedule_gang()
             assert placements == solo_placements
+            assert _results_doc(results) == solo_doc
             phases = sess.service.scheduler.metrics.snapshot()["phases"]
             assert phases["soloFallbacks"] == 1
             assert phases["batchedPasses"] == 0
-            # async gang (begin_gang_pass/resolve) through the armed
-            # plane: same fallback, pass completes
-            sess2, _ = mgr.create(name="g2", snapshot=_snapshot(0))
-            handle = sess2.service.scheduler.begin_gang_pass()
-            assert handle.resolve() == sum(
-                1 for v in solo_placements.values() if v
-            )
-            phases2 = sess2.service.scheduler.metrics.snapshot()["phases"]
-            assert phases2["soloFallbacks"] == 1
+            assert phases["batchedGangPasses"] == 0
+            default = mgr.get("default").service.scheduler.metrics
+            assert default.snapshot()["phases"]["batchWindows"] == 0
         finally:
             mgr.shutdown()
 
@@ -314,8 +325,6 @@ class TestFallbacks:
                 assert phases["soloFallbacks"] == 1
         finally:
             mgr.shutdown()
-
-
 class TestFairnessAndLiveness:
     def test_lone_tenant_bounded_by_one_window(self):
         """A lone tenant's pass waits at most ~one window before the
@@ -363,6 +372,17 @@ class TestFairnessAndLiveness:
                 mgr.create(name=f"t{i}", snapshot=_snapshot(i))[0]
                 for i in range(2)
             ]
+            # warm up the solo program OUTSIDE the timed section (the
+            # lone-tenant test's pattern): the deadlock wall below must
+            # measure window/semaphore interaction, not a cold compile
+            # on a loaded 1-core CI box
+            for i, sess in enumerate(sessions):
+                sess.service.scheduler.schedule()
+                for p in _snapshot(i)["pods"]:
+                    sess.service.store.delete(
+                        "pods", p["metadata"]["name"], "default"
+                    )
+                sess.service.import_({"pods": _snapshot(i)["pods"]})
             done, errors = [], {}
 
             def run(i):
@@ -612,3 +632,29 @@ class TestPlumbing:
         ):
             samples = fams[name]["samples"]
             assert samples and samples[0][2] == want
+
+    def test_gang_counters_roundtrip(self):
+        m = metrics_mod.SchedulingMetrics()
+        m.record_gang(fixpoint_rounds=7, batched_passes=2)
+        m.record_gang(fixpoint_rounds=3)
+        snap = m.snapshot()
+        assert snap["phases"]["gangFixpointRounds"] == 10
+        assert snap["phases"]["batchedGangPasses"] == 2
+        # checkpoint round trip
+        m2 = metrics_mod.SchedulingMetrics()
+        m2.load_state(m.state_dict())
+        assert m2.snapshot()["phases"]["gangFixpointRounds"] == 10
+        assert m2.snapshot()["phases"]["batchedGangPasses"] == 2
+        # exposition round trip through the strict parser
+        text = metrics_mod.render_prometheus(snap)
+        fams = metrics_mod.parse_prometheus_text(text)
+        for name, want in (
+            ("kss_gang_fixpoint_rounds_total", 10),
+            ("kss_batched_gang_passes_total", 2),
+        ):
+            samples = fams[name]["samples"]
+            assert samples and samples[0][2] == want
+        m.reset()
+        phases = m.snapshot()["phases"]
+        assert phases["gangFixpointRounds"] == 0
+        assert phases["batchedGangPasses"] == 0

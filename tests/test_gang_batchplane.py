@@ -1,0 +1,265 @@
+"""Gang passes through the batch plane (``batch.gang.run``).
+
+The fused `gang.fixpoint` vmapped over the session axis — the gang half
+of the cross-tenant continuous-batching contract (the sequential half
+lives in test_batchplane.py, whose fixtures this file shares). The
+parity pin is the same hard contract: the plane may change throughput
+and latency, never an answer. Covered here: sync + async parity
+(placements, rounds-to-fixpoint, store write-back bytes) on both the
+plain and the PREEMPTION fixtures, per-tenant ledger attribution of the
+window's ONE device dispatch, the mid-batch session DELETE, and the
+batched-failure → per-session resilience-ladder fallback.
+
+Solo baselines are memoized module-wide: every test compares against
+the same once-computed solo answer, so the file pays each baseline
+compile exactly once.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import threading
+import time
+
+import pytest
+
+from kube_scheduler_simulator_tpu.server.batchplane import (
+    BATCH_GANG_LABEL,
+    BatchPlane,
+)
+from kube_scheduler_simulator_tpu.utils import ledger as ledger_mod
+
+from test_batchplane import N, _armed_manager, _manager, _snapshot
+
+
+@functools.lru_cache(maxsize=None)
+def _solo_gang(preempt: bool = False) -> dict:
+    """Solo gang baselines (record=False, unarmed manager), computed
+    ONCE per fixture for the whole module:
+    {i: (placements, rounds, store_pods_doc)}. Callers treat the
+    returned structures as read-only."""
+    mgr = _manager()
+    out = {}
+    try:
+        for i in range(N):
+            sess, errs = mgr.create(
+                name=f"gsolo{i}", snapshot=_snapshot(i, preempt)
+            )
+            assert not errs
+            placements, rounds, _ = sess.service.scheduler.schedule_gang(
+                record=False
+            )
+            store_doc = json.dumps(
+                sess.service.store.list("pods"), sort_keys=True
+            )
+            out[i] = (placements, rounds, store_doc)
+    finally:
+        mgr.shutdown()
+    return out
+
+
+def _concurrent_gang(mgr, sessions, mode: str = "sync"):
+    """Drive every session's gang pass (record=False) concurrently,
+    barrier-aligned so all enroll in one window. Returns
+    {i: (placements, rounds)} (async mode: {i: scheduled_count})."""
+    out, errors = {}, {}
+    barrier = threading.Barrier(len(sessions))
+
+    def run(i):
+        try:
+            barrier.wait(timeout=30)
+            svc = sessions[i].service
+            with mgr.pass_slot():
+                if mode == "async":
+                    handle = svc.scheduler.begin_gang_pass()
+                    out[i] = handle.resolve()
+                else:
+                    placements, rounds, _ = svc.scheduler.schedule_gang(
+                        record=False
+                    )
+                    out[i] = (placements, rounds)
+        except Exception as e:  # noqa: BLE001 — surfaced by the assert
+            errors[i] = repr(e)
+
+    threads = [
+        threading.Thread(target=run, args=(i,)) for i in range(len(sessions))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not errors, errors
+    assert len(out) == len(sessions)
+    return out
+
+
+class TestGangBatching:
+    def test_sync_parity_counters_and_attribution(self, monkeypatch):
+        """N sessions' gang passes batch into ONE window: placements,
+        rounds-to-fixpoint, and store write-back bytes identical to
+        solo — on the PREEMPTION fixture, so the fused program's
+        cond-guarded phase + resume run under vmap. The window is ONE
+        ledger-pinned device dispatch (`batch.gang.run` calls == 1)
+        with every tenant attributed and the solo fused program silent.
+        """
+        solo = _solo_gang(True)  # before the ledger reset below
+        monkeypatch.setenv("KSS_PROGRAM_LEDGER", "1")
+        # reset BEFORE building the armed manager: its engines hook the
+        # ledger at jit-wrap time, so their records survive the reset
+        # (pre-existing wrappers' handles would be orphaned instead)
+        ledger_mod.LEDGER.reset()
+        try:
+            mgr, _plane = _armed_manager()
+            try:
+                sessions = [
+                    mgr.create(name=f"t{i}", snapshot=_snapshot(i, True))[0]
+                    for i in range(N)
+                ]
+                sids = [s.id for s in sessions]
+                out = _concurrent_gang(mgr, sessions)
+                for i in range(N):
+                    placements, rounds = out[i]
+                    assert placements == solo[i][0], f"session {i} diverged"
+                    assert rounds == solo[i][1], f"session {i} rounds diverged"
+                    got = json.dumps(
+                        sessions[i].service.store.list("pods"), sort_keys=True
+                    )
+                    assert got == solo[i][2], f"session {i} store diverged"
+                default_phases = (
+                    mgr.get("default").service.scheduler.metrics.snapshot()
+                )
+                assert default_phases["phases"]["batchWindows"] == 1
+                assert default_phases["phases"]["batchOccupancySum"] == N
+                for i, s in enumerate(sessions):
+                    phases = s.service.scheduler.metrics.snapshot()["phases"]
+                    assert phases["batchedGangPasses"] == 1
+                    assert phases["batchedPasses"] == 1
+                    assert phases["soloFallbacks"] == 0
+                    assert phases["gangFixpointRounds"] == solo[i][1]
+                recs = [
+                    rec
+                    for rec in ledger_mod.LEDGER.snapshot()["programs"]
+                    if rec["label"] == BATCH_GANG_LABEL
+                ]
+                assert len(recs) == 1
+                assert recs[0]["calls"] == 1
+                for sid in sids:
+                    assert sid in recs[0]["sessions"], (
+                        f"{sid} missing from {recs[0]['sessions']}"
+                    )
+                assert sum(recs[0]["sessions"].values()) == N
+                # the solo fused program never fired: the window's one
+                # dispatch served every pass (the memoized baselines
+                # above predate the reset, so any calls here would be
+                # the armed manager's own)
+                solo_recs = [
+                    rec
+                    for rec in ledger_mod.LEDGER.snapshot()["programs"]
+                    if rec["label"] == "gang.fixpoint" and rec["calls"]
+                ]
+                assert not solo_recs
+            finally:
+                mgr.shutdown()
+        finally:
+            ledger_mod.LEDGER.reset()
+
+    def test_async_parity(self):
+        """begin_gang_pass/resolve (the async pipeline's split) through
+        the batch plane: store write-backs identical to the SYNC solo
+        baseline — the split must not change the answer either."""
+        solo = _solo_gang(False)
+        mgr, _plane = _armed_manager()
+        try:
+            sessions = [
+                mgr.create(name=f"t{i}", snapshot=_snapshot(i))[0]
+                for i in range(N)
+            ]
+            _concurrent_gang(mgr, sessions, mode="async")
+            for i, s in enumerate(sessions):
+                got = json.dumps(s.service.store.list("pods"), sort_keys=True)
+                assert got == solo[i][2], f"session {i} store diverged"
+                phases = s.service.scheduler.metrics.snapshot()["phases"]
+                assert phases["batchedGangPasses"] == 1
+                assert phases["soloFallbacks"] == 0
+        finally:
+            mgr.shutdown()
+
+    def test_mid_batch_session_delete(self):
+        """A session DELETEd while its gang pass waits in a window: the
+        pass still completes (write-backs land on the orphaned store),
+        and the surviving enrollee stays identical to solo."""
+        solo = _solo_gang(False)
+        # max_sessions=3 so a 2-enrollee window stays OPEN (timer flush)
+        mgr, _plane = _armed_manager(window_ms=1000.0, max_sessions=3)
+        try:
+            a, _ = mgr.create(name="a", snapshot=_snapshot(0))
+            b, _ = mgr.create(name="b", snapshot=_snapshot(1))
+            out, errors = {}, {}
+            barrier = threading.Barrier(3)
+
+            def run(i, sess):
+                try:
+                    barrier.wait(timeout=30)
+                    with mgr.pass_slot():
+                        placements, rounds, _ = (
+                            sess.service.scheduler.schedule_gang(record=False)
+                        )
+                        out[i] = (placements, rounds)
+                except Exception as e:  # noqa: BLE001
+                    errors[i] = repr(e)
+
+            def deleter():
+                barrier.wait(timeout=30)
+                time.sleep(0.2)  # mid-window: both passes enrolled
+                mgr.delete(b.id)
+
+            ts = [
+                threading.Thread(target=run, args=(0, a)),
+                threading.Thread(target=run, args=(1, b)),
+                threading.Thread(target=deleter),
+            ]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=120)
+            assert not errors, errors
+            assert out[0] == (solo[0][0], solo[0][1])
+            # the orphaned pass still answered
+            assert out[1] == (solo[1][0], solo[1][1])
+            with pytest.raises(Exception):
+                mgr.get(b.id)
+        finally:
+            mgr.shutdown()
+
+    def test_batched_failure_falls_back_per_session(self, monkeypatch):
+        """ANY failure inside the batched gang execution marks every
+        enrollee solo: each pass completes on its own dispatch ladder
+        with placements identical to solo — the plane can degrade
+        throughput, never correctness."""
+        solo = _solo_gang(False)
+        monkeypatch.setattr(
+            BatchPlane,
+            "_execute_inner",
+            lambda self, kind, key, items: (_ for _ in ()).throw(
+                RuntimeError("injected batch failure")
+            ),
+        )
+        mgr, _plane = _armed_manager()
+        try:
+            sessions = [
+                mgr.create(name=f"t{i}", snapshot=_snapshot(i))[0]
+                for i in range(N)
+            ]
+            out = _concurrent_gang(mgr, sessions)
+            for i in range(N):
+                assert out[i] == (solo[i][0], solo[i][1]), (
+                    f"session {i} diverged after the failed batch"
+                )
+                phases = sessions[i].service.scheduler.metrics.snapshot()[
+                    "phases"
+                ]
+                assert phases["soloFallbacks"] == 1
+                assert phases["batchedGangPasses"] == 0
+        finally:
+            mgr.shutdown()

@@ -1,5 +1,5 @@
 """`make batch-smoke`: cross-tenant continuous batching end-to-end on
-CPU (server/batchplane.py, docs/sessions.md). Three gates, one JSON line:
+CPU (server/batchplane.py, docs/sessions.md). Four gates, one JSON line:
 
 1. **One device dispatch per window** — N bucket-compatible sessions
    scheduling concurrently must be served by ONE `batch.seq.run`
@@ -11,6 +11,11 @@ CPU (server/batchplane.py, docs/sessions.md). Three gates, one JSON line:
    throughput, never an answer.
 3. **Lone-tenant fairness** — a single tenant's pass waits at most
    ~one `KSS_BATCH_WINDOW_MS` before the solo fallback serves it.
+4. **Gang batching** — N tenants' gang passes (the fused device
+   fixpoint, record=False) served by ONE `batch.gang.run` dispatch,
+   every tenant attributed, placements + rounds identical to solo gang
+   dispatch, and `soloFallbacks` NOT ticking (the old "gang passes are
+   not batch-eligible" fallback is gone).
 
 Exit 0 on pass. Small enough for CI (seconds, CPU-only): a sanity gate,
 not a benchmark — the throughput curve lives in
@@ -88,6 +93,7 @@ def main() -> int:
         0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     )
     from kube_scheduler_simulator_tpu.server.batchplane import (
+        BATCH_GANG_LABEL,
         BATCH_SEQ_LABEL,
         BatchPlane,
     )
@@ -179,6 +185,88 @@ def main() -> int:
     report["parity"] = not mismatches
     if mismatches:
         failures.append(f"solo/batched result divergence for sessions {mismatches}")
+
+    # gate 4 (gang): N tenants' gang passes (record=False) batch into
+    # ONE `batch.gang.run` dispatch — the vmapped fused fixpoint — with
+    # every tenant attributed on the one call and placements identical
+    # to solo gang dispatch. soloFallbacks must NOT tick: the old
+    # "gang passes are not batch-eligible" branch is gone.
+    solo_gang_mgr = SessionManager(
+        SimulatorService(), max_sessions=16, max_concurrent_passes=N
+    )
+    solo_gang = {}
+    for i in range(N):
+        sess, errs = solo_gang_mgr.create(
+            name=f"gsolo{i}", snapshot=_snapshot(i)
+        )
+        assert not errs, errs
+        placements, rounds, _ = sess.service.scheduler.schedule_gang(
+            record=False
+        )
+        solo_gang[i] = (placements, rounds)
+    solo_gang_mgr.shutdown()
+
+    gang_sessions = []
+    for i in range(N):
+        sess, errs = mgr.create(name=f"g{i}", snapshot=_snapshot(i))
+        assert not errs, errs
+        gang_sessions.append(sess)
+    gout: dict = {}
+    gerrors: dict = {}
+    gbarrier = threading.Barrier(N)
+
+    def grun(i):
+        try:
+            gbarrier.wait(timeout=60)
+            with mgr.pass_slot():
+                placements, rounds, _ = (
+                    gang_sessions[i].service.scheduler.schedule_gang(
+                        record=False
+                    )
+                )
+                gout[i] = (placements, rounds)
+        except Exception as e:  # noqa: BLE001 — reported below
+            gerrors[i] = repr(e)
+
+    gthreads = [threading.Thread(target=grun, args=(i,)) for i in range(N)]
+    for t in gthreads:
+        t.start()
+    for t in gthreads:
+        t.join(timeout=600)
+    if gerrors:
+        failures.append(f"batched gang passes raised: {gerrors}")
+    gang_recs = [
+        rec
+        for rec in ledger_mod.LEDGER.snapshot()["programs"]
+        if rec["label"] == BATCH_GANG_LABEL
+    ]
+    gang_calls = sum(rec["calls"] for rec in gang_recs)
+    gang_attributed = {sid for rec in gang_recs for sid in rec["sessions"]}
+    report["gangBatchDispatches"] = gang_calls
+    report["gangAttributedSessions"] = sorted(gang_attributed)
+    if gang_calls != 1:
+        failures.append(
+            f"expected 1 ledger-pinned gang dispatch, got {gang_calls}"
+        )
+    gmissing = {s.id for s in gang_sessions} - gang_attributed
+    if gmissing:
+        failures.append(
+            f"gang sessions missing from ledger attribution: {gmissing}"
+        )
+    gang_mismatch = [i for i in range(N) if gout.get(i) != solo_gang[i]]
+    report["gangParity"] = not gang_mismatch
+    if gang_mismatch:
+        failures.append(
+            f"solo/batched gang divergence for sessions {gang_mismatch}"
+        )
+    for i, s in enumerate(gang_sessions):
+        ph = s.service.scheduler.metrics.snapshot()["phases"]
+        if ph["batchedGangPasses"] != 1 or ph["soloFallbacks"] != 0:
+            failures.append(
+                f"gang session {i}: batchedGangPasses="
+                f"{ph['batchedGangPasses']} soloFallbacks="
+                f"{ph['soloFallbacks']} (want 1 / 0)"
+            )
 
     # gate 3: a lone tenant is bounded by ~one window
     lone_mgr = SessionManager(

@@ -16,7 +16,10 @@ Three gates, one JSON line:
    scheduled again: the crossing pass must record ZERO synchronous
    compiles on the request thread (`compileMisses` stays at the cold
    start's 1, the crossing served by the `speculativeCompiles == 1`
-   warm engine).
+   warm engine). The gang half of the gate: the fused whole-pass
+   program (`gang.fixpoint`) compiles ONCE per bucket — zero ledger
+   rebuilds, zero engine builds, and exactly one device dispatch per
+   pass across warm churn at a stable bucket.
 
 3. **The program ledger answers and diffs clean** — the whole run
    executes under `KSS_PROGRAM_LEDGER=1` (utils/ledger.py): the ledger
@@ -46,6 +49,20 @@ def _crossing_gate() -> "tuple[dict, list[str]]":
     from kube_scheduler_simulator_tpu.utils.broker import CompileBroker
     from kube_scheduler_simulator_tpu.utils.metrics import SchedulingMetrics
 
+    from kube_scheduler_simulator_tpu.utils import ledger as ledger_mod
+
+    def _fixpoint_builds() -> "dict[str, int]":
+        """builds per `gang.fixpoint` fingerprint — the ledger is
+        process-global (the lifecycle gate's engines share labels AND
+        fingerprints with ours), so every assertion below is a DELTA
+        over this gate's own lifetime."""
+        return {
+            p["fingerprint"]: p["builds"]
+            for p in ledger_mod.LEDGER.snapshot()["programs"]
+            if p["label"] == "gang.fixpoint"
+        }
+
+    builds_at_start = _fixpoint_builds()
     store = ResourceStore()
     for i in range(6):
         store.apply(
@@ -112,6 +129,72 @@ def _crossing_gate() -> "tuple[dict, list[str]]":
     bound = sum(1 for v in placements.values() if v)
     if bound < 20:
         problems.append(f"crossing pass scheduled too little ({bound}/20)")
+
+    # gate 2b (gang fusion): the fused whole-pass program
+    # (`gang.fixpoint`, engine/gang.py) compiles ONCE per bucket over
+    # this gate's whole lifetime (cold start + speculation + crossing)
+    # and stays warm across churn at a stable bucket — zero rebuilds,
+    # zero engine builds, exactly one device dispatch per warm pass
+    # (the one-dispatch contract, docs/performance.md "gang fixpoint
+    # on device").
+    builds_after_crossing = _fixpoint_builds()
+    overbuilt = {
+        fp: b - builds_at_start.get(fp, 0)
+        for fp, b in builds_after_crossing.items()
+        if b - builds_at_start.get(fp, 0) > 1
+    }
+    fields["gang_fixpoint_builds_delta"] = sum(
+        b - builds_at_start.get(fp, 0)
+        for fp, b in builds_after_crossing.items()
+    )
+    if not builds_after_crossing:
+        problems.append(
+            "fused gang program (gang.fixpoint) never reached the ledger"
+        )
+    if overbuilt:
+        problems.append(
+            f"fused gang program compiled more than once per bucket "
+            f"within one service: {overbuilt}"
+        )
+
+    def _fixpoint_calls() -> int:
+        return sum(
+            p["calls"]
+            for p in ledger_mod.LEDGER.snapshot()["programs"]
+            if p["label"] == "gang.fixpoint"
+        )
+
+    engine_builds_before = metrics.snapshot()["phases"]["engineBuilds"]
+    calls_before = _fixpoint_calls()
+    warm_passes = 3
+    for i in range(warm_passes):
+        store.apply("pods", churn_pod(f"warm-{i}"))  # 75 pods: bucket 128
+        svc.schedule_gang(record=False)
+    phases = metrics.snapshot()["phases"]
+    rebuilds = {
+        fp: b - builds_after_crossing.get(fp, 0)
+        for fp, b in _fixpoint_builds().items()
+        if b - builds_after_crossing.get(fp, 0) > 0
+    }
+    fields["gang_warm_engine_builds_delta"] = (
+        phases["engineBuilds"] - engine_builds_before
+    )
+    fields["gang_warm_dispatches"] = _fixpoint_calls() - calls_before
+    if rebuilds:
+        problems.append(
+            f"fused gang program recompiled across warm churn at a "
+            f"stable bucket: {rebuilds}"
+        )
+    if phases["engineBuilds"] != engine_builds_before:
+        problems.append(
+            f"warm gang churn at a stable bucket rebuilt engines "
+            f"({engine_builds_before} -> {phases['engineBuilds']})"
+        )
+    if fields["gang_warm_dispatches"] != warm_passes:
+        problems.append(
+            f"expected {warm_passes} fused dispatches for {warm_passes} "
+            f"warm gang passes, got {fields['gang_warm_dispatches']}"
+        )
     return fields, problems
 
 
@@ -251,6 +334,11 @@ def main() -> int:
     snap = result["metrics"]
     phases = snap.get("phases", {})
     wall = result["wallSeconds"]
+    # settle the lifecycle run's broker before the crossing gate opens:
+    # its watermark speculation may still be compiling in the
+    # background, and gate 2b's compile-once deltas must not count a
+    # prior stage's build landing mid-gate
+    eng.scheduler.broker.drain(timeout=600)
     crossing_fields, crossing_problems = _crossing_gate()
     ledger_fields, ledger_problems = _ledger_gate()
     line = {
