@@ -417,6 +417,137 @@ def _gang_sweep_probe(shape: str = "bench", window: "int | None" = None):
         print(json.dumps({**result, **extra}), flush=True)
 
 
+def _encoding_probe():
+    """Subprocess mode (`bench.py --encoding-probe`): the packed
+    low-precision encoding plane (KSS_DTYPE_POLICY=packed,
+    engine/packing.py) measured against the TPU32 baseline, one JSON
+    line. The vehicle is the label-rich affinity cluster — the shape the
+    bitpacked mask planes target (the plain synthetic cluster carries no
+    label vocabulary, so its presence planes are tiny and the byte win
+    understates). Per policy: encoded-cluster device bytes
+    (arrays/state0/total, the same accounting the perf-smoke packing
+    gate reads), host→device delta-transfer bytes for one warm bind
+    burst (DeltaEncoder.last_transfer_bytes), warm decisions/s, and
+    ledger-counted device dispatches per warm pass — the in-kernel
+    unpack contract is ZERO extra programs, so the counts must be equal.
+    Placements are cross-checked identical BEFORE the line is printed: a
+    byte win that moves a pod is a bug, not a result."""
+    import os
+
+    # arm the program ledger BEFORE any engine import (hooking happens
+    # at jit-wrap time): the probe certifies dispatch-count parity
+    os.environ["KSS_PROGRAM_LEDGER"] = "1"
+
+    import numpy as np
+
+    from kube_scheduler_simulator_tpu.engine import PACKED, TPU32, encode_cluster
+    from kube_scheduler_simulator_tpu.engine.delta import DeltaEncoder
+    from kube_scheduler_simulator_tpu.engine.engine import (
+        BatchedScheduler,
+        supported_config,
+    )
+    from kube_scheduler_simulator_tpu.engine.packing import encoded_device_bytes
+    from kube_scheduler_simulator_tpu.models.store import ResourceStore
+    from kube_scheduler_simulator_tpu.synth import synthetic_affinity_cluster
+    from kube_scheduler_simulator_tpu.utils import ledger as ledger_mod
+
+    fallback = bool(os.environ.get("_KSS_BENCH_CPU_FALLBACK"))
+    n_nodes = CPU_FALLBACK["AFF_NODES"] if fallback else AFF_NODES
+    n_pods = CPU_FALLBACK["AFF_PODS"] if fallback else AFF_PODS
+    nodes, pods = synthetic_affinity_cluster(n_nodes, n_pods, seed=11)
+    cfg = supported_config()
+    node_names = [m["metadata"]["name"] for m in nodes]
+
+    def _seq_calls():
+        # keyed (label, fingerprint): BOTH policies' programs share the
+        # "seq.run" label (a policy flip is a distinct compile, not a
+        # distinct site), so a label-only dict would hide one of them
+        return {
+            (rec["label"], rec["fingerprint"]): rec["calls"]
+            for rec in ledger_mod.LEDGER.snapshot()["programs"]
+            if rec["label"].startswith("seq.")
+        }
+
+    def measure(policy):
+        enc = encode_cluster(nodes, pods, cfg, policy=policy)
+        sc = BatchedScheduler(enc, record=False, unroll=UNROLL)
+
+        def once():
+            state, _ = sc.run()
+            return np.asarray(state.assignment)
+
+        placements = once()  # compile + warm
+        best = _best_of(once, reps=3)
+        # dispatches per WARM pass, as a ledger calls delta (reset()
+        # would orphan live record handles — see _gang_probe)
+        before = _seq_calls()
+        once()
+        dispatches = sum(
+            calls - before.get(label, 0)
+            for label, calls in _seq_calls().items()
+        )
+        # delta-transfer bytes: replay the same cluster through the
+        # watch-store path, then bind a burst — the bytes a warm tenant
+        # ships per reconcile under this policy (packed mask rows and
+        # narrowed int rows travel at their stored width)
+        store = ResourceStore()
+        for m in nodes:
+            store.apply("nodes", m)
+        for m in pods:
+            store.apply("pods", m)
+        delta = DeltaEncoder(policy=policy)
+        _, info = delta.encode(store, cfg)
+        assert info["mode"] == "full", info
+        for i in range(16):
+            store.apply(
+                "pods",
+                {
+                    **pods[i],
+                    "spec": {
+                        **pods[i]["spec"],
+                        "nodeName": node_names[i % len(node_names)],
+                    },
+                },
+            )
+        _, info = delta.encode(store, cfg)
+        return {
+            "device_bytes": encoded_device_bytes(enc),
+            "delta_transfer_bytes": int(delta.last_transfer_bytes),
+            "delta_mode": info["mode"],
+            "warm_dps": round(n_pods / best, 1),
+            "dispatches_per_pass": dispatches,
+        }, placements
+
+    base, base_asg = measure(TPU32)
+    packed, packed_asg = measure(PACKED)
+    if not np.array_equal(base_asg, packed_asg):
+        raise SystemExit(
+            "encoding-probe: PACKED placements diverge from TPU32"
+        )
+    result = {
+        "shape": f"{n_pods}x{n_nodes}",
+        "policies": {"tpu32": base, "packed": packed},
+        # the headline ratios: encoded-cluster device bytes and warm
+        # delta-transfer bytes, TPU32 over PACKED (>= 2x is the gate)
+        "bytes_ratio": round(
+            base["device_bytes"]["total"] / packed["device_bytes"]["total"],
+            2,
+        ),
+        "delta_bytes_ratio": round(
+            base["delta_transfer_bytes"]
+            / max(packed["delta_transfer_bytes"], 1),
+            2,
+        ),
+        "warm_dps_ratio": round(
+            packed["warm_dps"] / base["warm_dps"], 3
+        ),
+        "extra_dispatches": packed["dispatches_per_pass"]
+        - base["dispatches_per_pass"],
+        "placements_match": True,
+    }
+    print(json.dumps(result), flush=True)
+
+
 def _lifecycle_probe(events: int = 300, n_nodes: int = 64, seed_pods: int = 500):
     """Subprocess mode (`bench.py --lifecycle-probe`): the churn-heavy
     lifecycle measurement — a seeded Poisson arrival storm (plus cordon
@@ -1595,6 +1726,19 @@ def main(profile_dir: "str | None" = None):
         a_nodes, a_pods, cfg, reps=2, label="affinity"
     )
 
+    # 4b) encoded-cluster device bytes under the ACTIVE dtype policy
+    # (KSS_DTYPE_POLICY, engine/packing.py) on the affinity shape — the
+    # label-rich vehicle the bitpacked mask planes target. Always in the
+    # headline so a byte regression shows up in every campaign, not only
+    # when the full --encoding-probe subprocess runs.
+    from kube_scheduler_simulator_tpu.engine import policy_from_env
+    from kube_scheduler_simulator_tpu.engine.packing import encoded_device_bytes
+
+    enc_policy = policy_from_env()
+    enc_bytes = encoded_device_bytes(
+        encode_cluster(a_nodes, a_pods, cfg, policy=enc_policy)
+    )
+
     # oracle baseline: sequential python on a sample of the same workload
     oracle = Oracle(nodes, pods[:BASELINE_PODS], cfg)
     t0 = time.perf_counter()
@@ -1803,6 +1947,15 @@ def main(profile_dir: "str | None" = None):
         ["--fleet-probe"], 900.0, "fleet_baseline_dps", device=False
     )
 
+    # packed-encoding plane, PACKED vs TPU32 head-to-head (device bytes,
+    # delta-transfer bytes, warm dps parity, dispatch-count parity) —
+    # compiles the engine under both policies, so on an accelerator it
+    # gets device-probe containment like the cold-start probe
+    encoding_probe = _probe_json_subprocess(
+        ["--encoding-probe"], 900.0, "bytes_ratio",
+        device=not platform.startswith("cpu"),
+    )
+
     # time-to-first-scheduled-pod from a cold process (ROADMAP #1's
     # wished-for headline, docs/performance.md): a fresh subprocess
     # boots the serving path from nothing and reports its cold-start
@@ -1918,6 +2071,19 @@ def main(profile_dir: "str | None" = None):
                 # worker's time-to-first-scheduled-pod (docs/fleet.md)
                 "fleet": fleet
                 or {"error": "probe did not complete in its window"},
+                # the packed-encoding plane (docs/performance.md
+                # "Encoding widths"): encoded-cluster device bytes under
+                # the ACTIVE policy are always present; `probe` carries
+                # the PACKED-vs-TPU32 head-to-head (bytes_ratio,
+                # delta_bytes_ratio, warm_dps_ratio, extra_dispatches,
+                # placements_match) when the subprocess completes
+                "encoding": {
+                    "policy": enc_policy.name,
+                    "shape": f"{AFF_PODS}podsx{AFF_NODES}nodes",
+                    "deviceBytes": enc_bytes,
+                    "probe": encoding_probe
+                    or {"error": "probe did not complete in its window"},
+                },
                 # the memory trajectory hoisted to the headline (the
                 # fleet & memory observatory, docs/observability.md):
                 # peak device bytes over the churn run and how
@@ -2037,6 +2203,9 @@ if __name__ == "__main__":
         sys.exit(0)
     if "--sweep-preempt-probe" in sys.argv:
         _sweep_preempt_probe()
+        sys.exit(0)
+    if "--encoding-probe" in sys.argv:
+        _encoding_probe()
         sys.exit(0)
     def _shape_arg(allowed):
         shape = allowed[0]

@@ -8,7 +8,7 @@ spans are balanced, locks are acquired in one global order. Nothing in
 Python enforces any of that — the next PR can silently break all five.
 
 This package is the mechanical reviewer: an AST-based lint framework
-(`core.py`) with seven analyzers, each guarding one contract:
+(`core.py`) with eight analyzers, each guarding one contract:
 
   ===========  ==========================================================
   rules        contract
@@ -39,6 +39,11 @@ This package is the mechanical reviewer: an AST-based lint framework
                runs (runtime counterpart: KSS_JAXPR_AUDIT hook in
                broker.jit, fingerprints persisted next to the XLA
                compile cache)
+  KSS716       width-class — every `ClusterArrays` / `PodRelArrays`
+               field declares a width class (exact/id/count/mask) in
+               its module's WIDTH_CLASSES dict, no stale or unknown
+               entries (what keeps the PACKED dtype policy's
+               narrow/bitpack encode total, engine/packing.py)
   ===========  ==========================================================
 
 Run as tier-1 tests (tests/test_static_analysis.py), as a CLI

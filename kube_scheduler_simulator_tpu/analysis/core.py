@@ -208,6 +208,7 @@ def all_analyzers() -> "dict[str, object]":
         lock_order,
         metrics_registry,
         span_balance,
+        width_class,
     )
 
     return {
@@ -218,6 +219,7 @@ def all_analyzers() -> "dict[str, object]":
         "span-balance": span_balance.run,
         "guarded-state": guarded_state.run,
         "jaxpr-audit": jaxpr_audit.run,
+        "width-class": width_class.run,
     }
 
 

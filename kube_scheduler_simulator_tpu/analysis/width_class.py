@@ -1,0 +1,132 @@
+"""width-class analyzer (KSS716): every encoded plane declares its width.
+
+The PACKED dtype policy (engine/encode.py, engine/packing.py) stores the
+encoded cluster narrowed/bitpacked and widens it back inside the jitted
+trace. What keeps that sound is the WIDTH CLASS declaration: each field
+of the `ClusterArrays` / `PodRelArrays` dataclasses is classified as
+``exact`` (dtype untouched), ``id`` / ``count`` (narrow-int candidates),
+or ``mask`` (bitpack candidate) in a same-module dict (`WIDTH_CLASSES` /
+`REL_WIDTH_CLASSES`) that `put_field` consults at encode time. A field
+added WITHOUT a class would crash the packed encode at runtime — or
+worse, a stale entry would silently misclassify a renamed plane.
+
+  KSS716  an encoded-plane dataclass field with no width-class entry, a
+          width-class entry naming no field (stale), an entry whose
+          value is outside {exact, id, count, mask}, or an encoded-plane
+          module missing its width-class dict entirely.
+
+Purely syntactic (AST over the declaring modules), so the rule is
+negative-testable on synthetic trees like the other analyzers.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, RepoContext, SourceTree
+
+# encoded-plane dataclass -> the same-module dict declaring its widths
+PLANES = {
+    "ClusterArrays": "WIDTH_CLASSES",
+    "PodRelArrays": "REL_WIDTH_CLASSES",
+}
+WIDTHS = frozenset({"exact", "id", "count", "mask"})
+# fields that are not device planes: nested dataclasses carry their own
+# width table
+_SKIP_FIELDS = frozenset({"rel"})
+
+
+def _class_fields(node: ast.ClassDef) -> "list[tuple[str, int]]":
+    """The dataclass's annotated field names with line numbers."""
+    out: list[tuple[str, int]] = []
+    for stmt in node.body:
+        if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+            name = stmt.target.id
+            if name not in _SKIP_FIELDS and not name.startswith("_"):
+                out.append((name, stmt.lineno))
+    return out
+
+
+def _dict_literal(tree: ast.Module, name: str):
+    """The module-level dict literal assigned to `name` (plain or
+    annotated assignment), or None."""
+    for stmt in tree.body:
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = [t for t in stmt.targets if isinstance(t, ast.Name)]
+        elif isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+            targets = [stmt.target]
+        if any(t.id == name for t in targets) and isinstance(
+            stmt.value, ast.Dict
+        ):
+            return stmt.value
+    return None
+
+
+def run(tree: SourceTree, repo: RepoContext) -> "list[Finding]":
+    findings: list[Finding] = []
+    for sf in tree.files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ClassDef) or node.name not in PLANES:
+                continue
+            dict_name = PLANES[node.name]
+            fields = _class_fields(node)
+            decl = _dict_literal(sf.tree, dict_name)
+            if decl is None:
+                findings.append(
+                    Finding(
+                        "KSS716",
+                        sf.rel,
+                        node.lineno,
+                        f"encoded plane {node.name} has no {dict_name} "
+                        f"width-class dict in its module",
+                        hint=f"declare {dict_name} = {{field: "
+                        f"'exact'|'id'|'count'|'mask', ...}} next to "
+                        f"{node.name}",
+                    )
+                )
+                continue
+            declared: dict[str, tuple[object, int]] = {}
+            for k, v in zip(decl.keys, decl.values):
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    val = v.value if isinstance(v, ast.Constant) else None
+                    declared[k.value] = (val, k.lineno)
+            field_names = {name for name, _ in fields}
+            for name, lineno in fields:
+                if name not in declared:
+                    findings.append(
+                        Finding(
+                            "KSS716",
+                            sf.rel,
+                            lineno,
+                            f"{node.name}.{name} declares no width class "
+                            f"in {dict_name}",
+                            hint="add the field to the dict with one of "
+                            "exact/id/count/mask",
+                        )
+                    )
+            for name, (val, lineno) in sorted(declared.items()):
+                if val not in WIDTHS:
+                    findings.append(
+                        Finding(
+                            "KSS716",
+                            sf.rel,
+                            lineno,
+                            f"{dict_name}[{name!r}] is {val!r}, not one of "
+                            f"exact/id/count/mask",
+                            hint="use a supported width class",
+                        )
+                    )
+                if name not in field_names:
+                    findings.append(
+                        Finding(
+                            "KSS716",
+                            sf.rel,
+                            lineno,
+                            f"{dict_name} entry {name!r} names no "
+                            f"{node.name} field (stale)",
+                            hint="drop the stale entry (or restore the "
+                            "field)",
+                        )
+                    )
+    return findings

@@ -22,7 +22,16 @@ converted on the host to the reference's exact 13-annotation wire format
 reference's result stores produce.
 """
 
-from .encode import EncodedCluster, ClusterArrays, SchedState, encode_cluster, EXACT, TPU32
+from .encode import (
+    EncodedCluster,
+    ClusterArrays,
+    SchedState,
+    encode_cluster,
+    policy_from_env,
+    EXACT,
+    TPU32,
+    PACKED,
+)
 from .engine import BatchedScheduler
 from .gang import GangScheduler
 
@@ -31,8 +40,10 @@ __all__ = [
     "ClusterArrays",
     "SchedState",
     "encode_cluster",
+    "policy_from_env",
     "BatchedScheduler",
     "GangScheduler",
     "EXACT",
     "TPU32",
+    "PACKED",
 ]

@@ -93,6 +93,12 @@ from .encode_rel import (
     parse_pod_spread,
 )
 from .encode_vol import pod_disk_vol_rows
+from .packing import (
+    encoded_device_bytes,
+    pack_bits_np,
+    rows_fit,
+    unpack_bits_np,
+)
 
 
 class _Fallback(Exception):
@@ -282,8 +288,19 @@ class _Retained:
         # int64-fill-then-cast exactly (mod 2^32). Copied: np views of
         # device buffers are read-only and appends write rows in place.
         a = enc.arrays
+        pd = enc.aux.get("packed_dims") or {}
+
+        def mirror(name):
+            v = np.asarray(getattr(a, name))
+            n = pd.get(name)
+            if n is not None and v.dtype == np.uint32:
+                # PACKED bitpacks this plane; the mirror keeps the
+                # LOGICAL bool rows the binding math reads
+                return unpack_bits_np(v, n)
+            return v.copy()
+
         self.m = {
-            name: np.asarray(getattr(a, name)).copy()
+            name: mirror(name)
             for name in (
                 "pod_req", "pod_sreq", "want_pair", "want_wild", "want_trip",
                 "pod_claim", "pod_disk_any", "pod_disk_rw", "pod_vol3",
@@ -324,6 +341,10 @@ class DeltaEncoder:
         self.pod_lo = pod_lo
         self.max_dirty_frac = max_dirty_frac
         self._st: "_Retained | None" = None
+        # host->device bytes the LAST encode() shipped: the full encoded
+        # cluster on a full pass, the dirty row stacks on a delta pass,
+        # zero on cached/empty passes (bench.py --encoding-probe reads it)
+        self.last_transfer_bytes = 0
 
     def invalidate(self) -> None:
         self._st = None
@@ -332,11 +353,17 @@ class DeltaEncoder:
 
     def encode(self, store: ResourceStore, config):
         rv = store.latest_rv()
+        self.last_transfer_bytes = 0
         st = self._st
         if st is None:
             return self._full(store, config, rv, "cold-start")
         if st.config is not config:
             return self._full(store, config, rv, "config-change")
+        if st.enc.policy is not self.policy:
+            # a KSS_DTYPE_POLICY flip mid-run: the retained tensors carry
+            # the OLD policy's widths — scattering new-policy rows into
+            # them would corrupt silently, so re-encode from scratch
+            return self._full(store, config, rv, "dtype-policy-change")
         if rv == st.rv:
             enc = st.enc
             return (enc if len(enc.queue) else None), {"mode": "cached"}
@@ -381,6 +408,7 @@ class DeltaEncoder:
             pod_capacity=pcap,
         )
         self._st = _Retained(enc, rv, config)
+        self.last_transfer_bytes = encoded_device_bytes(enc)["total"]
         return enc, info
 
     # -- delta path ----------------------------------------------------------
@@ -514,27 +542,54 @@ class DeltaEncoder:
         new_rel = new_arrays.rel
         new_state0 = enc.state0
         rel_fields = set(type(new_rel).__dataclass_fields__)
+        packed_dims = enc.aux.get("packed_dims") or {}
+        xfer = 0
+
+        def row_bytes(arr, idx, rows):
+            return (int(np.dtype(arr.dtype).itemsize)
+                    * int(np.prod(np.shape(rows[0]), dtype=np.int64))
+                    + 4) * len(idx)
+
         arr_updates = {}
         rel_updates = {}
         for field, (idx, rows) in arr_set.items():
+            arr = getattr(
+                new_rel if field in rel_fields else new_arrays, field
+            )
+            if field in packed_dims:
+                # PACKED bitpacks this plane: ship the dirty rows as the
+                # same uint32 words the full encode stores
+                rows = [pack_bits_np(r) for r in rows]
+            elif not rows_fit(rows, arr.dtype):
+                # a dirty row overflows the narrowed tensor — `.at[].set`
+                # would wrap silently; the full re-encode re-runs the fit
+                # rule and lands this field on its wide fallback dtype
+                raise _Fallback("packed-overflow")
+            xfer += row_bytes(arr, idx, rows)
             if field in rel_fields:
-                rel_updates[field] = _apply_set(getattr(new_rel, field), idx, rows)
+                rel_updates[field] = _apply_set(arr, idx, rows)
             else:
-                arr_updates[field] = _apply_set(getattr(new_arrays, field), idx, rows)
+                arr_updates[field] = _apply_set(arr, idx, rows)
         if rel_updates:
             new_rel = new_rel.replace(**rel_updates)
         if rel_updates or arr_updates:
             new_arrays = new_arrays.replace(rel=new_rel, **arr_updates)
         st0_updates = {}
         for field, (idx, rows) in st0_add.items():
-            st0_updates[field] = _apply_add(getattr(new_state0, field), idx, rows)
+            arr = getattr(new_state0, field)
+            xfer += row_bytes(arr, idx, rows)
+            st0_updates[field] = _apply_add(arr, idx, rows)
         for field, (idx, rows) in st0_set.items():
-            st0_updates[field] = _apply_set(getattr(new_state0, field), idx, rows)
+            arr = getattr(new_state0, field)
+            xfer += row_bytes(arr, idx, rows)
+            st0_updates[field] = _apply_set(arr, idx, rows)
         if claims_dirty:
             st0_updates["used_claims"] = _vec_add(
                 new_state0.used_claims,
                 jnp.asarray(claims_delta, new_state0.used_claims.dtype),
             )
+            xfer += int(new_state0.used_claims.nbytes)
+        self.last_transfer_bytes = xfer
         if st0_updates:
             new_state0 = new_state0.replace(**st0_updates)
 
@@ -744,8 +799,15 @@ class DeltaEncoder:
                 or _preferred_terms(pv.pod_anti_affinity)
             ):
                 raise _Fallback("pod carries inter-pod affinity")
-            pair_row = np.zeros(rel.pair_present.shape[1], bool)
-            key_row = np.zeros(rel.key_present.shape[1], bool)
+            # LOGICAL row widths: under PACKED these planes store uint32
+            # words, so shape[1] is the word count, not the lane count
+            pd = aux.get("packed_dims") or {}
+            pair_row = np.zeros(
+                pd.get("pair_present", rel.pair_present.shape[1]), bool
+            )
+            key_row = np.zeros(
+                pd.get("key_present", rel.key_present.shape[1]), bool
+            )
             for k, v in pv.labels.items():
                 key_row[cb_ng.key_vocab.intern(k)] = True
                 pair_row[cb_ng.pair_id(k, str(v))] = True

@@ -14,16 +14,25 @@ whole cluster once into padded, statically-shaped arrays:
     padding + boolean masks, keeping XLA shapes static (SURVEY.md §7 hard
     part #5).
 
-Two dtype policies:
+Three dtype policies:
   * EXACT — int64/float64 (tests, CPU): bit-identical to the pure-Python
     oracle's integer semantics for arbitrary quantities;
   * TPU32 — int32/float32 with per-resource unit scaling (memory in Mi):
     native TPU dtypes; exact whenever quantities are Mi-granular, which
-    real manifests are.
+    real manifests are;
+  * PACKED — TPU32 semantics with packed storage (engine/packing.py):
+    id/count columns narrow to int8/int16, boolean planes bitpack into
+    uint32 lanes, kernels widen in-trace — placements and trace bytes
+    stay byte-identical to TPU32, the encoded cluster shrinks.
+
+Every `ClusterArrays` field declares a width class in `WIDTH_CLASSES`
+(exact / id / count / mask — enforced by kss-lint KSS716) so new fields
+can't silently default to int32 under PACKED.
 """
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import Any
 
@@ -42,6 +51,7 @@ from ..models.objects import (
 from ..models.vocab import Vocab
 from ..sched.config import SchedulerConfiguration
 from ..sched.resources import to_int_resources
+from .packing import put_field
 
 # Node index sentinels in pod_node_name: -1 = no nodeName requested,
 # -2 = names a node that does not exist (fails NodeName everywhere,
@@ -63,6 +73,11 @@ class DTypePolicy:
     score: Any
     flt: Any
     scale_bytes: bool = False  # divide bytes-like resources by 2**20 (Mi)
+    # storage-width reduction (engine/packing.py): id/count columns narrow
+    # to int8/int16, mask planes bitpack into uint32 words, kernels widen
+    # in-trace. Kernel arithmetic is untouched, so placements and trace
+    # bytes stay identical to the same policy without `packed`.
+    packed: bool = False
 
     def divisor(self, resource: str) -> int:
         if self.scale_bytes and (
@@ -87,6 +102,27 @@ class DTypePolicy:
 
 EXACT = DTypePolicy("exact", jnp.int64, jnp.int64, jnp.float64)
 TPU32 = DTypePolicy("i32", jnp.int32, jnp.int32, jnp.float32, scale_bytes=True)
+# TPU32 semantics (same unit scaling, same int32 kernel arithmetic, same
+# placements) with packed storage: the at-rest encoding and the delta
+# encoder's host→device row updates shrink, the trace does not change.
+PACKED = DTypePolicy(
+    "packed", jnp.int32, jnp.int32, jnp.float32, scale_bytes=True, packed=True
+)
+
+_POLICIES = {
+    "exact": EXACT,
+    "i32": TPU32,
+    "tpu32": TPU32,
+    "packed": PACKED,
+}
+
+
+def policy_from_env() -> DTypePolicy:
+    """The dtype policy selected by KSS_DTYPE_POLICY (default TPU32 — the
+    serving default since the first engine). Unknown spellings fall back
+    to TPU32; `utils/envcheck.py` rejects them up front in strict mode."""
+    raw = os.environ.get("KSS_DTYPE_POLICY", "").strip().lower()
+    return _POLICIES.get(raw, TPU32)
 
 
 # Taint/toleration effect ids.
@@ -170,6 +206,73 @@ class ClusterArrays:
     pod_vol3: jnp.ndarray  # [P, V3] int32 per-type volume counts
     # pod-relational encodings (PodTopologySpread, InterPodAffinity)
     rel: Any  # PodRelArrays (encode_rel.py)
+
+
+# Width class per ClusterArrays field (kss-lint KSS716: every field must
+# appear here; `rel` nests PodRelArrays, classed in encode_rel.py).
+#   exact — kernel arithmetic operand, dtype is the policy's (capacities,
+#           requests, Gt/Lt numerics, image byte sums, priorities);
+#   id    — vocab ids / node indices: int16 when values fit (enum
+#           families in ENUM8 go int8);
+#   count — small counters / weights: int16 when values fit;
+#   mask  — bool planes: bitpacked per engine/packing.py rules.
+WIDTH_CLASSES: "dict[str, str]" = {
+    "node_alloc": "exact",
+    "node_unsched": "mask",
+    "node_mask": "mask",
+    "pod_req": "exact",
+    "pod_sreq": "exact",
+    "pod_req_rank": "count",
+    "pod_node_name": "id",
+    "pod_tol_unsched": "mask",
+    "pod_priority": "exact",  # k8s priorities reach 2e9 (system-critical)
+    "pod_mask": "mask",
+    "taint_key": "id",
+    "taint_val": "id",
+    "taint_effect": "id",
+    "tol_key": "id",
+    "tol_val": "id",
+    "tol_effect": "id",
+    "tol_op": "id",
+    "label_val": "id",
+    "label_num": "exact",
+    "label_num_ok": "mask",
+    "nsel_key": "id",
+    "nsel_val": "id",
+    "raff_key": "id",
+    "raff_op": "id",
+    "raff_vals": "id",
+    "raff_num": "exact",
+    "raff_num_ok": "mask",
+    "raff_term_valid": "mask",
+    "pod_has_raff": "mask",
+    "paff_key": "id",
+    "paff_op": "id",
+    "paff_vals": "id",
+    "paff_num": "exact",
+    "paff_num_ok": "mask",
+    "paff_weight": "count",
+    "paff_term_valid": "mask",
+    "want_wild": "count",
+    "want_trip": "count",
+    "want_pair": "count",
+    "trip_pair": "id",
+    "img_contrib": "exact",
+    "pod_img": "count",
+    "pod_ncont": "count",
+    "vb_row": "id",
+    "vb_code": "id",
+    "vz_code": "id",
+    "vb_pf": "id",
+    "pod_claim": "mask",
+    "pod_disk_any": "count",
+    "pod_disk_rw": "count",
+    "pod_vol3": "count",
+}
+
+# id-class fields whose values are tiny closed enums (effect/op ids in
+# [-2, 6]) — these narrow all the way to int8.
+ENUM8 = frozenset({"taint_effect", "tol_effect", "tol_op", "raff_op", "paff_op"})
 
 
 @chex.dataclass
@@ -744,6 +847,7 @@ def encode_cluster(
         label_keys=label_keys,
         constraints=pod_constraints,
         namespaces=namespaces,
+        policy=policy,
     )
     want_pair = port_arrays["want_pair"]
     Q = want_pair.shape[1]
@@ -786,28 +890,48 @@ def encode_cluster(
     queue = np.asarray(pending, np.int32)
 
     num_dt = policy.res  # Gt/Lt numerics and image sums share the res dtype
+    res_dtypes = {  # exact-class fields that carry the policy's res dtype
+        "node_alloc": policy.res,
+        "pod_req": policy.res,
+        "pod_sreq": policy.res,
+        "label_num": num_dt,
+        "raff_num": num_dt,
+        "paff_num": num_dt,
+        "img_contrib": num_dt,
+    }
+    host_arrays = dict(
+        node_alloc=node_alloc,
+        node_unsched=node_unsched,
+        node_mask=node_mask,
+        pod_req=pod_req,
+        pod_sreq=pod_sreq,
+        pod_req_rank=pod_req_rank,
+        pod_node_name=pod_node_name,
+        pod_tol_unsched=pod_tol_unsched,
+        pod_priority=pod_priority,
+        pod_mask=pod_mask,
+        **taint_arrays,
+        **label_arrays,
+        **port_arrays,
+        **img_arrays,
+        **vol_arrays,
+    )
+    # logical last dim of every field the PACKED policy actually bitpacked
+    # (engine/packing.py layout); rel contributes its own via rel_aux
+    packed_dims: "dict[str, int]" = dict(rel_aux.pop("packed_dims", {}))
     arrays = ClusterArrays(
-        node_alloc=jnp.asarray(node_alloc, policy.res),
-        node_unsched=jnp.asarray(node_unsched),
-        node_mask=jnp.asarray(node_mask),
-        pod_req=jnp.asarray(pod_req, policy.res),
-        pod_sreq=jnp.asarray(pod_sreq, policy.res),
-        pod_req_rank=jnp.asarray(pod_req_rank),
-        pod_node_name=jnp.asarray(pod_node_name),
-        pod_tol_unsched=jnp.asarray(pod_tol_unsched),
-        pod_priority=jnp.asarray(pod_priority),
-        pod_mask=jnp.asarray(pod_mask),
-        **{k: jnp.asarray(v) for k, v in taint_arrays.items()},
         **{
-            k: jnp.asarray(v, num_dt if k in ("label_num", "raff_num", "paff_num") else None)
-            for k, v in label_arrays.items()
+            k: put_field(
+                k,
+                v,
+                WIDTH_CLASSES[k],
+                policy=policy,
+                enum8=ENUM8,
+                packed_dims=packed_dims,
+                dtype=res_dtypes.get(k),
+            )
+            for k, v in host_arrays.items()
         },
-        **{k: jnp.asarray(v) for k, v in port_arrays.items()},
-        **{
-            k: jnp.asarray(v, num_dt if k == "img_contrib" else None)
-            for k, v in img_arrays.items()
-        },
-        **{k: jnp.asarray(v) for k, v in vol_arrays.items()},
         rel=rel,
     )
     state0 = SchedState(
@@ -848,6 +972,7 @@ def encode_cluster(
             "label_vals": label_vals,
             "res_vocab": res_vocab,
             "topo_keys": set(topo_keys),
+            "packed_dims": packed_dims,
         },
     )
     # Retained for the kernel builders that consume them (volume-binding

@@ -26,6 +26,7 @@ import numpy as np
 
 from ..models.vocab import Vocab
 from ..sched.oracle_plugins import spread_log_weight
+from .packing import put_field
 
 PAIR_ANY, NOTIN, EXISTS, DNE, NEVER = 0, 1, 2, 3, 4
 CL_PAD = -1
@@ -90,6 +91,64 @@ class PodRelArrays:
     ipan_nsall: jnp.ndarray
     ipan_ns: jnp.ndarray
     ipan_weight: jnp.ndarray  # [P, T] int32
+
+
+# Width class per PodRelArrays field (kss-lint KSS716; classes as in
+# engine/encode.py WIDTH_CLASSES — field names are unique across the two
+# dataclasses, so the delta encoder and unpacker use one flat namespace).
+REL_WIDTH_CLASSES: "dict[str, str]" = {
+    "pair_present": "mask",
+    "key_present": "mask",
+    "ns_id": "id",
+    "deleted": "mask",
+    "node_pair": "id",
+    "sph_key": "id",
+    "sph_skew": "count",
+    "sph_self": "mask",
+    "sph_ctype": "id",
+    "sph_ckey": "id",
+    "sph_cpairs": "id",
+    "sps_key": "id",
+    "sps_skew": "count",
+    "sps_host": "mask",
+    "sps_ctype": "id",
+    "sps_ckey": "id",
+    "sps_cpairs": "id",
+    "req_all": "mask",
+    "spread_lut": "exact",  # fixed-point log weights, full int32 range
+    "ia_key": "id",
+    "ia_ctype": "id",
+    "ia_ckey": "id",
+    "ia_cpairs": "id",
+    "ia_nsall": "mask",
+    "ia_ns": "mask",
+    "ia_self": "mask",
+    "ian_key": "id",
+    "ian_ctype": "id",
+    "ian_ckey": "id",
+    "ian_cpairs": "id",
+    "ian_nsall": "mask",
+    "ian_ns": "mask",
+    "ipa_key": "id",
+    "ipa_ctype": "id",
+    "ipa_ckey": "id",
+    "ipa_cpairs": "id",
+    "ipa_nsall": "mask",
+    "ipa_ns": "mask",
+    "ipa_weight": "count",
+    "ipan_key": "id",
+    "ipan_ctype": "id",
+    "ipan_ckey": "id",
+    "ipan_cpairs": "id",
+    "ipan_nsall": "mask",
+    "ipan_ns": "mask",
+    "ipan_weight": "count",
+}
+
+# clause-type ids are the tiny closed enum above (PAIR_ANY..NEVER, CL_PAD)
+REL_ENUM8 = frozenset(
+    {"sph_ctype", "sps_ctype", "ia_ctype", "ian_ctype", "ipa_ctype", "ipan_ctype"}
+)
 
 
 class _ClauseBuilder:
@@ -227,6 +286,7 @@ def encode_pod_relations(
     label_keys: Vocab,
     constraints,
     namespaces: "list[dict] | None" = None,
+    policy=None,
 ) -> tuple[PodRelArrays, dict]:
     """Build PodRelArrays.
 
@@ -384,55 +444,74 @@ def encode_pod_relations(
 
     lut = np.asarray([spread_log_weight(m) for m in range(N + 2)], np.int32)
 
-    rel = PodRelArrays(
-        pair_present=jnp.asarray(pair_present),
-        key_present=jnp.asarray(key_present),
-        ns_id=jnp.asarray(ns_id),
-        deleted=jnp.asarray(deleted),
-        node_pair=jnp.asarray(node_pair),
-        sph_key=jnp.asarray(hk),
-        sph_skew=jnp.asarray(hs),
-        sph_self=jnp.asarray(hself),
-        sph_ctype=jnp.asarray(hct),
-        sph_ckey=jnp.asarray(hck),
-        sph_cpairs=jnp.asarray(hcp),
-        sps_key=jnp.asarray(sk),
-        sps_skew=jnp.asarray(ss_),
-        sps_host=jnp.asarray(shost),
-        sps_ctype=jnp.asarray(sct),
-        sps_ckey=jnp.asarray(sck),
-        sps_cpairs=jnp.asarray(scp),
-        req_all=jnp.asarray(req_all),
-        spread_lut=jnp.asarray(lut),
-        ia_key=jnp.asarray(iak),
-        ia_ctype=jnp.asarray(iact),
-        ia_ckey=jnp.asarray(iack),
-        ia_cpairs=jnp.asarray(iacp),
-        ia_nsall=jnp.asarray(iana),
-        ia_ns=jnp.asarray(ians_),
-        ia_self=jnp.asarray(iaself),
-        ian_key=jnp.asarray(nk),
-        ian_ctype=jnp.asarray(nct),
-        ian_ckey=jnp.asarray(nck),
-        ian_cpairs=jnp.asarray(ncp),
-        ian_nsall=jnp.asarray(nna),
-        ian_ns=jnp.asarray(nns),
-        ipa_key=jnp.asarray(pak),
-        ipa_ctype=jnp.asarray(pact),
-        ipa_ckey=jnp.asarray(pack_),
-        ipa_cpairs=jnp.asarray(pacp),
-        ipa_nsall=jnp.asarray(pana),
-        ipa_ns=jnp.asarray(pans),
-        ipa_weight=jnp.asarray(paw),
-        ipan_key=jnp.asarray(qk),
-        ipan_ctype=jnp.asarray(qct),
-        ipan_ckey=jnp.asarray(qck),
-        ipan_cpairs=jnp.asarray(qcp),
-        ipan_nsall=jnp.asarray(qna),
-        ipan_ns=jnp.asarray(qns),
-        ipan_weight=jnp.asarray(qw),
+    rel_host = dict(
+        pair_present=pair_present,
+        key_present=key_present,
+        ns_id=ns_id,
+        deleted=deleted,
+        node_pair=node_pair,
+        sph_key=hk,
+        sph_skew=hs,
+        sph_self=hself,
+        sph_ctype=hct,
+        sph_ckey=hck,
+        sph_cpairs=hcp,
+        sps_key=sk,
+        sps_skew=ss_,
+        sps_host=shost,
+        sps_ctype=sct,
+        sps_ckey=sck,
+        sps_cpairs=scp,
+        req_all=req_all,
+        spread_lut=lut,
+        ia_key=iak,
+        ia_ctype=iact,
+        ia_ckey=iack,
+        ia_cpairs=iacp,
+        ia_nsall=iana,
+        ia_ns=ians_,
+        ia_self=iaself,
+        ian_key=nk,
+        ian_ctype=nct,
+        ian_ckey=nck,
+        ian_cpairs=ncp,
+        ian_nsall=nna,
+        ian_ns=nns,
+        ipa_key=pak,
+        ipa_ctype=pact,
+        ipa_ckey=pack_,
+        ipa_cpairs=pacp,
+        ipa_nsall=pana,
+        ipa_ns=pans,
+        ipa_weight=paw,
+        ipan_key=qk,
+        ipan_ctype=qct,
+        ipan_ckey=qck,
+        ipan_cpairs=qcp,
+        ipan_nsall=qna,
+        ipan_ns=qns,
+        ipan_weight=qw,
     )
-    aux = {"n_node_pairs": len(node_pair_vocab), "clause_builder": cb, "ns_vocab": ns_vocab}
+    packed_dims: "dict[str, int]" = {}
+    rel = PodRelArrays(
+        **{
+            k: put_field(
+                k,
+                v,
+                REL_WIDTH_CLASSES[k],
+                policy=policy,
+                enum8=REL_ENUM8,
+                packed_dims=packed_dims,
+            )
+            for k, v in rel_host.items()
+        }
+    )
+    aux = {
+        "n_node_pairs": len(node_pair_vocab),
+        "clause_builder": cb,
+        "ns_vocab": ns_vocab,
+        "packed_dims": packed_dims,
+    }
     return rel, aux
 
 

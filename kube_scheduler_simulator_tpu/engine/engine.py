@@ -35,6 +35,7 @@ from ..sched.results import (
 from ..utils import broker as broker_mod
 from . import kernels as K
 from .encode import EncodedCluster
+from .packing import make_unpacker
 
 class UnsupportedPluginError(NotImplementedError):
     pass
@@ -325,6 +326,11 @@ class BatchedScheduler:
             (tuple(leaf.shape), str(leaf.dtype))
             for leaf in jax.tree.leaves((enc.arrays, enc.state0))
         )
+        # PACKED: the word count ceil(n/32) is not injective in the
+        # logical lane count, so two encodings with equal leaf shapes can
+        # still unpack differently — the logical dims are program statics
+        # and must key the compile (and the AOT bundle) themselves.
+        packed_dims = tuple(sorted((enc.aux.get("packed_dims") or {}).items()))
         filter_names = [
             n for n in enc.config.enabled("filter") if n in K.FILTER_KERNELS
         ]
@@ -354,6 +360,7 @@ class BatchedScheduler:
             record,
             custom_statics,
             shapes,
+            packed_dims,
         )
         memo[mkey] = sig
         return sig
@@ -386,9 +393,17 @@ class BatchedScheduler:
         s_kernels = self._s_kernels
         s_normalize = self._s_normalize
         preempt_fn = self._preempt
+        # PACKED policy: widen the packed cluster planes back to the
+        # logical int32/bool form at the top of every exposed closure —
+        # inside the trace, so the unpack fuses into the one scheduling
+        # dispatch. Identity for EXACT/TPU32 and idempotent (dtype-driven,
+        # static at trace time), so internal reuse costs nothing.
+        unpack = make_unpacker(enc)
+        packed_bf16 = getattr(enc.policy, "packed", False)
 
         def attempt(state, a, weights, p):
             """One full Filter→Score→Normalize→select pass for pod p."""
+            a = unpack(a)
             if pf_kernels:
                 pf_codes = jnp.stack([k(a, state, p) for k in pf_kernels])
                 pf_ok = (pf_codes == 0).all()
@@ -420,6 +435,22 @@ class BatchedScheduler:
                         normed = mode(a, state, p, r, feasible)  # "custom"
                     else:
                         normed = r
+                    if packed_bf16 and not callable(mode):
+                        # bf16 score lane (PACKED): integers in [0, 256]
+                        # are exactly representable in bfloat16, so the
+                        # round-trip is lossless precisely where the
+                        # elementwise guard applies it and every other
+                        # lane rides through untouched — `final` (hence
+                        # every placement and trace byte) is identical
+                        # to TPU32 while the normalized plane runs
+                        # through bf16 storage.
+                        nb = normed.astype(score_dt)
+                        safe = (nb >= 0) & (nb <= 256)
+                        normed = jnp.where(
+                            safe,
+                            nb.astype(jnp.bfloat16).astype(score_dt),
+                            nb,
+                        )
                     finals.append(normed.astype(score_dt) * weights[j])
                 final = jnp.stack(finals, axis=1)  # [N,S]
                 total = final.sum(axis=1)
@@ -438,6 +469,7 @@ class BatchedScheduler:
             # p < 0 marks a queue-bucket padding step (run() pads the
             # scan to its geometric bucket): every write is gated off so
             # the step is an exact no-op on the carried state.
+            a = unpack(a)
             ok = p >= 0
             ps = jnp.maximum(p, 0)
             sel = jnp.where(ok, sel, jnp.int32(-1))
@@ -475,6 +507,7 @@ class BatchedScheduler:
         def evict_all(state, a, mask):
             """Remove every masked pod from its node (preemption victims;
             oracle Oracle.evict)."""
+            a = unpack(a)
             tgtv = jnp.maximum(state.assignment, 0)
             mf = mask.astype(a.pod_req.dtype)[:, None]
             mi = mask.astype(jnp.int32)
@@ -611,7 +644,11 @@ class BatchedScheduler:
         def run_segment(arrays, state, queue_seg, qis, weights):
             # one scan over a queue segment, resuming from `state` with
             # explicit global step indices — the chunked-trace primitive
-            # (run_chunked) and the building block of the full run
+            # (run_chunked) and the building block of the full run.
+            # Packed planes widen ONCE here, outside the scan, so the
+            # carry holds the logical arrays and per-step unpacks are
+            # static no-ops.
+            arrays = unpack(arrays)
             (state, _, _), out = jax.lax.scan(
                 step, (state, arrays, weights), (queue_seg, qis), unroll=self.unroll
             )

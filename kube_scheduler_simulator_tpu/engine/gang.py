@@ -94,6 +94,7 @@ from ..utils import broker as broker_mod
 from . import kernels as K
 from .encode import EncodedCluster
 from .engine import BatchedScheduler
+from .packing import make_unpacker
 
 # queue-position value that can never win a scatter-min
 _NO_ORDER = jnp.iinfo(jnp.int32).max
@@ -510,6 +511,11 @@ class GangScheduler:
 
         compact = self.compact
         W = self.eval_window
+        # PACKED-policy widening (engine/packing.py): identity for
+        # EXACT/TPU32, idempotent for PACKED — each exposed closure
+        # unpacks defensively (faultsweep jits `_bind_all` directly
+        # against the raw encoding) while the outer drivers unpack once.
+        unpack = make_unpacker(enc)
 
         def pod_score_row(state, a, weights, p):
             """[N] masked total score of pod p against `state` (NEG
@@ -636,6 +642,7 @@ class GangScheduler:
             """Scatter-bind every masked pod to its selected node in one
             update (the batched form of engine.py's per-pod `bind`;
             unmasked rows contribute zeros to node row 0)."""
+            a = unpack(a)
             tgt = jnp.where(mask, jnp.maximum(sel, 0), 0)
             mf = mask.astype(a.pod_req.dtype)[:, None]
             mi = mask.astype(jnp.int32)
@@ -670,7 +677,7 @@ class GangScheduler:
             semantics, reference wrappedplugin.go:518-546), expressed with
             the gang module's mask-vector bind so padded rows are exact
             no-ops. Returns (state, pods bound this phase)."""
-            a = arrays
+            a = unpack(arrays)
 
             def pstep(state, p_raw):
                 valid = p_raw >= 0
@@ -1038,6 +1045,7 @@ class GangScheduler:
             compiled program reusable across retargets and lets sweeps
             vmap over `weights` alone.
             """
+            arrays = unpack(arrays)
             round_once = make_round_once(arrays, order, weights)
 
             def cond(carry):
@@ -1119,6 +1127,7 @@ class GangScheduler:
             that bound it. A separate program so the default (chip-
             proven) compile class carries nothing extra; the round body
             is the SAME `make_round_once` closure."""
+            arrays = unpack(arrays)
             round_once = make_round_once(arrays, order, weights)
             br0 = jnp.full((P,), -1, jnp.int32)
             if static:
@@ -1236,6 +1245,9 @@ class GangScheduler:
             outer scan keeps its host auto-resume driver, and tracked
             (record) passes keep the host chronology driver that the
             byte-parity trace replay is built on."""
+            # widen packed planes ONCE, outside the while_loop — the
+            # nested run/preempt_phase unpacks become static no-ops
+            arrays = unpack(arrays)
             state, rounds = run(arrays, state0, order, weights)
             if preempt_fn is None:
                 return state, rounds

@@ -1,0 +1,207 @@
+"""Bitpacking + narrow-int helpers for the PACKED dtype policy.
+
+The encoded cluster is bytes-bound, not FLOPs-bound: most `int32` planes
+carry booleans, tiny enum ids, or small counts. Under the PACKED policy
+(engine/encode.py) each field's declared *width class* picks a storage
+width:
+
+  * ``exact`` — dtype unchanged (capacity/request arithmetic, priorities);
+  * ``id``    — vocab ids / node indices narrow to int16 (int8 for the
+                enum families) when every value fits, else stay wide
+                (per-field fallback — the compile signature carries leaf
+                dtypes, so a wide fallback is simply a distinct program);
+  * ``count`` — small counters narrow to int16 under the same fit rule;
+  * ``mask``  — bool planes bitpack their LAST axis into uint32 words
+                when it has >= PACK_MIN_DIM lanes and the plane is >= 2-D
+                (1-D liveness masks stay plain bool: the delta encoder
+                scatter-sets single elements, and EncodedCluster.N/P read
+                their shapes).
+
+Kernels never see the narrow forms: `make_unpacker` widens everything
+back to the logical int32/bool plane at the TOP of each engine-built
+closure, inside the jitted trace, so the unpack fuses into the one
+scheduling dispatch (no separate unpack program) and the arithmetic —
+hence every placement and trace byte — is identical to TPU32.
+
+Bit layout (shared by the host packer, the host unpacker, and the
+in-trace unpacker): bit j of word w holds logical element w*32 + j; the
+tail word zero-pads.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Bitpack a bool plane only when its last axis has at least this many
+# lanes — below it the uint32 word would cost more than the bool bytes.
+PACK_MIN_DIM = 8
+
+_I8 = np.iinfo(np.int8)
+_I16 = np.iinfo(np.int16)
+
+
+# -- bit packing ------------------------------------------------------------
+
+
+def pack_bits_np(b: np.ndarray) -> np.ndarray:
+    """Host-side bitpack of a bool array's last axis into uint32 words."""
+    b = np.asarray(b, bool)
+    n = b.shape[-1]
+    w = -(-n // 32)
+    pad = w * 32 - n
+    if pad:
+        b = np.concatenate(
+            [b, np.zeros(b.shape[:-1] + (pad,), bool)], axis=-1
+        )
+    b = b.reshape(b.shape[:-1] + (w, 32)).astype(np.uint32)
+    return (b << np.arange(32, dtype=np.uint32)).sum(axis=-1, dtype=np.uint32)
+
+
+def unpack_bits_np(a: np.ndarray, n: int) -> np.ndarray:
+    """Host-side inverse of `pack_bits_np`: uint32 [..., W] -> bool [..., n]."""
+    a = np.asarray(a, np.uint32)
+    bits = (a[..., None] >> np.arange(32, dtype=np.uint32)) & np.uint32(1)
+    flat = bits.reshape(a.shape[:-1] + (a.shape[-1] * 32,))
+    return flat[..., :n].astype(bool)
+
+
+def unpack_bits(x: jnp.ndarray, n: int) -> jnp.ndarray:
+    """In-trace unpack: uint32 words [..., W] -> bool [..., n]. Fuses into
+    the consuming kernel; XLA CSEs repeated unpacks of the same plane and
+    hoists loop-invariant ones out of `lax.scan`."""
+    bits = (x[..., None] >> jnp.arange(32, dtype=jnp.uint32)) & jnp.uint32(1)
+    flat = bits.reshape(x.shape[:-1] + (x.shape[-1] * 32,))
+    return flat[..., :n].astype(bool)
+
+
+# -- narrow ints ------------------------------------------------------------
+
+
+def narrow_int_np(v: np.ndarray, *, enum8: bool = False) -> np.ndarray:
+    """Narrow an integer array to int16 (int8 for enum families) when every
+    value fits; return it unchanged when one doesn't (per-field wide
+    fallback — correct for arbitrarily large vocabularies, just unpacked)."""
+    v = np.asarray(v)
+    if v.dtype.kind not in "iu":
+        return v
+    if v.size == 0:
+        return v.astype(np.int8 if enum8 else np.int16)
+    lo, hi = int(v.min()), int(v.max())
+    if enum8 and _I8.min <= lo and hi <= _I8.max:
+        return v.astype(np.int8)
+    if _I16.min <= lo and hi <= _I16.max:
+        return v.astype(np.int16)
+    return v
+
+
+def rows_fit(rows, dtype) -> bool:
+    """True when every (numpy) row's values fit `dtype` — the delta
+    encoder's guard before casting dirty rows into a narrowed tensor."""
+    dt = np.dtype(dtype)
+    if dt.kind not in "iu":
+        return True
+    info = np.iinfo(dt)
+    for r in rows:
+        r = np.asarray(r)
+        if r.size and (int(r.min()) < info.min or int(r.max()) > info.max):
+            return False
+    return True
+
+
+# -- width-class-aware device put ------------------------------------------
+
+
+def put_field(
+    name: str,
+    v,
+    cls: str,
+    *,
+    policy,
+    enum8: "frozenset[str]",
+    packed_dims: "dict[str, int]",
+    dtype=None,
+):
+    """Device-put one encoded field under its width class. Under unpacked
+    policies this is exactly `jnp.asarray` (byte-identical encodings).
+    Under PACKED, mask planes bitpack (recording their logical last dim in
+    `packed_dims`) and id/count planes narrow when their values fit."""
+    if dtype is not None:
+        return jnp.asarray(v, dtype)
+    if not getattr(policy, "packed", False):
+        return jnp.asarray(v)
+    v = np.asarray(v)
+    if cls == "mask":
+        if v.dtype == bool and v.ndim >= 2 and v.shape[-1] >= PACK_MIN_DIM:
+            packed_dims[name] = int(v.shape[-1])
+            return jnp.asarray(pack_bits_np(v))
+        return jnp.asarray(v)
+    if cls in ("id", "count"):
+        # counts (ranks, port/volume/image tallies, weights) are tiny in
+        # practice and may drop to int8; general ids keep an int16 floor
+        # (vocab ids routinely exceed 127 — an int8 id plane would
+        # recompile on every modest vocab growth) unless the field is a
+        # closed enum. Outlier values fall back per-field to the wide
+        # dtype; outlier delta rows fall back to a full re-encode.
+        return jnp.asarray(
+            narrow_int_np(v, enum8=name in enum8 or cls == "count")
+        )
+    return jnp.asarray(v)
+
+
+# -- in-trace widening ------------------------------------------------------
+
+_NARROW = (np.dtype(np.int8), np.dtype(np.int16))
+
+
+def make_unpacker(enc):
+    """A function widening a (possibly packed) ClusterArrays back to the
+    logical int32/bool plane INSIDE the trace.
+
+    Identity (`lambda a: a`) for unpacked policies, so EXACT/TPU32 traces
+    are untouched. Idempotent for PACKED: widened leaves no longer carry
+    the narrow dtypes, so re-application is a no-op — gang closures can
+    unpack defensively even when their caller already widened the arrays
+    (faultsweep jits `gang._bind_all` directly with packed arrays)."""
+    if not getattr(enc.policy, "packed", False):
+        return lambda a: a
+    pd = dict(enc.aux.get("packed_dims") or {})
+
+    def widen(name, x):
+        n = pd.get(name)
+        if n is not None and x.dtype == np.dtype(np.uint32):
+            return unpack_bits(x, n)
+        if x.dtype in _NARROW:
+            return x.astype(jnp.int32)
+        return x
+
+    def unpack(a):
+        rel = a.rel
+        rel = rel.replace(
+            **{
+                f: widen(f, getattr(rel, f))
+                for f in type(rel).__dataclass_fields__
+            }
+        )
+        return a.replace(
+            rel=rel,
+            **{
+                f: widen(f, getattr(a, f))
+                for f in type(a).__dataclass_fields__
+                if f != "rel"
+            },
+        )
+
+    return unpack
+
+
+# -- measurement ------------------------------------------------------------
+
+
+def encoded_device_bytes(enc) -> "dict[str, int]":
+    """Device bytes held by an encoding, split arrays (static cluster
+    planes, what PACKED shrinks) vs state0 (mutable state, always wide)."""
+    arrays = sum(int(l.nbytes) for l in jax.tree.leaves(enc.arrays))
+    state0 = sum(int(l.nbytes) for l in jax.tree.leaves(enc.state0))
+    return {"arrays": arrays, "state0": state0, "total": arrays + state0}

@@ -42,6 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .encode import PODS_RES, ClusterArrays, EncodedCluster, SchedState
+from .packing import make_unpacker
 
 PREEMPT_NO_LOWER = 0  # "no lower-priority pods to preempt"
 PREEMPT_NO_FIT = 1  # "preemption would not make pod schedulable"
@@ -415,8 +416,13 @@ def build_preemption(enc: EncodedCluster, filter_names):
                 "not declared state-independent (preempt.STATELESS_FILTERS)"
             )
     V = _victim_bound(enc, filter_names)
+    unpack = make_unpacker(enc)
 
     def preempt(a: ClusterArrays, state: SchedState, p):
+        # widen PACKED planes in-trace (no-op when the caller — the
+        # engine step — already unpacked; real work when the extender
+        # loop or a test jits this closure against the raw encoding)
+        a = unpack(a)
         prio_p = a.pod_priority[p]
         lower_all = (
             (state.assignment >= 0) & a.pod_mask & (a.pod_priority < prio_p)

@@ -39,7 +39,7 @@ import jax
 
 from ..engine import BatchedScheduler
 from ..engine.delta import DeltaEncoder
-from ..engine.encode import EncodingCache
+from ..engine.encode import EncodingCache, policy_from_env
 from ..engine.engine import unsupported_plugins
 from ..models.snapshot import export_snapshot, import_snapshot
 from ..models.store import ResourceStore
@@ -913,7 +913,14 @@ class SchedulerService:
         exact. Encode wall time + the path taken land in the metrics'
         phase breakdown."""
         t0 = time.perf_counter()
-        cache_key = (self.store.latest_rv(),)
+        # the dtype policy is re-read each pass, and both cache tiers are
+        # policy-aware: the LRU keys on the policy name, and the delta
+        # encoder falls back to a full re-encode when its retained
+        # tensors carry another policy's widths — a KSS_DTYPE_POLICY flip
+        # can never serve a stale encoding or scatter into a wrong-width
+        # tensor (counted as encodePolicyMisses)
+        policy = policy_from_env()
+        cache_key = (self.store.latest_rv(), policy.name)
         cached = self._enc_cache.get(cache_key, config)
         if cached is not EncodingCache.MISS:
             # published under the state lock: out-of-pass readers
@@ -925,7 +932,10 @@ class SchedulerService:
                 "pass.encode", t0, time.perf_counter(), mode="cached"
             )
             return cached
+        self._delta.policy = policy
         enc, info = self._delta.encode(self.store, config)
+        if info.get("reason") == "dtype-policy-change":
+            self.metrics.record_encode_policy_miss()
         self._enc_cache.put(cache_key, config, enc)
         with self._lock:
             self.last_encode_info = info

@@ -74,6 +74,15 @@ def _bool_validator(raw: str) -> "str | None":
     return None
 
 
+def _choice_validator(*choices: str) -> Validator:
+    def check(raw: str) -> "str | None":
+        if raw.strip().lower() not in choices:
+            return f"expected one of {'/'.join(choices)}, got {raw!r}"
+        return None
+
+    return check
+
+
 def _fault_spec_validator(raw: str) -> "str | None":
     from . import faultinject
 
@@ -117,6 +126,11 @@ def _slo_objectives_validator(raw: str) -> "str | None":
 KNOWN: "dict[str, Validator]" = {
     # serving stack
     "KSS_ENCODING_CACHE_CAP": _int_validator(1),
+    # the encoded-cluster dtype policy (engine/encode.py policy_from_env,
+    # docs/performance.md "Encoding widths"): "packed" stores the cluster
+    # tensors bitpacked/narrowed with in-kernel unpack; placements stay
+    # byte-identical to the default int32 plane. Empty = tpu32.
+    "KSS_DTYPE_POLICY": _choice_validator("", "exact", "i32", "tpu32", "packed"),
     # the gang engine's serving-path evaluation chunk (server/service.py
     # gang_chunk): compact mode's skip-settled granularity on the fused
     # fixpoint AND the record path's replay evaluation; placements are

@@ -249,6 +249,9 @@ class SchedulingMetrics:
         default_factory=lambda: {"delta": 0, "full": 0, "cached": 0, "empty": 0},
         repr=False,
     )
+    # full re-encodes forced by a KSS_DTYPE_POLICY flip landing on a
+    # delta encoder retaining the other policy's widths
+    _encode_policy_misses: int = 0
     _engine_builds: int = 0
     # compile-broker counters (utils/broker.py): warm-engine hits vs
     # request-thread synchronous compiles, background speculative builds,
@@ -476,6 +479,12 @@ class SchedulingMetrics:
             self._encode_counts[mode] += 1
             self._phase_s["encode"] += float(seconds)
 
+    def record_encode_policy_miss(self) -> None:
+        """One full re-encode whose only trigger was a dtype-policy flip
+        (the fallback ladder protecting the width contract)."""
+        with self._lock:
+            self._encode_policy_misses += 1
+
     def record_engine_build(self, seconds: float = 0.0) -> None:
         """One compiled-engine construction (the recompile proxy: a
         warm churn pass retargets instead and never lands here)."""
@@ -681,6 +690,7 @@ class SchedulingMetrics:
                     "fullEncodes": self._encode_counts.get("full", 0),
                     "cachedEncodes": self._encode_counts.get("cached", 0),
                     "emptyEncodes": self._encode_counts.get("empty", 0),
+                    "encodePolicyMisses": self._encode_policy_misses,
                     "engineBuilds": self._engine_builds,
                     "compileHits": self._compile_hits,
                     "compileMisses": self._compile_misses,
@@ -743,6 +753,7 @@ class SchedulingMetrics:
             self._encode_counts = {
                 "delta": 0, "full": 0, "cached": 0, "empty": 0
             }
+            self._encode_policy_misses = 0
             self._engine_builds = 0
             self._compile_hits = 0
             self._compile_misses = 0
@@ -777,6 +788,7 @@ class SchedulingMetrics:
     _STATE_FIELDS = (
         "_pass_count", "_total_pods", "_total_scheduled", "_total_wall_s",
         "_evicted", "_rescheduled", "_tts_sum_s", "_tts_max_s", "_tts_count",
+        "_encode_policy_misses",
         "_engine_builds", "_compile_hits", "_compile_misses",
         "_speculative_compiles", "_stall_s", "_compile_retries",
         "_eager_fallbacks", "_degraded_passes", "_worker_crashes",
@@ -877,6 +889,11 @@ _PROM_COUNTERS = (
         "kss_rescheduled_total",
         "Evicted pods that found a node again.",
         ("disruption", "rescheduled"),
+    ),
+    (
+        "kss_encode_policy_misses_total",
+        "Full re-encodes forced by a dtype-policy flip.",
+        ("phases", "encodePolicyMisses"),
     ),
     (
         "kss_engine_builds_total",

@@ -89,6 +89,11 @@ for _var in (
     "KSS_BATCH_WINDOW_MS",
     "KSS_BATCH_MAX_WAIT_MS",
     "KSS_BATCH_MAX_SESSIONS",
+    # the encoded-cluster dtype policy (engine/encode.py): an ambient
+    # KSS_DTYPE_POLICY=packed would re-key every encoding and compile
+    # signature the suite pins; packed-policy tests pass the policy (or
+    # set the knob) explicitly
+    "KSS_DTYPE_POLICY",
     # the gang serving chunk (server/service.py gang_chunk): an ambient
     # override would re-key every gang engine the suite builds (the
     # chunk is part of the compile signature) and skew the dispatch-

@@ -22,7 +22,7 @@ import numpy as np
 import pytest
 
 from kube_scheduler_simulator_tpu.engine.delta import DeltaEncoder
-from kube_scheduler_simulator_tpu.engine.encode import TPU32, encode_cluster
+from kube_scheduler_simulator_tpu.engine.encode import PACKED, TPU32, encode_cluster
 from kube_scheduler_simulator_tpu.lifecycle.engine import LifecycleEngine
 from kube_scheduler_simulator_tpu.models.store import ResourceStore
 from kube_scheduler_simulator_tpu.scenario.chaos import ChaosSpec
@@ -32,7 +32,7 @@ from kube_scheduler_simulator_tpu.utils.compilecache import capacity_buckets
 from helpers import node, pod
 
 
-def full_encode(store, config, *, node_lo=8, pod_lo=8):
+def full_encode(store, config, *, node_lo=8, pod_lo=8, policy=TPU32):
     """The from-scratch reference: exactly what the service's full path
     builds for this store state."""
     nodes = store.list("nodes")
@@ -44,7 +44,7 @@ def full_encode(store, config, *, node_lo=8, pod_lo=8):
         nodes,
         pods,
         config,
-        policy=TPU32,
+        policy=policy,
         priorityclasses=store.list("priorityclasses"),
         namespaces=store.list("namespaces"),
         pvcs=store.list("pvcs"),
@@ -84,7 +84,12 @@ def check(delta, store, config, ctx=""):
     if enc is not None:
         assert retained is enc
     if retained is not None:
-        assert_enc_equal(retained, full_encode(store, config), ctx)
+        # the reference is a from-scratch encode under the encoder's OWN
+        # policy, so under PACKED the comparison covers the packed words
+        # and narrowed dtypes bit-for-bit
+        assert_enc_equal(
+            retained, full_encode(store, config, policy=delta.policy), ctx
+        )
     else:
         # nothing retained: legitimately nothing schedulable right now
         pods = store.list("pods")
@@ -170,11 +175,11 @@ class _AssertingEngine(LifecycleEngine):
     and occasional evictions/deletions) so the MODIFIED-pod delta path
     gets real coverage without running the scheduling engine."""
 
-    def __init__(self, spec, config, rng):
+    def __init__(self, spec, config, rng, policy=TPU32):
         super().__init__(spec)
         self.cfg = config
         self.rng = rng
-        self.delta = DeltaEncoder()
+        self.delta = DeltaEncoder(policy=policy)
         self.infos = []
 
     def _converge(self, t):
@@ -211,10 +216,16 @@ class _AssertingEngine(LifecycleEngine):
         self.infos.append(check(self.delta, self.store, self.cfg, f"t={t} post"))
 
 
-@pytest.mark.parametrize("seed", [0, 1, 2])
-def test_random_chaos_delta_equals_full(seed):
+@pytest.mark.parametrize(
+    "seed, policy",
+    [(0, TPU32), (1, TPU32), (2, TPU32), (0, PACKED), (1, PACKED)],
+    ids=["0-i32", "1-i32", "2-i32", "0-packed", "1-packed"],
+)
+def test_random_chaos_delta_equals_full(seed, policy):
     spec = _chaos_spec(seed)
-    eng = _AssertingEngine(spec, SchedulerConfiguration.default(), random.Random(seed))
+    eng = _AssertingEngine(
+        spec, SchedulerConfiguration.default(), random.Random(seed), policy
+    )
     res = eng.run()
     assert res["phase"] == "Succeeded"
     modes = [i["mode"] for i in eng.infos]

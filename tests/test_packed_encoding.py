@@ -1,0 +1,272 @@
+"""The packed low-precision encoding plane (KSS_DTYPE_POLICY=packed,
+engine/packing.py, docs/performance.md "Encoding widths").
+
+Four contract families:
+
+* **Primitives** — host bitpack / device unpack round-trips, the
+  narrow-int fit rule, and the per-field overflow guard that keeps a
+  narrowed plane honest under delta updates.
+* **Parity** — PACKED placements and trace bytes are PIN-IDENTICAL to
+  the TPU32 baseline on a label-rich affinity cluster, sequential and
+  gang, while the encoded-cluster device bytes shrink; and the compile
+  signature keeps the policies (and the logical lane counts behind the
+  packed words, which the word shapes alone cannot recover) distinct.
+* **EXACT vs TPU32 quantities** — the satellite property: for
+  Mi-granular memory and integral millicores, the i64 EXACT plane and
+  the i32 TPU32 plane place every pod identically across seeded
+  randomized clusters.
+* **Policy flip** — a dtype-policy change is a DISTINCT compiled
+  program, so the delta encoder must force a full re-encode (reason
+  ``dtype-policy-change``) and the serving layer counts it
+  (``encodePolicyMisses`` / ``kss_encode_policy_misses_total``).
+"""
+
+from __future__ import annotations
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kube_scheduler_simulator_tpu.engine import (
+    EXACT,
+    PACKED,
+    TPU32,
+    encode_cluster,
+)
+from kube_scheduler_simulator_tpu.engine.delta import DeltaEncoder
+from kube_scheduler_simulator_tpu.engine.engine import (
+    BatchedScheduler,
+    supported_config,
+)
+from kube_scheduler_simulator_tpu.engine.packing import (
+    PACK_MIN_DIM,
+    encoded_device_bytes,
+    narrow_int_np,
+    pack_bits_np,
+    rows_fit,
+    unpack_bits,
+    unpack_bits_np,
+)
+from kube_scheduler_simulator_tpu.models.store import ResourceStore
+from kube_scheduler_simulator_tpu.synth import synthetic_affinity_cluster
+
+from helpers import node, pod
+
+
+# -- primitives --------------------------------------------------------------
+
+
+@pytest.mark.parametrize("shape", [(3, 8), (5, 33), (2, 4, 40), (7, 64)])
+def test_pack_unpack_roundtrip(shape):
+    rng = np.random.default_rng(sum(shape))
+    m = rng.random(shape) < 0.5
+    words = pack_bits_np(m)
+    assert words.dtype == np.uint32
+    assert words.shape == (*shape[:-1], -(-shape[-1] // 32))
+    np.testing.assert_array_equal(unpack_bits_np(words, shape[-1]), m)
+    # the DEVICE unpack (the one fused into the kernels) agrees bit for
+    # bit with the host mirror the delta encoder keeps
+    dev = np.asarray(unpack_bits(jnp.asarray(words), shape[-1]))
+    np.testing.assert_array_equal(dev, m)
+
+
+def test_narrow_int_fit_rule():
+    a = np.array([[0, 127], [5, 90]], np.int32)
+    # int8 is reserved for enum families; plain id/count planes stop
+    # at int16 (delta rows would overflow int8 under vocabulary growth)
+    assert narrow_int_np(a).dtype == np.int16
+    assert narrow_int_np(a, enum8=True).dtype == np.int8
+    b = np.array([[0, 300]], np.int32)  # overflows int8 even as enum
+    assert narrow_int_np(b, enum8=True).dtype == np.int16
+    c = np.array([[0, 1 << 20]], np.int32)  # stays wide
+    assert narrow_int_np(c).dtype == np.int32
+    # rows_fit is the delta path's overflow guard for an ALREADY
+    # narrowed plane: in-range rows pass, out-of-range rows refuse
+    assert rows_fit([np.array([1, 2])], np.dtype(np.int8))
+    assert not rows_fit([np.array([300])], np.dtype(np.int8))
+
+
+def test_packed_encoding_shapes_and_bytes():
+    # 96 pods: enough label-pair vocabulary to cross PACK_MIN_DIM lanes
+    nodes, pods = synthetic_affinity_cluster(32, 96, seed=5)
+    cfg = supported_config()
+    wide = encode_cluster(nodes, pods, cfg, policy=TPU32)
+    packed = encode_cluster(nodes, pods, cfg, policy=PACKED)
+    pd = packed.aux.get("packed_dims") or {}
+    assert pd, "a label-rich cluster must bitpack at least one plane"
+    for name, n in pd.items():
+        leaf = getattr(
+            packed.arrays,
+            name,
+            getattr(packed.arrays.rel, name, None),
+        )
+        assert leaf is not None
+        assert leaf.dtype == jnp.uint32
+        assert n >= PACK_MIN_DIM
+        assert leaf.shape[-1] == -(-n // 32)
+    assert (
+        encoded_device_bytes(packed)["total"]
+        < encoded_device_bytes(wide)["total"]
+    )
+
+
+# -- parity ------------------------------------------------------------------
+
+
+def test_packed_sequential_parity_placements_and_trace():
+    import jax
+
+    nodes, pods = synthetic_affinity_cluster(24, 72, seed=9)
+    cfg = supported_config()
+    base = BatchedScheduler(
+        encode_cluster(nodes, pods, cfg, policy=TPU32), record=True
+    )
+    packed = BatchedScheduler(
+        encode_cluster(nodes, pods, cfg, policy=PACKED), record=True
+    )
+    bstate, btrace = base.run()
+    pstate, ptrace = packed.run()
+    np.testing.assert_array_equal(
+        np.asarray(bstate.assignment), np.asarray(pstate.assignment)
+    )
+    bleaves = [np.asarray(x) for x in jax.tree_util.tree_leaves(btrace)]
+    pleaves = [np.asarray(x) for x in jax.tree_util.tree_leaves(ptrace)]
+    assert len(bleaves) == len(pleaves)
+    for b, p in zip(bleaves, pleaves):
+        assert b.dtype == p.dtype  # trace BYTES identical, not just values
+        np.testing.assert_array_equal(b, p)
+
+
+def test_packed_gang_parity_placements():
+    from kube_scheduler_simulator_tpu.engine.gang import GangScheduler
+
+    nodes, pods = synthetic_affinity_cluster(16, 48, seed=13)
+    cfg = supported_config()
+    base = GangScheduler(
+        encode_cluster(nodes, pods, cfg, policy=TPU32), chunk=16
+    )
+    base.run()
+    packed = GangScheduler(
+        encode_cluster(nodes, pods, cfg, policy=PACKED), chunk=16
+    )
+    packed.run()
+    assert base.placements() == packed.placements()
+
+
+def test_compile_signature_keys_policy_and_logical_dims():
+    nodes, pods = synthetic_affinity_cluster(32, 96, seed=5)
+    cfg = supported_config()
+    wide = encode_cluster(nodes, pods, cfg, policy=TPU32)
+    packed = encode_cluster(nodes, pods, cfg, policy=PACKED)
+    sig_wide = BatchedScheduler.compile_signature(wide)
+    sig_packed = BatchedScheduler.compile_signature(packed)
+    # a policy flip is a distinct compile (and a distinct AOT bundle)
+    assert sig_wide != sig_packed
+    # the word count ceil(n/32) is not injective in the logical lane
+    # count, so the signature must carry the logical dims themselves
+    pd = tuple(sorted((packed.aux.get("packed_dims") or {}).items()))
+    assert pd in sig_packed
+
+
+# -- EXACT vs TPU32 Mi-granular quantities (satellite property) --------------
+
+
+def _mi_cluster(rng: random.Random):
+    nodes = [
+        node(
+            f"n{i}",
+            cpu=str(rng.choice([4, 8, 16])),
+            mem=f"{rng.choice([8, 16, 32])}Gi",
+            labels={"zone": rng.choice(["a", "b"])},
+        )
+        for i in range(8)
+    ]
+    pods = []
+    for i in range(24):
+        kw = {}
+        if rng.random() < 0.3:
+            kw["node_selector"] = {"zone": rng.choice(["a", "b"])}
+        pods.append(
+            pod(
+                f"p{i}",
+                cpu=f"{rng.randrange(50, 3000, 50)}m",
+                mem=f"{rng.randrange(1, 128) * 16}Mi",
+                labels={"app": f"g{i % 4}"},
+                **kw,
+            )
+        )
+    return nodes, pods
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_exact_vs_tpu32_placements_agree_on_mi_quantities(seed):
+    """Mi-granular memory and integral millicores fit the i32 plane
+    exactly, so the EXACT (i64) and TPU32 (i32) policies must place
+    every pod identically — the quantization-safety property the TPU32
+    default rests on."""
+    rng = random.Random(seed)
+    nodes, pods = _mi_cluster(rng)
+    cfg = supported_config()
+    exact = BatchedScheduler(
+        encode_cluster(nodes, pods, cfg, policy=EXACT), record=False
+    )
+    i32 = BatchedScheduler(
+        encode_cluster(nodes, pods, cfg, policy=TPU32), record=False
+    )
+    estate, _ = exact.run()
+    istate, _ = i32.run()
+    np.testing.assert_array_equal(
+        np.asarray(estate.assignment), np.asarray(istate.assignment)
+    )
+
+
+# -- policy flip: full re-encode + the serving counter -----------------------
+
+
+def test_policy_change_forces_full_reencode():
+    store = ResourceStore()
+    store.apply("nodes", node("n0", cpu="8"))
+    for i in range(4):
+        store.apply("pods", pod(f"p{i}"))
+    cfg = supported_config()
+    delta = DeltaEncoder(policy=TPU32)
+    _, info = delta.encode(store, cfg)
+    assert info["mode"] == "full"
+    store.apply("pods", pod("p-new"))
+    _, info = delta.encode(store, cfg)
+    assert info["mode"] == "delta"
+    # the serving layer flips the policy attribute when KSS_DTYPE_POLICY
+    # changes between passes; the retained encoding's dtypes are wrong
+    # for the new program, so the next pass must be a full re-encode
+    delta.policy = PACKED
+    _, info = delta.encode(store, cfg)
+    assert info == {"mode": "full", "reason": "dtype-policy-change"}
+    # and warm again afterwards
+    store.apply("pods", pod("p-newer"))
+    _, info = delta.encode(store, cfg)
+    assert info["mode"] == "delta"
+
+
+def test_encode_policy_miss_counter():
+    from kube_scheduler_simulator_tpu.utils.metrics import (
+        SchedulingMetrics,
+        render_prometheus,
+    )
+
+    m = SchedulingMetrics()
+    m.record_encode_policy_miss()
+    snap = m.snapshot()
+    assert snap["phases"]["encodePolicyMisses"] == 1
+    text = render_prometheus(snap)
+    assert "kss_encode_policy_misses_total 1" in text
+
+
+def test_envcheck_dtype_policy_validator():
+    from kube_scheduler_simulator_tpu.utils import envcheck
+
+    assert "KSS_DTYPE_POLICY" in envcheck.KNOWN
+    for ok in ("", "exact", "i32", "tpu32", "packed", "PACKED"):
+        assert envcheck.check_env({"KSS_DTYPE_POLICY": ok}) == [], ok
+    assert envcheck.check_env({"KSS_DTYPE_POLICY": "float8"}) != []

@@ -23,6 +23,7 @@ from kube_scheduler_simulator_tpu.analysis import (
     lock_order,
     metrics_registry,
     span_balance,
+    width_class,
 )
 from kube_scheduler_simulator_tpu.analysis.core import (
     ALLOWLIST,
@@ -650,6 +651,59 @@ def test_lint_strict_fails_on_nonempty_allowlist(monkeypatch, capsys, tmp_path):
     finally:
         core.ALLOWLIST.pop("KSS301", None)
     assert "KSS_LINT_STRICT: failing" in capsys.readouterr().err
+
+
+def test_width_class_fires_on_missing_and_stale_entries():
+    tree = SourceTree.from_sources(
+        {
+            "engine/encode.py": (
+                "class ClusterArrays:\n"
+                "    declared: object\n"
+                "    undeclared: object\n"
+                "WIDTH_CLASSES = {\n"
+                "    'declared': 'mask',\n"
+                "    'ghost': 'id',\n"
+                "    'declared_badly': 'huge',\n"
+                "}\n"
+            ),
+        }
+    )
+    findings = width_class.run(tree, RepoContext())
+    assert rules_of(findings) == {"KSS716"}
+    messages = "\n".join(f.message for f in findings)
+    assert "undeclared" in messages      # field with no width class
+    assert "'ghost'" in messages         # stale entry, no such field
+    assert "'huge'" in messages          # unknown width class value
+    assert "declared_badly" in messages
+    assert len(findings) == 4  # 'declared_badly' is both stale AND unknown
+
+
+def test_width_class_fires_on_missing_dict():
+    tree = SourceTree.from_sources(
+        {"engine/encode_rel.py": "class PodRelArrays:\n    f: object\n"}
+    )
+    findings = width_class.run(tree, RepoContext())
+    assert rules_of(findings) == {"KSS716"}
+    (f,) = findings
+    assert "REL_WIDTH_CLASSES" in f.message
+
+
+def test_width_class_clean_on_total_declaration():
+    tree = SourceTree.from_sources(
+        {
+            "engine/encode.py": (
+                "class ClusterArrays:\n"
+                "    a: object\n"
+                "    b: object\n"
+                "    rel: object\n"  # nested plane: carries its own dict
+                'WIDTH_CLASSES: "dict[str, str]" = {\n'
+                "    'a': 'exact',\n"
+                "    'b': 'count',\n"
+                "}\n"
+            ),
+        }
+    )
+    assert width_class.run(tree, RepoContext()) == []
 
 
 # -- framework plumbing -------------------------------------------------------

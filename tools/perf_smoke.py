@@ -1,6 +1,6 @@
 """`make perf-smoke`: tiny CPU-only lifecycle throughput sanity check.
 
-Three gates, one JSON line:
+Four gates, one JSON line:
 
 1. **Churn is O(Δ)** — a small seeded churn timeline (Poisson arrivals +
    a cordon flap against a 6-node cluster) through the full service
@@ -21,7 +21,14 @@ Three gates, one JSON line:
    rebuilds, zero engine builds, and exactly one device dispatch per
    pass across warm churn at a stable bucket.
 
-3. **The program ledger answers and diffs clean** — the whole run
+3. **Packing is free** — the packed low-precision encoding plane
+   (`KSS_DTYPE_POLICY=packed`, engine/packing.py) against the TPU32
+   baseline on a label-rich affinity cluster: placements AND trace
+   byte-identical, encoded-cluster device bytes reduced ≥ 2x, and zero
+   extra ledger-counted device dispatches per warm pass (the unpack is
+   fused into the scheduling program, never its own dispatch).
+
+4. **The program ledger answers and diffs clean** — the whole run
    executes under `KSS_PROGRAM_LEDGER=1` (utils/ledger.py): the ledger
    must be populated (≥1 program carrying compile seconds, FLOPs, and
    a call count), `analysis ledger-diff` of the persisted ledger
@@ -198,6 +205,103 @@ def _crossing_gate() -> "tuple[dict, list[str]]":
     return fields, problems
 
 
+def _packing_gate() -> "tuple[dict, list[str]]":
+    """Gate 4: the packed low-precision encoding plane
+    (KSS_DTYPE_POLICY=packed, engine/packing.py). Three contracts on a
+    label-rich affinity cluster: PACKED placements and trace
+    byte-identical to TPU32, encoded-cluster device bytes reduced
+    >= 2x, and ZERO extra device dispatches per warm pass — the unpack
+    is fused into the scheduling program, never a separate dispatch."""
+    import jax
+    import numpy as np
+
+    from kube_scheduler_simulator_tpu.engine import (
+        PACKED,
+        TPU32,
+        encode_cluster,
+    )
+    from kube_scheduler_simulator_tpu.engine.engine import (
+        BatchedScheduler,
+        supported_config,
+    )
+    from kube_scheduler_simulator_tpu.engine.packing import (
+        encoded_device_bytes,
+    )
+    from kube_scheduler_simulator_tpu.synth import synthetic_affinity_cluster
+    from kube_scheduler_simulator_tpu.utils import ledger as ledger_mod
+
+    problems: list[str] = []
+    cfg = supported_config()
+
+    # the >= 2x byte floor: host-side accounting only (no scheduling),
+    # so the measuring shape can afford enough label vocabulary to be
+    # representative — bench.py --encoding-probe owns the real numbers
+    nodes, pods = synthetic_affinity_cluster(96, 768, seed=11)
+    wide = encoded_device_bytes(
+        encode_cluster(nodes, pods, cfg, policy=TPU32)
+    )
+    narrow = encoded_device_bytes(
+        encode_cluster(nodes, pods, cfg, policy=PACKED)
+    )
+    ratio = wide["total"] / narrow["total"]
+    if ratio < 2.0:
+        problems.append(
+            f"packed encoding saves only {ratio:.2f}x encoded device "
+            "bytes (< 2x floor)"
+        )
+
+    # placement/trace/dispatch parity at a smaller shape (two sequential
+    # compiles are this gate's cost; the contract is shape-independent)
+    nodes, pods = synthetic_affinity_cluster(48, 160, seed=3)
+
+    def _seq_calls() -> "dict[tuple, int]":
+        # keyed (label, fingerprint): both policies' programs share the
+        # "seq.run" label (a policy flip is a distinct compile, not a
+        # distinct site), so a label-only view would hide one of them
+        return {
+            (p["label"], p["fingerprint"]): p["calls"]
+            for p in ledger_mod.LEDGER.snapshot()["programs"]
+            if p["label"].startswith("seq.")
+        }
+
+    def one(policy):
+        enc = encode_cluster(nodes, pods, cfg, policy=policy)
+        sc = BatchedScheduler(enc, record=True)
+        sc.run()  # compile + warm
+        before = _seq_calls()
+        state, out = sc.run()
+        dispatches = sum(
+            calls - before.get(key, 0)
+            for key, calls in _seq_calls().items()
+        )
+        trace = [np.asarray(x) for x in jax.tree_util.tree_leaves(out)]
+        return np.asarray(state.assignment), trace, dispatches
+
+    base_asg, base_trace, base_disp = one(TPU32)
+    packed_asg, packed_trace, packed_disp = one(PACKED)
+    placements_ok = np.array_equal(base_asg, packed_asg)
+    trace_ok = len(base_trace) == len(packed_trace) and all(
+        b.dtype == p.dtype and np.array_equal(b, p)
+        for b, p in zip(base_trace, packed_trace)
+    )
+    if not placements_ok:
+        problems.append("PACKED placements diverge from TPU32")
+    if not trace_ok:
+        problems.append("PACKED trace bytes diverge from TPU32")
+    if packed_disp != base_disp:
+        problems.append(
+            f"packed warm pass dispatched {packed_disp} programs vs "
+            f"TPU32's {base_disp} (the in-kernel unpack contract is "
+            "zero extra)"
+        )
+    fields = {
+        "packed_bytes_ratio": round(ratio, 2),
+        "packed_placements_identical": bool(placements_ok and trace_ok),
+        "packed_extra_dispatches": packed_disp - base_disp,
+    }
+    return fields, problems
+
+
 def _ledger_gate() -> "tuple[dict, list[str]]":
     """Gate 3: the program ledger is populated and its regression diff
     both passes clean documents and catches an injected regression."""
@@ -340,6 +444,7 @@ def main() -> int:
     # prior stage's build landing mid-gate
     eng.scheduler.broker.drain(timeout=600)
     crossing_fields, crossing_problems = _crossing_gate()
+    packing_fields, packing_problems = _packing_gate()
     ledger_fields, ledger_problems = _ledger_gate()
     line = {
         "config": "perf_smoke",
@@ -355,10 +460,15 @@ def main() -> int:
         "execute_s": phases.get("executeSeconds", 0.0),
         "pipeline": "async",
         **crossing_fields,
+        **packing_fields,
         **ledger_fields,
     }
     print(json.dumps(line), flush=True)
-    problems = list(crossing_problems) + list(ledger_problems)
+    problems = (
+        list(crossing_problems)
+        + list(packing_problems)
+        + list(ledger_problems)
+    )
     if result["phase"] != "Succeeded":
         problems.append(f"run phase {result['phase']!r}")
     if result["pods"]["arrived"] < 10:
