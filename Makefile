@@ -5,7 +5,7 @@
 PORT ?= 1212
 PY ?= python
 
-.PHONY: test test-fast lint start bench dryrun batch lifecycle-smoke perf-smoke resilience-smoke observability-smoke session-smoke soak-smoke bundle-smoke batch-smoke fleet-smoke docker docker-up clean
+.PHONY: test test-fast lint start bench dryrun batch lifecycle-smoke perf-smoke resilience-smoke observability-smoke session-smoke soak-smoke bundle-smoke batch-smoke fleet-smoke fleet-chaos-smoke smoke-all docker docker-up clean
 
 # full suite on the 8-device virtual CPU mesh (tests/conftest.py pins it)
 test:
@@ -119,6 +119,21 @@ batch-smoke:
 # restart stays scrape-answerable throughout (gate C); one JSON line
 fleet-smoke:
 	env JAX_PLATFORMS=cpu $(PY) tools/fleet_smoke.py
+
+# fleet durability gate (docs/fleet.md, docs/resilience.md): spawned
+# workers on distinct session dirs with the HTTP checkpoint transport
+# forced and the lock witness armed — seeded chaos churn keeps every
+# acknowledged write (gate A); kill -9 the owner and the successor's
+# replica + sync journal answer canonically byte-identically (gate B);
+# a total net_drop storm opens the circuit breaker, sheds 503 +
+# Retry-After, and half-open recovery closes it (gate C); one JSON line
+fleet-chaos-smoke:
+	env JAX_PLATFORMS=cpu $(PY) tools/fleet_chaos_smoke.py
+
+# every smoke gate in sequence — the pre-PR confidence sweep (each
+# target prints its own one-JSON-line verdict; the first red one stops
+# the run; soak-smoke last, it's the slow one)
+smoke-all: lifecycle-smoke perf-smoke resilience-smoke observability-smoke session-smoke bundle-smoke batch-smoke fleet-smoke fleet-chaos-smoke soak-smoke
 
 # containerized dev flow (reference `make docker_build_and_up`, one service)
 docker:

@@ -54,7 +54,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
-from ..utils import locking
+from ..utils import faultinject, locking
 from ..utils import metrics as metrics_mod
 from .ring import DEFAULT_REPLICAS, HashRing
 
@@ -71,7 +71,18 @@ WORKER_BOOT_TIMEOUT_S = 240.0
 # how long a SIGTERM'd worker gets to finish its zero-loss drain before
 # the roll gives up waiting (KSS_DRAIN_DEADLINE_S lives inside this)
 DRAIN_EXIT_TIMEOUT_S = 180.0
+# per-request deadline budget defaults; overridable per deployment via
+# KSS_FLEET_REQUEST_TIMEOUT_S / KSS_FLEET_ADOPT_TIMEOUT_S (retries
+# included — the budget is the CALL's, not the attempt's)
 PROXY_TIMEOUT_S = 600.0
+ADOPT_TIMEOUT_S = 60.0
+# router resilience defaults (docs/resilience.md): bounded retry with
+# exponential backoff on idempotent calls, and a per-worker circuit
+# breaker distinct from the probe loop's dead-worker ladder
+RETRIES_DEFAULT = 2
+RETRY_BACKOFF_S_DEFAULT = 0.05
+BREAKER_FAILURES_DEFAULT = 3
+BREAKER_OPEN_S_DEFAULT = 5.0
 
 # repo root, for spawned workers' PYTHONPATH: the child must import the
 # package regardless of the router's cwd
@@ -103,7 +114,32 @@ _ROUTER_FAMILY_DEFS = (
         "counter",
         "Requests shed at the router because no worker could serve them.",
     ),
+    (
+        "kss_fleet_retries_total",
+        "counter",
+        "Idempotent worker calls retried after a transport failure.",
+    ),
+    (
+        "kss_fleet_breaker_open_total",
+        "counter",
+        "Per-worker circuit breaker transitions into the open state.",
+    ),
+    (
+        "kss_fleet_pending_adopts_total",
+        "counter",
+        "Re-home adoptions that failed and were queued for probe-tick retry.",
+    ),
 )
+
+
+class BreakerOpen(ConnectionError):
+    """The per-worker circuit breaker is open: the call is shed without
+    touching the socket (docs/resilience.md). An OSError subclass so
+    every existing unreachable-worker handler degrades the same way."""
+
+    def __init__(self, wid: str):
+        super().__init__(f"worker {wid} circuit breaker open")
+        self.wid = wid
 
 
 def _free_port(host: str) -> int:
@@ -122,14 +158,38 @@ def _request(
     body: "bytes | None" = None,
     headers: "dict | None" = None,
     timeout: float = 10.0,
+    faults: bool = True,
 ) -> "tuple[int, dict, bytes]":
     """One buffered HTTP exchange with a worker; raises OSError family
-    on connection trouble (the caller's shed/death signal)."""
+    on connection trouble (the caller's shed/death signal).
+
+    This is the router's network chokepoint, so the fleet fault sites
+    (utils/faultinject.py) fire here: ``net_drop`` fails the exchange
+    BEFORE anything is sent, ``net_delay`` sleeps first, and
+    ``net_partition`` performs the full exchange and then discards the
+    response — the worker processed the request, the caller sees a
+    reset (the partition that punishes non-idempotent retries). Control
+    traffic the chaos harness must not blind — the probe loop's health
+    checks, drain polling, replication topology pushes — passes
+    ``faults=False``.
+    """
+    plane = faultinject.active() if faults else None
+    if plane is not None:
+        try:
+            plane.maybe_raise("net_drop")
+        except faultinject.InjectedFault as e:
+            raise ConnectionRefusedError(str(e)) from None
+        plane.delay("net_delay")
     conn = http.client.HTTPConnection(host, port, timeout=timeout)
     try:
         conn.request(method, path, body=body, headers=headers or {})
         resp = conn.getresponse()
         data = resp.read()
+        if plane is not None:
+            try:
+                plane.maybe_raise("net_partition")
+            except faultinject.InjectedFault as e:
+                raise ConnectionResetError(str(e)) from None
         return resp.status, dict(resp.getheaders()), data
     finally:
         conn.close()
@@ -164,6 +224,14 @@ class Worker:
         self.state = "booting"
         self.failures = 0
         self.health: dict = {}
+        # per-worker circuit breaker (docs/resilience.md): "closed" |
+        # "open" | "half-open". Distinct from the probe loop's
+        # dead-worker ladder — the breaker sheds calls to a live-but-
+        # misbehaving worker; the ladder removes a dead one from the
+        # ring entirely.
+        self.breaker_state = "closed"
+        self.breaker_failures = 0
+        self.breaker_opened_at = 0.0
 
     @property
     def spawned(self) -> bool:
@@ -177,6 +245,7 @@ class Worker:
             "spawned": self.spawned,
             "sessionDir": self.session_dir,
             "health": self.health,
+            "breaker": self.breaker_state,
         }
         if self.proc is not None:
             doc["pid"] = self.proc.pid
@@ -220,12 +289,51 @@ class FleetRouter:
         )
         self._lock = locking.make_lock("fleet.router")
         self._ring = HashRing(replicas=replicas)
+        # router resilience knobs (docs/resilience.md): per-call
+        # deadline budgets, bounded idempotent retry, and the circuit
+        # breaker thresholds
+        self.request_timeout_s = float(
+            env.get("KSS_FLEET_REQUEST_TIMEOUT_S") or PROXY_TIMEOUT_S
+        )
+        self.adopt_timeout_s = float(
+            env.get("KSS_FLEET_ADOPT_TIMEOUT_S") or ADOPT_TIMEOUT_S
+        )
+        self.retries = int(env.get("KSS_FLEET_RETRIES") or RETRIES_DEFAULT)
+        self.retry_backoff_s = float(
+            env.get("KSS_FLEET_RETRY_BACKOFF_S") or RETRY_BACKOFF_S_DEFAULT
+        )
+        self.breaker_failures = int(
+            env.get("KSS_FLEET_BREAKER_FAILURES") or BREAKER_FAILURES_DEFAULT
+        )
+        self.breaker_open_s = float(
+            env.get("KSS_FLEET_BREAKER_OPEN_S") or BREAKER_OPEN_S_DEFAULT
+        )
+        # re-home transport (docs/fleet.md): "" / "auto" = file move
+        # when both namespaces are visible on this filesystem, HTTP
+        # checkpoint transport otherwise; "http" forces the transport
+        # even over a shared dir (the cross-host behavior, testable
+        # anywhere)
+        self.transport = (env.get("KSS_FLEET_TRANSPORT") or "").strip()
+        # durability-plane topology the router pushes to workers
+        # (server/replication.py): successor count + ship cadence
+        self.fleet_replicas = int(env.get("KSS_FLEET_REPLICAS") or 1)
+        self.replicate_every_s = float(
+            env.get("KSS_FLEET_REPLICATE_EVERY_S") or 5.0
+        )
         # session id -> worker id: learned placements (creates,
         # re-homes). Ring ownership is the stateless fallback for ids
         # the table has never seen (a restarted router re-derives it).
         self._table: dict[str, str] = {}
         self._rehomed = 0
         self._shed = 0
+        self._retries_done = 0
+        self._breaker_opens = 0
+        self._pending_adopt_total = 0
+        # sid -> source worker id: adoptions that failed (unreachable
+        # successor, missing replica) and are retried each probe tick —
+        # the honest accounting `kss_fleet_rehomed_sessions_total` used
+        # to fake by counting file moves as adoptions
+        self._pending_adopts: dict[str, str] = {}
         self._roll_state: dict = {
             "rolling": False,
             "phase": "idle",
@@ -260,6 +368,12 @@ class FleetRouter:
                 child_env["KSS_SESSION_DIR"] = session_dir
                 child_env["KSS_BUNDLE_DIR"] = self.bundle_dir
                 child_env.setdefault("KSS_AOT_BUNDLES", "1")
+                # arm the durability plane on spawned workers: every
+                # acknowledged write journals, and the replication
+                # topology push at fleet start begins successor shipping
+                # (KSS_FLEET_JOURNAL_SYNC passes through from the
+                # router's env for the zero-loss mode)
+                child_env.setdefault("KSS_FLEET_JOURNAL", "1")
                 child_env["PYTHONPATH"] = _PKG_ROOT + (
                     os.pathsep + child_env["PYTHONPATH"]
                     if child_env.get("PYTHONPATH")
@@ -326,6 +440,7 @@ class FleetRouter:
             owner = self._ring.owner("default")
             if owner is not None:
                 self._table["default"] = owner
+        self.push_replication()
         self._probe_thread = threading.Thread(
             target=self._probe_loop, name="kss-fleet-probe", daemon=True
         )
@@ -393,7 +508,12 @@ class FleetRouter:
                 return False  # exited before it ever served
             try:
                 status, _, data = _request(
-                    w.host, w.port, "GET", "/api/v1/readyz", timeout=5.0
+                    w.host,
+                    w.port,
+                    "GET",
+                    "/api/v1/readyz",
+                    timeout=5.0,
+                    faults=False,
                 )
             except OSError:
                 time.sleep(0.25)
@@ -429,7 +549,11 @@ class FleetRouter:
     def probe_once(self) -> None:
         """One probe round over every worker not already dead or being
         rolled: readyz → ready/degraded; process exit or repeated
-        connection failure → death handling (re-home)."""
+        connection failure → death handling (re-home). Probes are
+        EXEMPT from the net fault sites (``faults=False``) — chaos must
+        not blind the control loop that recovers from chaos — and each
+        round retries any adoptions still pending from failed
+        re-homes."""
         with self._lock:
             targets = [
                 w
@@ -443,7 +567,12 @@ class FleetRouter:
             else:
                 try:
                     status, _, data = _request(
-                        w.host, w.port, "GET", "/api/v1/readyz", timeout=5.0
+                        w.host,
+                        w.port,
+                        "GET",
+                        "/api/v1/readyz",
+                        timeout=5.0,
+                        faults=False,
                     )
                 except OSError:
                     with self._lock:
@@ -461,6 +590,7 @@ class FleetRouter:
                             w.state = "ready" if status == 200 else "degraded"
             if dead:
                 self._handle_worker_death(w)
+        self._retry_pending_adopts()
 
     def _handle_worker_death(self, w: Worker) -> None:
         """Declare `w` dead, pull it from the ring, and re-home its
@@ -475,59 +605,339 @@ class FleetRouter:
                 return
             w.state = "dead"
             self._ring.remove(w.id)
+        # the survivors must agree on the shrunken ring before re-homed
+        # sessions start replicating from their new owners
+        self.push_replication()
         self._rehome_from(w)
 
-    def _rehome_from(self, w: Worker) -> int:
-        """Move every checkpoint file in `w`'s session namespace to its
-        ring-successor's namespace and have the successor adopt it (the
-        cross-worker PR 8 path). Files with no live successor stay put
-        — the worker's own restart adopts them at boot. Returns the
-        number of sessions re-homed."""
+    def _rehome_sids(self, w: Worker) -> list[str]:
+        """Every session id `w` may be holding: its checkpoint
+        namespace (shared-fs deployments), plus the affinity table's
+        placements on it (the only record a cross-host dead worker
+        leaves behind). The default session is worker-local and never
+        re-homes."""
+        sids: set[str] = set()
         d = w.session_dir
-        if not d or not os.path.isdir(d):
-            return 0
-        moves: dict[str, tuple[Worker, list[str]]] = {}
-        for fn in sorted(os.listdir(d)):
-            if not fn.endswith(".json"):
-                continue
-            sid = fn[: -len(".json")]
+        if d and os.path.isdir(d):
+            for fn in os.listdir(d):
+                if fn.endswith(".json") and not fn.startswith("."):
+                    sids.add(fn[: -len(".json")])
+        with self._lock:
+            sids.update(
+                sid for sid, wid in self._table.items() if wid == w.id
+            )
+        sids.discard("default")
+        return sorted(sids)
+
+    def _rehome_one(self, sid: str, source: Worker, target: Worker) -> bool:
+        """Move one session from `source` to `target`, trying in order:
+        the same-filesystem file move (PR 15's fast path, unless
+        KSS_FLEET_TRANSPORT=http), the HTTP checkpoint transport (fetch
+        the digest-guarded unit from a still-serving source, push it to
+        the successor), and finally replica promotion (the source is
+        gone; the successor goes live from what the durability plane
+        shipped it). True ONLY on an acknowledged adoption."""
+        adopt_headers = {"Content-Type": "application/json"}
+        if self.transport != "http":
+            src = os.path.join(source.session_dir or "", f"{sid}.json")
+            if source.session_dir and os.path.exists(src):
+                try:
+                    # the successor's namespace may not exist yet —
+                    # session managers create their snapshot dir lazily
+                    os.makedirs(target.session_dir, exist_ok=True)
+                    shutil.move(
+                        src,
+                        os.path.join(target.session_dir, f"{sid}.json"),
+                    )
+                    # the write-ahead journal travels with its
+                    # checkpoint so the adopting restore replays the
+                    # post-snapshot tail
+                    jsrc = os.path.join(
+                        source.session_dir, f"{sid}.journal.jsonl"
+                    )
+                    if os.path.exists(jsrc):
+                        shutil.move(
+                            jsrc,
+                            os.path.join(
+                                target.session_dir, f"{sid}.journal.jsonl"
+                            ),
+                        )
+                except OSError:
+                    return False
+                try:
+                    status, _, _data = self._worker_call(
+                        target,
+                        "POST",
+                        "/api/v1/admin/adopt",
+                        timeout=self.adopt_timeout_s,
+                        idempotent=True,
+                    )
+                    return 200 <= status < 300
+                except OSError:
+                    # the files sit in the successor's namespace; its
+                    # next boot (or a pending-adopt retry) adopts them
+                    return False
+        # HTTP transport: fetch the unit from a still-serving source
+        unit = None
+        try:
+            status, _, data = self._worker_call(
+                source,
+                "GET",
+                f"/api/v1/admin/checkpoints/{sid}",
+                timeout=self.adopt_timeout_s,
+                idempotent=True,
+            )
+            if status == 200:
+                unit = json.loads(data)
+        except (OSError, ValueError):
+            unit = None
+        if unit is not None:
+            try:
+                status, _, data = self._worker_call(
+                    target,
+                    "POST",
+                    "/api/v1/admin/adopt",
+                    body=json.dumps({"checkpoints": [unit]}).encode(),
+                    headers=adopt_headers,
+                    timeout=self.adopt_timeout_s,
+                    idempotent=True,
+                )
+                doc = json.loads(data) if status == 200 else {}
+                if sid in (doc.get("adopted") or []) or sid in (
+                    doc.get("duplicate") or []
+                ):
+                    return True
+            except (OSError, ValueError):
+                pass
+            return False
+        # source gone: the successor promotes the replica the
+        # durability plane shipped it ("skipped" = already live there,
+        # e.g. an earlier attempt's adoption landed)
+        try:
+            status, _, data = self._worker_call(
+                target,
+                "POST",
+                "/api/v1/admin/adopt",
+                body=json.dumps({"promote": [sid]}).encode(),
+                headers=adopt_headers,
+                timeout=self.adopt_timeout_s,
+                idempotent=True,
+            )
+            doc = json.loads(data) if status == 200 else {}
+            return sid in (doc.get("promoted") or []) or sid in (
+                doc.get("skipped") or []
+            )
+        except (OSError, ValueError):
+            return False
+
+    def _rehome_from(self, w: Worker) -> int:
+        """Re-home every session `w` held to its ring successor and
+        count ONLY acknowledged adoptions (the honest accounting —
+        `kss_fleet_rehomed_sessions_total` used to count file moves the
+        successor never confirmed). Failures queue as pending adopts,
+        retried each probe tick. Returns sessions re-homed NOW."""
+        total = 0
+        for sid in self._rehome_sids(w):
             with self._lock:
                 owner = self._ring.owner(sid)
                 target = self._workers.get(owner) if owner else None
-            if target is None or target.id == w.id:
+            if target is None or target.id == w.id or target.state == "dead":
+                self._pend_adopt(sid, w.id)
                 continue
-            try:
-                # the successor's namespace may not exist yet — session
-                # managers create their snapshot dir lazily
-                os.makedirs(target.session_dir, exist_ok=True)
-                shutil.move(
-                    os.path.join(d, fn),
-                    os.path.join(target.session_dir, fn),
-                )
-            except OSError:
-                continue
-            moves.setdefault(target.id, (target, []))[1].append(sid)
-        total = 0
-        for target, sids in moves.values():
-            try:
-                _request(
-                    target.host,
-                    target.port,
-                    "POST",
-                    "/api/v1/admin/adopt",
-                    timeout=60.0,
-                )
-            except OSError:
-                # unreachable successor: the files sit in its namespace
-                # and its next boot adopts them — routed-to-it requests
-                # shed until then
-                pass
-            with self._lock:
-                for sid in sids:
+            if self._rehome_one(sid, w, target):
+                with self._lock:
                     self._table[sid] = target.id
                     self._rehomed += 1
-            total += len(sids)
+                    self._pending_adopts.pop(sid, None)
+                total += 1
+            else:
+                self._pend_adopt(sid, w.id)
         return total
+
+    def _pend_adopt(self, sid: str, source_wid: str) -> None:
+        with self._lock:
+            if sid not in self._pending_adopts:
+                self._pending_adopt_total += 1
+            self._pending_adopts[sid] = source_wid
+
+    def _retry_pending_adopts(self) -> None:
+        """Probe-tick retry of adoptions that failed at death/roll time
+        (unreachable successor, replica not yet promotable)."""
+        with self._lock:
+            pending = dict(self._pending_adopts)
+        for sid, src_wid in pending.items():
+            with self._lock:
+                source = self._workers.get(src_wid)
+                owner = self._ring.owner(sid)
+                target = self._workers.get(owner) if owner else None
+            if (
+                source is None
+                or target is None
+                or target.state == "dead"
+                or target.id == src_wid
+            ):
+                continue
+            if self._rehome_one(sid, source, target):
+                with self._lock:
+                    self._table[sid] = target.id
+                    self._rehomed += 1
+                    self._pending_adopts.pop(sid, None)
+
+    # -- worker calls: breaker + retries + fault sites ------------------------
+
+    def _worker_call(
+        self,
+        w: Worker,
+        method: str,
+        path: str,
+        *,
+        body: "bytes | None" = None,
+        headers: "dict | None" = None,
+        timeout: "float | None" = None,
+        idempotent: "bool | None" = None,
+    ) -> "tuple[int, dict, bytes]":
+        """EVERY router→worker data-plane exchange goes through here:
+        circuit-breaker gate, the ``worker_kill`` chaos site, then
+        `_request` (which fires the net fault sites) under a total
+        deadline budget with bounded exponential-backoff retries —
+        idempotent calls only; a non-idempotent POST that failed may
+        have been applied (the net_partition lesson) and must surface
+        the error instead. Raises `BreakerOpen` (an OSError) when the
+        breaker sheds the call without touching the socket."""
+        if idempotent is None:
+            idempotent = method == "GET"
+        budget = self.request_timeout_s if timeout is None else timeout
+        if not self._breaker_allow(w):
+            raise BreakerOpen(w.id)
+        plane = faultinject.active()
+        if plane is not None:
+            try:
+                plane.maybe_raise("worker_kill")
+            except faultinject.InjectedFault:
+                self._chaos_kill(w)
+                self._breaker_record(w, ok=False)
+                raise ConnectionResetError(
+                    f"injected fault: worker_kill ({w.id})"
+                ) from None
+        attempts = 1 + (max(0, self.retries) if idempotent else 0)
+        deadline = time.monotonic() + budget
+        last: "OSError | None" = None
+        for attempt in range(attempts):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                result = _request(
+                    w.host,
+                    w.port,
+                    method,
+                    path,
+                    body=body,
+                    headers=headers,
+                    timeout=remaining,
+                )
+            except OSError as e:
+                last = e
+                self._breaker_record(w, ok=False)
+                if attempt + 1 < attempts:
+                    with self._lock:
+                        self._retries_done += 1
+                    pause = min(
+                        self.retry_backoff_s * (2**attempt),
+                        max(0.0, deadline - time.monotonic()),
+                    )
+                    if pause > 0:
+                        time.sleep(pause)
+                continue
+            self._breaker_record(w, ok=True)
+            return result
+        if last is not None:
+            raise last
+        raise TimeoutError(
+            f"worker {w.id}: deadline budget {budget:.1f}s exhausted"
+        )
+
+    def _breaker_allow(self, w: Worker) -> bool:
+        """closed → allow; open → shed until KSS_FLEET_BREAKER_OPEN_S
+        elapses, then ONE half-open probe call; half-open → shed until
+        the probe's outcome closes or re-opens."""
+        with self._lock:
+            if w.breaker_state == "closed":
+                return True
+            if w.breaker_state == "open":
+                if (
+                    time.monotonic() - w.breaker_opened_at
+                    >= self.breaker_open_s
+                ):
+                    w.breaker_state = "half-open"
+                    return True
+                return False
+            return False  # half-open: the probe call is in flight
+
+    def _breaker_record(self, w: Worker, ok: bool) -> None:
+        with self._lock:
+            if ok:
+                w.breaker_state = "closed"
+                w.breaker_failures = 0
+                return
+            w.breaker_failures += 1
+            if (
+                w.breaker_state == "half-open"
+                or w.breaker_failures >= self.breaker_failures
+            ):
+                if w.breaker_state != "open":
+                    self._breaker_opens += 1
+                w.breaker_state = "open"
+                w.breaker_opened_at = time.monotonic()
+
+    def _chaos_kill(self, w: Worker) -> None:
+        """The ``worker_kill`` site's effect: SIGKILL the spawned
+        target — no drain, no snapshot; the probe loop notices the
+        corpse and the durability plane's replicas absorb the loss."""
+        with self._lock:
+            proc = w.proc
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.kill()
+            except OSError:
+                pass
+
+    # -- replication topology -------------------------------------------------
+
+    def push_replication(self) -> None:
+        """Push the current ring membership to every live worker so
+        each re-derives the SAME ring locally and ships its sessions to
+        its KSS_FLEET_REPLICAS successors (server/replication.py).
+        Called at fleet start and on every membership change (death,
+        roll). Control traffic: fault-exempt, failures best-effort —
+        the next push repairs a missed one."""
+        with self._lock:
+            members = [
+                (wid, self._workers[wid])
+                for wid in sorted(self._workers)
+                if self._workers[wid].state in ("ready", "degraded")
+            ]
+            peers = [{"id": wid, "url": w.url} for wid, w in members]
+        for wid, w in members:
+            body = {
+                "self": wid,
+                "peers": peers,
+                "replicas": self.fleet_replicas,
+                "everyS": self.replicate_every_s,
+            }
+            try:
+                _request(
+                    w.host,
+                    w.port,
+                    "POST",
+                    "/api/v1/admin/replication",
+                    body=json.dumps(body).encode(),
+                    headers={"Content-Type": "application/json"},
+                    timeout=self.adopt_timeout_s,
+                    faults=False,
+                )
+            except OSError:
+                pass
 
     # -- routing -------------------------------------------------------------
 
@@ -635,8 +1045,12 @@ class FleetRouter:
                         if ok:
                             w.state = "ready"
                             self._ring.add(w.id)
+                            # a fresh process: breaker history is stale
+                            w.breaker_state = "closed"
+                            w.breaker_failures = 0
                         else:
                             w.state = "dead"
+                    self.push_replication()
                 else:
                     # adopted members can't be restarted from here;
                     # drained + re-homed, they leave the ring until
@@ -651,7 +1065,12 @@ class FleetRouter:
     def _drain_http(self, w: Worker) -> None:
         try:
             _request(
-                w.host, w.port, "POST", "/api/v1/admin/drain", timeout=10.0
+                w.host,
+                w.port,
+                "POST",
+                "/api/v1/admin/drain",
+                timeout=10.0,
+                faults=False,
             )
         except OSError:
             return
@@ -659,7 +1078,12 @@ class FleetRouter:
         while time.monotonic() < deadline:
             try:
                 _, _, data = _request(
-                    w.host, w.port, "GET", "/api/v1/admin/drain", timeout=10.0
+                    w.host,
+                    w.port,
+                    "GET",
+                    "/api/v1/admin/drain",
+                    timeout=10.0,
+                    faults=False,
                 )
                 if json.loads(data).get("done"):
                     return
@@ -710,7 +1134,15 @@ class FleetRouter:
                 },
                 "sessions": dict(self._table),
                 "rehomedSessions": self._rehomed,
+                "pendingAdopts": dict(self._pending_adopts),
                 "shedRequests": self._shed,
+                "retries": self._retries_done,
+                "breakerOpens": self._breaker_opens,
+                "transport": self.transport or "auto",
+                "replication": {
+                    "replicas": self.fleet_replicas,
+                    "everySeconds": self.replicate_every_s,
+                },
                 "roll": dict(self._roll_state),
             }
 
@@ -719,8 +1151,8 @@ class FleetRouter:
         workers: dict[str, dict] = {}
         for w in self.live_workers():
             try:
-                _, _, data = _request(
-                    w.host, w.port, "GET", "/api/v1/sessions", timeout=30.0
+                _, _, data = self._worker_call(
+                    w, "GET", "/api/v1/sessions", timeout=30.0
                 )
                 doc = json.loads(data)
             except (OSError, ValueError):
@@ -741,8 +1173,8 @@ class FleetRouter:
         agg = {"passes": 0, "totalScheduled": 0}
         for w in self.live_workers():
             try:
-                _, _, data = _request(
-                    w.host, w.port, "GET", "/api/v1/metrics", timeout=30.0
+                _, _, data = self._worker_call(
+                    w, "GET", "/api/v1/metrics", timeout=30.0
                 )
                 doc = json.loads(data)
             except (OSError, ValueError):
@@ -754,8 +1186,8 @@ class FleetRouter:
             # aggregate sums every session's counters (default included)
             # from the worker's session listing.
             try:
-                _, _, sdata = _request(
-                    w.host, w.port, "GET", "/api/v1/sessions", timeout=30.0
+                _, _, sdata = self._worker_call(
+                    w, "GET", "/api/v1/sessions", timeout=30.0
                 )
                 session_docs = json.loads(sdata).get("sessions") or []
             except (OSError, ValueError):
@@ -772,12 +1204,18 @@ class FleetRouter:
             )
             rehomed = self._rehomed
             shed = self._shed
+            retries = self._retries_done
+            breaker_opens = self._breaker_opens
+            pending = len(self._pending_adopts)
         return {
             "fleet": True,
             "workersTotal": total,
             "workersReady": ready,
             "rehomedSessions": rehomed,
             "shedRequests": shed,
+            "retries": retries,
+            "breakerOpens": breaker_opens,
+            "pendingAdopts": pending,
             "aggregate": agg,
             "workers": workers_doc,
         }
@@ -791,9 +1229,8 @@ class FleetRouter:
         texts: list[str] = []
         for w in self.live_workers():
             try:
-                status, _, data = _request(
-                    w.host,
-                    w.port,
+                status, _, data = self._worker_call(
+                    w,
                     "GET",
                     "/api/v1/metrics?format=prometheus",
                     timeout=30.0,
@@ -822,11 +1259,17 @@ class FleetRouter:
             )
             rehomed = self._rehomed
             shed = self._shed
+            retries = self._retries_done
+            breaker_opens = self._breaker_opens
+            pending = self._pending_adopt_total
         values = {
             "kss_fleet_workers": total,
             "kss_fleet_workers_ready": ready,
             "kss_fleet_rehomed_sessions_total": rehomed,
             "kss_fleet_router_shed_total": shed,
+            "kss_fleet_retries_total": retries,
+            "kss_fleet_breaker_open_total": breaker_opens,
+            "kss_fleet_pending_adopts_total": pending,
         }
         out = []
         for name, mtype, help_text in _ROUTER_FAMILY_DEFS:
@@ -843,8 +1286,8 @@ class FleetRouter:
         counters: dict[str, float] = {}
         for w in self.live_workers():
             try:
-                _, _, data = _request(
-                    w.host, w.port, "GET", "/api/v1/alerts", timeout=30.0
+                _, _, data = self._worker_call(
+                    w, "GET", "/api/v1/alerts", timeout=30.0
                 )
                 doc = json.loads(data)
             except (OSError, ValueError):
@@ -879,9 +1322,8 @@ class FleetRouter:
         workers: dict[str, dict] = {}
         for w in self.live_workers():
             try:
-                _, _, data = _request(
-                    w.host,
-                    w.port,
+                _, _, data = self._worker_call(
+                    w,
                     "GET",
                     f"/api/v1/timeseries{qs}",
                     timeout=30.0,
@@ -980,6 +1422,47 @@ def _make_router_handler(router: FleetRouter):
                 headers={"Retry-After": str(RETRY_AFTER_S)},
             )
 
+        def _shed_breaker(self, w: Worker):
+            """The circuit-open shed: Retry-After hints the breaker's
+            half-open horizon instead of the generic backoff."""
+            router.count_shed()
+            return self._error(
+                503,
+                f"worker {w.id} circuit breaker open; retry shortly",
+                kind="CircuitOpen",
+                headers={
+                    "Retry-After": str(
+                        max(1, int(round(router.breaker_open_s)))
+                    )
+                },
+            )
+
+        def _faultinject(self):
+            body = {}
+            raw = self._read_body()
+            if raw:
+                try:
+                    body = json.loads(raw)
+                except ValueError:
+                    return self._error(
+                        400, "fault spec must be a JSON mapping"
+                    )
+            if not isinstance(body, dict):
+                return self._error(400, "fault spec must be a mapping")
+            spec = (body.get("spec") or "").strip()
+            if not spec:
+                faultinject.deactivate()
+                return self._json(200, {"active": False, "sites": {}})
+            try:
+                seed = int(body.get("seed") or 0)
+                plane = faultinject.FaultPlane.parse(spec, seed=seed)
+            except ValueError as e:
+                return self._error(400, str(e), kind="BadFaultSpec")
+            faultinject.activate(plane)
+            return self._json(
+                200, {"active": True, "sites": plane.rules, "seed": seed}
+            )
+
         def _read_body(self) -> bytes:
             length = int(self.headers.get("Content-Length") or 0)
             return self.rfile.read(length) if length else b""
@@ -1010,6 +1493,26 @@ def _make_router_handler(router: FleetRouter):
                             doc = dict(router.fleet_doc()["roll"])
                             doc["started"] = started
                             return self._json(202, doc)
+                        return self._error(405, "method not allowed")
+                    if rest == ["fleet", "faultinject"]:
+                        # arm/disarm the chaos plane from outside
+                        # (tools/fleet_chaos_smoke.py): {"spec": "...",
+                        # "seed": n}; empty spec disarms. Probes and
+                        # other control traffic stay exempt.
+                        if method == "POST":
+                            return self._faultinject()
+                        if method == "GET":
+                            plane = faultinject.active()
+                            return self._json(
+                                200,
+                                {
+                                    "active": plane is not None,
+                                    "sites": plane.rules if plane else {},
+                                    "injected": (
+                                        plane.counts() if plane else {}
+                                    ),
+                                },
+                            )
                         return self._error(405, "method not allowed")
                     if rest == ["healthz"] and method == "GET":
                         return self._json(200, router.health_doc())
@@ -1107,15 +1610,19 @@ def _make_router_handler(router: FleetRouter):
             body["id"] = sid
             data = json.dumps(body).encode()
             try:
-                status, headers, resp_body = _request(
-                    w.host,
-                    w.port,
+                # non-idempotent: one attempt — a create that failed
+                # mid-flight may have landed (net_partition), and the
+                # client's retry of the 503 is duplicate-safe upstream
+                status, headers, resp_body = router._worker_call(
+                    w,
                     "POST",
                     "/api/v1/sessions",
                     body=data,
                     headers={"Content-Type": "application/json"},
-                    timeout=PROXY_TIMEOUT_S,
+                    idempotent=False,
                 )
+            except BreakerOpen:
+                return self._shed_breaker(w)
             except OSError:
                 return self._shed(
                     f"worker {w.id} unreachable for session create; "
@@ -1140,10 +1647,13 @@ def _make_router_handler(router: FleetRouter):
             return None
 
         def _proxy(self, w: Worker, method: str, url) -> "int | None":
-            """Pass the request through to `w` verbatim — buffered for
-            normal routes, streamed for the SSE/watch surfaces — and
-            relay status + Content-Type + Retry-After back. Returns the
-            upstream status (None when shed)."""
+            """Pass the request through to `w` — buffered routes ride
+            `_worker_call` (breaker gate, fault sites, idempotent-GET
+            retries, the KSS_FLEET_REQUEST_TIMEOUT_S budget); the
+            SSE/watch surfaces stream directly (a retry would replay
+            the event history). Relays status + Content-Type +
+            Retry-After back; returns the upstream status (None when
+            shed)."""
             path_qs = url.path + (f"?{url.query}" if url.query else "")
             body = self._read_body() or None
             stream = url.path.rstrip("/").endswith(
@@ -1153,19 +1663,51 @@ def _make_router_handler(router: FleetRouter):
             ct = self.headers.get("Content-Type")
             if ct:
                 headers["Content-Type"] = ct
-            conn = http.client.HTTPConnection(
-                w.host,
-                w.port,
-                timeout=None if stream else PROXY_TIMEOUT_S,
-            )
+            if stream:
+                return self._proxy_stream(w, method, path_qs, body, headers)
+            try:
+                status, rheaders, data = router._worker_call(
+                    w,
+                    method,
+                    path_qs,
+                    body=body,
+                    headers=headers,
+                    idempotent=(method == "GET"),
+                )
+            except BreakerOpen:
+                self._shed_breaker(w)
+                return None
+            except OSError:
+                self._shed(f"worker {w.id} unreachable; retry shortly")
+                return None
+            self.send_response(status)
+            for name in ("Content-Type", "Retry-After"):
+                v = rheaders.get(name)
+                if v:
+                    self.send_header(name, v)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            if data:
+                self.wfile.write(data)
+            return status
+
+        def _proxy_stream(
+            self, w: Worker, method: str, path_qs: str, body, headers
+        ) -> "int | None":
+            if not router._breaker_allow(w):
+                self._shed_breaker(w)
+                return None
+            conn = http.client.HTTPConnection(w.host, w.port, timeout=None)
             try:
                 try:
                     conn.request(method, path_qs, body=body, headers=headers)
                     resp = conn.getresponse()
                 except OSError:
+                    router._breaker_record(w, ok=False)
                     self._shed(f"worker {w.id} unreachable; retry shortly")
                     return None
-                if stream and resp.status == 200:
+                router._breaker_record(w, ok=True)
+                if resp.status == 200:
                     self._stream_through(resp)
                     return 200
                 data = resp.read()

@@ -33,6 +33,7 @@ the previous checkpoint intact, never a torn one.
 from __future__ import annotations
 
 import copy
+import hashlib
 import json
 import os
 
@@ -86,6 +87,22 @@ def checkpoint_doc(engine) -> dict:
         },
         "metrics": engine.scheduler.metrics.state_dict(),
     }
+
+
+def canonical_bytes(doc) -> bytes:
+    """The ONE serialization every digest in the durability plane is
+    computed over: sorted keys, tight separators — the same shape
+    `write_checkpoint` persists, so a digest taken from a document in
+    memory matches the digest of its on-disk file."""
+    return json.dumps(doc, separators=(",", ":"), sort_keys=True).encode()
+
+
+def canonical_digest(doc) -> str:
+    """sha256 hex over `canonical_bytes(doc)` — the payload digest the
+    cross-host checkpoint transport verifies on receive (docs/fleet.md):
+    a torn or corrupted transfer changes the digest and is rejected
+    instead of adopted."""
+    return hashlib.sha256(canonical_bytes(doc)).hexdigest()
 
 
 def write_checkpoint(doc: dict, path: str) -> str:

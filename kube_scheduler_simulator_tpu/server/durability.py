@@ -1,0 +1,302 @@
+"""The session durability plane: write-ahead journals + checkpoint
+transport units (docs/fleet.md).
+
+PR 15's fleet promises zero acknowledged-write loss only on the graceful
+paths (SIGTERM drain, eviction) — a SIGKILL'd worker loses every write
+since its last snapshot, and the re-home path `shutil.move`s files
+between session dirs, which only works on a shared filesystem. This
+module supplies the two primitives that close both gaps:
+
+  * **SessionJournal** — an append-only JSONL of a session's
+    acknowledged store mutations, fed synchronously from the store's
+    watch-event dispatch (models/store.py `subscribe`): the event fires
+    on the mutating request thread AFTER the mutation commits and
+    BEFORE the HTTP layer acknowledges it, so a journaled write is
+    exactly an acknowledged write. Each record carries the mutation's
+    resourceVersion and the post-mutation object VERBATIM (rv/uid
+    included), which makes replay byte-exact and idempotent: replaying
+    a record the snapshot already contains is filtered by rv, and
+    replaying verbatim objects twice lands the same state.
+    ``KSS_FLEET_JOURNAL_SYNC=1`` fsyncs every append (and lets the
+    replication plane ship it inline) — crash-kill then loses nothing.
+
+  * **Transport units** — ``{"id", "sha256", "doc", "journal",
+    "journalSha256"}``: a ``kss-session-checkpoint/v1`` document plus
+    the journal entries past its store rv, each guarded by a sha256
+    over `lifecycle.checkpoint.canonical_bytes`. The receive side
+    (`verify_unit`) recomputes both digests and rejects mismatches —
+    a torn or corrupted transfer is refused, never adopted.
+
+`replay_store_state` is the adopt-side replay: a pure function over the
+`ResourceStore.dump_state` shape, so it runs on checkpoint documents
+BEFORE a service is built from them — replay never re-triggers
+controllers, schedulers, or admission.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from ..lifecycle.checkpoint import canonical_digest
+from ..models.store import KINDS, ResourceStore, WatchEvent
+from ..utils import locking
+
+# journal + replica file layout inside a session snapshot dir:
+#   <dir>/<sid>.json                   live checkpoint (adopt_snapshots)
+#   <dir>/<sid>.journal.jsonl          the session's write-ahead journal
+#   <dir>/replicas/<sid>.json          passively held successor replica
+#   <dir>/replicas/<sid>.journal.jsonl the replica's shipped journal
+JOURNAL_SUFFIX = ".journal.jsonl"
+REPLICA_SUBDIR = "replicas"
+
+
+def journal_path(snapshot_dir: str, sid: str) -> str:
+    return os.path.join(snapshot_dir, f"{sid}{JOURNAL_SUFFIX}")
+
+
+def replica_dir(snapshot_dir: str) -> str:
+    return os.path.join(snapshot_dir, REPLICA_SUBDIR)
+
+
+def replica_paths(snapshot_dir: str, sid: str) -> "tuple[str, str]":
+    d = replica_dir(snapshot_dir)
+    return (
+        os.path.join(d, f"{sid}.json"),
+        os.path.join(d, f"{sid}{JOURNAL_SUFFIX}"),
+    )
+
+
+@locking.guard_inferred
+class SessionJournal:
+    """One session's write-ahead mutation journal.
+
+    Appends happen on the mutating thread (store event dispatch), so
+    ordering matches the store's event log by construction. ``sync``
+    fsyncs each append — the KSS_FLEET_JOURNAL_SYNC durability mode.
+    ``base_rv`` is the resourceVersion high-water mark of the last full
+    snapshot; entries at or below it are superseded and dropped on the
+    next `rebase` (the snapshot IS those writes).
+    """
+
+    def __init__(self, path: str, base_rv: int = 0, sync: bool = False):
+        self.path = path
+        self.sync = bool(sync)
+        self._lock = locking.make_lock("durability.journal")
+        self.base_rv = int(base_rv)
+        self.appended = 0
+        self.bytes_written = 0
+        # the sync-replication hook (server/replication.py): called with
+        # each appended entry AFTER it is durable locally, still on the
+        # acknowledging thread — the inline successor ship
+        self.on_append = None
+
+    def record(self, ev: WatchEvent) -> None:
+        """Append one store watch event (the subscriber entry point)."""
+        self.append(
+            {
+                "rv": ev.resource_version,
+                "t": ev.event_type,
+                "k": ev.kind,
+                "o": ev.obj,
+            }
+        )
+
+    def append(self, entry: dict) -> None:
+        line = json.dumps(entry, separators=(",", ":"), sort_keys=True)
+        data = line.encode() + b"\n"
+        with self._lock:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            with open(self.path, "ab") as f:
+                f.write(data)
+                if self.sync:
+                    f.flush()
+                    os.fsync(f.fileno())
+            self.appended += 1
+            self.bytes_written += len(data)
+            hook = self.on_append
+        if hook is not None:
+            hook(entry)
+
+    def entries(self, since_rv: "int | None" = None) -> list[dict]:
+        """Parsed journal records past `since_rv` (default: base_rv).
+        A torn final line — the crash artifact an unsynced append can
+        leave — is skipped, not fatal: everything before it was
+        acknowledged with an intact record."""
+        with self._lock:
+            floor = self.base_rv if since_rv is None else int(since_rv)
+            return read_journal(self.path, floor)
+
+    def counters(self) -> "tuple[int, int]":
+        """(appends, bytes written) so far — cumulative across rebases."""
+        with self._lock:
+            return (self.appended, self.bytes_written)
+
+    def rebase(self, base_rv: int) -> None:
+        """A full snapshot at `base_rv` just landed: entries it covers
+        are obsolete — truncate the file and move the floor."""
+        with self._lock:
+            self.base_rv = int(base_rv)
+            try:
+                with open(self.path, "wb"):
+                    pass
+            except OSError:
+                pass
+
+    def drop(self) -> None:
+        with self._lock:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+
+
+def write_journal(path: str, entries: "list[dict]") -> str:
+    """Atomically replace the journal at `path` with `entries` — the
+    replica-receive path (a shipped unit's journal REPLACES the held
+    copy; same tmp+fsync+rename discipline as `write_checkpoint`)."""
+    directory = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(directory, exist_ok=True)
+    tmp = os.path.join(directory, f".{os.path.basename(path)}.tmp-{os.getpid()}")
+    try:
+        with open(tmp, "wb") as f:
+            for entry in entries:
+                f.write(
+                    json.dumps(
+                        entry, separators=(",", ":"), sort_keys=True
+                    ).encode()
+                    + b"\n"
+                )
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return path
+
+
+def read_journal(path: str, since_rv: int = 0) -> list[dict]:
+    """Read a journal file's records past `since_rv`, tolerating a torn
+    tail line (see `SessionJournal.entries`)."""
+    out: list[dict] = []
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError:
+        return out
+    for line in raw.splitlines():
+        if not line.strip():
+            continue
+        try:
+            entry = json.loads(line)
+        except ValueError:
+            continue  # torn tail: the write it belonged to never ack'd
+        if isinstance(entry, dict) and int(entry.get("rv", 0)) > since_rv:
+            out.append(entry)
+    return out
+
+
+def replay_store_state(state: dict, entries: "list[dict]") -> dict:
+    """Replay journal `entries` on top of a `ResourceStore.dump_state`
+    dump, returning the advanced dump.
+
+    Pure and idempotent: entries with ``rv <= state["rv"]`` are already
+    IN the snapshot and are skipped, so replaying a journal twice (the
+    double-adopt case) lands exactly one state. Objects land verbatim
+    (rv/uid preserved) in event order, reproducing the insertion order
+    the live store would have: ADDED (re-)inserts at the end, MODIFIED
+    replaces in place, DELETED removes.
+    """
+    base_rv = int(state.get("rv", 0))
+    books: "dict[str, dict[str, dict]]" = {}
+    for kind in KINDS:
+        book: "dict[str, dict]" = {}
+        for obj in (state.get("objects") or {}).get(kind) or []:
+            book[ResourceStore.key(kind, obj)] = obj
+        books[kind] = book
+    rv = base_rv
+    for entry in sorted(entries, key=lambda e: int(e.get("rv", 0))):
+        erv = int(entry.get("rv", 0))
+        if erv <= base_rv:
+            continue  # already folded into the snapshot
+        kind = entry.get("k")
+        obj = entry.get("o")
+        if kind not in KINDS or not isinstance(obj, dict):
+            continue
+        key = ResourceStore.key(kind, obj)
+        etype = entry.get("t")
+        if etype == "DELETED":
+            books[kind].pop(key, None)
+        elif etype == "ADDED":
+            books[kind].pop(key, None)
+            books[kind][key] = obj
+        else:  # MODIFIED (or unknown: treat as upsert-in-place)
+            books[kind][key] = obj
+        rv = max(rv, erv)
+    return {
+        "rv": rv,
+        "objects": {kind: list(book.values()) for kind, book in books.items()},
+    }
+
+
+def replay_into_doc(doc: dict, entries: "list[dict]") -> dict:
+    """A copy of `doc` with `entries` replayed into its store state
+    (the input document is left untouched — it may be a still-verified
+    transport payload). Counters/passSeq stay at the snapshot's values —
+    the journal guarantees resource state, and the failure matrix
+    (docs/fleet.md) says so out loud."""
+    if not entries:
+        return doc
+    out = dict(doc)
+    out["store"] = replay_store_state(doc.get("store") or {}, entries)
+    return out
+
+
+# -- transport units ---------------------------------------------------------
+
+
+def build_unit(sid: str, doc: dict, entries: "list[dict] | None") -> dict:
+    """The wire shape one session travels as (docs/fleet.md): digests
+    computed over the canonical serialization, so the receiver can
+    verify without trusting the transport."""
+    unit = {"id": sid, "doc": doc, "sha256": canonical_digest(doc)}
+    if entries:
+        unit["journal"] = entries
+        unit["journalSha256"] = canonical_digest(entries)
+    return unit
+
+
+def verify_unit(unit: dict) -> "tuple[dict, list[dict]]":
+    """Validate a transport unit: shape, checkpoint format, and both
+    payload digests. Returns (doc, journal entries); raises ValueError
+    with a torn-transfer diagnosis on any mismatch."""
+    if not isinstance(unit, dict):
+        raise ValueError("transport unit must be a mapping")
+    doc = unit.get("doc")
+    if not isinstance(doc, dict):
+        raise ValueError("transport unit carries no checkpoint document")
+    claimed = unit.get("sha256")
+    if not claimed:
+        raise ValueError("transport unit carries no sha256 digest")
+    actual = canonical_digest(doc)
+    if actual != claimed:
+        raise ValueError(
+            f"checkpoint digest mismatch (claimed {claimed[:12]}…, got "
+            f"{actual[:12]}…): torn or corrupted transfer, refusing to adopt"
+        )
+    entries = unit.get("journal") or []
+    if not isinstance(entries, list):
+        raise ValueError("transport unit journal must be a list")
+    if entries:
+        jclaimed = unit.get("journalSha256")
+        if not jclaimed:
+            raise ValueError("transport unit journal carries no digest")
+        jactual = canonical_digest(entries)
+        if jactual != jclaimed:
+            raise ValueError(
+                f"journal digest mismatch (claimed {jclaimed[:12]}…, got "
+                f"{jactual[:12]}…): torn or corrupted transfer, refusing "
+                f"to adopt"
+            )
+    return doc, entries

@@ -106,6 +106,7 @@ from ..utils import metrics as metrics_mod
 from ..utils import slo as slo_mod
 from ..utils import telemetry
 from ..utils.broker import CompileDeadlineExceeded, CompileUnavailable
+from .replication import ReplicationPlane
 from .service import (
     EngineDegraded,
     InvalidSchedulerConfiguration,
@@ -170,6 +171,15 @@ class SimulatorServer:
         # SHARED CompileBroker + admission knobs. `session_config`
         # overrides the KSS_* environment (tests, embedded drivers).
         self.sessions = SessionManager(self.service, **(session_config or {}))
+        # the fleet durability plane's shipper (server/replication.py):
+        # dormant until the router pushes a peer topology through
+        # POST /api/v1/admin/replication. Registered with the manager so
+        # drain ships one last round and sync-mode journal appends ride
+        # the acknowledging thread to the ring successors.
+        self.replication = ReplicationPlane(
+            self.sessions, env=(session_config or {}).get("env")
+        )
+        self.sessions.set_replication(self.replication)
         # SSE subscriber accounting (the satellite hardening): live
         # subscriber count against the manager's cap, and the events
         # dropped on slow consumers (surfaced as sseDroppedEvents)
@@ -476,20 +486,96 @@ def _make_handler(server: SimulatorServer):
                     if method == "GET":
                         return self._json(200, server.drain_status())
                     return self._error(405, "method not allowed")
-                if rest == ["admin", "adopt"] and not server.draining:
-                    # re-scan KSS_SESSION_DIR for checkpoint documents
-                    # and register any new ones — the fleet router's
-                    # re-home path moves a dead worker's snapshots into
-                    # a successor's directory and POSTs here so they go
-                    # live without a restart (docs/fleet.md). Idempotent:
-                    # ids already present are skipped. A DRAINING server
-                    # falls through to the shed below — it must not
-                    # admit tenants its own drain will never snapshot.
+                if rest[:2] == ["admin", "checkpoints"] and method == "GET":
+                    # the cross-host checkpoint transport's read side
+                    # (docs/fleet.md): list sessions + payload digests,
+                    # or fetch one as a digest-guarded transport unit.
+                    # Deliberately ABOVE the draining shed — the router
+                    # re-homes a draining/drained worker's sessions by
+                    # fetching them from here.
+                    if len(rest) == 2:
+                        return self._json(
+                            200, server.sessions.checkpoint_index()
+                        )
+                    if len(rest) == 3:
+                        unit = server.sessions.checkpoint_unit(rest[2])
+                        if unit is None:
+                            return self._error(
+                                404,
+                                f"no checkpoint for session {rest[2]!r}",
+                                kind="UnknownSession",
+                            )
+                        return self._json(200, unit)
+                if rest == ["admin", "replication"]:
+                    # router-pushed replication topology (docs/fleet.md);
+                    # answerable while draining — membership pushes race
+                    # rolling drains and must not bounce
                     if method == "POST":
                         return self._json(
                             200,
-                            {"adopted": server.sessions.adopt_snapshots()},
+                            server.replication.configure(self._body() or {}),
                         )
+                    if method == "GET":
+                        return self._json(200, server.replication.stats())
+                    return self._error(405, "method not allowed")
+                if rest == ["admin", "adopt"] and not server.draining:
+                    # session adoption (docs/fleet.md). The body selects
+                    # the mode; an empty body is the legacy shared-dir
+                    # re-scan of KSS_SESSION_DIR. Body-carried modes:
+                    #   {"checkpoints": [unit...]}           adopt live
+                    #   {"checkpoints": [...], "replica": 1} hold passively
+                    #   {"journalAppend": {...}}             sync-mode entry
+                    #   {"promote": [sid...]}                replica -> live
+                    # All idempotent: known ids are skipped, digests are
+                    # verified before anything lands. A DRAINING server
+                    # falls through to the shed below — it must not
+                    # admit tenants its own drain will never snapshot.
+                    if method == "POST":
+                        body = self._body()
+                        if not body:
+                            return self._json(
+                                200,
+                                {"adopted": server.sessions.adopt_snapshots()},
+                            )
+                        if not isinstance(body, dict):
+                            return self._error(
+                                400,
+                                "adopt body must be a JSON object",
+                                kind="BadAdoptBody",
+                            )
+                        try:
+                            doc = {}
+                            if body.get("checkpoints") is not None:
+                                doc.update(
+                                    server.sessions.receive_checkpoints(
+                                        body["checkpoints"],
+                                        replica=bool(body.get("replica")),
+                                    )
+                                )
+                            if body.get("journalAppend") is not None:
+                                doc.update(
+                                    server.sessions.append_replica_journal(
+                                        body["journalAppend"]
+                                    )
+                                )
+                            if body.get("promote") is not None:
+                                doc.update(
+                                    server.sessions.promote_replicas(
+                                        body["promote"] or None
+                                    )
+                                )
+                        except ValueError as e:
+                            return self._error(
+                                400, str(e), kind="BadAdoptBody"
+                            )
+                        if not doc:
+                            return self._error(
+                                400,
+                                "adopt body carries none of checkpoints/"
+                                "journalAppend/promote",
+                                kind="BadAdoptBody",
+                            )
+                        return self._json(200, doc)
                     return self._error(405, "method not allowed")
                 if server.draining and not (
                     method == "GET" and rest == ["metrics"]
@@ -1284,25 +1370,43 @@ def _make_handler(server: SimulatorServer):
                     entries = [entry(sid, doc, doc["encodingCacheCapacity"])]
                     slo_planes = [(sid, svc.scheduler.metrics.slo_plane())]
                 mgr_stats = server.sessions.stats()
+                global_counters = {
+                    "kss_sse_dropped_events_total": (
+                        "Events dropped disconnecting slow SSE "
+                        "subscribers.",
+                        server.sse_dropped,
+                    ),
+                    "kss_session_evictions_total": (
+                        "Idle sessions snapshotted to disk.",
+                        mgr_stats["evictions"],
+                    ),
+                    "kss_drained_sessions_total": (
+                        "Sessions snapshotted by the graceful drain "
+                        "path.",
+                        mgr_stats["drainedSessions"],
+                    ),
+                }
+                if mgr_stats["journal"]["armed"] or mgr_stats[
+                    "replication"
+                ].get("armed"):
+                    # the durability-plane families exist only where the
+                    # plane does: a standalone unarmed server keeps its
+                    # honest kss_fleet_-free exposition (fleet workers
+                    # always journal — the router arms them)
+                    global_counters["kss_fleet_replications_total"] = (
+                        "Session transport units acknowledged by ring "
+                        "successors (server/replication.py).",
+                        mgr_stats["replication"].get("shippedUnits", 0),
+                    )
+                    global_counters["kss_fleet_journal_bytes_total"] = (
+                        "Write-ahead session journal bytes appended "
+                        "(server/durability.py).",
+                        mgr_stats["journal"]["bytes"],
+                    )
                 text = metrics_mod.render_prometheus_sessions(
                     entries,
                     openmetrics=openmetrics,
-                    global_counters={
-                        "kss_sse_dropped_events_total": (
-                            "Events dropped disconnecting slow SSE "
-                            "subscribers.",
-                            server.sse_dropped,
-                        ),
-                        "kss_session_evictions_total": (
-                            "Idle sessions snapshotted to disk.",
-                            mgr_stats["evictions"],
-                        ),
-                        "kss_drained_sessions_total": (
-                            "Sessions snapshotted by the graceful drain "
-                            "path.",
-                            mgr_stats["drainedSessions"],
-                        ),
-                    },
+                    global_counters=global_counters,
                     global_gauges={
                         "kss_sessions_live": (
                             "Sessions resident in memory.",

@@ -1,0 +1,311 @@
+"""Worker-side successor replication — the fleet durability plane's
+shipping half (docs/fleet.md).
+
+The router places sessions on a consistent-hash ring (fleet/ring.py) and
+tells every worker who its peers are (`POST /api/v1/admin/replication`,
+pushed at fleet start and on every membership change). Each worker then
+re-derives the SAME ring locally — ownership is a pure sha256 function
+of (worker set, replicas, key), so router and workers agree with no
+coordination protocol — and ships each session it owns to its
+``KSS_FLEET_REPLICAS`` ring successors:
+
+  * on a ``KSS_FLEET_REPLICATE_EVERY_S`` cadence (the ticker thread):
+    the session's replication base document + the journal entries past
+    it, as a digest-guarded transport unit (server/durability.py),
+    POSTed to each successor's adopt endpoint with ``"replica": true``
+    — the receiver stores it passively under ``<dir>/replicas/``,
+    never adopting until the router promotes;
+  * inline per acknowledged write when ``KSS_FLEET_JOURNAL_SYNC=1``
+    (the journal's ``on_append`` hook): the entry rides a
+    ``journalAppend`` body to the same successors BEFORE the HTTP ack
+    returns, so a crash-kill loses nothing;
+  * once more at drain (`ship_once` from the drain path), closing the
+    window for the graceful exit too.
+
+A successor that is down just misses this round: shipping NEVER raises
+into the serving path — durability degrades to the previous round's
+replica and the counters say so (``kss_fleet_replications_total`` stops
+advancing, ``shipErrors`` climbs).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import threading
+import time
+from urllib.parse import urlsplit
+
+from ..fleet.ring import HashRing
+from ..lifecycle.checkpoint import canonical_digest
+from ..utils import locking
+
+
+def _env_int(env, name: str, default: int, minimum: int) -> int:
+    raw = env.get(name, "")
+    if not raw:
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r}: expected an integer") from None
+    if v < minimum:
+        raise ValueError(f"{name}={raw!r}: must be >= {minimum}")
+    return v
+
+
+def _env_float(env, name: str, default: float, minimum: float) -> float:
+    raw = env.get(name, "")
+    if not raw:
+        return default
+    try:
+        v = float(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r}: expected a number") from None
+    if v < minimum:
+        raise ValueError(f"{name}={raw!r}: must be >= {minimum}")
+    return v
+
+
+def _post_json(url: str, path: str, body: dict, timeout: float) -> dict:
+    """POST `body` to `url` + `path`; returns the decoded JSON response.
+    Raises OSError-family on transport failure, ValueError on a non-2xx
+    status — the caller counts either as one missed ship."""
+    parts = urlsplit(url)
+    conn = http.client.HTTPConnection(
+        parts.hostname, parts.port, timeout=timeout
+    )
+    try:
+        conn.request(
+            "POST",
+            path,
+            body=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        data = resp.read()
+        if not 200 <= resp.status < 300:
+            raise ValueError(
+                f"{url}{path}: HTTP {resp.status} {data[:200]!r}"
+            )
+        try:
+            return json.loads(data) if data else {}
+        except ValueError:
+            return {}
+    finally:
+        conn.close()
+
+
+@locking.guard_inferred
+class ReplicationPlane:
+    """One worker's view of the replication topology + the shipper.
+
+    Dormant until `configure` delivers a peer list (a standalone server
+    with no fleet around it never ships). The manager back-reference is
+    how units are built (`SessionManager.replication_unit`) — the plane
+    owns WHO and WHEN, the manager owns WHAT.
+    """
+
+    def __init__(self, manager, env: "dict | None" = None):
+        env = os.environ if env is None else env
+        self.manager = manager
+        self.replicas = _env_int(env, "KSS_FLEET_REPLICAS", 1, 0)
+        self.every_s = _env_float(env, "KSS_FLEET_REPLICATE_EVERY_S", 5.0, 0.05)
+        # the successor push shares the adopt deadline budget: a slow
+        # replica must not wedge the ticker (or, in sync mode, the ack)
+        self.ship_timeout_s = _env_float(
+            env, "KSS_FLEET_ADOPT_TIMEOUT_S", 60.0, 0.05
+        )
+        self._lock = locking.make_lock("replication.plane")
+        self.self_id = env.get("KSS_WORKER_ID") or ""
+        self._peers: "dict[str, str]" = {}  # wid -> base url
+        self._ring: "HashRing | None" = None
+        self.ships = 0  # ship rounds completed
+        self.shipped_units = 0  # unit x successor deliveries acknowledged
+        self.shipped_entries = 0  # sync-mode journal entries delivered
+        self.ship_errors = 0  # deliveries a dead/slow successor missed
+        self.skipped_units = 0  # unchanged units the digest memo elided
+        # (sid, successor wid) -> (base digest, journal digest) of the
+        # last unit that successor ACKNOWLEDGED: an idle session costs
+        # one digest comparison per round, not a full unit POST
+        self._shipped_digests: "dict[tuple[str, str], tuple]" = {}
+        self._stop = threading.Event()
+        self._ticker: "threading.Thread | None" = None
+
+    # -- topology -----------------------------------------------------------
+
+    def configure(self, doc: dict) -> dict:
+        """Install the router-pushed topology: ``{"self": wid, "peers":
+        [{"id", "url"}...], "replicas": R, "everyS": s}``. Idempotent;
+        re-pushes on membership change just rebuild the ring. Starts
+        (or leaves running) the ticker when there is anyone to ship to."""
+        peers_doc = doc.get("peers") or []
+        peers: "dict[str, str]" = {}
+        for p in peers_doc:
+            if isinstance(p, dict) and p.get("id") and p.get("url"):
+                peers[str(p["id"])] = str(p["url"])
+        with self._lock:
+            if doc.get("self"):
+                self.self_id = str(doc["self"])
+            if doc.get("replicas") is not None:
+                self.replicas = max(0, int(doc["replicas"]))
+            if doc.get("everyS") is not None:
+                self.every_s = max(0.05, float(doc["everyS"]))
+            self._peers = peers
+            # the SAME ring the router builds (fleet/ring.py default
+            # virtual-node count): placement agreement by construction
+            self._ring = HashRing(sorted(peers)) if peers else None
+            # membership changed: a successor may have restarted with
+            # an empty disk, so the digest memo can no longer prove a
+            # replica is current — re-ship everything next round
+            self._shipped_digests.clear()
+            armed = self._armed_locked()
+            if armed and self._ticker is None:
+                self._stop.clear()
+                self._ticker = threading.Thread(
+                    target=self._tick_loop,
+                    name="kss-replication-ticker",
+                    daemon=True,
+                )
+                self._ticker.start()
+        return self.stats()
+
+    def _armed_locked(self) -> bool:
+        return bool(
+            self.replicas > 0
+            and self._ring is not None
+            and any(wid != self.self_id for wid in self._peers)
+        )
+
+    def targets(self, sid: str) -> "list[tuple[str, str]]":
+        """The (worker id, url) successors `sid` replicates to: the
+        ring's next `replicas` DISTINCT owners clockwise of the session,
+        excluding this worker."""
+        with self._lock:
+            if not self._armed_locked():
+                return []
+            owners = self._ring.owners(sid, self.replicas + 1)
+            return [
+                (wid, self._peers[wid])
+                for wid in owners
+                if wid != self.self_id and wid in self._peers
+            ][: self.replicas]
+
+    # -- shipping -----------------------------------------------------------
+
+    def ship_once(self) -> dict:
+        """One replication round: every session this manager holds,
+        shipped as a digest-guarded unit to each of its successors.
+        Failures are counted, never raised — a down replica degrades
+        durability, not serving."""
+        shipped = 0
+        errors = 0
+        for sid in self.manager.session_ids():
+            per_sid = self.ship_session(sid)
+            shipped += per_sid[0]
+            errors += per_sid[1]
+        with self._lock:
+            self.ships += 1
+        return {"shipped": shipped, "errors": errors}
+
+    def ship_session(self, sid: str) -> "tuple[int, int]":
+        """Ship one session to its successors; returns (ok, errors)."""
+        targets = self.targets(sid)
+        if not targets:
+            return (0, 0)
+        unit = self.manager.replication_unit(sid)
+        if unit is None:
+            return (0, 0)
+        body = {"replica": True, "checkpoints": [unit]}
+        digest = (unit.get("sha256"), unit.get("journalSha256"))
+        ok = errors = skipped = 0
+        for wid, url in targets:
+            with self._lock:
+                if self._shipped_digests.get((sid, wid)) == digest:
+                    skipped += 1
+                    continue
+            try:
+                _post_json(
+                    url, "/api/v1/admin/adopt", body, self.ship_timeout_s
+                )
+                ok += 1
+                with self._lock:
+                    self._shipped_digests[(sid, wid)] = digest
+            except (OSError, ValueError):
+                errors += 1
+        with self._lock:
+            self.shipped_units += ok
+            self.ship_errors += errors
+            self.skipped_units += skipped
+        return (ok, errors)
+
+    def ship_entry(self, sid: str, entry: dict) -> int:
+        """The sync-mode inline ship (journal ``on_append`` hook): one
+        acknowledged mutation to every successor BEFORE the ack returns.
+        Returns deliveries that succeeded; failures degrade to the next
+        full-unit round."""
+        targets = self.targets(sid)
+        if not targets:
+            return 0
+        body = {
+            "journalAppend": {
+                "id": sid,
+                "entries": [entry],
+                "sha256": canonical_digest([entry]),
+            }
+        }
+        ok = errors = 0
+        for _wid, url in targets:
+            try:
+                _post_json(
+                    url, "/api/v1/admin/adopt", body, self.ship_timeout_s
+                )
+                ok += 1
+            except (OSError, ValueError):
+                errors += 1
+        with self._lock:
+            self.shipped_entries += ok
+            self.ship_errors += errors
+        return ok
+
+    def _tick_loop(self) -> None:
+        while True:
+            with self._lock:
+                stop = self._stop
+                every = self.every_s
+            if stop.wait(every):
+                return
+            with self._lock:
+                armed = self._armed_locked()
+            if not armed:
+                continue
+            try:
+                self.ship_once()
+            except Exception:  # noqa: BLE001 — the ticker must survive
+                with self._lock:
+                    self.ship_errors += 1
+
+    # -- introspection ------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "armed": self._armed_locked(),
+                "self": self.self_id,
+                "peers": len(self._peers),
+                "replicas": self.replicas,
+                "everySeconds": self.every_s,
+                "ships": self.ships,
+                "shippedUnits": self.shipped_units,
+                "shippedEntries": self.shipped_entries,
+                "shipErrors": self.ship_errors,
+                "skippedUnits": self.skipped_units,
+            }
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stop.set()
+            t, self._ticker = self._ticker, None
+        if t is not None:
+            t.join(timeout=2)

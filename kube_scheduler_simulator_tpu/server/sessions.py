@@ -44,6 +44,7 @@ so every legacy single-session route keeps working unchanged.
 
 from __future__ import annotations
 
+import json
 import os
 import secrets
 import tempfile
@@ -54,14 +55,16 @@ from contextlib import contextmanager
 
 from ..lifecycle.checkpoint import (
     SESSION_CHECKPOINT_FORMAT,
+    canonical_digest,
     load_checkpoint,
     write_checkpoint,
 )
-from ..utils import faultinject, fleetstats, locking
+from ..utils import envcheck, faultinject, fleetstats, locking
 from ..utils import ledger as ledger_mod
 from ..utils import slo as slo_mod
 from ..utils.broker import CompileBroker
 from . import batchplane as batchplane_mod
+from . import durability
 from .service import SchedulerServiceDisabled, SimulatorService
 
 DEFAULT_SESSION_ID = "default"
@@ -191,6 +194,8 @@ class SessionManager:
         idle_evict_s: "float | None" = None,
         snapshot_dir: "str | None" = None,
         sse_max_subscribers: "int | None" = None,
+        journal: "bool | None" = None,
+        journal_sync: "bool | None" = None,
         env: "dict | None" = None,
     ):
         env = os.environ if env is None else env
@@ -227,6 +232,50 @@ class SessionManager:
             env, "KSS_DRAIN_DEADLINE_S", 30.0, 0.0
         )
         self._snapshot_dir = snapshot_dir or env.get("KSS_SESSION_DIR") or None
+        # the fleet durability plane (server/durability.py, docs/fleet.md):
+        # KSS_FLEET_JOURNAL arms per-session write-ahead journaling of
+        # acknowledged store mutations; KSS_FLEET_JOURNAL_SYNC fsyncs
+        # every append AND ships it inline to ring successors before the
+        # HTTP ack (the zero-loss crash-kill mode). The fleet router arms
+        # KSS_FLEET_JOURNAL on the workers it spawns (sync mode passes
+        # through from the caller's env); a standalone server opts in
+        # explicitly.
+        self.journal_enabled = (
+            journal
+            if journal is not None
+            else envcheck.env_truthy(env.get("KSS_FLEET_JOURNAL"))
+        )
+        self.journal_sync = (
+            journal_sync
+            if journal_sync is not None
+            else envcheck.env_truthy(env.get("KSS_FLEET_JOURNAL_SYNC"))
+        )
+        self._journals: "dict[str, durability.SessionJournal]" = {}
+        # serializes every replica-file mutation (full-unit store,
+        # inline journal append, promote) — an append racing a rewrite
+        # would land on the replaced inode and silently vanish
+        self._replica_lock = locking.make_lock("sessions.replica-files")
+        # sid -> the store object whose watch feed the journal rides:
+        # distinguishes a re-arm onto the SAME store (rebase in place)
+        # from a fresh service (new journal, new subscription)
+        self._journal_stores: "dict[str, object]" = {}
+        # sid -> the checkpoint document the journal is relative to:
+        # base + journal entries IS the session's replication unit, with
+        # no quiesce on the hot path (the base is immutable, the journal
+        # append-only)
+        self._repl_base: "dict[str, dict]" = {}
+        # counters carried across journal replacement (restore re-arms)
+        self._journal_appends_retired = 0
+        self._journal_bytes_retired = 0
+        # transport bookkeeping (receive_checkpoints / promote_replicas)
+        self.adopted_units = 0
+        self.stored_replicas = 0
+        self.rejected_units = 0
+        self.promoted_replicas = 0
+        # the worker-side successor shipper (server/replication.py),
+        # wired by the HTTP server after construction; never under the
+        # manager lock — shipping does network I/O
+        self.replication = None
         # ONE broker for every session: warm engines shared by compile
         # signature; per-session bulkheading lives in the broker's
         # scope-keyed cooldowns and per-key leases (utils/broker.py).
@@ -293,6 +342,31 @@ class SessionManager:
         # session's state restores in place; others restore on touch)
         if self._snapshot_dir:
             self.adopt_snapshots()
+        # arm the default session's journal LAST: adopt_snapshots has
+        # already restored its snapshot (if one survived), so any
+        # journal tail past that snapshot — the acknowledged writes a
+        # crash-kill left un-snapshotted — replays into the live store
+        # before new appends begin (the local half of the zero-loss
+        # story; the cross-host half is the replica ship)
+        if self.journal_enabled:
+            with self._lock:
+                dsess = self._sessions[DEFAULT_SESSION_ID]
+                armed = DEFAULT_SESSION_ID in self._journals
+            if not armed:
+                base_doc = self._session_doc(dsess)
+                tail = durability.read_journal(
+                    durability.journal_path(
+                        self.snapshot_dir(), DEFAULT_SESSION_ID
+                    ),
+                    int((base_doc.get("store") or {}).get("rv", 0)),
+                )
+                if tail:
+                    svc = dsess.service
+                    svc.store.load_state(
+                        durability.replay_store_state(base_doc["store"], tail)
+                    )
+                    svc.store.snapshot_initial()
+                self._arm_journal(dsess, base_doc=base_doc)
 
     # -- lookup --------------------------------------------------------------
 
@@ -428,6 +502,12 @@ class SessionManager:
                 "batching": self.batch_plane.stats()
                 if self.batch_plane is not None
                 else {"armed": False},
+                # the durability plane (docs/fleet.md): write-ahead
+                # journaling + successor replication
+                "journal": self.journal_stats(),
+                "replication": self.replication.stats()
+                if self.replication is not None
+                else {"armed": False},
             }
 
     # -- create / fork / delete ---------------------------------------------
@@ -480,6 +560,10 @@ class SessionManager:
             sess = Session(sid, name or sid, service)
             sess.fault_spec = fault_inject
             self._sessions[sid] = sess
+            if self.journal_enabled:
+                # arm BEFORE the session is reachable: the journal sees
+                # every acknowledged write from the very first one
+                self._arm_journal(sess, fresh=True)
         if slo is not None:
             if slo_plane is not None:
                 slo_plane.session_id = sid
@@ -544,6 +628,22 @@ class SessionManager:
             if sess is None:
                 raise UnknownSession(sid)
             path = sess.snapshot_path
+            j = self._journals.pop(sid, None)
+            self._journal_stores.pop(sid, None)
+            self._repl_base.pop(sid, None)
+            if j is not None:
+                appended, byts = j.counters()
+                self._journal_appends_retired += appended
+                self._journal_bytes_retired += byts
+        if j is not None:
+            j.drop()
+        # any passively-held replica of the dead tenant goes too
+        with self._lock:
+            d = self._snapshot_dir
+        if d:
+            for rp in durability.replica_paths(d, sid):
+                if os.path.exists(rp):
+                    os.unlink(rp)
         # purge the dead tenant's namespaced ladder state from the
         # SHARED broker: its leftover cooldowns would otherwise keep
         # /api/v1/readyz degraded forever (nothing re-probes a scope
@@ -777,13 +877,42 @@ class SessionManager:
         path = os.path.join(self.snapshot_dir(), f"{sess.id}.json")
         write_checkpoint(doc, path)
         sess.snapshot_path = path
+        # the snapshot IS the journal up to its rv: rebase the journal
+        # and refresh the replication base to the new document
+        with self._lock:
+            j = self._journals.get(sess.id)
+            if j is not None:
+                self._repl_base[sess.id] = doc
+        if j is not None:
+            j.rebase(int((doc.get("store") or {}).get("rv", 0)))
         return path, got
 
     def _restore(self, sess: Session) -> None:
         """Under sess._state_lock (NOT the manager lock): disk load +
         service rebuild, then a brief manager-lock window to go live."""
         doc = load_checkpoint(sess.snapshot_path, SESSION_CHECKPOINT_FORMAT)
-        service = self._service_from_doc(sess.id, sess, doc)
+        live_doc = doc
+        if self.journal_enabled:
+            tail = durability.read_journal(
+                durability.journal_path(self.snapshot_dir(), sess.id),
+                int((doc.get("store") or {}).get("rv", 0)),
+            )
+            if tail:
+                # acknowledged writes the snapshot missed (a crash-kill's
+                # local journal tail, or a transport-shipped journal):
+                # replay BEFORE the service exists, so controllers and
+                # the scheduler never re-fire on journaled mutations
+                live_doc = dict(doc)
+                live_doc["store"] = durability.replay_store_state(
+                    doc.get("store") or {}, tail
+                )
+        service = self._service_from_doc(sess.id, sess, live_doc)
+        if self.journal_enabled:
+            # subscribe before the session goes live: no unjournaled gap
+            # between the restore and the next acknowledged write. The
+            # base stays the ON-DISK document (not the replayed state),
+            # so base + journal remains the session's exact history.
+            self._arm_journal(sess, base_doc=doc, service=service)
         with self._lock:
             sess.service = service
             sess.state = "live"
@@ -913,6 +1042,13 @@ class SessionManager:
                 forced.append(sess.id)
             with self._lock:
                 self.drained += 1
+        if self.replication is not None:
+            # the at-drain ship (docs/fleet.md): successors hold every
+            # session's FINAL state before this process exits
+            try:
+                self.replication.ship_once()
+            except Exception:  # noqa: BLE001 — drain must complete
+                pass
         self.broker.quiesce(timeout=max(0.0, deadline - time.monotonic()))
         result: dict = {
             "drainedSessions": drained,
@@ -958,6 +1094,13 @@ class SessionManager:
                     svc.scheduler.metrics.load_state(doc.get("metrics") or {})
                     svc.scheduler.restore_pass_seq(doc.get("passSeq", 0))
                     svc.store.snapshot_initial()
+                    # an armed default journal re-bases onto the adopted
+                    # document (its subscription on the live store rides
+                    # through load_state unchanged)
+                    j = self._journals.get(sid)
+                    if j is not None:
+                        self._repl_base[sid] = doc
+                        j.rebase(int((doc.get("store") or {}).get("rv", 0)))
                     os.unlink(path)  # consumed: the live service IS the state
                 else:
                     if sid in self._sessions:
@@ -971,7 +1114,418 @@ class SessionManager:
                         sess.created_at = float(created)
                     self._sessions[sid] = sess
             adopted.append(sid)
+        # orphan journals — a crash-killed process's sessions that never
+        # reached their first snapshot: synthesize the empty base their
+        # journal is relative to, so the replay on first touch brings
+        # back every acknowledged write (the default session's orphan
+        # tail replays at arm time instead: its live service IS the
+        # empty base)
+        if self.journal_enabled:
+            for fn in sorted(os.listdir(d)):
+                if not fn.endswith(durability.JOURNAL_SUFFIX):
+                    continue
+                sid = fn[: -len(durability.JOURNAL_SUFFIX)]
+                if sid == DEFAULT_SESSION_ID:
+                    continue
+                with self._lock:
+                    if sid in self._sessions:
+                        continue
+                if not durability.read_journal(os.path.join(d, fn), 0):
+                    continue
+                path = write_checkpoint(
+                    {
+                        "format": SESSION_CHECKPOINT_FORMAT,
+                        "id": sid,
+                        "name": sid,
+                        "createdAt": time.time(),
+                        "store": {"rv": 0, "objects": {}},
+                        "schedulerConfig": None,
+                        "metrics": {},
+                        "passSeq": 0,
+                        "faultInject": None,
+                    },
+                    os.path.join(d, f"{sid}.json"),
+                )
+                with self._lock:
+                    if sid in self._sessions:
+                        continue
+                    sess = Session(sid, sid, None)
+                    sess.state = "evicted"
+                    sess.snapshot_path = path
+                    self._sessions[sid] = sess
+                adopted.append(sid)
         return adopted
+
+    # -- the fleet durability plane (server/durability.py, docs/fleet.md) -----
+
+    def _arm_journal(
+        self,
+        sess: Session,
+        base_doc: "dict | None" = None,
+        fresh: bool = False,
+        service: "SimulatorService | None" = None,
+    ) -> None:
+        """Attach the write-ahead journal to a session's store. Re-arming
+        onto the SAME store (the default session re-adopting a snapshot)
+        rebases the existing journal in place, keeping its subscription;
+        a new service gets a new journal over the same FILE — kept,
+        because its tail may hold acknowledged writes no snapshot has
+        (`fresh=True`, the brand-new-session path, truncates instead).
+        `service` overrides `sess.service` for the restore path, which
+        arms before the session flips live."""
+        svc = service if service is not None else sess.service
+        if not self.journal_enabled or svc is None:
+            return
+        if base_doc is None:
+            base_doc = self._session_doc(sess)
+        base_rv = int((base_doc.get("store") or {}).get("rv", 0))
+        with self._lock:
+            old = self._journals.get(sess.id)
+            old_store = self._journal_stores.get(sess.id)
+        if old is not None and old_store is svc.store:
+            old.rebase(base_rv)
+            with self._lock:
+                self._repl_base[sess.id] = base_doc
+            return
+        j = durability.SessionJournal(
+            durability.journal_path(self.snapshot_dir(), sess.id),
+            base_rv=base_rv,
+            sync=self.journal_sync,
+        )
+        if fresh:
+            j.rebase(base_rv)  # truncate a stale file from a prior life
+        if self.journal_sync:
+
+            def _hook(entry, _sid=sess.id):
+                self._ship_entry(_sid, entry)
+
+            j.on_append = _hook
+        with self._lock:
+            if old is not None:
+                appended, byts = old.counters()
+                self._journal_appends_retired += appended
+                self._journal_bytes_retired += byts
+            self._journals[sess.id] = j
+            self._journal_stores[sess.id] = svc.store
+            self._repl_base[sess.id] = base_doc
+        svc.store.subscribe(j.record)
+
+    def _ship_entry(self, sid: str, entry: dict) -> None:
+        """The sync-journal hook: ship one acknowledged mutation to the
+        ring successors BEFORE the ack returns (server/replication.py).
+        A failed ship degrades to the next full-unit round — it never
+        fails the acknowledgment."""
+        plane = self.replication
+        if plane is None:
+            return
+        try:
+            plane.ship_entry(sid, entry)
+        except Exception:  # noqa: BLE001 — the ack must not fail
+            pass
+
+    def set_replication(self, plane) -> None:
+        """Wire the successor shipper (the HTTP server does, right after
+        construction — before any fleet traffic arrives)."""
+        self.replication = plane
+
+    def journal_stats(self) -> dict:
+        with self._lock:
+            js = list(self._journals.values())
+            appends = self._journal_appends_retired
+            byts = self._journal_bytes_retired
+            doc = {
+                "armed": self.journal_enabled,
+                "sync": self.journal_sync,
+                "adoptedUnits": self.adopted_units,
+                "storedReplicas": self.stored_replicas,
+                "rejectedUnits": self.rejected_units,
+                "promotedReplicas": self.promoted_replicas,
+            }
+        for j in js:
+            a, b = j.counters()
+            appends += a
+            byts += b
+        doc["journals"] = len(js)
+        doc["appends"] = appends
+        doc["bytes"] = byts
+        return doc
+
+    def replication_unit(self, sid: str) -> "dict | None":
+        """The digest-guarded transport unit `sid` travels as: the
+        cached base document plus the journal entries past it — no
+        quiesce on the hot path (the base is immutable, the journal
+        append-only). Sessions without a journal fall back to their
+        on-disk snapshot (evicted) or a pass-boundary snapshot (live)."""
+        with self._lock:
+            sess = self._sessions.get(sid)
+            j = self._journals.get(sid)
+            base = self._repl_base.get(sid)
+            state = sess.state if sess is not None else None
+            path = sess.snapshot_path if sess is not None else None
+        if sess is None:
+            return None
+        if j is not None and base is not None:
+            return durability.build_unit(sid, base, j.entries())
+        if state == "evicted" and path and os.path.exists(path):
+            try:
+                doc = load_checkpoint(path, SESSION_CHECKPOINT_FORMAT)
+            except (ValueError, OSError):
+                return None
+            entries = durability.read_journal(
+                durability.journal_path(self.snapshot_dir(), sid),
+                int((doc.get("store") or {}).get("rv", 0)),
+            )
+            return durability.build_unit(sid, doc, entries)
+        # live and unjournaled: a best-effort cut at the pass boundary
+        with sess._state_lock:
+            with self._lock:
+                if self._sessions.get(sid) is not sess:
+                    return None
+            if sess.state != "live" or sess.service is None:
+                return None
+            lock = sess.service.scheduler._schedule_lock
+            got = lock.acquire(timeout=1.0)
+            try:
+                doc = self._session_doc(sess)
+            finally:
+                if got:
+                    lock.release()
+        return durability.build_unit(sid, doc, [])
+
+    def held_replicas(self) -> "list[str]":
+        """Session ids this worker passively holds replicas for."""
+        with self._lock:
+            d = self._snapshot_dir
+        if not d:
+            return []
+        rd = durability.replica_dir(d)
+        if not os.path.isdir(rd):
+            return []
+        return sorted(
+            fn[: -len(".json")]
+            for fn in os.listdir(rd)
+            if fn.endswith(".json")
+        )
+
+    def checkpoint_index(self) -> dict:
+        """GET /api/v1/admin/checkpoints: every session this worker can
+        hand over (id + payload digest), plus the replicas it holds for
+        its ring predecessors — the router's transport inventory."""
+        checkpoints = []
+        for sid in self.session_ids():
+            unit = self.replication_unit(sid)
+            if unit is None:
+                continue
+            checkpoints.append(
+                {
+                    "id": sid,
+                    "sha256": unit["sha256"],
+                    "journalEntries": len(unit.get("journal") or []),
+                }
+            )
+        replicas = []
+        for sid in self.held_replicas():
+            dpath, jpath = durability.replica_paths(self.snapshot_dir(), sid)
+            try:
+                doc = load_checkpoint(dpath, SESSION_CHECKPOINT_FORMAT)
+            except (ValueError, OSError):
+                continue
+            replicas.append(
+                {
+                    "id": sid,
+                    "sha256": canonical_digest(doc),
+                    "journalEntries": len(
+                        durability.read_journal(
+                            jpath,
+                            int((doc.get("store") or {}).get("rv", 0)),
+                        )
+                    ),
+                }
+            )
+        return {"checkpoints": checkpoints, "replicas": replicas}
+
+    def checkpoint_unit(self, sid: str) -> "dict | None":
+        """GET /api/v1/admin/checkpoints/<sid>: the session's transport
+        unit, whether held as a session or as a replica."""
+        unit = self.replication_unit(sid)
+        if unit is not None:
+            return unit
+        dpath, jpath = durability.replica_paths(self.snapshot_dir(), sid)
+        if not os.path.exists(dpath):
+            return None
+        try:
+            doc = load_checkpoint(dpath, SESSION_CHECKPOINT_FORMAT)
+        except (ValueError, OSError):
+            return None
+        return durability.build_unit(
+            sid,
+            doc,
+            durability.read_journal(
+                jpath, int((doc.get("store") or {}).get("rv", 0))
+            ),
+        )
+
+    def receive_checkpoints(self, units, *, replica: bool = False) -> dict:
+        """POST /api/v1/admin/adopt with body-carried checkpoints: the
+        cross-host transport's receive side. Every unit is digest-
+        verified (`durability.verify_unit` — a torn transfer is rejected,
+        never adopted) and lands atomically (tmp + rename). `replica`
+        stores units passively under ``<dir>/replicas/`` for a later
+        promote; otherwise the journal replays into the document and the
+        session is adopted. Re-pushing a unit for a session already here
+        is an idempotent duplicate, not an error — the router may retry."""
+        adopted: list[str] = []
+        stored: list[str] = []
+        duplicate: list[str] = []
+        rejected: "dict[str, str]" = {}
+        pending_roots: list[str] = []
+        for unit in units if isinstance(units, list) else []:
+            label = str(
+                (unit.get("id") if isinstance(unit, dict) else None) or "?"
+            )
+            try:
+                doc, entries = durability.verify_unit(unit)
+            except ValueError as e:
+                rejected[label] = str(e)
+                continue
+            if doc.get("format") != SESSION_CHECKPOINT_FORMAT or not isinstance(
+                doc.get("store"), dict
+            ):
+                rejected[label] = "not a kss-session-checkpoint/v1 document"
+                continue
+            sid = str(doc.get("id") or label)
+            if sid == DEFAULT_SESSION_ID:
+                rejected[label] = "the default session is worker-local"
+                continue
+            if replica:
+                dpath, jpath = durability.replica_paths(
+                    self.snapshot_dir(), sid
+                )
+                # MERGE with what sync-mode `journalAppend` bodies
+                # already delivered: this unit's journal was cut on the
+                # sender BEFORE it travelled, so a blind overwrite could
+                # clobber an inline-shipped entry that raced past it —
+                # exactly the acknowledged write a crash-kill must keep
+                with self._replica_lock:
+                    write_checkpoint(doc, dpath)
+                    by_rv = {
+                        int(e.get("rv", 0)): e
+                        for e in durability.read_journal(jpath)
+                    }
+                    by_rv.update(
+                        (int(e.get("rv", 0)), e) for e in entries
+                    )
+                    durability.write_journal(
+                        jpath, [by_rv[rv] for rv in sorted(by_rv)]
+                    )
+                stored.append(sid)
+                continue
+            with self._lock:
+                known = sid in self._sessions
+            if known:
+                duplicate.append(sid)  # idempotent re-push
+                continue
+            merged = durability.replay_into_doc(doc, entries)
+            write_checkpoint(
+                merged, os.path.join(self.snapshot_dir(), f"{sid}.json")
+            )
+            pending_roots.append(sid)
+        if pending_roots:
+            got = set(self.adopt_snapshots())
+            for sid in pending_roots:
+                if sid in got:
+                    adopted.append(sid)
+                else:
+                    duplicate.append(sid)  # raced with a concurrent adopt
+        with self._lock:
+            self.adopted_units += len(adopted)
+            self.stored_replicas += len(stored)
+            self.rejected_units += len(rejected)
+        return {
+            "adopted": adopted,
+            "stored": stored,
+            "duplicate": duplicate,
+            "rejected": rejected,
+        }
+
+    def append_replica_journal(self, body: dict) -> dict:
+        """POST /api/v1/admin/adopt ``journalAppend`` bodies: the sync-
+        replication inline ship. Entries append to the replica journal,
+        digest-verified; they fold into the session at promote time."""
+        body = body or {}
+        sid = str(body.get("id") or "")
+        entries = body.get("entries")
+        if not sid or not isinstance(entries, list) or not entries:
+            raise ValueError("journalAppend requires id + entries")
+        if sid == DEFAULT_SESSION_ID:
+            raise ValueError("the default session is worker-local")
+        claimed = body.get("sha256")
+        if claimed and canonical_digest(entries) != claimed:
+            raise ValueError(
+                "journalAppend digest mismatch: torn transfer, refused"
+            )
+        _dpath, jpath = durability.replica_paths(self.snapshot_dir(), sid)
+        os.makedirs(os.path.dirname(jpath), exist_ok=True)
+        with self._replica_lock:
+            with open(jpath, "ab") as f:
+                for entry in entries:
+                    f.write(
+                        json.dumps(
+                            entry, separators=(",", ":"), sort_keys=True
+                        ).encode()
+                        + b"\n"
+                    )
+                if self.journal_sync:
+                    f.flush()
+                    os.fsync(f.fileno())
+        return {"id": sid, "appended": len(entries)}
+
+    def promote_replicas(self, sids: "list[str] | None" = None) -> dict:
+        """POST /api/v1/admin/adopt ``promote`` bodies: fold each held
+        replica's journal into its document, move it into the root
+        snapshot namespace, and adopt it — the router's dead-worker
+        re-home when the primary can no longer be asked (docs/fleet.md).
+        `sids` None promotes everything held."""
+        held = self.held_replicas()
+        want = held if sids is None else [str(s) for s in sids]
+        promoted: list[str] = []
+        missing: list[str] = []
+        skipped: list[str] = []
+        for sid in want:
+            with self._lock:
+                if sid in self._sessions:
+                    skipped.append(sid)  # already a session here
+                    continue
+            dpath, jpath = durability.replica_paths(self.snapshot_dir(), sid)
+            with self._replica_lock:
+                if not os.path.exists(dpath):
+                    missing.append(sid)
+                    continue
+                try:
+                    doc = load_checkpoint(dpath, SESSION_CHECKPOINT_FORMAT)
+                except (ValueError, OSError):
+                    missing.append(sid)
+                    continue
+                entries = durability.read_journal(
+                    jpath, int((doc.get("store") or {}).get("rv", 0))
+                )
+                merged = durability.replay_into_doc(doc, entries)
+                write_checkpoint(
+                    merged, os.path.join(self.snapshot_dir(), f"{sid}.json")
+                )
+                for rp in (dpath, jpath):
+                    if os.path.exists(rp):
+                        os.unlink(rp)
+            promoted.append(sid)
+        adopted = set(self.adopt_snapshots()) if promoted else set()
+        with self._lock:
+            self.promoted_replicas += len(promoted)
+        return {
+            "promoted": promoted,
+            "adopted": [s for s in promoted if s in adopted],
+            "missing": missing,
+            "skipped": skipped,
+        }
 
     def _sweep_loop(self) -> None:
         interval = max(0.05, min(self.idle_evict_s / 4.0, 5.0))
@@ -993,6 +1547,8 @@ class SessionManager:
 
     def shutdown(self) -> None:
         self._stop.set()
+        if self.replication is not None:
+            self.replication.stop()
         if self.batch_plane is not None:
             self.batch_plane.begin_drain()
         if self._sweeper is not None:

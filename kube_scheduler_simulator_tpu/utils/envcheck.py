@@ -228,6 +228,30 @@ KNOWN: "dict[str, Validator]" = {
     "KSS_FLEET_DIR": _path_validator,
     "KSS_FLEET_BASE_PORT": _int_validator(0),
     "KSS_FLEET_PROBE_INTERVAL_S": _float_validator(0.05),
+    # the fleet durability plane (server/durability.py,
+    # server/replication.py, docs/fleet.md): JOURNAL arms per-session
+    # write-ahead journaling of acknowledged store mutations;
+    # JOURNAL_SYNC fsyncs each append and ships it inline to the ring
+    # successors before the HTTP ack (zero-loss crash-kill); REPLICAS is
+    # the successor count each session replicates to (0 = off);
+    # REPLICATE_EVERY_S the full-unit ship cadence
+    "KSS_FLEET_JOURNAL": _bool_validator,
+    "KSS_FLEET_JOURNAL_SYNC": _bool_validator,
+    "KSS_FLEET_REPLICAS": _int_validator(0),
+    "KSS_FLEET_REPLICATE_EVERY_S": _float_validator(0.05),
+    # router resilience (fleet/router.py, docs/resilience.md):
+    # per-call deadline budgets, bounded idempotent retry with
+    # exponential backoff, the per-worker circuit breaker, and the
+    # re-home transport selector ("" / "auto" = file move when the
+    # namespaces share a filesystem, "http" forces the cross-host
+    # checkpoint transport)
+    "KSS_FLEET_REQUEST_TIMEOUT_S": _float_validator(0.05),
+    "KSS_FLEET_ADOPT_TIMEOUT_S": _float_validator(0.05),
+    "KSS_FLEET_RETRIES": _int_validator(0),
+    "KSS_FLEET_RETRY_BACKOFF_S": _float_validator(0.0),
+    "KSS_FLEET_BREAKER_FAILURES": _int_validator(1),
+    "KSS_FLEET_BREAKER_OPEN_S": _float_validator(0.0),
+    "KSS_FLEET_TRANSPORT": _choice_validator("", "auto", "http"),
     # session plane (docs/sessions.md)
     "KSS_MAX_SESSIONS": _int_validator(1),
     "KSS_MAX_PENDING_PODS_PER_SESSION": _int_validator(0),

@@ -28,13 +28,27 @@ Grammar (env ``KSS_FAULT_INJECT``, comma-separated ``site:value``):
         accelerator, and that rung no longer touches it;
       - ``worker_crash``  — the broker's speculative worker loop (the
         crash the hardened worker must contain);
+      - ``net_drop``      — the fleet router's `_request` chokepoint,
+        BEFORE the request is sent: the connection fails and the worker
+        never sees the request (a dropped SYN / refused connect);
+      - ``net_partition`` — the same chokepoint, AFTER the worker has
+        processed the request: the response is discarded and the caller
+        sees a connection error — the request *happened* but nobody
+        knows (the partition that punishes non-idempotent retries);
+      - ``worker_kill``   — the router-side chaos kill: the target
+        worker process is SIGKILL'd (no drain, no snapshot) and the
+        in-flight request fails — the crash the durability plane's
+        replicated journal must absorb (docs/fleet.md);
   * duration sites — ``value`` is a duration (``5s``, ``250ms``): the
     site sleeps that long every time it fires:
       - ``compile_slow``  — injected compile latency, the wedged-compile
         stand-in the KSS_COMPILE_DEADLINE_S watchdog trips on;
       - ``dispatch_hang`` — injected dispatch latency at the serving
         layer's device-dispatch point, the wedged-dispatch stand-in the
-        KSS_DISPATCH_DEADLINE_S watchdog trips on.
+        KSS_DISPATCH_DEADLINE_S watchdog trips on;
+      - ``net_delay``     — injected router→worker network latency at
+        the `_request` chokepoint (the slow-network row of the fleet
+        failure matrix; the per-request deadline budget trips on it).
 
 Determinism: every probability site draws from its own
 ``random.Random(f"kss-fault:{seed}:{site}")`` stream (seed from
@@ -61,9 +75,12 @@ import time
 from . import locking, telemetry
 
 PROBABILITY_SITES = (
-    "compile_fail", "device_error", "device_lost", "worker_crash"
+    "compile_fail", "device_error", "device_lost", "worker_crash",
+    # the fleet network sites (docs/fleet.md): fired at the router's
+    # `_request` chokepoint, never inside the engine
+    "net_drop", "net_partition", "worker_kill",
 )
-DURATION_SITES = ("compile_slow", "dispatch_hang")
+DURATION_SITES = ("compile_slow", "dispatch_hang", "net_delay")
 
 ENV_VAR = "KSS_FAULT_INJECT"
 SEED_VAR = "KSS_FAULT_INJECT_SEED"

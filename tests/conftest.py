@@ -115,6 +115,22 @@ for _var in (
     "KSS_FLEET_DIR",
     "KSS_FLEET_BASE_PORT",
     "KSS_FLEET_PROBE_INTERVAL_S",
+    # the fleet durability plane + router resilience (docs/fleet.md,
+    # docs/resilience.md): ambient journaling would add disk writes to
+    # every acknowledged mutation in the suite, and ambient breaker/
+    # retry/transport overrides would skew the state-machine and
+    # re-home tests; durability tests arm these explicitly
+    "KSS_FLEET_JOURNAL",
+    "KSS_FLEET_JOURNAL_SYNC",
+    "KSS_FLEET_REPLICAS",
+    "KSS_FLEET_REPLICATE_EVERY_S",
+    "KSS_FLEET_REQUEST_TIMEOUT_S",
+    "KSS_FLEET_ADOPT_TIMEOUT_S",
+    "KSS_FLEET_RETRIES",
+    "KSS_FLEET_RETRY_BACKOFF_S",
+    "KSS_FLEET_BREAKER_FAILURES",
+    "KSS_FLEET_BREAKER_OPEN_S",
+    "KSS_FLEET_TRANSPORT",
 ):
     os.environ.pop(_var, None)
 

@@ -40,6 +40,27 @@ class TestGrammar:
         with pytest.raises(ValueError):
             FaultPlane.parse("dispatch_hang:0.5")  # durations need a unit
 
+    def test_fleet_network_sites(self):
+        """The fleet chaos grammar (docs/resilience.md): net_drop,
+        net_partition, and worker_kill are probability sites fired in
+        the router's network chokepoint; net_delay is a duration."""
+        plane = FaultPlane.parse(
+            "net_drop:1.0,net_partition:0.5,worker_kill:0.1,net_delay:20ms"
+        )
+        assert plane.rules == {
+            "net_drop": 1.0,
+            "net_partition": 0.5,
+            "worker_kill": 0.1,
+            "net_delay": 0.02,
+        }
+        with pytest.raises(InjectedFault) as exc:
+            plane.maybe_raise("net_drop")
+        assert exc.value.site == "net_drop"
+        with pytest.raises(ValueError):
+            FaultPlane.parse("net_drop:1.5")  # probability bounds hold
+        with pytest.raises(ValueError):
+            FaultPlane.parse("net_delay:0.5")  # durations need a unit
+
     @pytest.mark.parametrize(
         "bad",
         [
