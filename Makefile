@@ -126,7 +126,11 @@ fleet-smoke:
 # acknowledged write (gate A); kill -9 the owner and the successor's
 # replica + sync journal answer canonically byte-identically (gate B);
 # a total net_drop storm opens the circuit breaker, sheds 503 +
-# Retry-After, and half-open recovery closes it (gate C); one JSON line
+# Retry-After, and half-open recovery closes it (gate C); with
+# KSS_TRACE=1 under seeded net faults, the merged Perfetto export
+# carries ONE trace id from the router request span (with a
+# retry-attempt child) through the owning worker's pass span to its
+# device.execute span, all intervals well-formed (gate D); one JSON line
 fleet-chaos-smoke:
 	env JAX_PLATFORMS=cpu $(PY) tools/fleet_chaos_smoke.py
 

@@ -1195,11 +1195,35 @@ def _fleet_probe(n_nodes: int = 8, n_pods: int = 24, rounds: int = 2):
                 if total_wall > 0
                 else 0.0
             )
+            # router-added latency from the request ring
+            # (/api/v1/fleet/requests, docs/observability.md):
+            # routerSeconds is total wall minus time spent on worker
+            # calls — the proxy's own overhead, p50/p99 so regressions
+            # in the routing path show up in the campaign headline
+            _code, ring = _req(router.port, "GET", "/api/v1/fleet/requests")
+            added = sorted(
+                float(e.get("routerSeconds") or 0.0)
+                for e in (ring or {}).get("requests") or []
+                if e.get("worker") is not None
+            )
+
+            def pct(q):
+                if not added:
+                    return None
+                return round(
+                    added[min(len(added) - 1, int(q * len(added)))] * 1e3, 3
+                )
+
             curve[str(width)] = {
                 "aggregate_dps": round(agg_dps, 1),
                 "speedup_vs_single_process": round(agg_dps / baseline_dps, 2)
                 if baseline_dps
                 else None,
+                "router_latency": {
+                    "p50_ms": pct(0.50),
+                    "p99_ms": pct(0.99),
+                    "requests": len(added),
+                },
             }
         finally:
             router.shutdown(drain=False)

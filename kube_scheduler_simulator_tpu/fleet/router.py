@@ -56,6 +56,7 @@ from urllib.parse import parse_qs, urlparse
 
 from ..utils import faultinject, locking
 from ..utils import metrics as metrics_mod
+from ..utils import telemetry
 from .ring import DEFAULT_REPLICAS, HashRing
 
 # Retry-After (seconds) on router-level sheds — matches the worker's
@@ -130,6 +131,20 @@ _ROUTER_FAMILY_DEFS = (
         "Re-home adoptions that failed and were queued for probe-tick retry.",
     ),
 )
+
+# The per-request latency family (docs/observability.md) — a histogram,
+# rendered via metrics_mod.render_histogram rather than the scalar defs
+# loop above; the name stays a standalone literal for the registry lint.
+_REQUEST_SECONDS_FAMILY = "kss_fleet_request_seconds"
+_REQUEST_SECONDS_HELP = (
+    "Router-observed proxied-request latency by split "
+    "(total/net/worker/router)."
+)
+_REQUEST_SPLITS = ("total", "net", "worker", "router")
+
+# default bound of the always-on per-request ring backing
+# GET /api/v1/fleet/requests (KSS_FLEET_REQUEST_RING_CAP overrides)
+REQUEST_RING_CAP_DEFAULT = 512
 
 
 class BreakerOpen(ConnectionError):
@@ -334,6 +349,26 @@ class FleetRouter:
         # the honest accounting `kss_fleet_rehomed_sessions_total` used
         # to fake by counting file moves as adoptions
         self._pending_adopts: dict[str, str] = {}
+        # distributed tracing + request accounting
+        # (docs/observability.md): a bounded ring of every proxied
+        # request — trace id, route, owner, attempts, breaker state,
+        # latency split — plus the kss_fleet_request_seconds
+        # histograms. Always on: with KSS_TRACE off the `trace` field
+        # is None but the latency accounting still serves the bench's
+        # router-overhead probe.
+        self.request_ring_cap = int(
+            env.get("KSS_FLEET_REQUEST_RING_CAP") or REQUEST_RING_CAP_DEFAULT
+        )
+        self._requests: list[dict] = []
+        self._req_seq = 0
+        self._req_hists = {
+            split: metrics_mod.Histogram(metrics_mod.LATENCY_BUCKETS)
+            for split in _REQUEST_SPLITS
+        }
+        # per-request worker-call accounting, reset by the handler at
+        # each request's entry (thread-local: the front server is
+        # thread-per-request)
+        self._call_stats = threading.local()
         self._roll_state: dict = {
             "rolling": False,
             "phase": "idle",
@@ -630,6 +665,23 @@ class FleetRouter:
         return sorted(sids)
 
     def _rehome_one(self, sid: str, source: Worker, target: Worker) -> bool:
+        """`_rehome_one_inner` under a distributed-trace scope: each
+        re-home runs as its own `router.rehome` span, minting a fresh
+        trace id when none is active (worker death and probe-tick
+        retries have no inbound request to inherit from) so the
+        successor's adopt/promote instants record the causing trace
+        (docs/observability.md)."""
+        tid = telemetry.current_trace_id()
+        if tid is None and telemetry.propagate_enabled():
+            tid = telemetry.new_trace_id()
+        with telemetry.trace_context(tid), telemetry.span(
+            "router.rehome", session=sid, source=source.id, target=target.id
+        ):
+            return self._rehome_one_inner(sid, source, target)
+
+    def _rehome_one_inner(
+        self, sid: str, source: Worker, target: Worker
+    ) -> bool:
         """Move one session from `source` to `target`, trying in order:
         the same-filesystem file move (PR 15's fast path, unless
         KSS_FLEET_TRANSPORT=http), the HTTP checkpoint transport (fetch
@@ -822,22 +874,43 @@ class FleetRouter:
         attempts = 1 + (max(0, self.retries) if idempotent else 0)
         deadline = time.monotonic() + budget
         last: "OSError | None" = None
+        # distributed tracing (docs/observability.md): every attempt —
+        # including the first — gets its own child span in the router
+        # track, and carries the active trace id to the worker as a
+        # W3C-style traceparent header. With KSS_TRACE off both are
+        # no-ops and the exchange is byte-identical.
+        tid = (
+            telemetry.current_trace_id()
+            if telemetry.propagate_enabled()
+            else None
+        )
+        if tid is not None:
+            headers = dict(headers or {})
+            headers["traceparent"] = telemetry.make_traceparent(tid)
         for attempt in range(attempts):
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 break
+            att_t0 = time.perf_counter()
             try:
-                result = _request(
-                    w.host,
-                    w.port,
-                    method,
-                    path,
-                    body=body,
-                    headers=headers,
-                    timeout=remaining,
-                )
+                with telemetry.span(
+                    "router.attempt",
+                    worker=w.id,
+                    attempt=attempt + 1,
+                    path=path,
+                ):
+                    result = _request(
+                        w.host,
+                        w.port,
+                        method,
+                        path,
+                        body=body,
+                        headers=headers,
+                        timeout=remaining,
+                    )
             except OSError as e:
                 last = e
+                self._note_attempt(time.perf_counter() - att_t0, None)
                 self._breaker_record(w, ok=False)
                 if attempt + 1 < attempts:
                     with self._lock:
@@ -849,6 +922,11 @@ class FleetRouter:
                     if pause > 0:
                         time.sleep(pause)
                 continue
+            self._note_attempt(
+                time.perf_counter() - att_t0,
+                result[1].get("X-KSS-Worker-Seconds"),
+                wid=w.id,
+            )
             self._breaker_record(w, ok=True)
             return result
         if last is not None:
@@ -856,6 +934,109 @@ class FleetRouter:
         raise TimeoutError(
             f"worker {w.id}: deadline budget {budget:.1f}s exhausted"
         )
+
+    def _call_reset(self) -> None:
+        """Arm the per-request call accounting for this handler thread
+        (the request ring's attempts + latency split)."""
+        st = self._call_stats
+        st.attempts = 0
+        st.call_s = 0.0
+        st.worker_s = 0.0
+        st.worker = None
+
+    def _note_attempt(self, call_s: float, worker_s, wid=None) -> None:
+        st = self._call_stats
+        st.attempts = getattr(st, "attempts", 0) + 1
+        st.call_s = getattr(st, "call_s", 0.0) + call_s
+        if wid is not None:
+            st.worker = wid
+        try:
+            st.worker_s = getattr(st, "worker_s", 0.0) + float(worker_s)
+        except (TypeError, ValueError):
+            pass
+
+    def _call_snapshot(self) -> dict:
+        st = self._call_stats
+        return {
+            "attempts": getattr(st, "attempts", 0),
+            "callSeconds": getattr(st, "call_s", 0.0),
+            "workerSeconds": getattr(st, "worker_s", 0.0),
+            "worker": getattr(st, "worker", None),
+        }
+
+    def record_request(
+        self,
+        method: str,
+        route: str,
+        trace: "str | None",
+        total_s: float,
+        stats: dict,
+        status: "int | None",
+    ) -> None:
+        """One completed inbound request into the bounded ring +
+        the kss_fleet_request_seconds histograms. The latency split:
+        worker = worker-reported wall (X-KSS-Worker-Seconds, 0 when
+        propagation is off), net = wire time (attempt wall minus
+        worker wall), router = everything the router itself added
+        (routing, queueing, merge work). Histograms only observe
+        requests that touched a worker — router-local routes would
+        pollute the proxy-overhead signal the bench reads."""
+        attempts = int(stats.get("attempts") or 0)
+        call_s = float(stats.get("callSeconds") or 0.0)
+        worker_s = float(stats.get("workerSeconds") or 0.0)
+        wid = stats.get("worker")
+        net_s = max(0.0, call_s - worker_s)
+        router_s = max(0.0, total_s - call_s)
+        entry = {
+            "ts": round(time.time(), 3),
+            "trace": trace,
+            "method": method,
+            "route": route,
+            "status": status,
+            "worker": wid,
+            "attempts": attempts,
+            "totalSeconds": round(total_s, 6),
+            "netSeconds": round(net_s, 6),
+            "workerSeconds": round(worker_s, 6),
+            "routerSeconds": round(router_s, 6),
+        }
+        exemplar = (
+            {"trace_id": trace}
+            if trace is not None and metrics_mod.exemplars_enabled()
+            else None
+        )
+        with self._lock:
+            w = self._workers.get(wid) if wid else None
+            entry["breaker"] = w.breaker_state if w is not None else None
+            self._req_seq += 1
+            entry["seq"] = self._req_seq
+            self._requests.append(entry)
+            if len(self._requests) > self.request_ring_cap:
+                del self._requests[: -self.request_ring_cap]
+            if attempts > 0:
+                for split, v in (
+                    ("total", total_s),
+                    ("net", net_s),
+                    ("worker", worker_s),
+                    ("router", router_s),
+                ):
+                    self._req_hists[split].observe(v, exemplar=exemplar)
+
+    def requests_doc(self) -> dict:
+        """GET /api/v1/fleet/requests: the ring, oldest first."""
+        with self._lock:
+            entries = [dict(e) for e in self._requests]
+            cap = self.request_ring_cap
+        return {
+            "requests": entries,
+            "cap": cap,
+            "tracing": telemetry.active() is not None,
+        }
+
+    def worker_by_id(self, wid: str) -> "Worker | None":
+        with self._lock:
+            w = self._workers.get(wid)
+            return None if w is None or w.state == "dead" else w
 
     def _breaker_allow(self, w: Worker) -> bool:
         """closed → allow; open → shed until KSS_FLEET_BREAKER_OPEN_S
@@ -870,6 +1051,9 @@ class FleetRouter:
                     >= self.breaker_open_s
                 ):
                     w.breaker_state = "half-open"
+                    telemetry.instant(
+                        "router.breaker", worker=w.id, state="half-open"
+                    )
                     return True
                 return False
             return False  # half-open: the probe call is in flight
@@ -877,6 +1061,10 @@ class FleetRouter:
     def _breaker_record(self, w: Worker, ok: bool) -> None:
         with self._lock:
             if ok:
+                if w.breaker_state != "closed":
+                    telemetry.instant(
+                        "router.breaker", worker=w.id, state="closed"
+                    )
                 w.breaker_state = "closed"
                 w.breaker_failures = 0
                 return
@@ -887,6 +1075,9 @@ class FleetRouter:
             ):
                 if w.breaker_state != "open":
                     self._breaker_opens += 1
+                    telemetry.instant(
+                        "router.breaker", worker=w.id, state="open"
+                    )
                 w.breaker_state = "open"
                 w.breaker_opened_at = time.monotonic()
 
@@ -1246,12 +1437,12 @@ class FleetRouter:
                 text = metrics_mod.label_exposition(text, {"worker": w.id})
             texts.append(text)
         merged = _merge_expositions(texts)
-        merged += self._router_families()
+        merged += self._router_families(openmetrics)
         if openmetrics:
             merged += "# EOF\n"
         return merged
 
-    def _router_families(self) -> str:
+    def _router_families(self, openmetrics: bool = False) -> str:
         with self._lock:
             total = len(self._workers)
             ready = sum(
@@ -1271,12 +1462,85 @@ class FleetRouter:
             "kss_fleet_breaker_open_total": breaker_opens,
             "kss_fleet_pending_adopts_total": pending,
         }
+        with self._lock:
+            hist_snaps = [
+                (split, self._req_hists[split].snapshot())
+                for split in _REQUEST_SPLITS
+            ]
         out = []
         for name, mtype, help_text in _ROUTER_FAMILY_DEFS:
             out.append(f"# HELP {name} {help_text}")
             out.append(f"# TYPE {name} {mtype}")
             out.append(f"{name} {values[name]}")
-        return "\n".join(out) + "\n"
+        text = "\n".join(out) + "\n"
+        # the request-latency histograms, one labeled series per split;
+        # _merge_expositions dedups the family's HELP/TYPE headers. The
+        # OpenMetrics form attaches trace-id exemplars to bucket lines.
+        text += _merge_expositions(
+            [
+                metrics_mod.render_histogram(
+                    _REQUEST_SECONDS_FAMILY,
+                    _REQUEST_SECONDS_HELP,
+                    snap,
+                    labels={"split": split},
+                    openmetrics=openmetrics,
+                )
+                for split, snap in hist_snaps
+            ]
+        )
+        return text
+
+    def merged_trace(self) -> dict:
+        """GET /api/v1/debug/trace (no ?worker=): every live worker's
+        Chrome-trace export federated with the router's own ring into
+        ONE Perfetto document — a process track per worker plus the
+        router track. Each worker fetch is bracketed by the router's
+        monotonic clock; offset = fetch-window midpoint − the export's
+        ``otherData.clockUs`` (the NTP-style handshake; accuracy ~ half
+        the fetch RTT, which the docs call out). Unreachable workers
+        are skipped — a partial merge beats none."""
+        rec = telemetry.active()
+        tracks = [
+            {
+                "pid": 0,
+                "name": "router",
+                "events": rec.snapshot() if rec is not None else [],
+                "offset_us": 0.0,
+            }
+        ]
+        dropped = rec.dropped if rec is not None else 0
+        for i, w in enumerate(self.live_workers()):
+            t0 = time.perf_counter()
+            try:
+                status, _, data = self._worker_call(
+                    w, "GET", "/api/v1/debug/trace", timeout=30.0
+                )
+                t1 = time.perf_counter()
+                doc = json.loads(data) if status == 200 else None
+            except (OSError, ValueError):
+                continue
+            if not isinstance(doc, dict):
+                continue
+            other = doc.get("otherData") or {}
+            clock = other.get("clockUs")
+            offset = 0.0
+            if isinstance(clock, (int, float)):
+                offset = ((t0 + t1) / 2.0) * 1e6 - float(clock)
+            try:
+                dropped += int(other.get("droppedEvents") or 0)
+            except (TypeError, ValueError):
+                pass
+            tracks.append(
+                {
+                    "pid": i + 1,
+                    "name": f"worker {w.id}",
+                    "events": doc.get("traceEvents") or [],
+                    "offset_us": offset,
+                }
+            )
+        merged = telemetry.merged_chrome_trace(tracks, dropped=dropped)
+        merged["otherData"]["tracingEnabled"] = rec is not None
+        return merged
 
     def federated_alerts(self) -> dict:
         enabled = False
@@ -1415,6 +1679,7 @@ def _make_router_handler(router: FleetRouter):
 
         def _shed(self, why: str):
             router.count_shed()
+            telemetry.instant("router.shed", why="WorkerUnavailable")
             return self._error(
                 503,
                 why,
@@ -1426,6 +1691,9 @@ def _make_router_handler(router: FleetRouter):
             """The circuit-open shed: Retry-After hints the breaker's
             half-open horizon instead of the generic backoff."""
             router.count_shed()
+            telemetry.instant(
+                "router.shed", why="CircuitOpen", worker=w.id
+            )
             return self._error(
                 503,
                 f"worker {w.id} circuit breaker open; retry shortly",
@@ -1479,7 +1747,58 @@ def _make_router_handler(router: FleetRouter):
         def do_DELETE(self):  # noqa: N802
             self._route("DELETE")
 
+        def send_response(self, code, message=None):  # noqa: N802
+            # every response path funnels through here — the request
+            # ring's status column
+            self._kss_status = code
+            super().send_response(code, message)
+
         def _route(self, method: str):
+            """The distributed-trace edge (docs/observability.md):
+            mint (or adopt) a trace id per inbound request, serve it
+            under a `router.request` span, and record the completed
+            request — attempts, owner, latency split — into the ring.
+            With KSS_TRACE off no context exists and no span is
+            emitted; the ring still records (trace None)."""
+            t0 = time.perf_counter()
+            router._call_reset()
+            self._kss_status = None
+            path = urlparse(self.path).path
+            # two request shapes must not get a router.request span:
+            # the trace-export route (its own still-open span would
+            # land in the very snapshot it serves, breaking merged
+            # well-formedness for every export) and the unbounded SSE
+            # streams (a span that never closes can't nest)
+            unspanned = path == "/api/v1/debug/trace" or path.rstrip(
+                "/"
+            ).endswith(("/events", "/listwatchresources"))
+            tid = None
+            if not unspanned and telemetry.propagate_enabled():
+                tid = telemetry.parse_traceparent(
+                    self.headers.get("traceparent")
+                ) or telemetry.new_trace_id()
+            try:
+                if tid is None:
+                    return self._route_inner(method)
+                with telemetry.trace_context(tid), telemetry.span(
+                    "router.request", method=method, route=path
+                ):
+                    return self._route_inner(method)
+            finally:
+                # the ring's own read route stays out of the ring — a
+                # polling dashboard must not amplify itself into the
+                # very panel it renders
+                if path != "/api/v1/fleet/requests":
+                    router.record_request(
+                        method,
+                        path,
+                        tid,
+                        time.perf_counter() - t0,
+                        router._call_snapshot(),
+                        self._kss_status,
+                    )
+
+        def _route_inner(self, method: str):
             url = urlparse(self.path)
             parts = [p for p in url.path.split("/") if p]
             try:
@@ -1533,6 +1852,50 @@ def _make_router_handler(router: FleetRouter):
                         return self._json(
                             200, router.federated_timeseries(url.query)
                         )
+                    if rest == ["fleet", "requests"] and method == "GET":
+                        # the per-request ring: trace id, route, owner,
+                        # attempts, breaker state, latency split
+                        return self._json(200, router.requests_doc())
+                    if rest == ["debug", "trace"] and method == "GET":
+                        # ?worker=<id> proxies that worker's own export;
+                        # the no-arg form answers the federated merge
+                        # (which subsumes the single-process document)
+                        wid = (
+                            parse_qs(url.query).get("worker") or [None]
+                        )[0]
+                        if wid is None:
+                            return self._json(200, router.merged_trace())
+                        w = router.worker_by_id(wid)
+                        if w is None:
+                            return self._error(
+                                404,
+                                f"no live worker {wid!r}",
+                                kind="UnknownWorker",
+                            )
+                        self._proxy(w, method, url)
+                        return None
+                    if rest == ["debug", "profile"] and method == "POST":
+                        # worker-only route, unreachable behind the
+                        # fleet without an explicit target
+                        wid = (
+                            parse_qs(url.query).get("worker") or [None]
+                        )[0]
+                        if wid is None:
+                            return self._error(
+                                400,
+                                "debug/profile behind the router needs "
+                                "?worker=<id> (profiling is per-process)",
+                                kind="MissingWorker",
+                            )
+                        w = router.worker_by_id(wid)
+                        if w is None:
+                            return self._error(
+                                404,
+                                f"no live worker {wid!r}",
+                                kind="UnknownWorker",
+                            )
+                        self._proxy(w, method, url)
+                        return None
                     if rest == ["sessions"] and method == "GET":
                         return self._json(200, router.merged_sessions())
                     if rest == ["sessions"] and method == "POST":
@@ -1545,13 +1908,17 @@ def _make_router_handler(router: FleetRouter):
                                 f"no worker can serve session {sid!r}; "
                                 f"retry shortly"
                             )
-                        status = self._proxy(w, method, url)
-                        if (
-                            method == "DELETE"
-                            and len(rest) == 2
-                            and status == 200
-                        ):
-                            router.forget_session(sid)
+                        on_status = None
+                        if method == "DELETE" and len(rest) == 2:
+                            # drop the placement record BEFORE the ack
+                            # bytes reach the client: a reader polling
+                            # GET /api/v1/fleet right after its DELETE
+                            # returns must not see the dead session
+                            def on_status(s, sid=sid):
+                                if s == 200:
+                                    router.forget_session(sid)
+
+                        self._proxy(w, method, url, on_status=on_status)
                         return None
                 # everything else — the legacy/default surface and the
                 # dashboard — rides with the owner of "default"
@@ -1646,14 +2013,19 @@ def _make_router_handler(router: FleetRouter):
                 self.wfile.write(resp_body)
             return None
 
-        def _proxy(self, w: Worker, method: str, url) -> "int | None":
+        def _proxy(
+            self, w: Worker, method: str, url, on_status=None
+        ) -> "int | None":
             """Pass the request through to `w` — buffered routes ride
             `_worker_call` (breaker gate, fault sites, idempotent-GET
             retries, the KSS_FLEET_REQUEST_TIMEOUT_S budget); the
             SSE/watch surfaces stream directly (a retry would replay
             the event history). Relays status + Content-Type +
             Retry-After back; returns the upstream status (None when
-            shed)."""
+            shed). `on_status` runs with the upstream status BEFORE the
+            response bytes go out — router bookkeeping that must be
+            visible by the time the client sees the ack (the session
+            DELETE's placement-table drop) hooks in here."""
             path_qs = url.path + (f"?{url.query}" if url.query else "")
             body = self._read_body() or None
             stream = url.path.rstrip("/").endswith(
@@ -1680,6 +2052,8 @@ def _make_router_handler(router: FleetRouter):
             except OSError:
                 self._shed(f"worker {w.id} unreachable; retry shortly")
                 return None
+            if on_status is not None:
+                on_status(status)
             self.send_response(status)
             for name in ("Content-Type", "Retry-After"):
                 v = rheaders.get(name)

@@ -146,7 +146,7 @@ class _Enrollee:
 
     __slots__ = (
         "engine", "queue", "session_id", "metrics",
-        "done", "state", "trace", "error", "abandoned",
+        "done", "state", "trace", "error", "abandoned", "trace_id",
     )
 
     def __init__(self, engine, queue, session_id, metrics):
@@ -157,6 +157,10 @@ class _Enrollee:
         self.done = threading.Event()
         self.state = None
         self.trace = None
+        # the enrolling request's distributed-trace id, captured on the
+        # submit thread: the ONE vmapped window dispatch links back to
+        # every enrolled tenant's request trace (docs/observability.md)
+        self.trace_id = telemetry.current_trace_id()
         self.error: "Exception | None" = None
         # set (under the plane lock) by a follower whose done-wait
         # expired: it is about to dispatch solo, so the late leader
@@ -453,9 +457,14 @@ class BatchPlane:
         if self.metrics is not None:
             self.metrics.record_batching(windows=1, occupancy=B)
         telemetry.counter("fleet.batchOccupancy", float(B))
+        # span links: the one device dispatch names every enrolled
+        # tenant's request trace — the N-tenants-one-dispatch
+        # attribution, navigable from either end in the merged export
+        links = sorted({it.trace_id for it in items if it.trace_id})
+        extra = {"links": links} if links else {}
         telemetry.complete(
             "batch.execute", t0, time.perf_counter(),
-            tid=telemetry.DEVICE_TID, fill=B, bucket=bucket,
+            tid=telemetry.DEVICE_TID, fill=B, bucket=bucket, **extra,
         )
         # per-tenant ledger attribution: the window's ONE device
         # dispatch was recorded (by the AuditedJit/Bundled wrapper)

@@ -449,7 +449,39 @@ def _make_handler(server: SimulatorServer):
             self.send_header("Content-Length", "0")
             self.end_headers()
 
+        def end_headers(self):  # noqa: N802 (stdlib casing)
+            # distributed tracing (docs/observability.md): report the
+            # worker-side wall for this request so the router can split
+            # request latency into net vs worker without a second probe.
+            # Gated on propagation so untraced runs stay byte-identical.
+            t0 = getattr(self, "_kss_t0", None)
+            if t0 is not None and telemetry.propagate_enabled():
+                self.send_header(
+                    "X-KSS-Worker-Seconds",
+                    f"{time.perf_counter() - t0:.6f}",
+                )
+            self._kss_t0 = None
+            super().end_headers()
+
         def _route(self, method: str):
+            # distributed-trace adoption chokepoint: EVERY api call
+            # funnels through here, so parsing the router-minted
+            # traceparent once and entering trace_context makes pass,
+            # compile, and device.execute spans carry the originating
+            # request's trace id (docs/observability.md). Malformed or
+            # absent headers degrade to untraced — never an error.
+            self._kss_t0 = time.perf_counter()
+            tid = None
+            if telemetry.propagate_enabled():
+                tid = telemetry.parse_traceparent(
+                    self.headers.get("traceparent")
+                )
+            if tid is None:
+                return self._route_inner(method)
+            with telemetry.trace_context(tid):
+                return self._route_inner(method)
+
+        def _route_inner(self, method: str):
             url = urlparse(self.path)
             parts = [p for p in url.path.split("/") if p]
             try:
@@ -804,6 +836,11 @@ def _make_handler(server: SimulatorServer):
                     events, dropped=rec.dropped if rec is not None else 0
                 )
                 doc["otherData"]["tracingEnabled"] = rec is not None
+                # monotonic-clock sample for the router's merged-trace
+                # offset handshake (docs/observability.md): the router
+                # brackets this fetch with its own clock and estimates
+                # offset = midpoint - clockUs
+                doc["otherData"]["clockUs"] = telemetry.clock_us()
                 return self._json(200, doc)
             if rest == ["debug", "programs"] and method == "GET":
                 # the per-program performance ledger (utils/ledger.py,

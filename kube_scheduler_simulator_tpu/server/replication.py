@@ -40,6 +40,7 @@ from urllib.parse import urlsplit
 from ..fleet.ring import HashRing
 from ..lifecycle.checkpoint import canonical_digest
 from ..utils import locking
+from ..utils import telemetry
 
 
 def _env_int(env, name: str, default: int, minimum: int) -> int:
@@ -230,6 +231,11 @@ class ReplicationPlane:
                     url, "/api/v1/admin/adopt", body, self.ship_timeout_s
                 )
                 ok += 1
+                # stamped with the causing request's trace id (when the
+                # shipping thread carries one) by the telemetry plane
+                telemetry.instant(
+                    "fleet.ship", session=sid, target=wid, kind="unit"
+                )
                 with self._lock:
                     self._shipped_digests[(sid, wid)] = digest
             except (OSError, ValueError):
@@ -256,12 +262,17 @@ class ReplicationPlane:
             }
         }
         ok = errors = 0
-        for _wid, url in targets:
+        for wid, url in targets:
             try:
                 _post_json(
                     url, "/api/v1/admin/adopt", body, self.ship_timeout_s
                 )
                 ok += 1
+                # sync-mode ship runs ON the acking request thread, so
+                # the instant carries the mutation's own trace id
+                telemetry.instant(
+                    "fleet.ship", session=sid, target=wid, kind="entry"
+                )
             except (OSError, ValueError):
                 errors += 1
         with self._lock:

@@ -495,7 +495,16 @@ class SchedulerService:
 
     def _schedule_gang_locked(self, config, record: bool, window=None):
         """Gang pass: encode, run to fixpoint, write results back."""
+        t0 = time.perf_counter()
         disp = self._gang_dispatch(config, record, window)
+        if disp is not None:
+            telemetry.complete(
+                "device.execute",
+                t0,
+                time.perf_counter(),
+                tid=telemetry.DEVICE_TID,
+                mode="gang",
+            )
         if disp is None:
             return {}, 0, ([] if record else None)
         return self._gang_finish(disp, record)
@@ -1086,6 +1095,9 @@ class SchedulerService:
                 ):
                     disp = self._seq_dispatch(config)
                 info = self.last_encode_info
+                # the originating request's distributed-trace id: resolve
+                # may run on a different thread, so the handle carries it
+                armed_trace = telemetry.current_trace_id()
         except BaseException:
             self._unlease_engine()
             self._schedule_lock.release()
@@ -1103,8 +1115,11 @@ class SchedulerService:
                 tid=telemetry.DEVICE_TID,
                 pass_id=pass_id,
                 mode=mode,
+                trace=armed_trace,
             )
-            with self._session_scope(), telemetry.pass_context(
+            with self._session_scope(), telemetry.trace_context(
+                armed_trace
+            ), telemetry.pass_context(
                 pass_id
             ), telemetry.span(f"pass.{mode}.resolve", pass_id=pass_id):
                 results = [] if disp is None else self._seq_finish(disp)
@@ -1153,6 +1168,7 @@ class SchedulerService:
                 ):
                     disp = self._gang_dispatch(config, record, window)
                 info = self.last_encode_info
+                armed_trace = telemetry.current_trace_id()
         except BaseException:
             self._unlease_engine()
             self._schedule_lock.release()
@@ -1166,6 +1182,7 @@ class SchedulerService:
                 tid=telemetry.DEVICE_TID,
                 pass_id=pass_id,
                 mode="gang",
+                trace=armed_trace,
             )
             if disp is None:
                 self.metrics.record(
@@ -1175,7 +1192,9 @@ class SchedulerService:
                     pass_id=pass_id,
                 )
                 return 0
-            with self._session_scope(), telemetry.pass_context(
+            with self._session_scope(), telemetry.trace_context(
+                armed_trace
+            ), telemetry.pass_context(
                 pass_id
             ), telemetry.span("pass.gang.resolve", pass_id=pass_id):
                 placements, rounds, _results = self._gang_finish(disp, record)
@@ -1195,9 +1214,21 @@ class SchedulerService:
         return SchedulingPassHandle(self, "gang", finish, info, pass_id=pass_id)
 
     def _schedule_locked(self, config) -> list[PodSchedulingResult]:
+        # the synchronous pass's device window, on the synthetic device
+        # track like the async handle's (encode + engine execution live
+        # inside the dispatch): pass/session/trace ids stamp from the
+        # ambient contexts — the request thread runs the whole pass
+        t0 = time.perf_counter()
         disp = self._seq_dispatch(config)
         if disp is None:
-            return []
+            return []  # nothing schedulable: no device work to record
+        telemetry.complete(
+            "device.execute",
+            t0,
+            time.perf_counter(),
+            tid=telemetry.DEVICE_TID,
+            mode="extender" if config.extenders else "sequential",
+        )
         return self._seq_finish(disp)
 
     def _seq_dispatch(self, config):

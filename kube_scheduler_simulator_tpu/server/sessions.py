@@ -60,6 +60,7 @@ from ..lifecycle.checkpoint import (
     write_checkpoint,
 )
 from ..utils import envcheck, faultinject, fleetstats, locking
+from ..utils import telemetry
 from ..utils import ledger as ledger_mod
 from ..utils import slo as slo_mod
 from ..utils.broker import CompileBroker
@@ -1437,6 +1438,13 @@ class SessionManager:
                     adopted.append(sid)
                 else:
                     duplicate.append(sid)  # raced with a concurrent adopt
+        # distributed tracing (docs/observability.md): adopt/replica
+        # landings record the trace id of the request that caused them
+        # (the router's re-home or the peer's ship both propagate one)
+        for sid in adopted:
+            telemetry.instant("fleet.adopt", session=sid, kind="live")
+        for sid in stored:
+            telemetry.instant("fleet.adopt", session=sid, kind="replica")
         with self._lock:
             self.adopted_units += len(adopted)
             self.stored_replicas += len(stored)
@@ -1518,6 +1526,10 @@ class SessionManager:
                         os.unlink(rp)
             promoted.append(sid)
         adopted = set(self.adopt_snapshots()) if promoted else set()
+        for sid in promoted:
+            # carries the causing request's trace id (the router's
+            # dead-worker re-home propagates its context here)
+            telemetry.instant("fleet.promote", session=sid)
         with self._lock:
             self.promoted_replicas += len(promoted)
         return {

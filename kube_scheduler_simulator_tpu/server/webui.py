@@ -194,6 +194,16 @@ PAGE = """<!doctype html>
  <div class="hint">SLO burn-rate alerts (KSS_SLO=1 or a PUT /api/v1/slo
  override): seeded from /api/v1/alerts, then live from the SSE stream's
  <code>alert</code> events &mdash; pending &rarr; firing &rarr; resolved</div>
+ <h2>Recent requests</h2>
+ <table id="reqtable"><thead><tr><th>time</th><th>route</th><th>worker</th>
+  <th>status</th><th>attempts</th><th>breaker</th>
+  <th>total / net / worker / router (ms)</th><th>trace</th></tr>
+ </thead><tbody></tbody></table>
+ <span id="reqstat" class="hint"></span>
+ <div class="hint">the fleet router's per-request ring
+ (/api/v1/fleet/requests): attempt counts + the latency split per proxied
+ request; trace ids join the merged /api/v1/debug/trace Perfetto export
+ when KSS_TRACE=1 (docs/observability.md)</div>
 </div>
 <div id="editorpane">
  <b id="edtitle"></b><br>
@@ -569,6 +579,46 @@ function drawAlerts(){
     tb.appendChild(tr);
   }
 }
+// --- the Recent requests panel: the fleet router's bounded request
+// ring (/api/v1/fleet/requests), seeded at start and re-fetched (rate-
+// limited) on SSE activity — a worker serving this page directly (no
+// router in front) answers 404 and the panel says so
+let reqFetchAt=0;
+async function fetchRequests(force){
+  const now=Date.now();
+  if(!force&&now-reqFetchAt<2000) return;
+  reqFetchAt=now;
+  try{
+    const r=await fetch('/api/v1/fleet/requests');
+    if(!r.ok){document.getElementById('reqstat').textContent=
+      'not behind a fleet router (the ring lives at the router edge)';
+      return;}
+    const doc=await r.json();
+    drawRequests(doc.requests||[]);
+    document.getElementById('reqstat').textContent=
+      (doc.requests||[]).length+' request(s) in ring'+
+      (doc.tracing?' \u00b7 tracing armed'
+                  :' \u00b7 KSS_TRACE off: no trace ids');
+  }catch(e){document.getElementById('reqstat').textContent='requests: '+e;}
+}
+function drawRequests(rows){
+  const tb=document.querySelector('#reqtable tbody'); tb.innerHTML='';
+  const ms=v=>(Number(v||0)*1000).toFixed(1);
+  for(const q of rows.slice(-25).reverse()){
+    const tr=document.createElement('tr');
+    tr.innerHTML='<td>'+esc(q.ts?new Date(q.ts*1000)
+        .toLocaleTimeString():'')+'</td>'+
+      '<td>'+esc((q.method||'')+' '+(q.route||''))+'</td>'+
+      '<td>'+esc(q.worker||'\u2013')+'</td>'+
+      '<td>'+esc(q.status==null?'?':q.status)+'</td>'+
+      '<td>'+esc(q.attempts)+'</td>'+
+      '<td>'+esc(q.breaker||'\u2013')+'</td>'+
+      '<td>'+ms(q.totalSeconds)+' / '+ms(q.netSeconds)+' / '+
+        ms(q.workerSeconds)+' / '+ms(q.routerSeconds)+'</td>'+
+      '<td class="hint">'+esc(q.trace?q.trace.slice(0,8):'\u2013')+'</td>';
+    tb.appendChild(tr);
+  }
+}
 async function startObs(){
   if(obsSource) return;
   // connect FIRST, synchronously: the obsSource guard must hold before
@@ -576,9 +626,11 @@ async function startObs(){
   // EventSource (one SSE subscriber slot each) and Stop is a no-op
   obsSource=new EventSource('/api/v1/events');
   obsSource.addEventListener('fleet',
-    ev=>{obsFromFleet(JSON.parse(ev.data)); drawSparks();});
+    ev=>{obsFromFleet(JSON.parse(ev.data)); drawSparks();
+         fetchRequests(false);});
   obsSource.addEventListener('metrics',
-    ev=>{obsFromMetrics(JSON.parse(ev.data)); drawSparks();});
+    ev=>{obsFromMetrics(JSON.parse(ev.data)); drawSparks();
+         fetchRequests(false);});
   obsSource.addEventListener('alert',
     ev=>{onAlert(JSON.parse(ev.data));});
   document.getElementById('obsbtn').textContent='Stop live telemetry';
@@ -598,6 +650,7 @@ async function startObs(){
       ?`SLO plane armed \\u00b7 ${doc.counters.fired} alert(s) fired`
       :'SLO plane is off (KSS_SLO=1 or PUT /api/v1/slo to arm)';
   }catch(e){document.getElementById('alertstat').textContent='alerts: '+e;}
+  fetchRequests(true);
   drawSparks(); drawAlerts();
 }
 function stopObs(){

@@ -856,20 +856,25 @@ class CompileBroker:
                 token=str(token),
             )
             return False
-        # the causal pass id + session of the ARMING request thread (and
-        # its thread-locally scoped fault plane, the session bulkhead)
-        # travel with the task: the worker re-enters all three, so a
-        # speculative build's telemetry spans name the pass/session that
-        # armed it and its faults draw from the arming session's plane
+        # the causal pass id + session + distributed-trace id of the
+        # ARMING request thread (and its thread-locally scoped fault
+        # plane, the session bulkhead) travel with the task: the worker
+        # re-enters them all, so a speculative build's telemetry spans
+        # name the pass/session/trace that armed it and its faults draw
+        # from the arming session's plane
         armed_by = telemetry.current_pass_id()
         armed_session = telemetry.current_session_id()
+        armed_trace = telemetry.current_trace_id()
         armed_plane = faultinject.scoped_active()
         with self._lock:
             if token in self._tokens:
                 return False
             self._tokens.add(token)
             self._tasks.append(
-                (token, task, armed_by, armed_session, armed_plane, metrics)
+                (
+                    token, task, armed_by, armed_session, armed_trace,
+                    armed_plane, metrics,
+                )
             )
             self._busy += 1
             if self._worker is None:
@@ -886,8 +891,8 @@ class CompileBroker:
                     self._worker = None
                     return
                 (
-                    token, task, armed_by, armed_session, armed_plane,
-                    armed_metrics,
+                    token, task, armed_by, armed_session, armed_trace,
+                    armed_plane, armed_metrics,
                 ) = self._tasks.pop(0)
             try:
                 scope = (
@@ -897,7 +902,9 @@ class CompileBroker:
                 )
                 with scope, telemetry.pass_context(
                     armed_by
-                ), telemetry.session_context(armed_session), telemetry.span(
+                ), telemetry.session_context(
+                    armed_session
+                ), telemetry.trace_context(armed_trace), telemetry.span(
                     "compile.speculative", token=str(token)
                 ):
                     plane = faultinject.active()

@@ -148,6 +148,9 @@ KNOWN: "dict[str, Validator]" = {
     # telemetry plane
     "KSS_TRACE": _bool_validator,
     "KSS_TRACE_RING_CAP": _int_validator(1),
+    # cross-process trace-context propagation (defaults on whenever a
+    # recorder is active; =0 keeps spans local to each process)
+    "KSS_TRACE_PROPAGATE": _bool_validator,
     # the fleet & memory observatory (utils/fleetstats.py): per-pass
     # device-HBM + cluster-quality sampling into a bounded ring, served
     # by GET /api/v1/timeseries / Prometheus gauges / the dashboard;
@@ -252,6 +255,8 @@ KNOWN: "dict[str, Validator]" = {
     "KSS_FLEET_BREAKER_FAILURES": _int_validator(1),
     "KSS_FLEET_BREAKER_OPEN_S": _float_validator(0.0),
     "KSS_FLEET_TRANSPORT": _choice_validator("", "auto", "http"),
+    # the router's bounded per-request ring (GET /api/v1/fleet/requests)
+    "KSS_FLEET_REQUEST_RING_CAP": _int_validator(1),
     # session plane (docs/sessions.md)
     "KSS_MAX_SESSIONS": _int_validator(1),
     "KSS_MAX_PENDING_PODS_PER_SESSION": _int_validator(0),

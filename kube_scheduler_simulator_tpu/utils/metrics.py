@@ -1253,6 +1253,40 @@ def _render_prometheus(
     return "\n".join(lines) + "\n"
 
 
+def render_histogram(
+    name: str,
+    help_text: str,
+    snapshot: dict,
+    labels: "dict[str, str] | None" = None,
+    openmetrics: bool = False,
+) -> str:
+    """One standalone histogram family in exposition text, from a
+    `Histogram.snapshot()` document — the router's request-latency
+    families live OUTSIDE any `SchedulingMetrics` registry, so they
+    can't ride `_render_prometheus`'s HISTOGRAM_FAMILIES walk. Same
+    line grammar: `_bucket{le=...}` (+ OpenMetrics exemplar suffix when
+    asked), `_sum`, `_count`. Caller snapshots under its own lock."""
+    labels = labels or {}
+    lines = [
+        f"# HELP {name} {help_text}",
+        f"# TYPE {name} histogram",
+    ]
+    exemplars = snapshot.get("exemplars") or {}
+    for le, cum in snapshot["buckets"].items():
+        line = (
+            f"{name}_bucket{_label_body(labels, (('le', le),))} "
+            f"{_fmt_value(cum)}"
+        )
+        if openmetrics and le in exemplars:
+            line += _fmt_exemplar(exemplars[le])
+        lines.append(line)
+    lines.append(f"{name}_sum{_label_body(labels)} {_fmt_value(snapshot['sum'])}")
+    lines.append(
+        f"{name}_count{_label_body(labels)} {_fmt_value(snapshot['count'])}"
+    )
+    return "\n".join(lines) + "\n"
+
+
 _PROM_SAMPLE_RE = None  # compiled lazily (re import kept off the hot path)
 
 

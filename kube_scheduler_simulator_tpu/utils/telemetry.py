@@ -46,12 +46,24 @@ oldest events are overwritten — the flight-recorder contract.
 
 Timestamps are `time.perf_counter()` microseconds: monotonic, shared
 across threads, the unit Chrome trace events use (`ts`/`dur`).
+
+Distributed tracing (docs/observability.md "Distributed tracing"): the
+fleet router mints a W3C-traceparent-style context per inbound request
+and stamps it on every proxied hop; workers adopt the header at the
+HTTP chokepoint via `trace_context`, so every span a request causes —
+across processes — carries one ``args["trace"]`` id, and
+`merged_chrome_trace` joins per-process exports into one Perfetto
+document with per-track monotonic-clock offsets. Propagation rides the
+same arming as the recorder (`KSS_TRACE_PROPAGATE`, default on when
+KSS_TRACE is truthy): with tracing off nothing is minted, parsed, or
+stamped.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import secrets
 import threading
 import time
 
@@ -63,6 +75,7 @@ from .envcheck import TRUTHY as _TRUE
 
 ENV_VAR = "KSS_TRACE"
 CAP_VAR = "KSS_TRACE_RING_CAP"
+PROPAGATE_VAR = "KSS_TRACE_PROPAGATE"
 DEFAULT_RING_CAP = 65536
 
 # the synthetic track for non-thread-shaped intervals (the async
@@ -286,6 +299,92 @@ class pass_context:
         return False
 
 
+# -- distributed trace context (docs/observability.md) ------------------------
+
+
+def propagate_enabled() -> bool:
+    """Whether trace-context propagation is armed: tracing must be on
+    (no recorder = nothing to correlate) and KSS_TRACE_PROPAGATE not
+    spelled falsy (default on — arming KSS_TRACE arms the fleet's
+    causal joins too)."""
+    if active() is None:
+        return False
+    raw = os.environ.get(PROPAGATE_VAR, "")
+    if not raw:
+        return True
+    from .envcheck import FALSY
+
+    return raw.strip().lower() not in FALSY
+
+
+def new_trace_id() -> str:
+    """A fresh 128-bit trace id, W3C trace-context shaped (32 lowercase
+    hex chars, never all-zero)."""
+    return secrets.token_hex(16)
+
+
+def new_span_id() -> str:
+    """A fresh 64-bit parent span id for a traceparent hop."""
+    return secrets.token_hex(8)
+
+
+def make_traceparent(trace_id: str, span_id: "str | None" = None) -> str:
+    """The ``traceparent`` header value for one outbound hop:
+    ``00-<32hex trace id>-<16hex parent span id>-01`` (version 00,
+    sampled flag set — everything this plane propagates is recorded)."""
+    return f"00-{trace_id}-{span_id or new_span_id()}-01"
+
+
+def parse_traceparent(header: "str | None") -> "str | None":
+    """The trace id carried by a ``traceparent`` header, or None for
+    anything malformed — a bad header must degrade to an untraced
+    request, never an error on the serving path."""
+    if not header:
+        return None
+    parts = header.strip().split("-")
+    if len(parts) != 4:
+        return None
+    version, trace_id, span_id, _flags = parts
+    if version != "00" or len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    try:
+        int(trace_id, 16)
+        int(span_id, 16)
+    except ValueError:
+        return None
+    if trace_id == "0" * 32:
+        return None
+    return trace_id
+
+
+def current_trace_id() -> "str | None":
+    """The trace id of the innermost `trace_context` on this thread."""
+    return getattr(_ctx, "trace_id", None)
+
+
+class trace_context:
+    """Thread-local distributed-trace causality: spans/instants emitted
+    inside carry ``args["trace"] = trace_id`` — the id the fleet router
+    minted for the originating request (or None to run untraced). Same
+    plain-class shape as `pass_context`; re-entered on broker worker
+    threads and async-pass resolution for work a traced request armed."""
+
+    __slots__ = ("_trace_id", "_prev")
+
+    def __init__(self, trace_id: "str | None"):
+        self._trace_id = trace_id
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_ctx, "trace_id", None)
+        _ctx.trace_id = self._trace_id
+        return self
+
+    def __exit__(self, *exc):
+        _ctx.trace_id = self._prev
+        return False
+
+
 # -- emission -----------------------------------------------------------------
 
 
@@ -293,12 +392,24 @@ def _args(pass_id, attrs: dict) -> dict:
     if pass_id is None:
         pass_id = current_pass_id()
     session_id = attrs.get("session", current_session_id())
-    if pass_id is not None or session_id is not None:
+    # explicit trace=None (an untraced async handle) must not leave a
+    # null key behind — strip it and fall back to the thread-local id
+    trace_id = attrs.get("trace") or current_trace_id()
+    if (
+        pass_id is not None
+        or session_id is not None
+        or trace_id is not None
+        or "trace" in attrs
+    ):
         attrs = dict(attrs)
         if pass_id is not None:
             attrs["pass"] = pass_id
         if session_id is not None:
             attrs["session"] = session_id
+        if trace_id is not None:
+            attrs["trace"] = trace_id
+        else:
+            attrs.pop("trace", None)
     return attrs
 
 
@@ -502,23 +613,110 @@ def dump_chrome_trace(path: str, recorder: "SpanRecorder | None" = None) -> int:
     return len(events)
 
 
+def clock_us() -> float:
+    """This process's monotonic trace clock (perf_counter µs) — the
+    value worker exports report in ``otherData.clockUs`` so the fleet
+    router's merge handshake can estimate per-process clock offsets
+    (`merged_chrome_trace`)."""
+    return _now_us()
+
+
+def merged_chrome_trace(tracks: "list[dict]", *, dropped: int = 0) -> dict:
+    """Join per-process Chrome-trace exports into ONE Perfetto document:
+    each `tracks` entry is ``{"pid": int, "name": str, "events": [...],
+    "offset_us": float, "thread_names": {tid: label} | None}``. Every
+    event's ``ts`` is shifted by its track's monotonic-clock offset
+    (estimated NTP-style by the caller's probe handshake: the midpoint
+    of the fetch window minus the export's ``otherData.clockUs``) and
+    its ``pid`` remapped to the track's — one process lane per worker
+    plus the router lane. A constant per-track shift preserves each
+    process's B/E ordering, so merged intervals stay well-formed
+    (`check_nesting` holds iff it held per export)."""
+    meta: list[dict] = []
+    out: list[dict] = []
+    for track in tracks:
+        pid = int(track["pid"])
+        names = track.get("thread_names") or {}
+        offset = float(track.get("offset_us") or 0.0)
+        meta.append(
+            {
+                "ph": "M",
+                "name": "process_name",
+                "pid": pid,
+                "tid": DEVICE_TID,
+                "args": {"name": str(track.get("name") or f"process {pid}")},
+            }
+        )
+        seen_tids: set = set()
+        for ev in track.get("events") or []:
+            if ev.get("ph") == "M":
+                # per-export metadata is rebuilt here with the merged
+                # pids; carry the thread labels over instead
+                if ev.get("name") == "thread_name":
+                    names.setdefault(
+                        ev.get("tid"), (ev.get("args") or {}).get("name")
+                    )
+                continue
+            ev = dict(ev)
+            ev["pid"] = pid
+            if "ts" in ev:
+                ev["ts"] = float(ev["ts"]) + offset
+            out.append(ev)
+            tid = ev.get("tid")
+            if tid not in seen_tids:
+                seen_tids.add(tid)
+                meta.append(
+                    {
+                        "ph": "M",
+                        "name": "thread_name",
+                        "pid": pid,
+                        "tid": tid,
+                        "args": {
+                            "name": names.get(tid) or f"thread-{tid}"
+                        },
+                    }
+                )
+    return {
+        "traceEvents": meta + out,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "producer": "kube_scheduler_simulator_tpu.utils.telemetry",
+            "droppedEvents": dropped,
+            "merged": True,
+            "tracks": [
+                {
+                    "pid": int(t["pid"]),
+                    "name": str(t.get("name") or ""),
+                    "offsetUs": round(float(t.get("offset_us") or 0.0), 3),
+                }
+                for t in tracks
+            ],
+        },
+    }
+
+
 # -- span-interval utilities (tests, smoke tooling) ---------------------------
 
 
 def span_intervals(events: list[dict]) -> list[dict]:
     """Reconstruct closed spans from a trace-event list: each `X` event
     directly, each per-thread balanced B/E pair as one interval. Returns
-    dicts ``{"name", "tid", "start_us", "end_us", "args"}``; unmatched
-    B/E (ring-evicted partners) are skipped. Also the well-formedness
-    checker's engine: `check_nesting` raises on interleaved pairs."""
+    dicts ``{"name", "pid", "tid", "start_us", "end_us", "args"}``;
+    unmatched B/E (ring-evicted partners) are skipped. Stacks are keyed
+    (pid, tid): in a MERGED document (`merged_chrome_trace`) thread ids
+    can collide across process tracks — same-process exports carry one
+    constant pid, so the grouping is unchanged there. Also the well-
+    formedness checker's engine: `check_nesting` raises on interleaved
+    pairs."""
     out: list[dict] = []
-    stacks: "dict[int, list[dict]]" = {}
+    stacks: "dict[tuple, list[dict]]" = {}
     for ev in events:
         ph = ev.get("ph")
         if ph == "X":
             out.append(
                 {
                     "name": ev["name"],
+                    "pid": ev.get("pid"),
                     "tid": ev.get("tid"),
                     "start_us": float(ev["ts"]),
                     "end_us": float(ev["ts"]) + float(ev.get("dur", 0.0)),
@@ -526,14 +724,17 @@ def span_intervals(events: list[dict]) -> list[dict]:
                 }
             )
         elif ph == "B":
-            stacks.setdefault(ev.get("tid"), []).append(ev)
+            stacks.setdefault(
+                (ev.get("pid"), ev.get("tid")), []
+            ).append(ev)
         elif ph == "E":
-            stack = stacks.get(ev.get("tid"))
+            stack = stacks.get((ev.get("pid"), ev.get("tid")))
             if stack and stack[-1]["name"] == ev["name"]:
                 b = stack.pop()
                 out.append(
                     {
                         "name": b["name"],
+                        "pid": b.get("pid"),
                         "tid": b.get("tid"),
                         "start_us": float(b["ts"]),
                         "end_us": float(ev["ts"]),
@@ -550,11 +751,13 @@ def check_nesting(events: list[dict], *, dropped: int = 0) -> None:
     `dropped` count, or the export's `otherData.droppedEvents`), E
     events arriving on an empty stack are tolerated — their B partners
     were evicted; proper LIFO closing means such orphans always land on
-    an empty stack, so interleaving detection is unaffected."""
-    stacks: "dict[int, list[str]]" = {}
+    an empty stack, so interleaving detection is unaffected. Stacks are
+    keyed (pid, tid), so merged multi-process documents check each
+    process track independently."""
+    stacks: "dict[tuple, list[str]]" = {}
     for ev in events:
         ph = ev.get("ph")
-        tid = ev.get("tid")
+        tid = (ev.get("pid"), ev.get("tid"))
         if ph == "B":
             stacks.setdefault(tid, []).append(ev["name"])
         elif ph == "E":

@@ -35,6 +35,7 @@ for _var in (
     # zero-emission test would fail for the wrong reason)
     "KSS_TRACE",
     "KSS_TRACE_RING_CAP",
+    "KSS_TRACE_PROPAGATE",
     # the fleet & memory observatory (utils/fleetstats.py): ambient
     # KSS_FLEET_STATS=1 would make every pass in the suite pay the
     # quality reduction + host fetch, and an ambient headroom floor
@@ -131,6 +132,7 @@ for _var in (
     "KSS_FLEET_BREAKER_FAILURES",
     "KSS_FLEET_BREAKER_OPEN_S",
     "KSS_FLEET_TRANSPORT",
+    "KSS_FLEET_REQUEST_RING_CAP",
 ):
     os.environ.pop(_var, None)
 

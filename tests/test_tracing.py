@@ -1,0 +1,641 @@
+"""Fleet-wide distributed tracing (docs/observability.md "Distributed
+tracing"): the W3C-traceparent-shaped context grammar, thread-local
+trace stamping, the clock-offset merge (`merged_chrome_trace` over
+skewed per-process clocks), router retry-attempt span trees under
+injected net faults, batched-dispatch span links, the per-request ring
++ `kss_fleet_request_seconds` exemplars, the `?worker=` debug proxies,
+and the armed-vs-off byte-parity pin — all against in-process workers
+(tools/fleet_chaos_smoke.py gate D exercises the spawned-worker,
+multi-process path)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kube_scheduler_simulator_tpu.fleet import FleetRouter
+from kube_scheduler_simulator_tpu.server import SimulatorServer, SimulatorService
+from kube_scheduler_simulator_tpu.server.batchplane import BatchPlane
+from kube_scheduler_simulator_tpu.server.sessions import SessionManager
+from kube_scheduler_simulator_tpu.utils import faultinject, telemetry
+from kube_scheduler_simulator_tpu.utils.metrics import parse_prometheus_text
+
+from helpers import node, pod
+
+
+@pytest.fixture(autouse=True)
+def _clean_planes():
+    """Every test starts with no ambient recorder and no chaos plane,
+    and leaves none behind (both are process globals)."""
+    telemetry.deactivate()
+    faultinject.deactivate()
+    yield
+    telemetry.deactivate()
+    faultinject.deactivate()
+
+
+def _req(port, method, path, body=None, headers=None, timeout=300):
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers=hdrs,
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            raw = resp.read()
+            return resp.status, json.loads(raw) if raw else None, dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        return e.code, json.loads(raw) if raw else None, dict(e.headers)
+
+
+def _raw(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=300
+    ) as resp:
+        return resp.read()
+
+
+def _ring_entry(port, route, tries=100):
+    """The newest ring entry for `route`. The ring records a request
+    AFTER its response bytes go out (the recorded total must include
+    the relay), so a read racing one's own last request polls briefly."""
+    for _ in range(tries):
+        _, ring, _ = _req(port, "GET", "/api/v1/fleet/requests")
+        hits = [e for e in ring["requests"] if e["route"] == route]
+        if hits:
+            return ring, hits[-1]
+        time.sleep(0.05)
+    raise AssertionError(f"no ring entry for {route!r}")
+
+
+@pytest.fixture()
+def traced_fleet(tmp_path):
+    """Two in-process workers adopted by a router, with a recorder
+    armed — in one process router and workers share it, so the whole
+    causal chain of a routed request lands in one ring."""
+    rec = telemetry.SpanRecorder(capacity=16384)
+    telemetry.activate(rec)
+    servers, dirs = [], []
+    for i in range(2):
+        d = str(tmp_path / f"w{i}")
+        srv = SimulatorServer(
+            SimulatorService(),
+            port=0,
+            session_config={"snapshot_dir": d},
+        ).start()
+        servers.append(srv)
+        dirs.append(d)
+    router = FleetRouter(
+        adopt=[
+            (f"http://127.0.0.1:{srv.port}", d)
+            for srv, d in zip(servers, dirs)
+        ],
+        port=0,
+        probe_interval_s=60.0,
+        fleet_dir=str(tmp_path / "fleet"),
+    ).start()
+    yield router, servers, rec
+    router.shutdown(drain=False)
+    for srv in servers:
+        try:
+            srv.shutdown()
+        except Exception:
+            pass
+
+
+class TestTraceparentGrammar:
+    def test_round_trip(self):
+        tid = telemetry.new_trace_id()
+        assert len(tid) == 32 and int(tid, 16) >= 0
+        header = telemetry.make_traceparent(tid)
+        assert header.startswith("00-") and header.endswith("-01")
+        assert telemetry.parse_traceparent(header) == tid
+
+    def test_malformed_degrades_to_untraced(self):
+        """A bad header must become an untraced request, never an
+        error on the serving path."""
+        good = telemetry.make_traceparent(telemetry.new_trace_id())
+        for bad in (
+            None,
+            "",
+            "not-a-header",
+            good.replace("00-", "ff-"),  # unknown version
+            "00-" + "g" * 32 + "-" + "a" * 16 + "-01",  # non-hex trace
+            "00-" + "0" * 32 + "-" + "a" * 16 + "-01",  # all-zero trace
+            "00-" + "a" * 31 + "-" + "a" * 16 + "-01",  # short trace
+            "00-" + "a" * 32 + "-" + "a" * 15 + "-01",  # short parent
+            good + "-extra",
+        ):
+            assert telemetry.parse_traceparent(bad) is None
+
+    def test_propagation_rides_the_recorder_arming(self, monkeypatch):
+        monkeypatch.delenv(telemetry.PROPAGATE_VAR, raising=False)
+        assert not telemetry.propagate_enabled()  # no recorder, no joins
+        telemetry.activate(telemetry.SpanRecorder(capacity=8))
+        assert telemetry.propagate_enabled()  # default ON once armed
+        monkeypatch.setenv(telemetry.PROPAGATE_VAR, "0")
+        assert not telemetry.propagate_enabled()
+        monkeypatch.setenv(telemetry.PROPAGATE_VAR, "false")
+        assert not telemetry.propagate_enabled()
+        monkeypatch.setenv(telemetry.PROPAGATE_VAR, "1")
+        assert telemetry.propagate_enabled()
+
+
+class TestTraceStamping:
+    def test_spans_inside_trace_context_carry_the_id(self):
+        rec = telemetry.SpanRecorder(capacity=64)
+        telemetry.activate(rec)
+        tid = telemetry.new_trace_id()
+        with telemetry.trace_context(tid):
+            assert telemetry.current_trace_id() == tid
+            with telemetry.span("traced.work"):
+                pass
+            telemetry.instant("traced.mark")
+        assert telemetry.current_trace_id() is None
+        with telemetry.span("untraced.work"):
+            pass
+        by_name = {}
+        for ev in rec.snapshot():
+            by_name.setdefault(ev["name"], []).append(ev)
+        for name in ("traced.work", "traced.mark"):
+            assert all(ev["args"]["trace"] == tid for ev in by_name[name])
+        assert all(
+            "trace" not in ev["args"] for ev in by_name["untraced.work"]
+        )
+
+    def test_explicit_none_trace_is_stripped(self):
+        """An untraced async handle passes trace=None explicitly — the
+        exported args must not grow a null key."""
+        rec = telemetry.SpanRecorder(capacity=8)
+        telemetry.activate(rec)
+        telemetry.complete("x.window", 0.0, 1.0, tid=telemetry.DEVICE_TID, trace=None)
+        (ev,) = rec.snapshot()
+        assert "trace" not in ev["args"]
+
+    def test_context_reenters_on_worker_threads(self):
+        """Background work a traced request armed re-enters its context
+        (broker speculative builds, async resolves)."""
+        rec = telemetry.SpanRecorder(capacity=16)
+        telemetry.activate(rec)
+        tid = telemetry.new_trace_id()
+        done = threading.Event()
+
+        def worker():
+            with telemetry.trace_context(tid):
+                telemetry.instant("bg.work")
+            done.set()
+
+        threading.Thread(target=worker).start()
+        assert done.wait(timeout=30)
+        (ev,) = [e for e in rec.snapshot() if e["name"] == "bg.work"]
+        assert ev["args"]["trace"] == tid
+
+
+def _span(name, ph, ts, pid, tid):
+    return {
+        "ph": ph,
+        "name": name,
+        "cat": "kss",
+        "ts": float(ts),
+        "pid": pid,
+        "tid": tid,
+        "args": {},
+    }
+
+
+class TestClockOffsetMerge:
+    """`merged_chrome_trace` over per-process exports whose monotonic
+    clocks share no epoch: a constant per-track shift must land every
+    track on the router's timeline with B/E well-formedness intact —
+    even when thread ids collide across processes."""
+
+    def _tracks(self):
+        # router clock: epoch ~1s. worker clock: epoch ~9s, skewed by
+        # -8s so its spans interleave with the router's in merged time.
+        # BOTH use tid 7: before (pid, tid)-keyed stacks this would
+        # interleave the two processes' B/E pairs into one stack.
+        router_events = [
+            _span("router.request", "B", 1_000_000, 4242, 7),
+            _span("router.attempt", "B", 1_000_100, 4242, 7),
+            _span("router.attempt", "E", 1_000_400, 4242, 7),
+            _span("router.request", "E", 1_000_500, 4242, 7),
+        ]
+        worker_events = [
+            {
+                "ph": "M",
+                "name": "thread_name",
+                "pid": 9999,
+                "tid": 7,
+                "args": {"name": "http-worker"},
+            },
+            _span("pass.sequential", "B", 9_000_150, 9999, 7),
+            {
+                "ph": "X",
+                "name": "device.execute",
+                "cat": "kss",
+                "ts": 9_000_200.0,
+                "dur": 50.0,
+                "pid": 9999,
+                "tid": 0,
+                "args": {},
+            },
+            _span("pass.sequential", "E", 9_000_300, 9999, 7),
+        ]
+        return [
+            {
+                "pid": 0,
+                "name": "router",
+                "events": router_events,
+                "offset_us": 0.0,
+            },
+            {
+                "pid": 1,
+                "name": "worker w0",
+                "events": worker_events,
+                "offset_us": -8_000_000.0,
+            },
+        ]
+
+    def test_skewed_clocks_merge_into_well_formed_intervals(self):
+        doc = telemetry.merged_chrome_trace(self._tracks())
+        events = doc["traceEvents"]
+        telemetry.check_nesting(events)  # raises on interleaving
+        ivals = telemetry.span_intervals(events)
+        assert len(ivals) == 4
+        assert all(iv["end_us"] >= iv["start_us"] for iv in ivals)
+        by_name = {iv["name"]: iv for iv in ivals}
+        # the worker track landed on the router's timeline: its pass
+        # nests inside the router request's window in merged time
+        wpass = by_name["pass.sequential"]
+        assert wpass["pid"] == 1
+        assert wpass["start_us"] == pytest.approx(1_000_150.0)
+        assert (
+            by_name["router.request"]["start_us"]
+            < wpass["start_us"]
+            < wpass["end_us"]
+            < by_name["router.request"]["end_us"]
+        )
+        # device.execute shifted identically (constant per-track shift)
+        assert by_name["device.execute"]["start_us"] == pytest.approx(
+            1_000_200.0
+        )
+        # pids remapped to the track lanes, original pids gone
+        assert {ev.get("pid") for ev in events} == {0, 1}
+
+    def test_merged_metadata_rebuilt_per_track(self):
+        doc = telemetry.merged_chrome_trace(self._tracks(), dropped=3)
+        metas = [ev for ev in doc["traceEvents"] if ev["ph"] == "M"]
+        procs = {
+            ev["pid"]: ev["args"]["name"]
+            for ev in metas
+            if ev["name"] == "process_name"
+        }
+        assert procs == {0: "router", 1: "worker w0"}
+        # the worker export's own thread label carried over to pid 1
+        assert any(
+            ev["name"] == "thread_name"
+            and ev["pid"] == 1
+            and ev["args"]["name"] == "http-worker"
+            for ev in metas
+        )
+        other = doc["otherData"]
+        assert other["merged"] is True and other["droppedEvents"] == 3
+        assert [t["pid"] for t in other["tracks"]] == [0, 1]
+
+    def test_ring_wrapped_orphan_ends_tolerated_only_when_dropped(self):
+        tracks = self._tracks()
+        # evict the worker's B: its E arrives on an empty (pid,tid) stack
+        tracks[1]["events"] = [
+            ev
+            for ev in tracks[1]["events"]
+            if not (ev["ph"] == "B" and ev["name"] == "pass.sequential")
+        ]
+        events = telemetry.merged_chrome_trace(tracks)["traceEvents"]
+        telemetry.check_nesting(events, dropped=1)
+        with pytest.raises(ValueError):
+            telemetry.check_nesting(events, dropped=0)
+
+
+class TestRoutedTraceTree:
+    def _drive_session(self, port, sid):
+        assert _req(port, "POST", "/api/v1/sessions", {"id": sid})[0] == 201
+        base = f"/api/v1/sessions/{sid}"
+        _req(port, "PUT", f"{base}/resources/nodes", node("n0", cpu="2"))
+        _req(port, "PUT", f"{base}/resources/pods", pod("p0", cpu="500m"))
+        return base
+
+    def test_one_trace_id_from_edge_to_device_execute(self, traced_fleet):
+        """The tentpole contract: a routed schedule's trace id appears
+        on the router request span, its attempt child, the owning
+        worker's pass span, AND the device.execute window."""
+        router, _servers, rec = traced_fleet
+        base = self._drive_session(router.port, "e2e-1")
+        code, out, _ = _req(router.port, "POST", f"{base}/schedule")
+        assert code == 200 and out["scheduled"] == 1
+        ring, entry = _ring_entry(router.port, f"{base}/schedule")
+        assert ring["tracing"] is True
+        tid = entry["trace"]
+        assert tid and len(tid) == 32
+        assert entry["attempts"] == 1 and entry["worker"] in ("w0", "w1")
+        assert entry["status"] == 200 and entry["breaker"] == "closed"
+        # the worker reported its own wall via X-KSS-Worker-Seconds, so
+        # the split decomposes: total >= router overhead, worker > 0
+        assert entry["workerSeconds"] > 0
+        assert entry["totalSeconds"] >= entry["routerSeconds"] >= 0
+        assert entry["netSeconds"] >= 0
+        traced = [
+            ev
+            for ev in rec.snapshot()
+            if (ev.get("args") or {}).get("trace") == tid
+        ]
+        names = {(ev["name"], ev["ph"]) for ev in traced}
+        assert ("router.request", "B") in names
+        assert ("router.attempt", "B") in names
+        assert any(
+            name.startswith("pass.") and ph == "B" for name, ph in names
+        )
+        assert ("device.execute", "X") in names
+
+    def test_inbound_traceparent_is_adopted_not_reminted(self, traced_fleet):
+        router, _servers, _rec = traced_fleet
+        base = self._drive_session(router.port, "adopt-1")
+        mine = telemetry.new_trace_id()
+        code, _, _ = _req(
+            router.port,
+            "GET",
+            f"{base}/resources/pods",
+            headers={"traceparent": telemetry.make_traceparent(mine)},
+        )
+        assert code == 200
+        _, entry = _ring_entry(router.port, f"{base}/resources/pods")
+        assert entry["trace"] == mine
+
+    def test_retry_attempts_each_get_a_child_span(self, traced_fleet):
+        """Under a total net_drop storm an idempotent GET burns its
+        full retry budget — every attempt must be its own child span of
+        ONE router request, and the ring must count them."""
+        router, _servers, rec = traced_fleet
+        base = self._drive_session(router.port, "retry-1")
+        faultinject.activate(faultinject.FaultPlane.parse("net_drop:1.0", seed=3))
+        try:
+            code, _, _ = _req(router.port, "GET", f"{base}/resources/pods")
+        finally:
+            faultinject.deactivate()
+        assert code >= 500  # every attempt dropped
+        _, entry = _ring_entry(router.port, f"{base}/resources/pods")
+        assert entry["attempts"] == 1 + router.retries
+        tid = entry["trace"]
+        assert tid
+        attempts = [
+            ev
+            for ev in rec.snapshot()
+            if ev["name"] == "router.attempt"
+            and ev["ph"] == "B"
+            and (ev.get("args") or {}).get("trace") == tid
+        ]
+        assert len(attempts) == 1 + router.retries
+        assert sorted(ev["args"]["attempt"] for ev in attempts) == list(
+            range(1, 2 + router.retries)
+        )
+        # the attempt storm tripped the breaker: the transition is a
+        # point event carrying the same causing trace
+        opens = [
+            ev
+            for ev in rec.snapshot()
+            if ev["name"] == "router.breaker"
+            and ev["args"].get("state") == "open"
+        ]
+        assert opens and opens[-1]["args"]["trace"] == tid
+
+
+class TestMergedExportAndProxies:
+    def test_merged_trace_federates_all_tracks(self, traced_fleet):
+        router, _servers, _rec = traced_fleet
+        assert (
+            _req(router.port, "POST", "/api/v1/sessions", {"id": "mt-1"})[0]
+            == 201
+        )
+        doc = router.merged_trace()
+        other = doc["otherData"]
+        assert other["merged"] is True and other["tracingEnabled"] is True
+        assert [t["pid"] for t in other["tracks"]] == [0, 1, 2]
+        assert {t["name"] for t in other["tracks"]} == {
+            "router",
+            "worker w0",
+            "worker w1",
+        }
+        ivals = telemetry.span_intervals(doc["traceEvents"])
+        assert ivals and all(
+            iv["end_us"] >= iv["start_us"] for iv in ivals
+        )
+        # the shared in-process ring reaches every track, so the edge
+        # span shows up in worker lanes too — pid remapping held
+        assert {iv["pid"] for iv in ivals} <= {0, 1, 2}
+
+    def test_debug_trace_worker_proxy(self, traced_fleet):
+        router, _servers, _rec = traced_fleet
+        raw = _raw(router.port, "/api/v1/debug/trace?worker=w0")
+        doc = json.loads(raw)
+        # a single worker's own export: no merge happened
+        assert "merged" not in doc["otherData"]
+        assert "clockUs" in doc["otherData"]
+        code, err, _ = _req(
+            router.port, "GET", "/api/v1/debug/trace?worker=nope"
+        )
+        assert code == 404 and err["kind"] == "UnknownWorker"
+
+    def test_debug_profile_requires_explicit_worker(self, traced_fleet):
+        router, _servers, _rec = traced_fleet
+        code, err, _ = _req(router.port, "POST", "/api/v1/debug/profile")
+        assert code == 400 and err["kind"] == "MissingWorker"
+        code, err, _ = _req(
+            router.port, "POST", "/api/v1/debug/profile?worker=nope"
+        )
+        assert code == 404 and err["kind"] == "UnknownWorker"
+        # a live target proxies through: the worker answers (no capture
+        # running, so stopping is ITS 409 — not a router 4xx)
+        code, _, _ = _req(
+            router.port,
+            "POST",
+            "/api/v1/debug/profile?worker=w0",
+            {"action": "stop"},
+        )
+        assert code == 409
+
+    def test_request_ring_feeds_latency_histograms_with_exemplars(
+        self, traced_fleet
+    ):
+        router, _servers, _rec = traced_fleet
+        assert (
+            _req(router.port, "POST", "/api/v1/sessions", {"id": "hist-1"})[0]
+            == 201
+        )
+        for _ in range(3):
+            assert (
+                _req(
+                    router.port,
+                    "GET",
+                    "/api/v1/sessions/hist-1/resources/pods",
+                )[0]
+                == 200
+            )
+        text = _raw(
+            router.port, "/api/v1/metrics?format=openmetrics"
+        ).decode()
+        families = parse_prometheus_text(text)
+        fam = families["kss_fleet_request_seconds"]
+        assert fam["type"] == "histogram"
+        splits = {
+            labels["split"]
+            for name, labels, _v in fam["samples"]
+            if name.endswith("_count")
+        }
+        assert splits == {"total", "net", "worker", "router"}
+        # every observed request was traced: bucket exemplars link the
+        # distribution straight back to trace ids
+        assert '# {trace_id="' in text
+        # plain prometheus renders the same family without exemplars
+        plain = _raw(router.port, "/api/v1/metrics?format=prometheus").decode()
+        assert "kss_fleet_request_seconds_bucket" in plain
+        assert "# {" not in plain
+
+
+class TestBatchSpanLinks:
+    def _snapshot(self, i):
+        return {
+            "nodes": [node(f"n{j}", cpu="16") for j in range(3)],
+            "pods": [
+                pod(f"p{j}", cpu=f"{100 + 100 * i + 50 * j}m")
+                for j in range(4)
+            ],
+        }
+
+    def test_one_dispatch_links_every_enrolled_trace(self):
+        """The batch plane executes N tenants' passes as ONE device
+        dispatch — a single span can't carry one trace id, so the
+        `batch.execute` complete carries span LINKS to every enrolled
+        tenant's trace instead."""
+        rec = telemetry.SpanRecorder(capacity=4096)
+        telemetry.activate(rec)
+        mgr = SessionManager(
+            SimulatorService(), max_sessions=8, max_concurrent_passes=8
+        )
+        plane = BatchPlane(
+            window_ms=5000.0,
+            max_sessions=2,
+            metrics=mgr.get("default").service.scheduler.metrics,
+        )
+        mgr.batch_plane = plane
+        mgr.get("default").service.scheduler.batch_plane = plane
+        try:
+            sessions = []
+            for i in range(2):
+                sess, errs = mgr.create(
+                    name=f"link{i}", snapshot=self._snapshot(i)
+                )
+                assert not errs
+                sessions.append(sess)
+            tids = [telemetry.new_trace_id() for _ in range(2)]
+            barrier = threading.Barrier(2)
+            errors = {}
+
+            def run(i):
+                try:
+                    barrier.wait(timeout=30)
+                    with telemetry.trace_context(tids[i]), mgr.pass_slot():
+                        sessions[i].service.scheduler.schedule()
+                except Exception as e:  # noqa: BLE001 — surfaced below
+                    errors[i] = repr(e)
+
+            threads = [
+                threading.Thread(target=run, args=(i,)) for i in range(2)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=180)
+            assert not errors, errors
+        finally:
+            mgr.shutdown()
+        execs = [
+            ev
+            for ev in rec.snapshot()
+            if ev["name"] == "batch.execute" and ev["ph"] == "X"
+        ]
+        assert len(execs) == 1  # the window filled: ONE device dispatch
+        assert execs[0]["args"]["links"] == sorted(tids)
+        assert execs[0]["args"]["fill"] == 2
+
+
+class TestArmedVsOffByteParity:
+    def _drive(self, tmp_path, name, traced):
+        srv = SimulatorServer(
+            SimulatorService(),
+            port=0,
+            session_config={"snapshot_dir": str(tmp_path / name)},
+        ).start()
+        try:
+            headers = (
+                {
+                    "traceparent": telemetry.make_traceparent(
+                        telemetry.new_trace_id()
+                    )
+                }
+                if traced
+                else None
+            )
+            assert (
+                _req(
+                    srv.port,
+                    "POST",
+                    "/api/v1/sessions",
+                    {"id": "parity-t"},
+                    headers=headers,
+                )[0]
+                == 201
+            )
+            base = "/api/v1/sessions/parity-t"
+            for i in range(2):
+                _req(
+                    srv.port,
+                    "PUT",
+                    f"{base}/resources/nodes",
+                    node(f"n{i}", cpu="2", mem="4Gi"),
+                    headers=headers,
+                )
+            for i in range(4):
+                _req(
+                    srv.port,
+                    "PUT",
+                    f"{base}/resources/pods",
+                    pod(f"p{i}", cpu="500m", mem="512Mi"),
+                    headers=headers,
+                )
+            code, out, _ = _req(
+                srv.port, "POST", f"{base}/schedule", headers=headers
+            )
+            assert code == 200 and out["scheduled"] == 4
+            return _raw(srv.port, f"{base}/resources/pods")
+        finally:
+            srv.shutdown()
+
+    def test_placements_and_trace_bytes_identical(self, tmp_path):
+        """The whole plane is observability: with KSS_TRACE=0 it must
+        be a no-op, and arming it must not perturb a single placement
+        or scheduling-trace annotation byte."""
+        telemetry.activate(None)  # tracing explicitly OFF
+        off = self._drive(tmp_path, "off", traced=False)
+        rec = telemetry.SpanRecorder(capacity=16384)
+        telemetry.activate(rec)
+        armed = self._drive(tmp_path, "armed", traced=True)
+        assert rec.emitted > 0  # the armed run really recorded
+        assert off == armed

@@ -25,6 +25,15 @@ consecutive failures: requests shed 503 + Retry-After WITHOUT touching
 a socket. Lifting the storm, the half-open probe closes it and serving
 recovers.
 
+Gate D — end-to-end distributed-trace causality (KSS_TRACE=1 armed for
+router and workers, docs/observability.md). Under seeded net faults, a
+pod is scheduled through the router; the router's merged Perfetto
+export (`GET /api/v1/debug/trace`) must then contain ONE trace id
+shared by the router request span (with >=1 `router.attempt` child),
+the owning worker's pass span, and its `device.execute` span; every
+merged interval must be well-formed (`check_nesting` over the merged
+document), and some retried GET must show a >=2-attempt span tree.
+
 Exit 0 on pass, 1 with the problem list otherwise; one JSON line either
 way.
 """
@@ -44,11 +53,15 @@ sys.path.insert(0, REPO_ROOT)
 
 # the witness wraps locks at creation: arm before the package imports
 os.environ.setdefault("KSS_LOCK_CHECK", "1")
+# gate D's trace plane: the router process records its own span ring
+# and propagates trace context on every proxied hop
+os.environ["KSS_TRACE"] = "1"
 
 from kube_scheduler_simulator_tpu.fleet import FleetRouter  # noqa: E402
 from kube_scheduler_simulator_tpu.lifecycle.checkpoint import (  # noqa: E402
     canonical_bytes,
 )
+from kube_scheduler_simulator_tpu.utils import telemetry  # noqa: E402
 
 
 def _pod(name):
@@ -126,6 +139,8 @@ def main() -> int:
         # resilience knobs sized for a fast smoke
         KSS_FLEET_BREAKER_OPEN_S="0.5",
         KSS_FLEET_RETRY_BACKOFF_S="0.02",
+        # gate D: span rings + trace propagation on every process
+        KSS_TRACE="1",
     )
     env.pop("KSS_WORKER_ID", None)  # the router assigns identities
     env.pop("KSS_SESSION_DIR", None)  # per-worker dirs under fleet_dir
@@ -315,6 +330,162 @@ def main() -> int:
             "breakerOpens": fdoc.get("breakerOpens"),
             "breakers": breakers,
         }
+
+        # ---- Gate D: end-to-end distributed-trace causality ----------------
+        code, doc, _ = _req(port, "POST", "/api/v1/sessions", {"id": "trace-1"})
+        assert code == 201, f"create trace-1: {code} {doc}"
+        base = "/api/v1/sessions/trace-1"
+        code, _, _ = _req(
+            port,
+            "PUT",
+            f"{base}/resources/nodes",
+            {
+                "metadata": {"name": "tn0"},
+                "status": {
+                    "allocatable": {
+                        "cpu": "8", "memory": "16Gi", "pods": "110"
+                    }
+                },
+            },
+        )
+        assert code == 201, f"trace node: {code}"
+        code, _, _ = _req(
+            port, "PUT", f"{base}/resources/pods", _pod("tp0")
+        )
+        assert code == 201, f"trace pod: {code}"
+        # seeded net faults: idempotent GETs retry through the drops
+        # (the >=2-attempt span tree); the schedule POST is single-
+        # attempt per inbound request, retried here at the client
+        code, doc, _ = _req(
+            port,
+            "POST",
+            "/api/v1/fleet/faultinject",
+            {"spec": "net_drop:0.3", "seed": 11},
+        )
+        assert code == 200 and doc.get("active"), "gate D arm failed"
+        scheduled = False
+        for _ in range(25):
+            code, sdoc, _ = _req(port, "POST", f"{base}/schedule", timeout=60)
+            if code == 200 and (sdoc or {}).get("scheduled"):
+                scheduled = True
+                break
+        for _ in range(15):
+            _req(port, "GET", f"{base}/resources/pods", timeout=30)
+        code, doc, _ = _req(
+            port, "POST", "/api/v1/fleet/faultinject", {"spec": ""}
+        )
+        assert code == 200 and not doc.get("active"), "gate D disarm failed"
+        if not scheduled:
+            problems.append("gate D: pod never scheduled through the storm")
+        # the request ring names the schedule request's trace id and
+        # the retried GETs' attempt counts
+        _, ring, _ = _req(port, "GET", "/api/v1/fleet/requests")
+        entries = (ring or {}).get("requests") or []
+        sched = [
+            e
+            for e in entries
+            if e.get("route") == f"{base}/schedule" and e.get("status") == 200
+        ]
+        retried_gets = [
+            e
+            for e in entries
+            if e.get("method") == "GET" and (e.get("attempts") or 0) >= 2
+        ]
+        tid = sched[-1]["trace"] if sched else None
+        if tid is None:
+            problems.append(
+                "gate D: request ring has no traced 200 schedule entry"
+            )
+        if not retried_gets:
+            problems.append(
+                "gate D: no GET retried under the seeded drops "
+                "(no >=2-attempt span tree to check)"
+            )
+        _, merged, _ = _req(port, "GET", "/api/v1/debug/trace")
+        events = (merged or {}).get("traceEvents") or []
+        other = (merged or {}).get("otherData") or {}
+        if not other.get("merged") or not other.get("tracingEnabled"):
+            problems.append(f"gate D: merged export not armed: {other}")
+        if len(other.get("tracks") or []) < 3:
+            problems.append(
+                f"gate D: expected router + >=2 worker tracks, got "
+                f"{other.get('tracks')}"
+            )
+        try:
+            telemetry.check_nesting(
+                events, dropped=int(other.get("droppedEvents") or 0)
+            )
+        except ValueError as e:
+            problems.append(f"gate D: merged intervals malformed: {e}")
+
+        def _with_trace(t):
+            return [
+                ev
+                for ev in events
+                if (ev.get("args") or {}).get("trace") == t
+            ]
+
+        if tid is not None:
+            tev = _with_trace(tid)
+            req_spans = [
+                ev
+                for ev in tev
+                if ev.get("name") == "router.request" and ev.get("ph") == "B"
+            ]
+            attempt_spans = [
+                ev
+                for ev in tev
+                if ev.get("name") == "router.attempt" and ev.get("ph") == "B"
+            ]
+            pass_spans = [
+                ev
+                for ev in tev
+                if str(ev.get("name", "")).startswith("pass.")
+                and ev.get("pid") != 0
+            ]
+            device_spans = [
+                ev
+                for ev in tev
+                if ev.get("name") == "device.execute" and ev.get("pid") != 0
+            ]
+            if not req_spans:
+                problems.append(
+                    "gate D: no router.request span carries the "
+                    "scheduled pod's trace id"
+                )
+            if not attempt_spans:
+                problems.append(
+                    "gate D: the traced request has no router.attempt child"
+                )
+            if not pass_spans:
+                problems.append(
+                    "gate D: no worker pass span carries the trace id "
+                    "(context not adopted at the HTTP chokepoint?)"
+                )
+            if not device_spans:
+                problems.append(
+                    "gate D: no device.execute span carries the trace id"
+                )
+            result["gateD"] = {
+                "trace": tid,
+                "attemptSpans": len(attempt_spans),
+                "passSpans": len(pass_spans),
+                "deviceSpans": len(device_spans),
+                "retriedGets": len(retried_gets),
+                "tracks": other.get("tracks"),
+            }
+        if retried_gets:
+            rtid = retried_gets[-1].get("trace")
+            r_attempts = [
+                ev
+                for ev in _with_trace(rtid)
+                if ev.get("name") == "router.attempt" and ev.get("ph") == "B"
+            ]
+            if len(r_attempts) < 2:
+                problems.append(
+                    f"gate D: retried GET trace {rtid} shows "
+                    f"{len(r_attempts)} attempt span(s), expected >=2"
+                )
     finally:
         router.shutdown(drain=True)
 
